@@ -1,0 +1,12 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities of
+early-2018 PaddlePaddle (reference at /root/reference; see SURVEY.md).
+
+Programming model: build a serializable Program of ops via ``fluid.layers``,
+derive gradients source-to-source with ``fluid.append_backward`` (wrapped by
+``fluid.optimizer.*.minimize``), then ``fluid.Executor`` lowers whole program
+blocks to single jitted XLA computations on TPU.
+"""
+
+from . import fluid  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""Core runtime: IR-adjacent registry, compiling executor, scope, LoD."""

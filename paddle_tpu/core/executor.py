@@ -1,0 +1,325 @@
+"""Executor: lowers a Program block to ONE jitted XLA computation.
+
+The reference Executor interprets a block op-by-op, dispatching a per-op
+CPU/CUDA kernel each step (/root/reference/paddle/fluid/framework/
+executor.cc:96,317-319 — the hot loop) with a Prepare/RunPreparedContext split
+for reuse (executor.cc:271) and a Python-side program cache
+(python/paddle/fluid/executor.py:166,309-377).
+
+TPU-native re-design (SURVEY.md §7 "make the Executor a compiler"): the hot loop
+becomes a *trace* — ops' jax.numpy lowerings run under ``jax.jit``, so the whole
+block (forward + backward + optimizer ops, which live in the same program, see
+reference optimizer.py:224) compiles to a single fused XLA computation per
+(program-version, feed-signature). XLA does the kernel fusion/tiling the
+reference hand-wrote in CUDA. An eager mode (``mode="eager"``) keeps the
+op-at-a-time interpreter semantics for debugging and OpTest parity — the analog
+of the reference's CPU kernel path.
+
+State contract: persistable variables (parameters, optimizer accumulators,
+learning rates) live in a Scope between runs, exactly like the reference's
+global scope (executor.cc:286-315 creates persistables in the global scope and
+temporaries in a dropped local scope). The compiled step function is pure:
+``(state, feeds, rng) -> (new_state, fetches, rng')``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import registry
+from .lod import LoDArray, flat_to_lodarray, pack_sequences
+from .scope import Scope, global_scope
+from .types import np_dtype
+
+_RNG_KEY = "__rng_key__"
+
+
+class Place:
+    pass
+
+
+class CPUPlace(Place):
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace(Place):
+    """The device the reference calls CUDAPlace (platform/place.h) — here a TPU
+    chip addressed through JAX."""
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+
+def _resolve_device(place):
+    if place is None or isinstance(place, TPUPlace):
+        devs = jax.devices()
+        if place is None:
+            return devs[0]
+        return devs[min(getattr(place, "device_id", 0), len(devs) - 1)]
+    if isinstance(place, CPUPlace):
+        return jax.devices("cpu")[0]
+    return place  # already a jax Device
+
+
+class ExecContext:
+    """Per-op view of the environment handed to op lowerings — the analog of
+    the reference's ExecutionContext (framework/operator.h:183)."""
+
+    __slots__ = ("op", "block", "env", "_exec")
+
+    def __init__(self, op, block, env, exec_state):
+        self.op = op
+        self.block = block
+        self.env = env
+        self._exec = exec_state
+
+    # ---- inputs / outputs ----
+    def has_input(self, slot):
+        names = self.op.input(slot)
+        return bool(names) and names[0] in self.env
+
+    def input(self, slot):
+        names = self.op.input(slot)
+        if not names:
+            raise KeyError(f"op {self.op.type}: missing input slot {slot!r}")
+        return self._read(names[0])
+
+    def inputs(self, slot):
+        return [self._read(n) for n in self.op.input(slot)]
+
+    def _read(self, name):
+        if name not in self.env:
+            raise KeyError(
+                f"op {self.op.type}: variable {name!r} used before definition")
+        return self.env[name]
+
+    def set_output(self, slot, value):
+        names = self.op.output(slot)
+        if names:
+            self.env[names[0]] = value
+
+    def set_outputs(self, slot, values):
+        for n, v in zip(self.op.output(slot), values):
+            self.env[n] = v
+
+    # ---- attrs ----
+    def attr(self, name, default=None):
+        return self.op.attrs.get(name, default)
+
+    # ---- var metadata ----
+    def var(self, name):
+        return self.block.var(name)
+
+    def out_dtype(self, slot="Out"):
+        """Declared numpy dtype of the (first) output var, when annotated."""
+        names = self.op.output(slot)
+        if names and self.block.has_var(names[0]):
+            d = self.block.var(names[0]).dtype
+            if d is not None:
+                return np_dtype(d)
+        return None
+
+    # ---- rng ----
+    def next_rng(self):
+        key, sub = jax.random.split(self.env[_RNG_KEY])
+        self.env[_RNG_KEY] = key
+        return sub
+
+    # ---- control flow: run a sub-block over the current env ----
+    def run_sub_block(self, block_idx):
+        sub = self.block.program.blocks[block_idx]
+        _run_ops(sub, self.env, self._exec)
+
+    def sub_block(self, attr_name="sub_block"):
+        return self.block.program.blocks[self.attr(attr_name)]
+
+
+def _run_ops(block, env, exec_state):
+    """Run/trace every op of a block over ``env`` in order. This is both the
+    eager interpreter and the function traced by jit."""
+    for op in block.ops:
+        info = registry.get_op_info(op.type)
+        ctx = ExecContext(op, block, env, exec_state)
+        info.forward(ctx)
+
+
+def _collect_free_inputs(program, block_idx):
+    """Names a block (and its sub-blocks) reads before writing — the state +
+    feed surface of the compiled function. Mirrors what the reference resolves
+    dynamically through Scope parent lookup (executor.cc:286-315)."""
+    free: list[str] = []
+    seen = set()
+
+    def walk(bidx, defined):
+        block = program.blocks[bidx]
+        defined = set(defined)
+        for op in block.ops:
+            for name in op.input_arg_names():
+                if name not in defined and name not in seen:
+                    seen.add(name)
+                    free.append(name)
+            for attr in ("sub_block", "sub_block_false"):
+                if op.has_attr(attr):
+                    walk(op.attr(attr), defined)
+            for name in op.output_arg_names():
+                defined.add(name)
+
+    walk(block_idx, set())
+    return free
+
+
+def _written_names(program, block_idx):
+    out = []
+    seen = set()
+
+    def walk(bidx):
+        block = program.blocks[bidx]
+        for op in block.ops:
+            for name in op.output_arg_names():
+                if name not in seen:
+                    seen.add(name)
+                    out.append(name)
+            for attr in ("sub_block", "sub_block_false"):
+                if op.has_attr(attr):
+                    walk(op.attr(attr))
+
+    walk(block_idx)
+    return out
+
+
+def _is_traceable(v):
+    return isinstance(v, (jax.Array, np.ndarray, LoDArray, int, float, np.number))
+
+
+class Executor:
+    """User-facing executor (reference python/paddle/fluid/executor.py Executor).
+
+    mode="jit"   : compile the block to one XLA computation (TPU path)
+    mode="eager" : op-at-a-time interpreter (debug / OpTest path)
+    """
+
+    def __init__(self, place=None, mode="jit", donate=False):
+        self.place = place
+        self.device = _resolve_device(place)
+        self.mode = mode
+        self.donate = donate
+        self._cache = {}
+
+    # ------------------------------------------------------------------
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_program_cache=True):
+        from ..fluid.framework import default_main_program
+
+        program = program or default_main_program()
+        feed = dict(feed or {})
+        fetch_list = list(fetch_list or [])
+        scope = scope or global_scope()
+        fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
+
+        block = program.global_block()
+        feed_vals = self._prepare_feed(block, feed)
+
+        if scope.find_var(_RNG_KEY) is None:
+            scope.set(_RNG_KEY, jax.random.PRNGKey(program.random_seed or 0))
+
+        free = _collect_free_inputs(program, 0)
+        state_in = [n for n in free if n not in feed_vals and scope.has_var(n)]
+        missing = [n for n in free if n not in feed_vals and not scope.has_var(n)
+                   and not block.has_var(n)]
+        # names that are block vars but have no runtime value anywhere: the ops
+        # that produce them (e.g. fill ops) must come first; if an op truly
+        # reads them first, _run_ops raises a clean error.
+        written = _written_names(program, 0)
+        state_out = [n for n in written
+                     if (block.has_var(n) and block.var(n).persistable)
+                     or scope.has_var(n)]
+        del missing
+
+        state = {n: scope.find_var(n) for n in state_in}
+        state[_RNG_KEY] = scope.find_var(_RNG_KEY)
+
+        if self.mode == "eager" or not use_program_cache:
+            env = dict(state)
+            env.update(feed_vals)
+            _run_ops(block, env, self)
+            new_state = {n: env[n] for n in state_out if n in env}
+            new_state[_RNG_KEY] = env[_RNG_KEY]
+            fetches = [env[n] for n in fetch_names]
+        else:
+            fn = self._compiled(program, tuple(sorted(feed_vals)),
+                                tuple(fetch_names), tuple(state_in),
+                                tuple(state_out))
+            # non-traceable state (readers, rank tables) can't cross jit
+            trace_state = {k: v for k, v in state.items() if _is_traceable(v)}
+            with jax.default_device(self.device):
+                new_state, fetches = fn(trace_state, feed_vals)
+
+        for n, v in new_state.items():
+            scope.set(n, v)
+        return [self._fetch_value(v, return_numpy) for v in fetches]
+
+    # ------------------------------------------------------------------
+    def _compiled(self, program, feed_names, fetch_names, state_in, state_out):
+        key = (id(program), program._version, feed_names, fetch_names,
+               state_in, state_out, self.donate)
+        fn = self._cache.get(key)
+        if fn is not None:
+            return fn
+
+        block = program.global_block()
+
+        def step(state, feeds):
+            env = dict(state)
+            env.update(feeds)
+            _run_ops(block, env, self)
+            new_state = {n: env[n] for n in state_out if n in env}
+            new_state[_RNG_KEY] = env[_RNG_KEY]
+            fetches = [env[n] for n in fetch_names]
+            return new_state, fetches
+
+        donate = (0,) if self.donate else ()
+        fn = jax.jit(step, donate_argnums=donate)
+        self._cache[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    def _prepare_feed(self, block, feed):
+        out = {}
+        for name, value in feed.items():
+            if isinstance(value, LoDArray):
+                out[name] = value
+                continue
+            if isinstance(value, tuple) and len(value) == 2 and not np.isscalar(value[0]):
+                # reference feed form: (flat ndarray, lod offsets)
+                out[name] = flat_to_lodarray(value[0], value[1])
+                continue
+            if isinstance(value, list) and value and isinstance(
+                    value[0], (np.ndarray, list)):
+                v = block.var(name) if block.has_var(name) else None
+                if v is not None and v.lod_level > 0:
+                    out[name] = pack_sequences([np.asarray(s) for s in value])
+                    continue
+            arr = np.asarray(value)
+            if block.has_var(name):
+                v = block.var(name)
+                if v.dtype is not None and arr.dtype != np_dtype(v.dtype):
+                    arr = arr.astype(np_dtype(v.dtype))
+            out[name] = jnp.asarray(arr)
+        return out
+
+    @staticmethod
+    def _fetch_value(v, return_numpy):
+        if isinstance(v, LoDArray):
+            return v  # caller unpacks via core.lod.lodarray_to_flat
+        if return_numpy:
+            return np.asarray(v)
+        return v
+
+
+__all__ = ["Executor", "CPUPlace", "TPUPlace", "Scope", "global_scope"]

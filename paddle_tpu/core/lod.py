@@ -1,0 +1,127 @@
+"""LoD (Level-of-Detail) ragged-sequence support.
+
+The reference's signature data structure is the LoDTensor: a dense tensor of
+concatenated variable-length sequences plus nested offset tables
+(/root/reference/paddle/fluid/framework/lod_tensor.h:55-107). Every sequence op
+propagates those offsets, and RNNs run directly on the ragged layout via
+sequence2batch reordering (/root/reference/paddle/fluid/operators/math/
+sequence2batch.h) and ragged<->padded converters
+(operators/math/sequence_padding.h:64-71).
+
+TPU-native re-design: XLA wants static shapes, so on device a level-1 LoD tensor
+is a ``LoDArray``: padded dense data of shape [batch, max_len, ...] plus an
+int32 ``lens`` vector of true lengths. ``lens`` lives on device (it is data, so
+changing lengths never recompiles); max_len is static (bucketed padding at the
+feed boundary keeps recompiles bounded). Sequence ops mask with
+``mask = iota(max_len) < lens[:, None]`` instead of walking offsets — that is
+the ragged->padded packing the reference performs in sequence_padding.h promoted
+to the XLA boundary, exactly as SURVEY.md §5 prescribes.
+
+Host-side conversion helpers keep API parity with the reference's
+``create_lod_tensor`` (python/paddle/fluid/lod_tensor.py) recursive-seq-lens
+interface.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class LoDArray:
+    """Padded device representation of a level-1 LoD tensor.
+
+    data: [batch, max_len, *feature] padded with zeros past each row's length
+    lens: [batch] int32 true sequence lengths
+    """
+
+    __slots__ = ("data", "lens")
+
+    def __init__(self, data, lens):
+        self.data = data
+        self.lens = lens
+
+    # pytree protocol: traces through jit/grad/scan transparently
+    def tree_flatten(self):
+        return (self.data, self.lens), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def batch(self):
+        return self.data.shape[0]
+
+    @property
+    def max_len(self):
+        return self.data.shape[1]
+
+    def mask(self, dtype=jnp.float32):
+        """[batch, max_len] 1/0 validity mask."""
+        return (jnp.arange(self.data.shape[1])[None, :]
+                < self.lens[:, None]).astype(dtype)
+
+    def __repr__(self):
+        return f"LoDArray(data={getattr(self.data, 'shape', None)}, lens={self.lens})"
+
+
+def pack_sequences(seqs, dtype=None, max_len=None, pad_multiple=1):
+    """List of [len_i, *feature] numpy arrays -> host LoDArray (padded + lens).
+
+    ``pad_multiple`` buckets max_len up to a multiple to bound the number of
+    distinct compiled shapes (the bucketed-padding policy from SURVEY.md §5).
+    """
+    lens = np.array([len(s) for s in seqs], dtype=np.int32)
+    ml = int(max_len if max_len is not None else (lens.max() if len(lens) else 0))
+    if pad_multiple > 1:
+        ml = ((ml + pad_multiple - 1) // pad_multiple) * pad_multiple
+    ml = max(ml, 1)
+    first = np.asarray(seqs[0])
+    feat = first.shape[1:]
+    dt = dtype or first.dtype
+    out = np.zeros((len(seqs), ml) + tuple(feat), dtype=dt)
+    for i, s in enumerate(seqs):
+        s = np.asarray(s, dtype=dt)
+        out[i, : len(s)] = s
+    return LoDArray(out, lens)
+
+
+def lod_from_lens(lens) -> list:
+    """lengths -> reference-style level-1 offset table [[0, l0, l0+l1, ...]]."""
+    offs = np.concatenate([[0], np.cumsum(np.asarray(lens))]).astype(np.int64)
+    return [offs.tolist()]
+
+
+def lens_from_lod(lod) -> np.ndarray:
+    offs = np.asarray(lod[0] if isinstance(lod[0], (list, tuple, np.ndarray)) else lod)
+    return np.diff(offs).astype(np.int32)
+
+
+def flat_to_lodarray(flat, lod, pad_multiple=1):
+    """Reference feed form (concatenated [sum_len, *feat] array, offset lod) ->
+    padded LoDArray. This is the feed-boundary packer."""
+    lens = lens_from_lod(lod)
+    flat = np.asarray(flat)
+    seqs, start = [], 0
+    for ln in lens:
+        seqs.append(flat[start:start + int(ln)])
+        start += int(ln)
+    return pack_sequences(seqs, dtype=flat.dtype, pad_multiple=pad_multiple)
+
+
+def lodarray_to_flat(arr: LoDArray):
+    """Padded LoDArray -> (concatenated numpy array, offset lod): the fetch-
+    boundary unpacker, restoring the reference's LoDTensor wire form."""
+    data = np.asarray(arr.data)
+    lens = np.asarray(arr.lens)
+    parts = [data[i, : int(lens[i])] for i in range(len(lens))]
+    flat = np.concatenate(parts, axis=0) if parts else np.zeros((0,) + data.shape[2:],
+                                                               data.dtype)
+    return flat, lod_from_lens(lens)
+
+
+def sequence_mask(lens, max_len, dtype=jnp.float32):
+    return (jnp.arange(max_len)[None, :] < lens[:, None]).astype(dtype)

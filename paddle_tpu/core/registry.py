@@ -1,0 +1,114 @@
+"""Operator registry: per-op-type lowering, shape inference, grad maker.
+
+Plays the role of the reference's static op registry —
+``REGISTER_OPERATOR`` / ``OpInfoMap`` / ``GradOpDescMakerBase``
+(/root/reference/paddle/fluid/framework/op_registry.h:127,
+ op_info.h, grad_op_desc_maker.h:33) — with a TPU-native twist:
+
+* Instead of per-device kernel maps keyed by (place, dtype, layout, library)
+  (/root/reference/paddle/fluid/framework/op_kernel_type.h:43-72), every op has
+  ONE ``forward`` implementation written in jax.numpy. Run eagerly on CPU it is
+  the interpreter/debug path (the reference's CPU kernel); traced under jit it
+  becomes part of a single fused XLA computation for TPU (replacing the
+  hand-written CUDA kernels). Pallas kernels slot in transparently as the
+  forward of hot ops.
+* Grad makers are Python functions producing grad OpSpecs, exactly the
+  contract of the reference's GradOpDescMaker consumed by
+  python/paddle/fluid/backward.py:425 (append_backward).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+
+@dataclasses.dataclass
+class OpSpec:
+    """A to-be-appended op description returned by grad makers."""
+    type: str
+    inputs: dict
+    outputs: dict
+    attrs: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class OpInfo:
+    type: str
+    # forward(ctx) -> None; reads ctx.input/attr, writes ctx.set_output
+    forward: Callable
+    # infer_shape(op, block) -> None; create/annotate output vars at build time
+    infer_shape: Optional[Callable] = None
+    # grad(op, block) -> list[OpSpec]; None means "no gradient" (like ops
+    # registered without a grad maker in the reference)
+    grad: Optional[Callable] = None
+    # variadic-input ops (sum, concat) and control-flow ops set flags here
+    is_control_flow: bool = False
+    # ops whose outputs alias an input in-place in the reference (optimizer ops
+    # write ParamOut == Param). The functional lowering just rebinds the name.
+    in_place: bool = False
+
+
+_REGISTRY: dict[str, OpInfo] = {}
+
+
+def register_op(type, *, infer_shape=None, grad=None, is_control_flow=False,
+                in_place=False):
+    """Decorator registering ``forward`` for an op type.
+
+    Usage::
+
+        @register_op("relu", infer_shape=same_shape("X", "Out"), grad=relu_grad)
+        def relu(ctx):
+            ctx.set_output("Out", jnp.maximum(ctx.input("X"), 0))
+    """
+    def deco(fn):
+        if type in _REGISTRY:
+            raise KeyError(f"op {type!r} registered twice")
+        _REGISTRY[type] = OpInfo(type=type, forward=fn, infer_shape=infer_shape,
+                                 grad=grad, is_control_flow=is_control_flow,
+                                 in_place=in_place)
+        return fn
+    return deco
+
+
+def get_op_info(type) -> OpInfo:
+    info = _REGISTRY.get(type)
+    if info is None:
+        raise KeyError(f"op {type!r} is not registered "
+                       f"({len(_REGISTRY)} ops available)")
+    return info
+
+
+def has_op(type) -> bool:
+    return type in _REGISTRY
+
+
+def registered_ops():
+    return sorted(_REGISTRY)
+
+
+# ---- common infer_shape helpers ----
+
+def same_shape(src_slot="X", dst_slot="Out"):
+    """Output takes the shape/dtype/lod of the (first) input — the most common
+    rule (every activation/elementwise-unary op in the reference)."""
+    def infer(op, block):
+        x = block.var(op.input(src_slot)[0])
+        for name in op.output(dst_slot):
+            out = block.var(name)
+            out.shape = x.shape
+            if out.dtype is None:
+                out.dtype = x.dtype
+            out.lod_level = x.lod_level
+    return infer
+
+
+def infer_output(op, block, slot, shape, dtype=None, lod_level=None):
+    for name in op.output(slot):
+        v = block.var(name)
+        v.shape = tuple(int(s) for s in shape)
+        if dtype is not None:
+            v.dtype = dtype
+        if lod_level is not None:
+            v.lod_level = lod_level

@@ -1,0 +1,66 @@
+"""Scope: hierarchical name -> runtime value maps.
+
+Reference: /root/reference/paddle/fluid/framework/scope.h:38 (Scope with parent
+lookup) and variable.h (type-erased Variable). Here a runtime value is a JAX
+array, a ``LoDArray`` (core/lod.py), a Python object (reader state, rank tables)
+or None. The global scope holds persistable parameters/optimizer state between
+``Executor.run`` calls exactly like the reference's global scope
+(python/paddle/fluid/executor.py:27 global_scope).
+"""
+
+from __future__ import annotations
+
+
+class Scope:
+    def __init__(self, parent: "Scope | None" = None):
+        self._vars: dict[str, object] = {}
+        self.parent = parent
+        self._kids: list[Scope] = []
+
+    def new_scope(self) -> "Scope":
+        s = Scope(self)
+        self._kids.append(s)
+        return s
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def find_var(self, name):
+        """Lookup with parent recursion (reference scope.h FindVar). Returns
+        None when absent."""
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s.parent
+        return False
+
+    def local_names(self):
+        return list(self._vars)
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def drop_kids(self):
+        self._kids.clear()
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def reset_global_scope():
+    global _global_scope
+    _global_scope = Scope()
+    return _global_scope

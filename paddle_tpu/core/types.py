@@ -1,0 +1,75 @@
+"""Variable/data types for the paddle_tpu IR.
+
+Mirrors the capability of the reference's ``VarType`` proto
+(/root/reference/paddle/fluid/framework/framework.proto:94-161), which defines 18
+variable kinds (LOD_TENSOR, SELECTED_ROWS, FEED_MINIBATCH, FETCH_LIST, STEP_SCOPES,
+LOD_RANK_TABLE, LOD_TENSOR_ARRAY, READER, CHANNEL, RAW ...) and tensor dtypes.
+
+TPU-native re-design: dtypes are plain numpy/JAX dtypes (bfloat16 is first-class —
+it is the MXU-native matmul type), and the ragged LOD_TENSOR is represented on
+device as padded dense data + a per-sequence length vector (see core/lod.py)
+rather than the reference's flattened offset representation
+(/root/reference/paddle/fluid/framework/lod_tensor.h:55-107).
+"""
+
+import enum
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax
+    import ml_dtypes
+
+    bfloat16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    bfloat16 = np.dtype("float32")
+
+
+class VarType(enum.Enum):
+    """Kinds of variables a block may hold.
+
+    Reference: framework.proto:94-161 VarType.Type enum.
+    """
+
+    LOD_TENSOR = "lod_tensor"
+    SELECTED_ROWS = "selected_rows"          # sparse rows (framework/selected_rows.h:19)
+    FEED_MINIBATCH = "feed_minibatch"
+    FETCH_LIST = "fetch_list"
+    STEP_SCOPES = "step_scopes"              # recurrent_op step scopes
+    LOD_RANK_TABLE = "lod_rank_table"        # framework/lod_rank_table.h
+    LOD_TENSOR_ARRAY = "lod_tensor_array"    # framework/lod_tensor_array.h
+    READER = "reader"                        # framework/reader.h:28
+    RAW = "raw"
+
+
+_DTYPE_ALIASES = {
+    "float32": np.dtype("float32"),
+    "float64": np.dtype("float64"),
+    "float16": np.dtype("float16"),
+    "bfloat16": bfloat16,
+    "int8": np.dtype("int8"),
+    "uint8": np.dtype("uint8"),
+    "int16": np.dtype("int16"),
+    "int32": np.dtype("int32"),
+    "int64": np.dtype("int64"),
+    "bool": np.dtype("bool"),
+}
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (str / np.dtype / jax dtype) to a canonical string."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _DTYPE_ALIASES:
+            raise ValueError(f"unsupported dtype {dtype!r}")
+        return dtype
+    d = np.dtype(dtype)
+    for name, nd in _DTYPE_ALIASES.items():
+        if d == nd:
+            return name
+    raise ValueError(f"unsupported dtype {dtype!r}")
+
+
+def np_dtype(dtype):
+    """Canonical string or spec -> numpy dtype object."""
+    return _DTYPE_ALIASES[convert_dtype(dtype)]

@@ -1,0 +1,37 @@
+"""paddle_tpu.fluid — the primary user API, mirroring the reference's
+``paddle.fluid`` surface (/root/reference/python/paddle/fluid/__init__.py):
+layers, Program/program_guard, Executor, optimizer, initializer, io,
+backward, regularizer, ParamAttr, places, Scope.
+"""
+
+from .framework import (Program, Block, Operator, Variable, Parameter,
+                        program_guard, default_main_program,
+                        default_startup_program, switch_main_program,
+                        switch_startup_program, grad_var_name, unique_name)
+from ..core.executor import Executor, CPUPlace, TPUPlace
+from ..core.scope import Scope, global_scope
+from ..core.lod import LoDArray, pack_sequences, flat_to_lodarray, \
+    lodarray_to_flat
+from .. import ops as _ops  # registers all op lowerings
+
+from . import layers
+from . import optimizer
+from . import initializer
+from . import regularizer
+from . import backward
+from . import io
+from .backward import append_backward
+from .param_attr import ParamAttr
+from .data_feeder import DataFeeder
+
+# CUDAPlace alias: reference scripts say CUDAPlace(0); on this framework that
+# means "the accelerator", i.e. the TPU chip.
+CUDAPlace = TPUPlace
+
+__all__ = [
+    "Program", "Block", "Operator", "Variable", "Parameter", "program_guard",
+    "default_main_program", "default_startup_program", "Executor", "CPUPlace",
+    "TPUPlace", "CUDAPlace", "Scope", "global_scope", "layers", "optimizer",
+    "initializer", "regularizer", "backward", "io", "append_backward",
+    "ParamAttr", "DataFeeder", "LoDArray",
+]

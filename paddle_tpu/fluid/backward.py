@@ -1,0 +1,146 @@
+"""IR-level reverse-mode autodiff: ``append_backward``.
+
+Reference: /root/reference/python/paddle/fluid/backward.py:425
+(append_backward) — walk the block's ops in reverse from the loss, ask each
+op's grad maker for grad op descs (the C++ GradOpDescMaker contract,
+core.get_grad_op_desc there; core/registry.py OpInfo.grad here), de-duplicate
+repeated output grads by summation (_addup_repetitive_outputs_ backward.py:117),
+and prune branches that don't reach the loss (backward.py:167).
+
+The produced grad ops live in the SAME program block, so under the compiling
+Executor forward+backward fuse into one XLA computation. Unreachable grads
+(e.g. toward stop_gradient data vars) are appended but dead-code-eliminated by
+XLA, mirroring how the reference relies on no-grad pruning.
+"""
+
+from __future__ import annotations
+
+import collections
+
+from .framework import Program, Variable, Parameter, grad_var_name, unique_name
+from ..core import registry
+
+
+def _op_path(block, loss_name, start_idx=None):
+    """Indices of ops that contribute to ``loss_name`` (relevance pruning,
+    reference backward.py _op_path / no-grad pruning)."""
+    needed = {loss_name}
+    path = []
+    ops = block.ops if start_idx is None else block.ops[:start_idx]
+    for i in reversed(range(len(ops))):
+        op = ops[i]
+        if any(o in needed for o in op.output_arg_names()):
+            path.append(i)
+            needed.update(op.input_arg_names())
+    return set(path), needed
+
+
+def _create_grad_var(block, fwd_name, grad_name):
+    if block.has_var_local(grad_name):
+        return block.vars[grad_name]
+    if block.has_var(fwd_name):
+        fv = block.var(fwd_name)
+        return block.create_var(name=grad_name, shape=fv.shape, dtype=fv.dtype,
+                                lod_level=fv.lod_level)
+    return block.create_var(name=grad_name)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Append grad ops for ``loss`` to its program; returns [(param, grad_var)].
+
+    Matches the reference signature (backward.py:425). ``loss`` must be a
+    scalar (shape () or (1,)) variable in the root block.
+    """
+    assert isinstance(loss, Variable)
+    block = loss.block
+    program = block.program
+    no_grad = set(no_grad_set or ())
+
+    # d(loss)/d(loss) = 1
+    loss_grad = grad_var_name(loss.name)
+    _create_grad_var(block, loss.name, loss_grad)
+    block.append_op(
+        "fill_constant",
+        outputs={"Out": [loss_grad]},
+        attrs={"shape": list(loss.shape or ()), "value": 1.0,
+               "dtype": loss.dtype or "float32"})
+
+    path, needed = _op_path(block, loss.name, start_idx=len(block.ops) - 1)
+
+    # which forward vars should receive gradients
+    stop = {name for name, v in block.vars.items() if v.stop_gradient}
+    stop |= no_grad
+
+    produced = {loss_grad}  # grad names already written by appended grad ops
+
+    for i in reversed(sorted(path)):
+        op = block.ops[i]
+        info = registry.get_op_info(op.type)
+        if info.grad is None:
+            continue
+        # skip if none of this op's outputs have a live upstream gradient
+        out_grads = [grad_var_name(n) for n in op.output_arg_names()]
+        if not any(g in produced for g in out_grads):
+            continue
+        # outputs whose grad was never produced (unused forward outputs, e.g.
+        # softmax_with_cross_entropy's Softmax when only Loss is used): feed
+        # zeros, mirroring the reference's fill_zeros_like insertion
+        # (backward.py _append_backward_ops_).
+        for slot, names in op.outputs.items():
+            for n in names:
+                g = grad_var_name(n)
+                if g not in produced:
+                    _create_grad_var(block, n, g)
+                    block.append_op("fill_zeros_like",
+                                    inputs={"X": [n]}, outputs={"Out": [g]})
+                    produced.add(g)
+
+        for spec in info.grad(op):
+            # rename-and-sum for repeated gradients (backward.py:117)
+            renames = {}
+            for slot, names in spec.outputs.items():
+                new_names = []
+                for n in names:
+                    fwd = n[: -len("@GRAD")] if n.endswith("@GRAD") else n
+                    if fwd in stop and not _is_param(block, fwd):
+                        # still produce it (XLA DCEs it); cheaper than
+                        # rewriting the grad op's outputs
+                        pass
+                    if n in produced:
+                        tmp = unique_name(n + "@RENAME")
+                        _create_grad_var(block, fwd, tmp)
+                        renames[n] = tmp
+                        new_names.append(tmp)
+                    else:
+                        _create_grad_var(block, fwd, n)
+                        new_names.append(n)
+                spec.outputs[slot] = new_names
+            block.append_op(spec.type, spec.inputs, spec.outputs, spec.attrs)
+            for slot, names in spec.outputs.items():
+                for n in names:
+                    produced.add(n)
+            # accumulate renamed grads into the canonical name
+            for canonical, tmp in renames.items():
+                block.append_op("sum", inputs={"X": [canonical, tmp]},
+                                outputs={"Out": [canonical]})
+
+    # collect (param, grad) pairs
+    if parameter_list is not None:
+        params = [block.var(p) if isinstance(p, str) else p
+                  for p in parameter_list]
+    else:
+        params = [p for p in program.global_block().all_parameters()
+                  if p.trainable]
+    result = []
+    for p in params:
+        g = grad_var_name(p.name)
+        if g in produced:
+            result.append((p, block.var(g)))
+    return result
+
+
+def _is_param(block, name):
+    try:
+        return isinstance(block.var(name), Parameter)
+    except KeyError:
+        return False

@@ -1,0 +1,41 @@
+"""DataFeeder — convert python/numpy minibatch rows into feed dicts.
+
+Reference: /root/reference/python/paddle/fluid/data_feeder.py:69 (DataFeeder
+converts a list of rows into LoDTensors, ragged fields becoming LoD). Here
+ragged fields become padded LoDArrays at the feed boundary (core/lod.py),
+with bucketed padding to bound XLA recompiles.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.lod import pack_sequences
+from ..core.types import np_dtype
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None, pad_multiple=8):
+        self.feed_vars = feed_list
+        self.place = place
+        self.pad_multiple = pad_multiple
+
+    def feed(self, minibatch):
+        """minibatch: list of rows; each row is a tuple aligned with feed_list."""
+        feed = {}
+        for i, var in enumerate(self.feed_vars):
+            column = [row[i] for row in minibatch]
+            dtype = np_dtype(var.dtype)
+            if var.lod_level > 0:
+                seqs = [np.asarray(c, dtype=dtype) for c in column]
+                if seqs and seqs[0].ndim == 1:
+                    seqs = [s[:, None] for s in seqs]
+                feed[var.name] = pack_sequences(seqs, dtype=dtype,
+                                                pad_multiple=self.pad_multiple)
+            else:
+                arr = np.asarray(column, dtype=dtype)
+                want = [s for s in (var.shape or ()) if s != -1]
+                if want and list(arr.shape[1:]) != want:
+                    arr = arr.reshape([arr.shape[0]] + want)
+                feed[var.name] = arr
+        return feed

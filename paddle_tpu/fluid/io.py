@@ -1,0 +1,119 @@
+"""Persistence: save/load parameters and inference-model export/load.
+
+Reference: /root/reference/python/paddle/fluid/io.py — save_vars/save_params/
+save_persistables (:66-230), load equivalents (:234+), and
+save_inference_model/load_inference_model (:298-362) which prune the program
+to feed/fetch targets and write a ``__model__`` serialized ProgramDesc next to
+per-variable files (via save/load *ops* in tiny programs, save_op.cc/load_op.cc).
+
+TPU-native: variables are numpy ``.npy``-style archives written from the
+Scope; the ``__model__`` file is the Program's stable JSON form. Orbax-style
+sharded checkpointing arrives with the distributed milestone; this format is
+the single-host contract the tests pin down.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from .framework import Program, Parameter, default_main_program
+from ..core.scope import global_scope
+from ..core.lod import LoDArray
+
+MODEL_FILENAME = "__model__"
+
+
+def _is_persistable(var):
+    return var.persistable and not var.is_data
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None):
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in program.global_block().vars.values()
+                if (predicate or _is_persistable)(v)]
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    for v in vars:
+        val = scope.find_var(v.name)
+        if val is None:
+            continue
+        np.save(os.path.join(dirname, v.name + ".npy"), np.asarray(val))
+
+
+def save_params(executor, dirname, main_program=None):
+    program = main_program or default_main_program()
+    save_vars(executor, dirname, program,
+              vars=[p for p in program.all_parameters()])
+
+
+def save_persistables(executor, dirname, main_program=None):
+    save_vars(executor, dirname, main_program)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None):
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in program.global_block().vars.values()
+                if (predicate or _is_persistable)(v)]
+    scope = global_scope()
+    for v in vars:
+        path = os.path.join(dirname, v.name + ".npy")
+        if os.path.exists(path):
+            scope.set(v.name, np.load(path))
+
+
+def load_params(executor, dirname, main_program=None):
+    program = main_program or default_main_program()
+    load_vars(executor, dirname, program,
+              vars=[p for p in program.all_parameters()])
+
+
+def load_persistables(executor, dirname, main_program=None):
+    load_vars(executor, dirname, main_program)
+
+
+def _prune_program(program, feed_names, fetch_names):
+    """Keep only ops needed to compute fetches from feeds (reference
+    framework/prune.cc via Program.prune, io.py:298-340)."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = set(fetch_names)
+    keep = []
+    for i in reversed(range(len(block.ops))):
+        op = block.ops[i]
+        if any(o in needed for o in op.output_arg_names()):
+            keep.append(i)
+            needed.update(op.input_arg_names())
+    keep = set(keep)
+    block.ops = [op for i, op in enumerate(block.ops) if i in keep]
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None):
+    program = main_program or default_main_program()
+    fetch_names = [v if isinstance(v, str) else v.name for v in target_vars]
+    pruned = _prune_program(program, feeded_var_names, fetch_names)
+    os.makedirs(dirname, exist_ok=True)
+    meta = pruned.to_dict()
+    meta["feed_var_names"] = list(feeded_var_names)
+    meta["fetch_var_names"] = fetch_names
+    with open(os.path.join(dirname, MODEL_FILENAME), "w") as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, pruned)
+    return fetch_names
+
+
+def load_inference_model(dirname, executor):
+    with open(os.path.join(dirname, MODEL_FILENAME)) as f:
+        meta = json.load(f)
+    program = Program.from_dict(meta)
+    load_persistables(executor, dirname, program)
+    feed_names = meta["feed_var_names"]
+    fetch_vars = [program.global_block().var(n)
+                  for n in meta["fetch_var_names"]]
+    return program, feed_names, fetch_vars

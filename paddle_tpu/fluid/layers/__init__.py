@@ -1,0 +1,20 @@
+"""fluid.layers — the user-facing layer namespace.
+
+Reference: /root/reference/python/paddle/fluid/layers/__init__.py aggregates
+nn, io, tensor, control_flow, ops, device, detection, metric modules into one
+flat namespace.
+"""
+
+from . import nn, tensor, io, ops
+from .nn import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .io import data  # noqa: F401
+from .ops import *  # noqa: F401,F403
+
+from .nn import (fc, embedding, dropout, softmax, cross_entropy,  # noqa: F401
+                 softmax_with_cross_entropy, square_error_cost, mean,
+                 accuracy, topk, mul, matmul, elementwise_add,
+                 elementwise_sub, elementwise_mul, elementwise_div)
+from .tensor import (cast, concat, sums, assign, fill_constant,  # noqa: F401
+                     fill_constant_batch_size_like, ones, zeros, reshape,
+                     transpose, split, argmax, create_tensor)

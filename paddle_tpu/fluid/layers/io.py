@@ -1,0 +1,21 @@
+"""IO layer functions — the data layer.
+
+Reference: /root/reference/python/paddle/fluid/layers/io.py (data :25 —
+creates a feed var with -1 batch dim and stop_gradient).
+"""
+
+from __future__ import annotations
+
+from ..framework import default_main_program, default_startup_program
+from ...core.types import VarType
+
+
+def data(name, shape, dtype="float32", lod_level=0, type=VarType.LOD_TENSOR,
+         append_batch_size=True, stop_gradient=True):
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = default_main_program().global_block()
+    return block.create_var(name=name, shape=shape, dtype=dtype,
+                            lod_level=lod_level, stop_gradient=stop_gradient,
+                            type=type, is_data=True)

@@ -1,0 +1,285 @@
+"""Neural-network layer functions.
+
+Reference: /root/reference/python/paddle/fluid/layers/nn.py (~80 layer
+functions, each appending ops via LayerHelper.append_op — layer_helper.py:44).
+This module follows the same calling conventions (input, size, act, param_attr,
+bias_attr, ...) so reference model scripts port line-for-line, but the appended
+ops lower to fused XLA rather than per-kernel dispatch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import Variable, unique_name
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+from ..initializer import Constant, Normal, Xavier
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """Fully connected layer (reference nn.py fc): mul per input + sum +
+    bias + activation. MXU path: each mul is one big jnp.dot."""
+    helper = LayerHelper("fc", name=name, act=act, bias_attr=bias_attr)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    mul_results = []
+    for inp in inputs:
+        in_shape = inp.shape
+        flat_dim = int(np.prod(in_shape[num_flatten_dims:]))
+        w = helper.create_parameter(param_attr, shape=(flat_dim, size),
+                                    dtype=inp.dtype)
+        out = helper.create_tmp_variable(
+            inp.dtype, shape=tuple(in_shape[:num_flatten_dims]) + (size,),
+            lod_level=inp.lod_level)
+        helper.append_op("mul", inputs={"X": [inp.name], "Y": [w.name]},
+                         outputs={"Out": [out.name]},
+                         attrs={"x_num_col_dims": num_flatten_dims,
+                                "y_num_col_dims": 1})
+        mul_results.append(out)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_tmp_variable(
+            mul_results[0].dtype, shape=mul_results[0].shape,
+            lod_level=mul_results[0].lod_level)
+        helper.append_op("sum", inputs={"X": [m.name for m in mul_results]},
+                         outputs={"Out": [pre_bias.name]})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
+    return helper.append_activation(pre_act)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
+              dtype="float32"):
+    """Embedding lookup (reference nn.py embedding -> lookup_table op)."""
+    helper = LayerHelper("embedding")
+    w = helper.create_parameter(param_attr, shape=tuple(size), dtype=dtype,
+                                default_initializer=Xavier())
+    out = helper.create_tmp_variable(
+        dtype, shape=tuple(input.shape[:-1] or input.shape) + (size[1],),
+        lod_level=input.lod_level)
+    helper.append_op("lookup_table",
+                     inputs={"W": [w.name], "Ids": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"is_sparse": is_sparse,
+                            "padding_idx": padding_idx})
+    return out
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_tmp_variable(x.dtype, shape=x.shape,
+                                     lod_level=x.lod_level)
+    mask = helper.create_tmp_variable(x.dtype, shape=x.shape,
+                                      lod_level=x.lod_level,
+                                      stop_gradient=True)
+    helper.append_op("dropout", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name], "Mask": [mask.name]},
+                     attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+                            "seed": seed or 0})
+    return out
+
+
+def softmax(input, name=None):
+    helper = LayerHelper("softmax", name=name)
+    out = helper.create_tmp_variable(input.dtype, shape=input.shape,
+                                     lod_level=input.lod_level)
+    helper.append_op("softmax", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def cross_entropy(input, label, soft_label=False):
+    """reference nn.py cross_entropy -> cross_entropy op."""
+    helper = LayerHelper("cross_entropy")
+    out = helper.create_tmp_variable(
+        input.dtype, shape=tuple(input.shape[:-1]) + (1,))
+    helper.append_op("cross_entropy",
+                     inputs={"X": [input.name], "Label": [label.name]},
+                     outputs={"Y": [out.name]},
+                     attrs={"soft_label": soft_label})
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    softmax_out = helper.create_tmp_variable(logits.dtype, shape=logits.shape)
+    loss = helper.create_tmp_variable(
+        logits.dtype, shape=tuple(logits.shape[:-1]) + (1,))
+    helper.append_op("softmax_with_cross_entropy",
+                     inputs={"Logits": [logits.name], "Label": [label.name]},
+                     outputs={"Softmax": [softmax_out.name],
+                              "Loss": [loss.name]},
+                     attrs={"soft_label": soft_label})
+    return loss
+
+
+def square_error_cost(input, label):
+    """(input - label)^2 via sub + square ops (reference layers/nn.py
+    square_error_cost builds exactly these two ops)."""
+    helper = LayerHelper("square_error_cost")
+    minus_out = helper.create_tmp_variable(input.dtype, shape=input.shape)
+    helper.append_op("elementwise_sub",
+                     inputs={"X": [input.name], "Y": [label.name]},
+                     outputs={"Out": [minus_out.name]})
+    sq = helper.create_tmp_variable(input.dtype, shape=input.shape)
+    helper.append_op("square", inputs={"X": [minus_out.name]},
+                     outputs={"Out": [sq.name]})
+    return sq
+
+
+def sigmoid_cross_entropy_with_logits(x, label):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits")
+    out = helper.create_tmp_variable(x.dtype, shape=x.shape)
+    helper.append_op("sigmoid_cross_entropy_with_logits",
+                     inputs={"X": [x.name], "Label": [label.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    out = helper.create_tmp_variable(x.dtype, shape=())
+    helper.append_op("mean", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """reference layers/nn.py accuracy: top_k + accuracy ops."""
+    helper = LayerHelper("accuracy")
+    topk_out = helper.create_tmp_variable(input.dtype,
+                                          shape=tuple(input.shape[:-1]) + (k,),
+                                          stop_gradient=True)
+    topk_indices = helper.create_tmp_variable(
+        "int64", shape=tuple(input.shape[:-1]) + (k,), stop_gradient=True)
+    helper.append_op("top_k", inputs={"X": [input.name]},
+                     outputs={"Out": [topk_out.name],
+                              "Indices": [topk_indices.name]},
+                     attrs={"k": k})
+    acc_out = helper.create_tmp_variable("float32", shape=(),
+                                         stop_gradient=True)
+    correct = correct or helper.create_tmp_variable("int32", shape=(),
+                                                    stop_gradient=True)
+    total = total or helper.create_tmp_variable("int32", shape=(),
+                                                stop_gradient=True)
+    helper.append_op("accuracy",
+                     inputs={"Out": [topk_out.name],
+                             "Indices": [topk_indices.name],
+                             "Label": [label.name]},
+                     outputs={"Accuracy": [acc_out.name],
+                              "Correct": [correct.name],
+                              "Total": [total.name]})
+    return acc_out
+
+
+def topk(input, k):
+    helper = LayerHelper("top_k")
+    values = helper.create_tmp_variable(input.dtype,
+                                        shape=tuple(input.shape[:-1]) + (k,))
+    indices = helper.create_tmp_variable(
+        "int64", shape=tuple(input.shape[:-1]) + (k,))
+    helper.append_op("top_k", inputs={"X": [input.name]},
+                     outputs={"Out": [values.name],
+                              "Indices": [indices.name]},
+                     attrs={"k": k})
+    return values, indices
+
+
+def _elementwise_binary(x, other, op_type, reverse=False):
+    """Implements Variable operator sugar (+-*/) like the reference's
+    math_op_patch.py: scalars become scale ops / fill_constant."""
+    helper = LayerHelper(op_type)
+    if isinstance(other, (int, float)):
+        if op_type == "elementwise_add":
+            out = helper.create_tmp_variable(x.dtype, shape=x.shape,
+                                             lod_level=x.lod_level)
+            helper.append_op("scale", inputs={"X": [x.name]},
+                             outputs={"Out": [out.name]},
+                             attrs={"scale": 1.0, "bias": float(other)})
+            return out
+        if op_type == "elementwise_mul":
+            out = helper.create_tmp_variable(x.dtype, shape=x.shape,
+                                             lod_level=x.lod_level)
+            helper.append_op("scale", inputs={"X": [x.name]},
+                             outputs={"Out": [out.name]},
+                             attrs={"scale": float(other)})
+            return out
+        const = helper.create_tmp_variable(x.dtype, shape=x.shape)
+        helper.append_op("fill_constant_batch_size_like",
+                         inputs={"Input": [x.name]},
+                         outputs={"Out": [const.name]},
+                         attrs={"shape": list(x.shape or (1,)),
+                                "value": float(other), "dtype": x.dtype})
+        other = const
+    a, b = (other, x) if reverse else (x, other)
+    out = helper.create_tmp_variable(a.dtype, shape=a.shape,
+                                     lod_level=a.lod_level)
+    helper.append_op(op_type, inputs={"X": [a.name], "Y": [b.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": -1})
+    return out
+
+
+def elementwise_add(x, y, axis=-1, act=None):
+    return _elementwise_generic("elementwise_add", x, y, axis, act)
+
+
+def elementwise_sub(x, y, axis=-1, act=None):
+    return _elementwise_generic("elementwise_sub", x, y, axis, act)
+
+
+def elementwise_mul(x, y, axis=-1, act=None):
+    return _elementwise_generic("elementwise_mul", x, y, axis, act)
+
+
+def elementwise_div(x, y, axis=-1, act=None):
+    return _elementwise_generic("elementwise_div", x, y, axis, act)
+
+
+def elementwise_max(x, y, axis=-1, act=None):
+    return _elementwise_generic("elementwise_max", x, y, axis, act)
+
+
+def elementwise_min(x, y, axis=-1, act=None):
+    return _elementwise_generic("elementwise_min", x, y, axis, act)
+
+
+def elementwise_pow(x, y, axis=-1, act=None):
+    return _elementwise_generic("elementwise_pow", x, y, axis, act)
+
+
+def _elementwise_generic(op_type, x, y, axis, act):
+    helper = LayerHelper(op_type, act=act)
+    out = helper.create_tmp_variable(x.dtype, shape=x.shape,
+                                     lod_level=x.lod_level)
+    helper.append_op(op_type, inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": axis})
+    return helper.append_activation(out)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    helper = LayerHelper("mul")
+    out_shape = tuple(x.shape[:x_num_col_dims]) + tuple(y.shape[y_num_col_dims:])
+    out = helper.create_tmp_variable(x.dtype, shape=out_shape)
+    helper.append_op("mul", inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"x_num_col_dims": x_num_col_dims,
+                            "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    helper = LayerHelper("matmul", name=name)
+    xs = list(x.shape)
+    ys = list(y.shape)
+    if transpose_x:
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if transpose_y:
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    out_shape = tuple(xs[:-1] + ys[-1:])
+    out = helper.create_tmp_variable(x.dtype, shape=out_shape)
+    helper.append_op("matmul", inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"transpose_X": transpose_x,
+                            "transpose_Y": transpose_y})
+    return out

@@ -1,0 +1,69 @@
+"""Auto-generated pass-through layer wrappers for simple unary/reduce ops.
+
+Reference: /root/reference/python/paddle/fluid/layers/ops.py, which generates
+layer functions from registered OpProtos via layer_function_generator.py. Here
+we generate from the op registry the same way.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..layer_helper import LayerHelper
+
+_UNARY_OPS = [
+    "sigmoid", "logsigmoid", "exp", "relu", "tanh", "tanh_shrink",
+    "softshrink", "sqrt", "abs", "ceil", "floor", "round", "reciprocal",
+    "log", "square", "softplus", "softsign", "brelu", "leaky_relu",
+    "soft_relu", "elu", "relu6", "pow", "stanh", "hard_shrink",
+    "thresholded_relu", "hard_sigmoid", "swish", "sign",
+]
+
+_REDUCE_OPS = ["reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+               "reduce_prod"]
+
+
+def _make_unary(op_type):
+    def layer(x, name=None, **attrs):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_tmp_variable(x.dtype, shape=x.shape,
+                                         lod_level=x.lod_level)
+        helper.append_op(op_type, inputs={"X": [x.name]},
+                         outputs={"Out": [out.name]}, attrs=attrs)
+        return out
+    layer.__name__ = op_type
+    return layer
+
+
+def _make_reduce(op_type):
+    def layer(input, dim=None, keep_dim=False, name=None):
+        helper = LayerHelper(op_type, name=name)
+        reduce_all = dim is None
+        dims = [0] if dim is None else ([dim] if isinstance(dim, int) else list(dim))
+        if input.shape is not None:
+            nd = len(input.shape)
+            axes = sorted(d % nd for d in dims) if not reduce_all else list(range(nd))
+            shp = [s for i, s in enumerate(input.shape) if i not in axes]
+            if keep_dim:
+                shp = [1 if i in axes else s for i, s in enumerate(input.shape)]
+            out_shape = tuple(shp)
+        else:
+            out_shape = None
+        out = helper.create_tmp_variable(input.dtype, shape=out_shape)
+        helper.append_op(op_type, inputs={"X": [input.name]},
+                         outputs={"Out": [out.name]},
+                         attrs={"dim": dims if len(dims) > 1 else dims[0],
+                                "keep_dim": keep_dim,
+                                "reduce_all": reduce_all})
+        return out
+    layer.__name__ = op_type
+    return layer
+
+
+_mod = sys.modules[__name__]
+for _t in _UNARY_OPS:
+    setattr(_mod, _t, _make_unary(_t))
+for _t in _REDUCE_OPS:
+    setattr(_mod, _t, _make_reduce(_t))
+
+__all__ = _UNARY_OPS + _REDUCE_OPS

@@ -1,0 +1,153 @@
+"""Tensor-construction layer functions.
+
+Reference: /root/reference/python/paddle/fluid/layers/tensor.py
+(create_tensor, cast, concat, sums, assign, fill_constant, ones, zeros,
+argmax ...).
+"""
+
+from __future__ import annotations
+
+from ..framework import Variable, unique_name
+from ..layer_helper import LayerHelper
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.create_global_variable(shape=None, dtype=dtype,
+                                         persistable=persistable, name=name)
+
+
+def cast(x, dtype):
+    helper = LayerHelper("cast")
+    out = helper.create_tmp_variable(dtype, shape=x.shape,
+                                     lod_level=x.lod_level)
+    helper.append_op("cast", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"dtype": dtype, "in_dtype": x.dtype})
+    return out
+
+
+def concat(input, axis=0):
+    helper = LayerHelper("concat")
+    shapes = [v.shape for v in input]
+    out_shape = list(shapes[0])
+    if out_shape is not None and all(s is not None for s in shapes):
+        out_shape[axis] = sum(s[axis] for s in shapes)
+    out = helper.create_tmp_variable(input[0].dtype, shape=tuple(out_shape),
+                                     lod_level=input[0].lod_level)
+    helper.append_op("concat", inputs={"X": [v.name for v in input]},
+                     outputs={"Out": [out.name]}, attrs={"axis": axis})
+    return out
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum")
+    if out is None:
+        out = helper.create_tmp_variable(input[0].dtype, shape=input[0].shape)
+    helper.append_op("sum", inputs={"X": [v.name for v in input]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    if output is None:
+        output = helper.create_tmp_variable(input.dtype, shape=input.shape)
+    helper.append_op("assign", inputs={"X": [input.name]},
+                     outputs={"Out": [output.name]})
+    return output
+
+
+def fill_constant(shape, dtype, value, out=None):
+    helper = LayerHelper("fill_constant")
+    if out is None:
+        out = helper.create_tmp_variable(dtype, shape=tuple(shape),
+                                         stop_gradient=True)
+    helper.append_op("fill_constant", outputs={"Out": [out.name]},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "value": float(value)})
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    out = helper.create_tmp_variable(dtype, shape=tuple(shape),
+                                     stop_gradient=True)
+    helper.append_op("fill_constant_batch_size_like",
+                     inputs={"Input": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "value": float(value),
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
+    return out
+
+
+def ones(shape, dtype):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros(shape, dtype):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def reshape(x, shape, act=None):
+    helper = LayerHelper("reshape", act=act)
+    known = [s if s != 0 else x.shape[i] for i, s in enumerate(shape)]
+    if -1 in known and x.shape is not None:
+        total = 1
+        for s in x.shape:
+            total *= s
+        rest = 1
+        for s in known:
+            if s != -1:
+                rest *= s
+        known[known.index(-1)] = total // rest if rest else -1
+    out = helper.create_tmp_variable(x.dtype, shape=tuple(known))
+    helper.append_op("reshape", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"shape": list(shape)})
+    return helper.append_activation(out)
+
+
+def transpose(x, perm):
+    helper = LayerHelper("transpose")
+    out_shape = tuple(x.shape[p] for p in perm) if x.shape else None
+    out = helper.create_tmp_variable(x.dtype, shape=out_shape)
+    helper.append_op("transpose", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": list(perm)})
+    return out
+
+
+def split(x, num_or_sections, dim=-1):
+    helper = LayerHelper("split")
+    axis = dim if dim >= 0 else len(x.shape) + dim
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        sections = []
+        sizes = [x.shape[axis] // n] * n
+    else:
+        sections = list(num_or_sections)
+        n = len(sections)
+        sizes = sections
+    outs = []
+    for s in sizes:
+        shp = list(x.shape)
+        shp[axis] = s
+        outs.append(helper.create_tmp_variable(x.dtype, shape=tuple(shp)))
+    helper.append_op("split", inputs={"X": [x.name]},
+                     outputs={"Out": [o.name for o in outs]},
+                     attrs={"axis": axis, "sections": sections, "num":
+                            (num_or_sections if isinstance(num_or_sections, int)
+                             else 0)})
+    return outs
+
+
+def argmax(x, axis=-1):
+    helper = LayerHelper("argmax")
+    shp = tuple(s for i, s in enumerate(x.shape) if i != (axis % len(x.shape)))
+    out = helper.create_tmp_variable("int64", shape=shp, stop_gradient=True)
+    helper.append_op("argmax", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": axis})
+    return out

@@ -1,0 +1,294 @@
+"""Optimizers-as-op-inserters.
+
+Reference: /root/reference/python/paddle/fluid/optimizer.py — ``minimize`` =
+``append_backward`` + per-parameter optimize ops appended to the SAME program
+(optimizer.py:224), with persistable accumulator variables initialized in the
+startup program. Under the compiling Executor this means one fused XLA
+computation performs forward+backward+update per step.
+
+Optimizer classes: SGD (:34-ish), Momentum (:250), Adagrad (:320), Adam (:361),
+Adamax (:466), DecayedAdagrad (:550), RMSProp, Adadelta, Ftrl — reference line
+cites per class in their docstrings below refer to
+python/paddle/fluid/optimizer.py.
+"""
+
+from __future__ import annotations
+
+from .framework import (Program, Parameter, default_main_program,
+                        default_startup_program, unique_name)
+from .backward import append_backward
+from . import regularizer as _regularizer_mod
+
+
+class Optimizer:
+    """Base class (reference optimizer.py:34 Optimizer)."""
+
+    def __init__(self, learning_rate, regularization=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._accumulators = {}  # name -> {param_name: Variable}
+        self._lr_var = None
+
+    # ---- learning rate ----
+    def _create_lr_var(self, program, startup):
+        if self._lr_var is not None:
+            return self._lr_var
+        if hasattr(self._learning_rate, "name"):  # already a Variable (lr decay)
+            self._lr_var = self._learning_rate
+            return self._lr_var
+        block = program.global_block()
+        name = unique_name("learning_rate")
+        self._lr_var = block.create_var(name=name, shape=(1,), dtype="float32",
+                                        persistable=True)
+        startup.global_block().create_var(name=name, shape=(1,), dtype="float32",
+                                          persistable=True)
+        startup.global_block().append_op(
+            "fill_constant", outputs={"Out": [name]},
+            attrs={"shape": [1], "value": float(self._learning_rate),
+                   "dtype": "float32"})
+        return self._lr_var
+
+    # ---- accumulators (reference optimizer.py:96 _add_accumulator) ----
+    def _add_accumulator(self, name, param, startup, fill_value=0.0, shape=None,
+                         dtype=None):
+        key = (name, param.name)
+        if key in self._accumulators:
+            return self._accumulators[key]
+        block = param.block.program.global_block()
+        vname = unique_name(f"{param.name}_{name}")
+        shape = tuple(shape if shape is not None else param.shape)
+        dtype = dtype or param.dtype
+        v = block.create_var(name=vname, shape=shape, dtype=dtype,
+                             persistable=True)
+        startup.global_block().create_var(name=vname, shape=shape, dtype=dtype,
+                                          persistable=True)
+        startup.global_block().append_op(
+            "fill_constant", outputs={"Out": [vname]},
+            attrs={"shape": list(shape), "value": float(fill_value),
+                   "dtype": dtype})
+        self._accumulators[key] = v
+        return v
+
+    # ---- to be provided by subclasses ----
+    def _append_optimize_op(self, block, param_and_grad, startup):
+        raise NotImplementedError
+
+    # ---- main entry (reference optimizer.py:224 minimize) ----
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        startup = startup_program or default_startup_program()
+        params_grads = append_backward(loss, parameter_list, no_grad_set)
+        program = loss.block.program
+        block = program.global_block()
+        self._create_lr_var(program, startup)
+        # weight decay / regularization appended as grad = grad + coef*param
+        params_grads = _regularizer_mod.append_regularization_ops(
+            params_grads, self.regularization)
+        for pg in params_grads:
+            self._append_optimize_op(block, pg, startup)
+        return params_grads
+
+
+class SGD(Optimizer):
+    def _append_optimize_op(self, block, pg, startup):
+        p, g = pg
+        block.append_op("sgd",
+                        inputs={"Param": [p.name], "Grad": [g.name],
+                                "LearningRate": [self._lr_var.name]},
+                        outputs={"ParamOut": [p.name]})
+
+
+SGDOptimizer = SGD
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _append_optimize_op(self, block, pg, startup):
+        p, g = pg
+        v = self._add_accumulator("velocity", p, startup)
+        block.append_op("momentum",
+                        inputs={"Param": [p.name], "Grad": [g.name],
+                                "Velocity": [v.name],
+                                "LearningRate": [self._lr_var.name]},
+                        outputs={"ParamOut": [p.name], "VelocityOut": [v.name]},
+                        attrs={"mu": self._momentum,
+                               "use_nesterov": self._use_nesterov})
+
+
+MomentumOptimizer = Momentum
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _append_optimize_op(self, block, pg, startup):
+        p, g = pg
+        m1 = self._add_accumulator("moment1", p, startup)
+        m2 = self._add_accumulator("moment2", p, startup)
+        b1p = self._add_accumulator("beta1_pow", p, startup,
+                                    fill_value=self._beta1, shape=(1,))
+        b2p = self._add_accumulator("beta2_pow", p, startup,
+                                    fill_value=self._beta2, shape=(1,))
+        block.append_op(
+            "adam",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "Moment1": [m1.name], "Moment2": [m2.name],
+                    "Beta1Pow": [b1p.name], "Beta2Pow": [b2p.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "Moment1Out": [m1.name],
+                     "Moment2Out": [m2.name]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
+        # update beta powers, mirroring reference _finish_update
+        # (optimizer.py:441-463) which appends scale ops
+        block.append_op("scale", inputs={"X": [b1p.name]},
+                        outputs={"Out": [b1p.name]},
+                        attrs={"scale": self._beta1})
+        block.append_op("scale", inputs={"X": [b2p.name]},
+                        outputs={"Out": [b2p.name]},
+                        attrs={"scale": self._beta2})
+
+
+AdamOptimizer = Adam
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+
+    def _append_optimize_op(self, block, pg, startup):
+        p, g = pg
+        m = self._add_accumulator("moment", p, startup)
+        block.append_op("adagrad",
+                        inputs={"Param": [p.name], "Grad": [g.name],
+                                "Moment": [m.name],
+                                "LearningRate": [self._lr_var.name]},
+                        outputs={"ParamOut": [p.name], "MomentOut": [m.name]},
+                        attrs={"epsilon": self._epsilon})
+
+
+AdagradOptimizer = Adagrad
+
+
+class DecayedAdagrad(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _append_optimize_op(self, block, pg, startup):
+        p, g = pg
+        m = self._add_accumulator("moment", p, startup)
+        block.append_op("decayed_adagrad",
+                        inputs={"Param": [p.name], "Grad": [g.name],
+                                "Moment": [m.name],
+                                "LearningRate": [self._lr_var.name]},
+                        outputs={"ParamOut": [p.name], "MomentOut": [m.name]},
+                        attrs={"decay": self._decay, "epsilon": self._epsilon})
+
+
+DecayedAdagradOptimizer = DecayedAdagrad
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _append_optimize_op(self, block, pg, startup):
+        p, g = pg
+        asg = self._add_accumulator("avg_squared_grad", p, startup)
+        asu = self._add_accumulator("avg_squared_update", p, startup)
+        block.append_op(
+            "adadelta",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "AvgSquaredGrad": [asg.name], "AvgSquaredUpdate": [asu.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "AvgSquaredGradOut": [asg.name],
+                     "AvgSquaredUpdateOut": [asu.name]},
+            attrs={"rho": self._rho, "epsilon": self._epsilon})
+
+
+AdadeltaOptimizer = Adadelta
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon, self._momentum = rho, epsilon, momentum
+
+    def _append_optimize_op(self, block, pg, startup):
+        p, g = pg
+        ms = self._add_accumulator("mean_square", p, startup)
+        mom = self._add_accumulator("momentum_acc", p, startup)
+        block.append_op(
+            "rmsprop",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "MeanSquare": [ms.name], "Moment": [mom.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "MeanSquareOut": [ms.name],
+                     "MomentOut": [mom.name]},
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum})
+
+
+RMSPropOptimizer = RMSProp
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _append_optimize_op(self, block, pg, startup):
+        p, g = pg
+        m = self._add_accumulator("moment", p, startup)
+        inf = self._add_accumulator("inf_norm", p, startup)
+        b1p = self._add_accumulator("beta1_pow", p, startup,
+                                    fill_value=self._beta1, shape=(1,))
+        block.append_op(
+            "adamax",
+            inputs={"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+                    "InfNorm": [inf.name], "Beta1Pow": [b1p.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "MomentOut": [m.name],
+                     "InfNormOut": [inf.name]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
+        block.append_op("scale", inputs={"X": [b1p.name]},
+                        outputs={"Out": [b1p.name]},
+                        attrs={"scale": self._beta1})
+
+
+AdamaxOptimizer = Adamax
+
+
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _append_optimize_op(self, block, pg, startup):
+        p, g = pg
+        sq = self._add_accumulator("squared", p, startup)
+        lin = self._add_accumulator("linear", p, startup)
+        block.append_op(
+            "ftrl",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "SquaredAccumulator": [sq.name],
+                    "LinearAccumulator": [lin.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "SquaredAccumOut": [sq.name],
+                     "LinearAccumOut": [lin.name]},
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power})
+
+
+FtrlOptimizer = Ftrl

@@ -1,0 +1,36 @@
+"""ParamAttr — per-parameter configuration.
+
+Reference: /root/reference/python/paddle/fluid/param_attr.py (name,
+initializer, learning_rate, regularizer, trainable, gradient_clip).
+"""
+
+from __future__ import annotations
+
+from .initializer import Initializer, Xavier, Constant
+
+
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, gradient_clip=None):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.gradient_clip = gradient_clip
+
+    @staticmethod
+    def to_attr(arg):
+        if arg is None:
+            return ParamAttr()
+        if isinstance(arg, ParamAttr):
+            return arg
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        if isinstance(arg, Initializer):
+            return ParamAttr(initializer=arg)
+        if isinstance(arg, bool):
+            a = ParamAttr()
+            a.trainable = arg
+            return a
+        raise TypeError(f"cannot convert {arg!r} to ParamAttr")

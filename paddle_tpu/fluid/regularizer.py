@@ -1,0 +1,68 @@
+"""Weight-decay regularizers appended as graph ops.
+
+Reference: /root/reference/python/paddle/fluid/regularizer.py —
+append_regularization_ops builds grad = grad + coef * f(param) ops into the
+main program so decay fuses into the update step under XLA.
+"""
+
+from __future__ import annotations
+
+from .framework import unique_name
+
+
+class WeightDecayRegularizer:
+    def _append(self, block, param):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def _append(self, block, param):
+        out = block.create_var(name=unique_name(param.name + "_l2decay"),
+                               shape=param.shape, dtype=param.dtype)
+        block.append_op("scale", inputs={"X": [param.name]},
+                        outputs={"Out": [out.name]},
+                        attrs={"scale": self._coeff})
+        return out
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def _append(self, block, param):
+        sgn = block.create_var(name=unique_name(param.name + "_sign"),
+                               shape=param.shape, dtype=param.dtype)
+        block.append_op("sign", inputs={"X": [param.name]},
+                        outputs={"Out": [sgn.name]})
+        out = block.create_var(name=unique_name(param.name + "_l1decay"),
+                               shape=param.shape, dtype=param.dtype)
+        block.append_op("scale", inputs={"X": [sgn.name]},
+                        outputs={"Out": [out.name]},
+                        attrs={"scale": self._coeff})
+        return out
+
+
+L2DecayRegularizer = L2Decay
+L1DecayRegularizer = L1Decay
+
+
+def append_regularization_ops(params_grads, regularization=None):
+    """reference regularizer.py append_regularization_ops: per-param override
+    (param.regularizer) wins over the optimizer-level setting."""
+    out = []
+    for param, grad in params_grads:
+        reg = param.regularizer or regularization
+        if reg is None:
+            out.append((param, grad))
+            continue
+        block = grad.block
+        decay = reg._append(block, param)
+        new_grad = block.create_var(name=unique_name(grad.name + "_reg"),
+                                    shape=param.shape, dtype=param.dtype)
+        block.append_op("sum", inputs={"X": [grad.name, decay.name]},
+                        outputs={"Out": [new_grad.name]})
+        out.append((param, new_grad))
+    return out

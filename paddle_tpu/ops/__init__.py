@@ -1,0 +1,19 @@
+"""Op library: importing this package registers every op lowering.
+
+The registry split (core/registry.py) mirrors the reference's
+REGISTER_OPERATOR/REGISTER_OP_*_KERNEL machinery
+(/root/reference/paddle/fluid/framework/op_registry.h); modules here correspond
+to the op families in SURVEY.md §2.2.
+"""
+
+from . import (  # noqa: F401
+    elementwise,
+    activation,
+    tensor_ops,
+    matmul,
+    reduce,
+    loss,
+    nn_ops,
+    optimizer_ops,
+    metrics,
+)

@@ -1,0 +1,125 @@
+"""Activation ops — full parity with the reference's activation zoo.
+
+Reference: /root/reference/paddle/fluid/operators/activation_op.cc — 28 kinds
+(sigmoid, logsigmoid, exp, relu, tanh, tanh_shrink, softshrink, sqrt, abs,
+ceil, floor, round, reciprocal, log, square, softplus, softsign, brelu,
+leaky_relu, soft_relu, elu, relu6, pow, stanh, hard_shrink, thresholded_relu,
+hard_sigmoid, swish), each a CPU functor + CUDA kernel pair with a grad functor
+declaring whether it needs X or Out. Here: one jnp expression each; XLA fuses
+them into producers/consumers so they are free on TPU. Grad makers mirror the
+reference's X-or-Out dependency choice so the autodiff graph matches.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op, same_shape, OpSpec
+from .common import G, data_of, like
+
+
+def _register_act(name, fwd, grad_fn, use="out"):
+    """fwd(x, ctx) -> out; grad_fn(ref, dout, ctx) -> dx where ref is Out or X
+    per ``use`` (mirrors the reference functors' GradFunctor dependencies)."""
+
+    def maker(op, _name=name, _use=use):
+        inputs = {"Out@GRAD": G(op.output("Out"))}
+        if _use in ("out", "both"):
+            inputs["Out"] = op.output("Out")
+        if _use in ("x", "both"):
+            inputs["X"] = op.input("X")
+        return [OpSpec(_name + "_grad", inputs,
+                       {"X@GRAD": G(op.input("X"))}, dict(op.attrs))]
+
+    @register_op(name, infer_shape=same_shape("X", "Out"), grad=maker)
+    def forward(ctx, _fwd=fwd):
+        x = ctx.input("X")
+        ctx.set_output("Out", like(x, _fwd(data_of(x), ctx)))
+
+    @register_op(name + "_grad")
+    def backward(ctx, _g=grad_fn, _use=use):
+        dout_v = ctx.input("Out@GRAD")
+        dout = data_of(dout_v)
+        if _use == "out":
+            ref = (data_of(ctx.input("Out")),)
+        elif _use == "x":
+            ref = (data_of(ctx.input("X")),)
+        else:
+            ref = (data_of(ctx.input("X")), data_of(ctx.input("Out")))
+        ctx.set_output("X@GRAD", like(dout_v, _g(*ref, dout, ctx)))
+
+
+_A = _register_act
+
+_A("sigmoid", lambda x, c: jax.nn.sigmoid(x),
+   lambda o, d, c: d * o * (1 - o), "out")
+_A("logsigmoid", lambda x, c: -jnp.logaddexp(0.0, -x),
+   lambda x, d, c: d * (1.0 / (1.0 + jnp.exp(x))), "x")
+_A("exp", lambda x, c: jnp.exp(x), lambda o, d, c: d * o, "out")
+_A("relu", lambda x, c: jnp.maximum(x, 0), lambda o, d, c: d * (o > 0), "out")
+_A("tanh", lambda x, c: jnp.tanh(x), lambda o, d, c: d * (1 - o * o), "out")
+_A("tanh_shrink", lambda x, c: x - jnp.tanh(x),
+   lambda x, d, c: d * jnp.square(jnp.tanh(x)), "x")
+_A("softshrink",
+   lambda x, c: jnp.where(x > c.attr("lambda", 0.5), x - c.attr("lambda", 0.5),
+                          jnp.where(x < -c.attr("lambda", 0.5),
+                                    x + c.attr("lambda", 0.5), 0.0)),
+   lambda x, d, c: d * ((x > c.attr("lambda", 0.5)) | (x < -c.attr("lambda", 0.5))),
+   "x")
+_A("sqrt", lambda x, c: jnp.sqrt(x), lambda o, d, c: d * 0.5 / o, "out")
+_A("abs", lambda x, c: jnp.abs(x), lambda x, d, c: d * jnp.sign(x), "x")
+_A("ceil", lambda x, c: jnp.ceil(x), lambda x, d, c: jnp.zeros_like(d), "x")
+_A("floor", lambda x, c: jnp.floor(x), lambda x, d, c: jnp.zeros_like(d), "x")
+_A("round", lambda x, c: jnp.round(x), lambda x, d, c: jnp.zeros_like(d), "x")
+_A("reciprocal", lambda x, c: 1.0 / x, lambda o, d, c: -d * o * o, "out")
+_A("log", lambda x, c: jnp.log(x), lambda x, d, c: d / x, "x")
+_A("square", lambda x, c: jnp.square(x), lambda x, d, c: 2.0 * d * x, "x")
+_A("softplus", lambda x, c: jnp.logaddexp(0.0, x),
+   lambda x, d, c: d * (1.0 / (1.0 + jnp.exp(-x))), "x")
+_A("softsign", lambda x, c: x / (1 + jnp.abs(x)),
+   lambda x, d, c: d / jnp.square(1 + jnp.abs(x)), "x")
+_A("brelu",
+   lambda x, c: jnp.clip(x, c.attr("t_min", 0.0), c.attr("t_max", 24.0)),
+   lambda x, d, c: d * ((x > c.attr("t_min", 0.0)) & (x < c.attr("t_max", 24.0))),
+   "x")
+_A("leaky_relu",
+   lambda x, c: jnp.where(x >= 0, x, c.attr("alpha", 0.02) * x),
+   lambda x, d, c: d * jnp.where(x >= 0, 1.0, c.attr("alpha", 0.02)), "x")
+_A("soft_relu",
+   lambda x, c: jnp.log1p(jnp.exp(jnp.clip(x, -c.attr("threshold", 40.0),
+                                           c.attr("threshold", 40.0)))),
+   lambda o, d, c: d * (1 - jnp.exp(-o)), "out")
+_A("elu",
+   lambda x, c: jnp.where(x >= 0, x, c.attr("alpha", 1.0) * (jnp.exp(x) - 1)),
+   lambda x, d, c: d * jnp.where(x >= 0, 1.0,
+                                 c.attr("alpha", 1.0) * jnp.exp(x)), "x")
+_A("relu6", lambda x, c: jnp.clip(x, 0.0, c.attr("threshold", 6.0)),
+   lambda x, d, c: d * ((x > 0) & (x < c.attr("threshold", 6.0))), "x")
+_A("pow", lambda x, c: jnp.power(x, c.attr("factor", 1.0)),
+   lambda x, d, c: d * c.attr("factor", 1.0)
+   * jnp.power(x, c.attr("factor", 1.0) - 1), "x")
+_A("stanh",
+   lambda x, c: c.attr("scale_b", 1.7159) * jnp.tanh(c.attr("scale_a", 0.67) * x),
+   lambda x, d, c: d * c.attr("scale_a", 0.67) * c.attr("scale_b", 1.7159)
+   * (1 - jnp.square(jnp.tanh(c.attr("scale_a", 0.67) * x))), "x")
+_A("hard_shrink",
+   lambda x, c: jnp.where((x > c.attr("threshold", 0.5))
+                          | (x < -c.attr("threshold", 0.5)), x, 0.0),
+   lambda x, d, c: d * ((x > c.attr("threshold", 0.5))
+                        | (x < -c.attr("threshold", 0.5))), "x")
+_A("thresholded_relu",
+   lambda x, c: jnp.where(x > c.attr("threshold", 1.0), x, 0.0),
+   lambda x, d, c: d * (x > c.attr("threshold", 1.0)), "x")
+_A("hard_sigmoid",
+   lambda x, c: jnp.clip(c.attr("slope", 0.2) * x + c.attr("offset", 0.5), 0.0, 1.0),
+   lambda x, d, c: d * jnp.where(
+       (c.attr("slope", 0.2) * x + c.attr("offset", 0.5) > 0)
+       & (c.attr("slope", 0.2) * x + c.attr("offset", 0.5) < 1),
+       c.attr("slope", 0.2), 0.0), "x")
+_A("swish",
+   lambda x, c: x / (1 + jnp.exp(-c.attr("beta", 1.0) * x)),
+   lambda x, d, c: d * ((1 + jnp.exp(-c.attr("beta", 1.0) * x)
+                         + c.attr("beta", 1.0) * x * jnp.exp(-c.attr("beta", 1.0) * x))
+                        / jnp.square(1 + jnp.exp(-c.attr("beta", 1.0) * x))), "x")
+

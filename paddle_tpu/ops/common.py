@@ -1,0 +1,69 @@
+"""Shared helpers for op lowerings and grad makers."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.lod import LoDArray
+from ..core.registry import OpSpec
+from ..fluid.framework import grad_var_name
+
+
+def G(names):
+    """Names -> their gradient-variable names (the @GRAD convention used by the
+    reference's backward pass, python/paddle/fluid/backward.py)."""
+    if isinstance(names, str):
+        return grad_var_name(names)
+    return [grad_var_name(n) for n in names]
+
+
+def data_of(v):
+    """Unwrap a LoDArray to its padded dense data (LoD-transparent ops)."""
+    return v.data if isinstance(v, LoDArray) else v
+
+
+def like(ref, value):
+    """Re-wrap ``value`` as a LoDArray if ``ref`` carried LoD."""
+    if isinstance(ref, LoDArray):
+        return LoDArray(value, ref.lens)
+    return value
+
+
+def collapse_to(v, target_shape, lead_axis):
+    """Sum ``v`` down to ``target_shape`` which was broadcast into it starting
+    at ``lead_axis`` — the gradient of the reference's elementwise broadcast
+    rule (operators/elementwise_op_function.h)."""
+    nd = v.ndim
+    ynd = len(target_shape)
+    axes = tuple(range(lead_axis)) + tuple(range(lead_axis + ynd, nd))
+    if axes:
+        v = jnp.sum(v, axis=axes)
+    # handle size-1 dims inside target_shape broadcast
+    inner = tuple(i for i, s in enumerate(target_shape) if s == 1 and v.shape[i] != 1)
+    if inner:
+        v = jnp.sum(v, axis=inner, keepdims=True)
+    return v.reshape(target_shape)
+
+
+def simple_grad(op_type, in_slots, out_slots, grad_of_outs, grad_to_ins,
+                extra_inputs=None):
+    """Build a standard grad maker: grad op consumes listed forward slots +
+    output grads, produces input grads. Mirrors DefaultGradOpDescMaker
+    (/root/reference/paddle/fluid/framework/grad_op_desc_maker.h:133)."""
+    def maker(op):
+        inputs = {}
+        for s in in_slots:
+            inputs[s] = op.input(s)
+        for s in out_slots:
+            inputs[s] = op.output(s)
+        for s in grad_of_outs:
+            inputs[G_slot(s)] = G(op.output(s))
+        for s in (extra_inputs or []):
+            inputs[s] = op.input(s)
+        outputs = {G_slot(s): G(op.input(s)) for s in grad_to_ins}
+        return [OpSpec(op_type, inputs, outputs, dict(op.attrs))]
+    return maker
+
+
+def G_slot(slot):
+    return slot + "@GRAD"

@@ -1,0 +1,97 @@
+"""Elementwise binary ops with the reference's axis-broadcast semantics.
+
+Reference: /root/reference/paddle/fluid/operators/elementwise_op_function.h and
+elementwise_{add,sub,mul,div,max,min,pow}_op.cc. Semantics: Y (smaller rank) is
+broadcast into X starting at attr ``axis`` (axis == -1 means align trailing
+dims). The CUDA kernels there are replaced by jnp broadcasting, which XLA fuses
+into neighbors — elementwise ops should never be standalone HBM round-trips on
+TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op, same_shape, OpSpec
+from .common import G, data_of, like, collapse_to
+
+
+def _align(x, y, axis):
+    """Reshape y so it broadcasts into x per the reference's axis rule."""
+    if x.shape == y.shape:
+        return y, 0
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    new_shape = (1,) * axis + tuple(y.shape) + (1,) * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape), axis
+
+
+_FWD = {
+    "elementwise_add": lambda x, y: x + y,
+    "elementwise_sub": lambda x, y: x - y,
+    "elementwise_mul": lambda x, y: x * y,
+    "elementwise_div": lambda x, y: x / y,
+    "elementwise_max": lambda x, y: jnp.maximum(x, y),
+    "elementwise_min": lambda x, y: jnp.minimum(x, y),
+    "elementwise_pow": lambda x, y: jnp.power(x, y),
+}
+
+# (dx_fn, dy_fn): each takes (x, y_broadcast, out, dout)
+_GRADS = {
+    "elementwise_add": (lambda x, yb, o, d: d,
+                        lambda x, yb, o, d: d),
+    "elementwise_sub": (lambda x, yb, o, d: d,
+                        lambda x, yb, o, d: -d),
+    "elementwise_mul": (lambda x, yb, o, d: d * yb,
+                        lambda x, yb, o, d: d * x),
+    "elementwise_div": (lambda x, yb, o, d: d / yb,
+                        lambda x, yb, o, d: -d * x / (yb * yb)),
+    "elementwise_max": (lambda x, yb, o, d: d * (x >= yb),
+                        lambda x, yb, o, d: d * (x < yb)),
+    "elementwise_min": (lambda x, yb, o, d: d * (x <= yb),
+                        lambda x, yb, o, d: d * (x > yb)),
+    "elementwise_pow": (lambda x, yb, o, d: d * yb * jnp.power(x, yb - 1),
+                        lambda x, yb, o, d: d * o * jnp.log(jnp.where(x > 0, x, 1.0))),
+}
+
+
+def _make_grad_maker(op_type):
+    def maker(op):
+        return [OpSpec(
+            op_type + "_grad",
+            inputs={"X": op.input("X"), "Y": op.input("Y"),
+                    "Out": op.output("Out"), "Out@GRAD": G(op.output("Out"))},
+            outputs={"X@GRAD": G(op.input("X")), "Y@GRAD": G(op.input("Y"))},
+            attrs=dict(op.attrs))]
+    return maker
+
+
+def _register(op_type):
+    fwd = _FWD[op_type]
+    dx_fn, dy_fn = _GRADS[op_type]
+
+    @register_op(op_type, infer_shape=same_shape("X", "Out"),
+                 grad=_make_grad_maker(op_type))
+    def forward(ctx, _fwd=fwd):
+        xv, yv = ctx.input("X"), ctx.input("Y")
+        x, y = data_of(xv), data_of(yv)
+        yb, _ = _align(x, y, ctx.attr("axis", -1))
+        ctx.set_output("Out", like(xv, _fwd(x, yb)))
+
+    @register_op(op_type + "_grad")
+    def backward(ctx, _dx=dx_fn, _dy=dy_fn):
+        x = data_of(ctx.input("X"))
+        y = data_of(ctx.input("Y"))
+        out = data_of(ctx.input("Out"))
+        dout = data_of(ctx.input("Out@GRAD"))
+        yb, axis = _align(x, y, ctx.attr("axis", -1))
+        dx = _dx(x, yb, out, dout).astype(x.dtype)
+        dy_full = _dy(x, yb, out, dout)
+        dy = (collapse_to(dy_full, y.shape, axis)
+              if y.shape != x.shape else dy_full).astype(y.dtype)
+        ctx.set_output("X@GRAD", like(ctx.input("X"), dx))
+        ctx.set_output("Y@GRAD", like(ctx.input("Y"), dy))
+
+
+for _t in _FWD:
+    _register(_t)

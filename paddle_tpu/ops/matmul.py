@@ -1,0 +1,121 @@
+"""Matrix-multiply ops — the MXU workhorses.
+
+Reference: mul_op.cc (flatten-to-2D semantics via x_num_col_dims /
+y_num_col_dims), matmul_op.cc (batched, with transpose flags). The reference
+dispatches to cuBLAS GEMM (operators/math/math_function.cu); here a single
+jnp.dot / einsum lowers straight onto the TPU MXU. ``mul`` accumulates in
+float32 via preferred_element_type when inputs are bfloat16 — the TPU-native
+mixed-precision recipe.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op, OpSpec
+from .common import G, data_of, like
+
+
+def _flat2d(x, num_col_dims):
+    lead = 1
+    for s in x.shape[:num_col_dims]:
+        lead *= s
+    rest = 1
+    for s in x.shape[num_col_dims:]:
+        rest *= s
+    return x.reshape(lead, rest)
+
+
+def _mul_grad_maker(op):
+    return [OpSpec(
+        "mul_grad",
+        {"X": op.input("X"), "Y": op.input("Y"),
+         "Out@GRAD": G(op.output("Out"))},
+        {"X@GRAD": G(op.input("X")), "Y@GRAD": G(op.input("Y"))},
+        dict(op.attrs))]
+
+
+@register_op("mul", grad=_mul_grad_maker)
+def mul(ctx):
+    xv = ctx.input("X")
+    x, y = data_of(xv), data_of(ctx.input("Y"))
+    xnc = ctx.attr("x_num_col_dims", 1)
+    ync = ctx.attr("y_num_col_dims", 1)
+    x2, y2 = _flat2d(x, xnc), _flat2d(y, ync)
+    out = jnp.dot(x2, y2, preferred_element_type=jnp.float32).astype(x.dtype)
+    out_shape = x.shape[:xnc] + y.shape[ync:]
+    ctx.set_output("Out", like(xv, out.reshape(out_shape)))
+
+
+@register_op("mul_grad")
+def mul_grad(ctx):
+    x, y = data_of(ctx.input("X")), data_of(ctx.input("Y"))
+    d = data_of(ctx.input("Out@GRAD"))
+    xnc = ctx.attr("x_num_col_dims", 1)
+    ync = ctx.attr("y_num_col_dims", 1)
+    x2, y2 = _flat2d(x, xnc), _flat2d(y, ync)
+    d2 = d.reshape(x2.shape[0], y2.shape[1])
+    dx = jnp.dot(d2, y2.T, preferred_element_type=jnp.float32)
+    dy = jnp.dot(x2.T, d2, preferred_element_type=jnp.float32)
+    ctx.set_output("X@GRAD", like(ctx.input("X"), dx.reshape(x.shape).astype(x.dtype)))
+    ctx.set_output("Y@GRAD", dy.reshape(y.shape).astype(y.dtype))
+
+
+def _matmul_grad_maker(op):
+    return [OpSpec(
+        "matmul_grad",
+        {"X": op.input("X"), "Y": op.input("Y"),
+         "Out@GRAD": G(op.output("Out"))},
+        {"X@GRAD": G(op.input("X")), "Y@GRAD": G(op.input("Y"))},
+        dict(op.attrs))]
+
+
+def _mm(x, y, tx, ty):
+    if x.ndim == 1:
+        x = x[None, :]
+    if y.ndim == 1:
+        y = y[:, None]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y, preferred_element_type=jnp.float32)
+
+
+@register_op("matmul", grad=_matmul_grad_maker)
+def matmul(ctx):
+    xv = ctx.input("X")
+    x, y = data_of(xv), data_of(ctx.input("Y"))
+    out = _mm(x, y, ctx.attr("transpose_X", False), ctx.attr("transpose_Y", False))
+    if x.ndim == 1 and y.ndim == 1:
+        out = out.reshape(())
+    ctx.set_output("Out", like(xv, out.astype(x.dtype)))
+
+
+@register_op("matmul_grad")
+def matmul_grad(ctx):
+    x, y = data_of(ctx.input("X")), data_of(ctx.input("Y"))
+    d = data_of(ctx.input("Out@GRAD"))
+    tx, ty = ctx.attr("transpose_X", False), ctx.attr("transpose_Y", False)
+    if x.ndim == 1 and y.ndim == 1:
+        d = d.reshape(1, 1)
+    # standard matmul VJP with transpose flags
+    if not tx and not ty:
+        dx = _mm(d, y, False, True)
+        dy = _mm(x, d, True, False)
+    elif tx and not ty:
+        dx = _mm(y, d, False, True)
+        dy = _mm(x, d, False, False)
+    elif not tx and ty:
+        dx = _mm(d, y, False, False)
+        dy = _mm(d, x, True, False)
+    else:
+        dx = _mm(y, d, True, True)
+        dy = _mm(d, x, True, True)
+    # collapse broadcasting in batch dims
+    def fit(g, ref):
+        while g.ndim > ref.ndim:
+            g = jnp.sum(g, axis=0)
+        return g.reshape(ref.shape).astype(ref.dtype)
+    ctx.set_output("X@GRAD", like(ctx.input("X"), fit(dx, x)))
+    ctx.set_output("Y@GRAD", fit(dy, y))

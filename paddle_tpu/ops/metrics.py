@@ -1,0 +1,24 @@
+"""Metric ops: accuracy, auc — reference accuracy_op.cu, auc_op.cc
+(/root/reference/paddle/fluid/operators/)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import data_of
+
+
+@register_op("accuracy")
+def accuracy(ctx):
+    """Inputs follow the reference (accuracy_op.cc): Out = top-k indices' match
+    rate vs Label; also emits Correct and Total counters."""
+    indices = data_of(ctx.input("Indices"))
+    label = data_of(ctx.input("Label")).reshape(-1, 1)
+    correct_per_row = jnp.any(indices == label, axis=1)
+    num_correct = jnp.sum(correct_per_row.astype(jnp.int32))
+    total = indices.shape[0]
+    ctx.set_output("Accuracy",
+                   (num_correct.astype(jnp.float32) / total).reshape(()))
+    ctx.set_output("Correct", num_correct.reshape(()))
+    ctx.set_output("Total", jnp.asarray(total, dtype=jnp.int32))

@@ -1,0 +1,77 @@
+"""NN ops that aren't conv/pool/norm: dropout, lookup_table (embedding).
+
+Reference: dropout_op.cc, lookup_table_op.cc
+(/root/reference/paddle/fluid/operators/). lookup_table's grad in the reference
+can produce a SelectedRows sparse gradient (lookup_table_op.cc W@GRAD); here
+the dense scatter-add path is the default, with the sparse path provided later
+via the SelectedRows-equivalent segment-sum design (SURVEY.md hard part c).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op, same_shape, OpSpec
+from ..core.lod import LoDArray
+from .common import G, data_of, like
+
+
+@register_op("dropout", infer_shape=same_shape("X", "Out"), grad=lambda op: [OpSpec(
+    "dropout_grad",
+    {"Mask": op.output("Mask"), "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
+def dropout(ctx):
+    x = ctx.input("X")
+    xd = data_of(x)
+    prob = ctx.attr("dropout_prob", 0.5)
+    if ctx.attr("is_test", False):
+        # reference dropout_op.h: test mode scales by (1 - p)
+        ctx.set_output("Out", like(x, xd * (1.0 - prob)))
+        ctx.set_output("Mask", like(x, jnp.ones_like(xd)))
+        return
+    keep = jax.random.bernoulli(ctx.next_rng(), 1.0 - prob, xd.shape)
+    mask = keep.astype(xd.dtype)
+    ctx.set_output("Out", like(x, xd * mask))
+    ctx.set_output("Mask", like(x, mask))
+
+
+@register_op("dropout_grad")
+def dropout_grad(ctx):
+    d = ctx.input("Out@GRAD")
+    mask = data_of(ctx.input("Mask"))
+    ctx.set_output("X@GRAD", like(d, data_of(d) * mask))
+
+
+@register_op("lookup_table", grad=lambda op: [OpSpec(
+    "lookup_table_grad",
+    {"W": op.input("W"), "Ids": op.input("Ids"),
+     "Out@GRAD": G(op.output("Out"))},
+    {"W@GRAD": G(op.input("W"))}, dict(op.attrs))])
+def lookup_table(ctx):
+    w = data_of(ctx.input("W"))
+    ids_v = ctx.input("Ids")
+    ids = data_of(ids_v).astype(jnp.int32)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    out = jnp.take(w, ids, axis=0)
+    padding_idx = ctx.attr("padding_idx", None)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+    ctx.set_output("Out", like(ids_v, out))
+
+
+@register_op("lookup_table_grad")
+def lookup_table_grad(ctx):
+    w = data_of(ctx.input("W"))
+    ids = data_of(ctx.input("Ids")).astype(jnp.int32)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    d_v = ctx.input("Out@GRAD")
+    d = data_of(d_v)
+    if isinstance(d_v, LoDArray):
+        # padded positions carry garbage grads — mask them out
+        d = d * d_v.mask(d.dtype).reshape(d.shape[:2] + (1,) * (d.ndim - 2))
+    dw = jnp.zeros_like(w).at[ids.reshape(-1)].add(
+        d.reshape(-1, w.shape[-1]))
+    ctx.set_output("W@GRAD", dw)

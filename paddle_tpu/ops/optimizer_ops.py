@@ -1,0 +1,192 @@
+"""Optimizer update ops.
+
+Reference: sgd_op.cc, momentum_op.cc, adam_op.h, adagrad_op.cc, rmsprop_op.cc,
+adamax_op.cc, adadelta_op.cc, decayed_adagrad_op.cc, ftrl_op.cc
+(/root/reference/paddle/fluid/operators/). In the reference these are ops
+*inside the training program* that update parameters in place
+(ParamOut == Param); the functional lowering rebinds the name, and because the
+whole block is one jitted computation, XLA fuses the update into the backward
+pass — no separate "optimizer step" launch ever exists on TPU.
+
+Each op's ``*Out`` aliases follow the reference exactly so that
+optimizer.py-built programs are structurally identical to the reference's.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import data_of
+
+
+def _lr(ctx):
+    return data_of(ctx.input("LearningRate")).reshape(())
+
+
+@register_op("sgd", in_place=True)
+def sgd(ctx):
+    p = data_of(ctx.input("Param"))
+    g = data_of(ctx.input("Grad"))
+    ctx.set_output("ParamOut", p - _lr(ctx) * g)
+
+
+@register_op("momentum", in_place=True)
+def momentum(ctx):
+    p = data_of(ctx.input("Param"))
+    g = data_of(ctx.input("Grad"))
+    v = data_of(ctx.input("Velocity"))
+    mu = ctx.attr("mu")
+    lr = _lr(ctx)
+    v_new = mu * v + g
+    if ctx.attr("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    ctx.set_output("ParamOut", p_new)
+    ctx.set_output("VelocityOut", v_new)
+
+
+@register_op("adam", in_place=True)
+def adam(ctx):
+    p = data_of(ctx.input("Param"))
+    g = data_of(ctx.input("Grad"))
+    m1 = data_of(ctx.input("Moment1"))
+    m2 = data_of(ctx.input("Moment2"))
+    b1p = data_of(ctx.input("Beta1Pow")).reshape(())
+    b2p = data_of(ctx.input("Beta2Pow")).reshape(())
+    b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    lr = _lr(ctx) * jnp.sqrt(1 - b2p) / (1 - b1p)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * g * g
+    ctx.set_output("ParamOut", p - lr * m1n / (jnp.sqrt(m2n) + eps))
+    ctx.set_output("Moment1Out", m1n)
+    ctx.set_output("Moment2Out", m2n)
+
+
+@register_op("adagrad", in_place=True)
+def adagrad(ctx):
+    p = data_of(ctx.input("Param"))
+    g = data_of(ctx.input("Grad"))
+    m = data_of(ctx.input("Moment"))
+    eps = ctx.attr("epsilon", 1e-6)
+    m_new = m + g * g
+    ctx.set_output("ParamOut", p - _lr(ctx) * g / (jnp.sqrt(m_new) + eps))
+    ctx.set_output("MomentOut", m_new)
+
+
+@register_op("decayed_adagrad", in_place=True)
+def decayed_adagrad(ctx):
+    p = data_of(ctx.input("Param"))
+    g = data_of(ctx.input("Grad"))
+    m = data_of(ctx.input("Moment"))
+    decay = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    m_new = decay * m + (1 - decay) * g * g
+    ctx.set_output("ParamOut", p - _lr(ctx) * g / (jnp.sqrt(m_new) + eps))
+    ctx.set_output("MomentOut", m_new)
+
+
+@register_op("adadelta", in_place=True)
+def adadelta(ctx):
+    p = data_of(ctx.input("Param"))
+    g = data_of(ctx.input("Grad"))
+    avg_sq_grad = data_of(ctx.input("AvgSquaredGrad"))
+    avg_sq_upd = data_of(ctx.input("AvgSquaredUpdate"))
+    rho = ctx.attr("rho", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    asg = rho * avg_sq_grad + (1 - rho) * g * g
+    upd = -jnp.sqrt((avg_sq_upd + eps) / (asg + eps)) * g
+    asu = rho * avg_sq_upd + (1 - rho) * upd * upd
+    ctx.set_output("ParamOut", p + upd)
+    ctx.set_output("AvgSquaredGradOut", asg)
+    ctx.set_output("AvgSquaredUpdateOut", asu)
+
+
+@register_op("rmsprop", in_place=True)
+def rmsprop(ctx):
+    p = data_of(ctx.input("Param"))
+    g = data_of(ctx.input("Grad"))
+    ms = data_of(ctx.input("MeanSquare"))
+    mom = data_of(ctx.input("Moment"))
+    rho = ctx.attr("decay", 0.9)
+    eps = ctx.attr("epsilon", 1e-10)
+    momentum_c = ctx.attr("momentum", 0.0)
+    ms_new = rho * ms + (1 - rho) * g * g
+    mom_new = momentum_c * mom + _lr(ctx) * g / jnp.sqrt(ms_new + eps)
+    ctx.set_output("ParamOut", p - mom_new)
+    ctx.set_output("MeanSquareOut", ms_new)
+    ctx.set_output("MomentOut", mom_new)
+
+
+@register_op("adamax", in_place=True)
+def adamax(ctx):
+    p = data_of(ctx.input("Param"))
+    g = data_of(ctx.input("Grad"))
+    m = data_of(ctx.input("Moment"))
+    inf_norm = data_of(ctx.input("InfNorm"))
+    b1p = data_of(ctx.input("Beta1Pow")).reshape(())
+    b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf_norm, jnp.abs(g) + eps)
+    lr = _lr(ctx) / (1 - b1p)
+    ctx.set_output("ParamOut", p - lr * m_new / inf_new)
+    ctx.set_output("MomentOut", m_new)
+    ctx.set_output("InfNormOut", inf_new)
+
+
+@register_op("ftrl", in_place=True)
+def ftrl(ctx):
+    p = data_of(ctx.input("Param"))
+    g = data_of(ctx.input("Grad"))
+    sq = data_of(ctx.input("SquaredAccumulator"))
+    lin = data_of(ctx.input("LinearAccumulator"))
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr_power = ctx.attr("lr_power", -0.5)
+    lr = _lr(ctx)
+    new_sq = sq + g * g
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    x = jnp.clip(new_lin, -l1, l1) - new_lin
+    if lr_power == -0.5:
+        y = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        y = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    ctx.set_output("ParamOut", x / y)
+    ctx.set_output("SquaredAccumOut", new_sq)
+    ctx.set_output("LinearAccumOut", new_lin)
+
+
+@register_op("proximal_gd", in_place=True)
+def proximal_gd(ctx):
+    p = data_of(ctx.input("Param"))
+    g = data_of(ctx.input("Grad"))
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr = _lr(ctx)
+    prox = p - lr * g
+    ctx.set_output("ParamOut",
+                   jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+                   / (1.0 + lr * l2))
+
+
+@register_op("proximal_adagrad", in_place=True)
+def proximal_adagrad(ctx):
+    p = data_of(ctx.input("Param"))
+    g = data_of(ctx.input("Grad"))
+    m = data_of(ctx.input("Moment"))
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    m_new = m + g * g
+    lr = _lr(ctx) / jnp.sqrt(m_new)
+    prox = p - lr * g
+    ctx.set_output("ParamOut",
+                   jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+                   / (1.0 + lr * l2))
+    ctx.set_output("MomentOut", m_new)

@@ -1,0 +1,74 @@
+"""Reduction ops: mean, reduce_{sum,mean,max,min,prod}.
+
+Reference: mean_op.cc, reduce_op.cc (/root/reference/paddle/fluid/operators/).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op, OpSpec
+from .common import G, data_of
+
+
+@register_op("mean", grad=lambda op: [OpSpec(
+    "mean_grad", {"X": op.input("X"), "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))})])
+def mean(ctx):
+    x = data_of(ctx.input("X"))
+    ctx.set_output("Out", jnp.mean(x).reshape(()).astype(x.dtype))
+
+
+@register_op("mean_grad")
+def mean_grad(ctx):
+    x = data_of(ctx.input("X"))
+    d = data_of(ctx.input("Out@GRAD")).reshape(())
+    ctx.set_output("X@GRAD", jnp.full(x.shape, d / x.size).astype(x.dtype))
+
+
+def _axes(ctx, x):
+    dim = ctx.attr("dim", 0)
+    if ctx.attr("reduce_all", False):
+        return tuple(range(x.ndim))
+    if isinstance(dim, (list, tuple)):
+        return tuple(d % x.ndim for d in dim)
+    return (dim % x.ndim,)
+
+
+def _reg_reduce(name, fn, grad_fwd):
+    def maker(op):
+        return [OpSpec(name + "_grad",
+                       {"X": op.input("X"), "Out": op.output("Out"),
+                        "Out@GRAD": G(op.output("Out"))},
+                       {"X@GRAD": G(op.input("X"))}, dict(op.attrs))]
+
+    @register_op(name, grad=maker)
+    def forward(ctx, _fn=fn):
+        x = data_of(ctx.input("X"))
+        out = _fn(x, axis=_axes(ctx, x), keepdims=ctx.attr("keep_dim", False))
+        ctx.set_output("Out", out)
+
+    @register_op(name + "_grad")
+    def backward(ctx, _g=grad_fwd):
+        x = data_of(ctx.input("X"))
+        out = data_of(ctx.input("Out"))
+        d = data_of(ctx.input("Out@GRAD"))
+        axes = _axes(ctx, x)
+        if not ctx.attr("keep_dim", False):
+            shape = list(x.shape)
+            for a in axes:
+                shape[a] = 1
+            d = d.reshape(shape)
+            out = out.reshape(shape)
+        ctx.set_output("X@GRAD", _g(x, out, jnp.broadcast_to(d, x.shape), axes))
+
+
+_reg_reduce("reduce_sum", jnp.sum, lambda x, o, d, ax: d)
+_reg_reduce("reduce_mean", jnp.mean,
+            lambda x, o, d, ax: d / jnp.prod(jnp.asarray([x.shape[a] for a in ax])))
+_reg_reduce("reduce_max", jnp.max,
+            lambda x, o, d, ax: d * (x == jnp.broadcast_to(o, x.shape)))
+_reg_reduce("reduce_min", jnp.min,
+            lambda x, o, d, ax: d * (x == jnp.broadcast_to(o, x.shape)))
+_reg_reduce("reduce_prod", jnp.prod,
+            lambda x, o, d, ax: d * jnp.broadcast_to(o, x.shape) / x)

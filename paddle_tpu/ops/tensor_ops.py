@@ -1,0 +1,347 @@
+"""Tensor creation / manipulation ops.
+
+Reference counterparts: fill_constant_op.cc, uniform_random_op.cc,
+gaussian_random_op.cc, cast_op.cc, scale_op.cc, assign_op.cc,
+fill_zeros_like_op.cc, reshape_op.cc, transpose_op.cc, concat_op.cc,
+split_op.cc, sum_op.cc, sign_op.cc, clip_op.cc, clip_by_norm_op.cc,
+squared_l2_norm_op.cc, increment_op.cc, top_k_op.cc, one_hot_op.cc,
+gather_op.cc, scatter_op.cc, slice-style ops — all under
+/root/reference/paddle/fluid/operators/.
+
+Random ops: the reference seeds a per-op std::mt19937 from an attr
+(uniform_random_op.cc). TPU-native: random ops draw from the executor's
+threaded jax PRNG key (ctx.next_rng()), so randomness is reproducible from
+Program.random_seed and splits deterministically inside one compiled step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op, same_shape, OpSpec
+from ..core.types import np_dtype
+from .common import G, data_of, like, G_slot
+
+
+# ---------- creation ----------
+
+@register_op("fill_constant")
+def fill_constant(ctx):
+    dtype = np_dtype(ctx.attr("dtype", "float32"))
+    shape = tuple(ctx.attr("shape", []))
+    ctx.set_output("Out", jnp.full(shape, ctx.attr("value", 0.0), dtype=dtype))
+
+
+@register_op("fill_constant_batch_size_like")
+def fill_constant_batch_size_like(ctx):
+    """Shape copied from Input's batch dim (reference
+    fill_constant_batch_size_like_op.cc)."""
+    ref = data_of(ctx.input("Input"))
+    shape = list(ctx.attr("shape"))
+    in_idx = ctx.attr("input_dim_idx", 0)
+    out_idx = ctx.attr("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dtype = np_dtype(ctx.attr("dtype", "float32"))
+    ctx.set_output("Out", jnp.full(tuple(shape), ctx.attr("value", 0.0), dtype))
+
+
+@register_op("fill_zeros_like", infer_shape=same_shape("X", "Out"))
+def fill_zeros_like(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", like(x, jnp.zeros_like(data_of(x))))
+
+
+@register_op("uniform_random")
+def uniform_random(ctx):
+    dtype = np_dtype(ctx.attr("dtype", "float32"))
+    shape = tuple(ctx.attr("shape"))
+    lo, hi = ctx.attr("min", -1.0), ctx.attr("max", 1.0)
+    out = jax.random.uniform(ctx.next_rng(), shape, jnp.float32, lo, hi)
+    ctx.set_output("Out", out.astype(dtype))
+
+
+@register_op("gaussian_random")
+def gaussian_random(ctx):
+    dtype = np_dtype(ctx.attr("dtype", "float32"))
+    shape = tuple(ctx.attr("shape"))
+    mean, std = ctx.attr("mean", 0.0), ctx.attr("std", 1.0)
+    out = mean + std * jax.random.normal(ctx.next_rng(), shape, jnp.float32)
+    ctx.set_output("Out", out.astype(dtype))
+
+
+@register_op("assign_value")
+def assign_value(ctx):
+    values = np.asarray(ctx.attr("values"))
+    shape = tuple(ctx.attr("shape", values.shape))
+    ctx.set_output("Out", jnp.asarray(values).reshape(shape))
+
+
+# ---------- unary-ish ----------
+
+def _unary_grad(op_type, extra=()):
+    def maker(op):
+        inputs = {"Out@GRAD": G(op.output("Out"))}
+        for s in extra:
+            inputs[s] = op.input(s)
+        return [OpSpec(op_type + "_grad", inputs,
+                       {"X@GRAD": G(op.input("X"))}, dict(op.attrs))]
+    return maker
+
+
+@register_op("cast", grad=lambda op: [OpSpec(
+    "cast", {"X": G(op.output("Out"))}, {"Out": G(op.input("X"))},
+    {"dtype": op.attr("in_dtype", "float32"), "in_dtype": op.attr("dtype")})])
+def cast(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", like(x, data_of(x).astype(np_dtype(ctx.attr("dtype")))))
+
+
+@register_op("scale", infer_shape=same_shape("X", "Out"), grad=lambda op: [OpSpec(
+    "scale", {"X": G(op.output("Out"))}, {"Out": G(op.input("X"))},
+    {"scale": op.attr("scale", 1.0)})])
+def scale(ctx):
+    x = ctx.input("X")
+    s = ctx.attr("scale", 1.0)
+    b = ctx.attr("bias", 0.0)
+    ctx.set_output("Out", like(x, data_of(x) * s + b))
+
+
+@register_op("assign", infer_shape=same_shape("X", "Out"), grad=lambda op: [OpSpec(
+    "assign", {"X": G(op.output("Out"))}, {"Out": G(op.input("X"))})])
+def assign(ctx):
+    ctx.set_output("Out", ctx.input("X"))
+
+
+@register_op("sign", infer_shape=same_shape("X", "Out"))
+def sign(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", like(x, jnp.sign(data_of(x))))
+
+
+@register_op("clip", infer_shape=same_shape("X", "Out"),
+             grad=_unary_grad("clip", extra=("X",)))
+def clip(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", like(x, jnp.clip(data_of(x), ctx.attr("min"),
+                                           ctx.attr("max"))))
+
+
+@register_op("clip_grad")
+def clip_grad(ctx):
+    x = data_of(ctx.input("X"))
+    d = ctx.input("Out@GRAD")
+    mask = (x >= ctx.attr("min")) & (x <= ctx.attr("max"))
+    ctx.set_output("X@GRAD", like(d, data_of(d) * mask))
+
+
+@register_op("clip_by_norm", infer_shape=same_shape("X", "Out"))
+def clip_by_norm(ctx):
+    x = data_of(ctx.input("X"))
+    max_norm = ctx.attr("max_norm")
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale_f = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    ctx.set_output("Out", like(ctx.input("X"), x * scale_f))
+
+
+@register_op("squared_l2_norm", grad=lambda op: [OpSpec(
+    "squared_l2_norm_grad",
+    {"X": op.input("X"), "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))})])
+def squared_l2_norm(ctx):
+    x = data_of(ctx.input("X"))
+    ctx.set_output("Out", jnp.sum(jnp.square(x)).reshape((1,)))
+
+
+@register_op("squared_l2_norm_grad")
+def squared_l2_norm_grad(ctx):
+    x = data_of(ctx.input("X"))
+    d = data_of(ctx.input("Out@GRAD")).reshape(())
+    ctx.set_output("X@GRAD", 2.0 * d * x)
+
+
+@register_op("increment")
+def increment(ctx):
+    x = data_of(ctx.input("X"))
+    ctx.set_output("Out", x + ctx.attr("step", 1.0))
+
+
+@register_op("shape")
+def shape_op(ctx):
+    x = data_of(ctx.input("Input"))
+    ctx.set_output("Out", jnp.asarray(np.array(x.shape, dtype=np.int64)))
+
+
+# ---------- shape manipulation ----------
+
+@register_op("reshape", grad=lambda op: [OpSpec(
+    "reshape_grad", {"X": op.input("X"), "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))})])
+def reshape(ctx):
+    x = data_of(ctx.input("X"))
+    # reference reshape_op.cc: 0 means copy input dim, -1 infers
+    shape = [x.shape[i] if s == 0 else s
+             for i, s in enumerate(ctx.attr("shape"))]
+    ctx.set_output("Out", jnp.reshape(x, shape))
+
+
+@register_op("reshape_grad")
+def reshape_grad(ctx):
+    x = data_of(ctx.input("X"))
+    d = data_of(ctx.input("Out@GRAD"))
+    ctx.set_output("X@GRAD", jnp.reshape(d, x.shape))
+
+
+@register_op("transpose", grad=lambda op: [OpSpec(
+    "transpose_grad", {"Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
+def transpose(ctx):
+    x = data_of(ctx.input("X"))
+    ctx.set_output("Out", jnp.transpose(x, ctx.attr("axis")))
+
+
+@register_op("transpose_grad")
+def transpose_grad(ctx):
+    d = data_of(ctx.input("Out@GRAD"))
+    axis = ctx.attr("axis")
+    inv = np.argsort(axis)
+    ctx.set_output("X@GRAD", jnp.transpose(d, inv))
+
+
+@register_op("concat", grad=lambda op: [OpSpec(
+    "concat_grad",
+    {"X": op.input("X"), "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
+def concat(ctx):
+    xs = [data_of(v) for v in ctx.inputs("X")]
+    ctx.set_output("Out", jnp.concatenate(xs, axis=ctx.attr("axis", 0)))
+
+
+@register_op("concat_grad")
+def concat_grad(ctx):
+    xs = [data_of(v) for v in ctx.inputs("X")]
+    d = data_of(ctx.input("Out@GRAD"))
+    axis = ctx.attr("axis", 0)
+    sizes = np.cumsum([x.shape[axis] for x in xs])[:-1]
+    parts = jnp.split(d, sizes, axis=axis)
+    ctx.set_outputs("X@GRAD", parts)
+
+
+@register_op("split", grad=lambda op: [OpSpec(
+    "concat", {"X": G(op.output("Out"))}, {"Out": G(op.input("X"))},
+    {"axis": op.attr("axis", 0)})])
+def split(ctx):
+    x = data_of(ctx.input("X"))
+    axis = ctx.attr("axis", 0)
+    if ctx.attr("sections"):
+        idx = np.cumsum(ctx.attr("sections"))[:-1]
+        parts = jnp.split(x, idx, axis=axis)
+    else:
+        parts = jnp.split(x, ctx.attr("num", len(ctx.op.output("Out"))), axis=axis)
+    ctx.set_outputs("Out", parts)
+
+
+@register_op("sum", grad=lambda op: [OpSpec(
+    "assign", {"X": G(op.output("Out"))}, {"Out": [g]})
+    for g in G(op.input("X"))])
+def sum_op(ctx):
+    """Variadic sum (reference sum_op.cc — also handles SelectedRows)."""
+    xs = [data_of(v) for v in ctx.inputs("X")]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    ctx.set_output("Out", like(ctx.inputs("X")[0], out))
+
+
+# ---------- gather / scatter / indexing ----------
+
+@register_op("gather", grad=lambda op: [OpSpec(
+    "gather_grad",
+    {"X": op.input("X"), "Index": op.input("Index"),
+     "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))})])
+def gather(ctx):
+    x = data_of(ctx.input("X"))
+    idx = data_of(ctx.input("Index")).astype(jnp.int32)
+    ctx.set_output("Out", jnp.take(x, idx, axis=0))
+
+
+@register_op("gather_grad")
+def gather_grad(ctx):
+    x = data_of(ctx.input("X"))
+    idx = data_of(ctx.input("Index")).astype(jnp.int32)
+    d = data_of(ctx.input("Out@GRAD"))
+    ctx.set_output("X@GRAD", jnp.zeros_like(x).at[idx].add(d))
+
+
+@register_op("scatter")
+def scatter(ctx):
+    """Reference scatter_op.cc: overwrite rows of X at Ids with Updates."""
+    x = data_of(ctx.input("X"))
+    ids = data_of(ctx.input("Ids")).astype(jnp.int32)
+    upd = data_of(ctx.input("Updates"))
+    ctx.set_output("Out", x.at[ids].set(upd))
+
+
+# ---------- comparison / logical (reference compare_op.cc, logical_op.cc) ----
+
+def _cmp(name, fn):
+    @register_op(name)
+    def op(ctx, _fn=fn):
+        x, y = data_of(ctx.input("X")), data_of(ctx.input("Y"))
+        ctx.set_output("Out", _fn(x, y))
+
+
+_cmp("less_than", lambda x, y: x < y)
+_cmp("less_equal", lambda x, y: x <= y)
+_cmp("greater_than", lambda x, y: x > y)
+_cmp("greater_equal", lambda x, y: x >= y)
+_cmp("equal", lambda x, y: x == y)
+_cmp("not_equal", lambda x, y: x != y)
+_cmp("logical_and", lambda x, y: x & y)
+_cmp("logical_or", lambda x, y: x | y)
+_cmp("logical_xor", lambda x, y: x ^ y)
+
+
+@register_op("logical_not")
+def logical_not(ctx):
+    ctx.set_output("Out", ~data_of(ctx.input("X")))
+
+
+# ---------- top_k / one_hot / argmax ----------
+
+@register_op("top_k")
+def top_k(ctx):
+    x = data_of(ctx.input("X"))
+    k = ctx.attr("k", 1)
+    vals, idx = jax.lax.top_k(x, k)
+    ctx.set_output("Out", vals)
+    ctx.set_output("Indices", idx.astype(jnp.int64))
+
+
+@register_op("one_hot")
+def one_hot(ctx):
+    x = data_of(ctx.input("X"))
+    depth = ctx.attr("depth")
+    flat = x.reshape(x.shape[:-1]) if x.shape and x.shape[-1] == 1 else x
+    ctx.set_output("Out", jax.nn.one_hot(flat.astype(jnp.int32), depth,
+                                         dtype=jnp.float32))
+
+
+@register_op("argmax")
+def argmax(ctx):
+    x = data_of(ctx.input("X"))
+    ctx.set_output("Out", jnp.argmax(x, axis=ctx.attr("axis", -1)).astype(jnp.int64))
+
+
+# ---------- multiplex / is_empty ----------
+
+@register_op("multiplex")
+def multiplex(ctx):
+    """Row-wise select among candidate tensors by Ids
+    (reference multiplex_op.cc)."""
+    ids = data_of(ctx.input("Ids")).astype(jnp.int32).reshape(-1)
+    xs = jnp.stack([data_of(v) for v in ctx.inputs("X")], axis=0)
+    rows = jnp.arange(ids.shape[0])
+    ctx.set_output("Out", xs[ids, rows])

@@ -1,0 +1,14 @@
+"""Distributed execution: device meshes + sharding planner + collectives.
+
+This package is the TPU-native replacement for the reference's entire
+distribution stack (SURVEY.md §2.3): the DistributeTranspiler
+(/root/reference/python/paddle/fluid/distribute_transpiler.py:134), the gRPC
+pserver path (operators/detail/), NCCL parallel_do (operators/nccl/), and the
+legacy/Go parameter servers. Instead of rewriting programs into trainer+pserver
+pairs communicating over RPC, the planner annotates the compiled step function
+with jax.sharding shardings over a Mesh and lets GSPMD insert ICI collectives.
+"""
+
+from .sharding import ShardingPlan, make_mesh, shard_program_step
+
+__all__ = ["ShardingPlan", "make_mesh", "shard_program_step"]

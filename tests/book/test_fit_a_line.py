@@ -1,0 +1,55 @@
+"""Book chapter 1: linear regression (fit_a_line).
+
+Reference: /root/reference/python/paddle/fluid/tests/book/test_fit_a_line.py —
+train a linear model until avg loss drops under a threshold, then round-trip
+save/load_inference_model. Here synthetic data stands in for the UCI housing
+reader (the dataset module arrives with the input-pipeline milestone).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _synthetic_housing(n=512, dim=13, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-1, 1, (n, dim)).astype("float32")
+    w = rng.uniform(-2, 2, (dim, 1)).astype("float32")
+    y = x @ w + 0.5 + rng.normal(0, 0.01, (n, 1)).astype("float32")
+    return x, y.astype("float32")
+
+
+def test_fit_a_line_converges(tmp_path):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[13])
+        y = fluid.layers.data("y", shape=[1])
+        y_predict = fluid.layers.fc(input=x, size=1, act=None)
+        cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+        avg_cost = fluid.layers.mean(cost)
+        sgd = fluid.optimizer.SGD(learning_rate=0.05)
+        sgd.minimize(avg_cost, startup)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    xs, ys = _synthetic_housing()
+    batch = 64
+    loss = None
+    for epoch in range(30):
+        for i in range(0, len(xs), batch):
+            loss, = exe.run(main,
+                            feed={"x": xs[i:i + batch], "y": ys[i:i + batch]},
+                            fetch_list=[avg_cost])
+    assert loss is not None and float(loss) < 0.05, float(loss)
+
+    # save / load inference model round trip (reference book test does this)
+    model_dir = str(tmp_path / "fit_a_line.model")
+    fluid.io.save_inference_model(model_dir, ["x"], [y_predict], exe, main)
+    infer_prog, feed_names, fetch_vars = fluid.io.load_inference_model(
+        model_dir, exe)
+    assert feed_names == ["x"]
+    pred, = exe.run(infer_prog, feed={"x": xs[:8]}, fetch_list=fetch_vars)
+    np.testing.assert_allclose(pred, ys[:8], atol=0.2)

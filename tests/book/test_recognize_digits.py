@@ -1,0 +1,67 @@
+"""Book chapter 2: MNIST digit recognition (MLP head).
+
+Reference: /root/reference/python/paddle/fluid/tests/book/
+test_recognize_digits.py:45-127 — an MLP (two hidden fc layers + softmax),
+trained with Adam until accuracy crosses a threshold, with inference-model
+round trip. Synthetic separable data stands in for the MNIST reader until the
+dataset milestone; the convergence assertion contract is the same.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _synthetic_digits(n=2048, dim=64, classes=10, seed=1):
+    rng = np.random.RandomState(seed)
+    centers = rng.normal(0, 2.0, (classes, dim)).astype("float32")
+    labels = rng.randint(0, classes, n)
+    x = centers[labels] + rng.normal(0, 0.8, (n, dim)).astype("float32")
+    return x.astype("float32"), labels.reshape(-1, 1).astype("int64")
+
+
+def mlp(img, label):
+    hidden = fluid.layers.fc(input=img, size=128, act="relu")
+    hidden = fluid.layers.fc(input=hidden, size=64, act="relu")
+    prediction = fluid.layers.fc(input=hidden, size=10, act="softmax")
+    loss = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_loss = fluid.layers.mean(loss)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return prediction, avg_loss, acc
+
+
+def test_recognize_digits_mlp_converges(tmp_path):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[64])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        prediction, avg_loss, acc = mlp(img, label)
+        opt = fluid.optimizer.Adam(learning_rate=0.002)
+        opt.minimize(avg_loss, startup)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    xs, ys = _synthetic_digits()
+    batch = 128
+    acc_val = 0.0
+    for epoch in range(10):
+        accs = []
+        for i in range(0, len(xs), batch):
+            loss_v, acc_v = exe.run(
+                main, feed={"img": xs[i:i + batch], "label": ys[i:i + batch]},
+                fetch_list=[avg_loss, acc])
+            accs.append(float(acc_v))
+        acc_val = float(np.mean(accs))
+        if acc_val > 0.95:
+            break
+    assert acc_val > 0.9, f"MLP failed to converge, acc={acc_val}"
+
+    model_dir = str(tmp_path / "digits.model")
+    fluid.io.save_inference_model(model_dir, ["img"], [prediction], exe, main)
+    infer_prog, feed_names, fetch_vars = fluid.io.load_inference_model(
+        model_dir, exe)
+    pred, = exe.run(infer_prog, feed={"img": xs[:32]}, fetch_list=fetch_vars)
+    top1 = pred.argmax(axis=1)
+    assert (top1 == ys[:32].flatten()).mean() > 0.8

@@ -1,0 +1,35 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh so
+multi-chip sharding logic is exercised without TPU hardware (the driver's
+dryrun_multichip uses the same trick)."""
+
+import os
+
+# Force CPU: the machine environment pins JAX_PLATFORMS to the TPU plugin and
+# a sitecustomize imports jax at interpreter startup, so we must both fix the
+# env (for subprocesses) and reconfigure the already-imported jax before any
+# backend is initialized.
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Give every test fresh default programs + scope + name counters."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.core import scope as scope_mod
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    framework.reset_unique_name()
+    scope_mod.reset_global_scope()
+    np.random.seed(123)
+    yield

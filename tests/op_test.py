@@ -1,0 +1,178 @@
+"""OpTest — per-op numeric testing harness.
+
+Port of the reference's contract (/root/reference/python/paddle/fluid/tests/
+unittests/op_test.py:212): a test defines ``op_type``, ``inputs``, ``attrs``
+and numpy-computed ``outputs``; ``check_output`` builds a single-op program
+and compares executor results against the numpy reference on both the eager
+interpreter and the jit-compiled path (the reference's CPU/CUDA place pair →
+our eager/jit pair). ``check_grad`` compares analytic gradients obtained by
+``append_backward`` against central finite differences
+(reference op_test.py:97 get_numeric_gradient, :378 check_grad).
+
+LoD inputs are passed as ``(np_array, lod)`` tuples exactly like the
+reference (op_test.py:465).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.lod import LoDArray, lodarray_to_flat, flat_to_lodarray
+
+
+def _as_np(v):
+    if isinstance(v, tuple):
+        return np.asarray(v[0])
+    return np.asarray(v)
+
+
+class OpTest:
+    """Subclass-style harness; pytest test classes inherit and call
+    check_output/check_grad from test methods."""
+
+    op_type: str = None
+    inputs: dict = {}
+    outputs: dict = {}
+    attrs: dict = {}
+
+    # -- program construction ------------------------------------------------
+    def _build(self, extra_loss=False):
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            in_slots, feed = {}, {}
+            for slot, value in self.inputs.items():
+                entries = value if isinstance(value, list) else [(slot, value)]
+                names = []
+                for sub_name, sub_val in entries:
+                    lod_level = 1 if isinstance(sub_val, tuple) else 0
+                    arr = _as_np(sub_val)
+                    block.create_var(name=sub_name, shape=arr.shape,
+                                     dtype=str(arr.dtype), lod_level=lod_level,
+                                     stop_gradient=False, is_data=True)
+                    feed[sub_name] = sub_val if lod_level else arr
+                    names.append(sub_name)
+                in_slots[slot] = names
+            out_slots = {}
+            for slot, value in self.outputs.items():
+                entries = value if isinstance(value, list) else [(slot, value)]
+                names = []
+                for sub_name, sub_val in entries:
+                    lod_level = 1 if isinstance(sub_val, tuple) else 0
+                    block.create_var(name=sub_name, lod_level=lod_level)
+                    names.append(sub_name)
+                out_slots[slot] = names
+            block.append_op(self.op_type, in_slots, out_slots, dict(self.attrs))
+        return main, startup, feed
+
+    def _out_entries(self):
+        for slot, value in self.outputs.items():
+            entries = value if isinstance(value, list) else [(slot, value)]
+            for sub_name, sub_val in entries:
+                yield slot, sub_name, sub_val
+
+    # -- forward check -------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-4, no_check_set=None):
+        for mode in ("eager", "jit"):
+            main, startup, feed = self._build()
+            exe = fluid.Executor(fluid.CPUPlace(), mode=mode)
+            fetch_names = [n for _, n, _ in self._out_entries()]
+            results = exe.run(main, feed=feed, fetch_list=fetch_names)
+            for (slot, name, expect), got in zip(self._out_entries(), results):
+                if isinstance(got, LoDArray):
+                    got_flat, got_lod = lodarray_to_flat(got)
+                    if isinstance(expect, tuple):
+                        np.testing.assert_allclose(
+                            got_flat, np.asarray(expect[0]), atol=atol,
+                            rtol=rtol, err_msg=f"[{mode}] output {name} (lod)")
+                        assert got_lod[0] == list(np.asarray(expect[1][0])), \
+                            f"[{mode}] lod mismatch for {name}"
+                        continue
+                    got = got_flat
+                np.testing.assert_allclose(
+                    np.asarray(got, dtype=np.float64),
+                    np.asarray(_as_np(expect), dtype=np.float64).reshape(
+                        np.asarray(got).shape),
+                    atol=atol, rtol=rtol, err_msg=f"[{mode}] output {name}")
+
+    # -- gradient check ------------------------------------------------------
+    def _loss_value(self, outs, output_names):
+        return sum(float(np.mean(np.asarray(o, dtype=np.float64)))
+                   for n, o in outs.items() if n in output_names)
+
+    def _forward_loss(self, exe, main, feed, output_names):
+        results = exe.run(main, feed=feed, fetch_list=list(output_names))
+        vals = {}
+        for n, r in zip(output_names, results):
+            if isinstance(r, LoDArray):
+                r, _ = lodarray_to_flat(r)
+            vals[n] = r
+        return self._loss_value(vals, output_names)
+
+    def check_grad(self, inputs_to_check, output_names,
+                   max_relative_error=0.005, no_grad_set=None,
+                   numeric_grad_delta=0.005, user_defined_grads=None):
+        if isinstance(output_names, str):
+            output_names = [output_names]
+
+        # ---- analytic grads via append_backward ----
+        main, startup, feed = self._build()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            means = []
+            for n in output_names:
+                m = fluid.layers.mean(block.var(n))
+                means.append(m)
+            loss = means[0]
+            for m in means[1:]:
+                loss = loss + m
+            fluid.append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace(), mode="jit")
+        grad_names = [fluid.grad_var_name(n) for n in inputs_to_check]
+        analytic = exe.run(main, feed=feed, fetch_list=grad_names)
+        analytic = [lodarray_to_flat(a)[0] if isinstance(a, LoDArray)
+                    else np.asarray(a) for a in analytic]
+
+        # ---- numeric grads by central differences ----
+        if user_defined_grads is not None:
+            numeric = [np.asarray(g) for g in user_defined_grads]
+        else:
+            main_f, _, feed_f = self._build()
+            exe_f = fluid.Executor(fluid.CPUPlace(), mode="jit")
+            numeric = []
+            for name in inputs_to_check:
+                base = feed_f[name]
+                if isinstance(base, tuple):
+                    arr = np.asarray(base[0]).copy()
+                    lod = base[1]
+                    rebuild = lambda a: (a, lod)
+                else:
+                    arr = np.asarray(base).copy()
+                    rebuild = lambda a: a
+                grad = np.zeros_like(arr, dtype=np.float64)
+                flat = arr.reshape(-1)
+                for i in range(flat.size):
+                    orig = flat[i]
+                    flat[i] = orig + numeric_grad_delta
+                    feed_f[name] = rebuild(arr)
+                    lp = self._forward_loss(exe_f, main_f, feed_f, output_names)
+                    flat[i] = orig - numeric_grad_delta
+                    feed_f[name] = rebuild(arr)
+                    lm = self._forward_loss(exe_f, main_f, feed_f, output_names)
+                    flat[i] = orig
+                    grad.reshape(-1)[i] = (lp - lm) / (2 * numeric_grad_delta)
+                feed_f[name] = rebuild(arr)
+                numeric.append(grad)
+
+        # ---- compare (reference op_test.py __assert_is_close) ----
+        for name, a, n in zip(inputs_to_check, analytic, numeric):
+            a = np.asarray(a, dtype=np.float64).reshape(n.shape)
+            abs_a = np.maximum(np.abs(a), 1e-3)
+            diff = np.abs(a - n) / abs_a
+            max_diff = diff.max() if diff.size else 0.0
+            assert max_diff <= max_relative_error, (
+                f"gradient mismatch for input {name}: max relative error "
+                f"{max_diff:.6f} > {max_relative_error} "
+                f"(analytic={a.reshape(-1)[:5]}, numeric={n.reshape(-1)[:5]})")

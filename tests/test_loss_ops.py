@@ -1,0 +1,146 @@
+"""Loss/softmax op tests (reference test_softmax_op.py,
+test_cross_entropy_op.py, test_softmax_with_cross_entropy_op.py,
+test_sigmoid_cross_entropy_with_logits_op.py)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def np_softmax(x):
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def setup(self):
+        x = np.random.uniform(0.1, 1, (5, 7)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": np_softmax(x)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestCrossEntropy(OpTest):
+    op_type = "cross_entropy"
+
+    def setup(self):
+        batch, classes = 6, 9
+        x = np_softmax(np.random.uniform(0.1, 1, (batch, classes))
+                       .astype("float32"))
+        label = np.random.randint(0, classes, (batch, 1)).astype("int64")
+        y = -np.log(x[np.arange(batch), label.flatten()]).reshape(batch, 1)
+        self.inputs = {"X": x, "Label": label}
+        self.attrs = {}
+        self.outputs = {"Y": y}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+
+class TestCrossEntropySoft(OpTest):
+    op_type = "cross_entropy"
+
+    def setup(self):
+        batch, classes = 5, 7
+        x = np_softmax(np.random.uniform(0.1, 1, (batch, classes))
+                       .astype("float32"))
+        label = np.random.uniform(0.1, 1, (batch, classes)).astype("float32")
+        label /= label.sum(axis=1, keepdims=True)
+        y = (-label * np.log(x)).sum(axis=1, keepdims=True)
+        self.inputs = {"X": x, "Label": label}
+        self.attrs = {"soft_label": True}
+        self.outputs = {"Y": y}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def setup(self):
+        batch, classes = 6, 10
+        logits = np.random.uniform(0.1, 1, (batch, classes)).astype("float32")
+        sm = np_softmax(logits)
+        label = np.random.randint(0, classes, (batch, 1)).astype("int64")
+        loss = -np.log(sm[np.arange(batch), label.flatten()]).reshape(batch, 1)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.attrs = {}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["Logits"], "Loss", max_relative_error=0.02)
+
+
+class TestSigmoidCrossEntropyWithLogits(OpTest):
+    op_type = "sigmoid_cross_entropy_with_logits"
+
+    def setup(self):
+        x = np.random.uniform(-2, 2, (5, 8)).astype("float32")
+        label = np.random.randint(0, 2, (5, 8)).astype("float32")
+        out = np.maximum(x, 0) - x * label + np.log(1 + np.exp(-np.abs(x)))
+        self.inputs = {"X": x, "Label": label}
+        self.attrs = {}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestMean(OpTest):
+    op_type = "mean"
+
+    def setup(self):
+        x = np.random.random((7, 9)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": np.mean(x)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out")
+
+
+class TestHuberLoss(OpTest):
+    op_type = "huber_loss"
+
+    def setup(self):
+        x = np.random.uniform(0, 1, (6, 1)).astype("float32")
+        y = np.random.uniform(0, 1, (6, 1)).astype("float32")
+        delta = 0.5
+        r = y - x
+        loss = np.where(np.abs(r) <= delta, 0.5 * r * r,
+                        delta * (np.abs(r) - 0.5 * delta))
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"delta": delta}
+        self.outputs = {"Residual": r, "Out": loss}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()

@@ -1,0 +1,73 @@
+"""mul/matmul op tests (reference test_mul_op.py / test_matmul_op.py)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def setup(self):
+        x = np.random.random((8, 5)).astype("float32")
+        y = np.random.random((5, 7)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": np.dot(x, y)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+class TestMulFlatten(OpTest):
+    op_type = "mul"
+
+    def setup(self):
+        x = np.random.random((3, 4, 2, 5)).astype("float32")
+        y = np.random.random((2, 5, 6)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 2, "y_num_col_dims": 2}
+        out = np.dot(x.reshape(12, 10), y.reshape(10, 6)).reshape(3, 4, 6)
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+@pytest.mark.parametrize("tx,ty", [(False, False), (True, False),
+                                   (False, True), (True, True)])
+def test_matmul_2d(tx, ty):
+    t = OpTest()
+    t.op_type = "matmul"
+    x = np.random.random((4, 5) if not tx else (5, 4)).astype("float32")
+    y = np.random.random((5, 6) if not ty else (6, 5)).astype("float32")
+    t.inputs = {"X": x, "Y": y}
+    t.attrs = {"transpose_X": tx, "transpose_Y": ty}
+    xe = x.T if tx else x
+    ye = y.T if ty else y
+    t.outputs = {"Out": np.matmul(xe, ye)}
+    t.check_output()
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+def test_matmul_batched():
+    t = OpTest()
+    t.op_type = "matmul"
+    x = np.random.random((3, 4, 5)).astype("float32")
+    y = np.random.random((3, 5, 6)).astype("float32")
+    t.inputs = {"X": x, "Y": y}
+    t.attrs = {}
+    t.outputs = {"Out": np.matmul(x, y)}
+    t.check_output()
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.01)

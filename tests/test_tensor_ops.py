@@ -1,0 +1,296 @@
+"""Tensor/reduce/optimizer op tests (reference test_concat_op.py,
+test_reduce_op.py, test_sgd_op.py, test_adam_op.py, ...)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestConcat(OpTest):
+    op_type = "concat"
+
+    def setup(self):
+        x0 = np.random.random((2, 3, 4)).astype("float32")
+        x1 = np.random.random((2, 5, 4)).astype("float32")
+        self.inputs = {"X": [("x0", x0), ("x1", x1)]}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate([x0, x1], axis=1)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["x0", "x1"], "Out")
+
+
+class TestSum(OpTest):
+    op_type = "sum"
+
+    def setup(self):
+        xs = [np.random.random((3, 4)).astype("float32") for _ in range(3)]
+        self.inputs = {"X": [(f"x{i}", x) for i, x in enumerate(xs)]}
+        self.attrs = {}
+        self.outputs = {"Out": xs[0] + xs[1] + xs[2]}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["x0", "x1", "x2"], "Out")
+
+
+class TestReduceSum(OpTest):
+    op_type = "reduce_sum"
+
+    def setup(self):
+        x = np.random.random((5, 6, 7)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": 1}
+        self.outputs = {"Out": x.sum(axis=1)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceMeanKeepdim(OpTest):
+    op_type = "reduce_mean"
+
+    def setup(self):
+        x = np.random.random((4, 5)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": -1, "keep_dim": True}
+        self.outputs = {"Out": x.mean(axis=-1, keepdims=True)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceMax(OpTest):
+    op_type = "reduce_max"
+
+    def setup(self):
+        x = np.random.random((5, 6)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": 0}
+        self.outputs = {"Out": x.max(axis=0)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+
+class TestReshape(OpTest):
+    op_type = "reshape"
+
+    def setup(self):
+        x = np.random.random((2, 3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [6, 4]}
+        self.outputs = {"Out": x.reshape(6, 4)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out")
+
+
+class TestTranspose(OpTest):
+    op_type = "transpose"
+
+    def setup(self):
+        x = np.random.random((2, 3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [1, 2, 0]}
+        self.outputs = {"Out": x.transpose(1, 2, 0)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out")
+
+
+class TestScale(OpTest):
+    op_type = "scale"
+
+    def setup(self):
+        x = np.random.random((4, 5)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5}
+        self.outputs = {"Out": x * 2.5}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out")
+
+
+class TestCast(OpTest):
+    op_type = "cast"
+
+    def setup(self):
+        x = np.random.random((3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dtype": "float64", "in_dtype": "float32"}
+        self.outputs = {"Out": x.astype("float64")}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+
+class TestClip(OpTest):
+    op_type = "clip"
+
+    def setup(self):
+        x = np.random.uniform(-2, 2, (4, 5)).astype("float32")
+        # keep away from clip boundaries for finite differences
+        x[np.abs(x - 1.0) < 0.05] = 1.2
+        x[np.abs(x + 1.0) < 0.05] = -1.2
+        self.inputs = {"X": x}
+        self.attrs = {"min": -1.0, "max": 1.0}
+        self.outputs = {"Out": np.clip(x, -1.0, 1.0)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out")
+
+
+class TestGather(OpTest):
+    op_type = "gather"
+
+    def setup(self):
+        x = np.random.random((10, 20)).astype("float32")
+        idx = np.array([1, 3, 5], dtype="int64")
+        self.inputs = {"X": x, "Index": idx}
+        self.attrs = {}
+        self.outputs = {"Out": x[idx]}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+
+class TestLookupTable(OpTest):
+    op_type = "lookup_table"
+
+    def setup(self):
+        w = np.random.random((17, 31)).astype("float32")
+        ids = np.random.randint(0, 17, (4, 1)).astype("int64")
+        self.inputs = {"W": w, "Ids": ids}
+        self.attrs = {}
+        self.outputs = {"Out": w[ids.flatten()]}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["W"], "Out")
+
+
+class TestTopK(OpTest):
+    op_type = "top_k"
+
+    def setup(self):
+        x = np.random.random((5, 10)).astype("float32")
+        k = 3
+        idx = np.argsort(-x, axis=1)[:, :k]
+        vals = np.take_along_axis(x, idx, axis=1)
+        self.inputs = {"X": x}
+        self.attrs = {"k": k}
+        self.outputs = {"Out": vals, "Indices": idx.astype("int64")}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+
+class TestSGDOp(OpTest):
+    op_type = "sgd"
+
+    def setup(self):
+        p = np.random.random((10, 5)).astype("float32")
+        g = np.random.random((10, 5)).astype("float32")
+        lr = np.array([0.1]).astype("float32")
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+        self.attrs = {}
+        self.outputs = {"ParamOut": p - 0.1 * g}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+
+class TestAdamOp(OpTest):
+    op_type = "adam"
+
+    def setup(self):
+        p = np.random.random((6, 4)).astype("float32")
+        g = np.random.random((6, 4)).astype("float32")
+        m1 = np.random.random((6, 4)).astype("float32")
+        m2 = np.random.random((6, 4)).astype("float32")
+        lr = np.array([0.01]).astype("float32")
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        b1p = np.array([b1 ** 3]).astype("float32")
+        b2p = np.array([b2 ** 3]).astype("float32")
+        m1n = b1 * m1 + (1 - b1) * g
+        m2n = b2 * m2 + (1 - b2) * g * g
+        lr_t = 0.01 * np.sqrt(1 - b2p) / (1 - b1p)
+        pn = p - lr_t * m1n / (np.sqrt(m2n) + eps)
+        self.inputs = {"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+                       "Beta1Pow": b1p, "Beta2Pow": b2p, "LearningRate": lr}
+        self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps}
+        self.outputs = {"ParamOut": pn, "Moment1Out": m1n, "Moment2Out": m2n}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+
+class TestMomentumOp(OpTest):
+    op_type = "momentum"
+
+    def setup(self):
+        p = np.random.random((8, 3)).astype("float32")
+        g = np.random.random((8, 3)).astype("float32")
+        v = np.random.random((8, 3)).astype("float32")
+        lr = np.array([0.1]).astype("float32")
+        mu = 0.9
+        vn = mu * v + g
+        pn = p - 0.1 * vn
+        self.inputs = {"Param": p, "Grad": g, "Velocity": v,
+                       "LearningRate": lr}
+        self.attrs = {"mu": mu}
+        self.outputs = {"ParamOut": pn, "VelocityOut": vn}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
