@@ -257,8 +257,13 @@ class Executor:
                                 tuple(state_out))
             # non-traceable state (readers, rank tables) can't cross jit
             trace_state = {k: v for k, v in state.items() if _is_traceable(v)}
-            with jax.default_device(self.device):
-                new_state, fetches = fn(trace_state, feed_vals)
+            if self.place is not None:
+                # explicit place: commit state so jit follows the operands.
+                # (NEVER wrap dispatch in jax.default_device — on the tunneled
+                # TPU backend that context makes every dispatch ~30x slower.)
+                trace_state = {k: jax.device_put(v, self.device)
+                               for k, v in trace_state.items()}
+            new_state, fetches = fn(trace_state, feed_vals)
 
         for n, v in new_state.items():
             scope.set(n, v)
@@ -279,6 +284,11 @@ class Executor:
             env.update(feeds)
             _run_ops(block, env, self)
             new_state = {n: env[n] for n in state_out if n in env}
+            # pass unwritten state through so that, under buffer donation,
+            # the scope never retains a donated (deleted) input buffer
+            for n in state:
+                if n not in new_state:
+                    new_state[n] = env[n]
             new_state[_RNG_KEY] = env[_RNG_KEY]
             fetches = [env[n] for n in fetch_names]
             return new_state, fetches
@@ -291,26 +301,40 @@ class Executor:
     # ------------------------------------------------------------------
     def _prepare_feed(self, block, feed):
         out = {}
+
+        def place_lod(v):
+            return jax.device_put(v, self.device) if self.place is not None \
+                else v
+
         for name, value in feed.items():
-            if isinstance(value, LoDArray):
+            if isinstance(value, jax.Array):
+                # already device-resident (pre-staged / double-buffered feed):
+                # never round-trip through the host
                 out[name] = value
+                continue
+            if isinstance(value, LoDArray):
+                out[name] = place_lod(value)
                 continue
             if isinstance(value, tuple) and len(value) == 2 and not np.isscalar(value[0]):
                 # reference feed form: (flat ndarray, lod offsets)
-                out[name] = flat_to_lodarray(value[0], value[1])
+                out[name] = place_lod(flat_to_lodarray(value[0], value[1]))
                 continue
             if isinstance(value, list) and value and isinstance(
                     value[0], (np.ndarray, list)):
                 v = block.var(name) if block.has_var(name) else None
                 if v is not None and v.lod_level > 0:
-                    out[name] = pack_sequences([np.asarray(s) for s in value])
+                    out[name] = place_lod(
+                        pack_sequences([np.asarray(s) for s in value]))
                     continue
             arr = np.asarray(value)
             if block.has_var(name):
                 v = block.var(name)
                 if v.dtype is not None and arr.dtype != np_dtype(v.dtype):
                     arr = arr.astype(np_dtype(v.dtype))
-            out[name] = jnp.asarray(arr)
+            if self.place is not None:
+                out[name] = jax.device_put(arr, self.device)
+            else:
+                out[name] = jnp.asarray(arr)
         return out
 
     @staticmethod

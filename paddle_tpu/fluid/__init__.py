@@ -15,6 +15,7 @@ from ..core.lod import LoDArray, pack_sequences, flat_to_lodarray, \
 from .. import ops as _ops  # registers all op lowerings
 
 from . import layers
+from . import nets
 from . import optimizer
 from . import initializer
 from . import regularizer
@@ -32,6 +33,6 @@ __all__ = [
     "Program", "Block", "Operator", "Variable", "Parameter", "program_guard",
     "default_main_program", "default_startup_program", "Executor", "CPUPlace",
     "TPUPlace", "CUDAPlace", "Scope", "global_scope", "layers", "optimizer",
-    "initializer", "regularizer", "backward", "io", "append_backward",
+    "initializer", "regularizer", "backward", "io", "nets", "append_backward",
     "ParamAttr", "DataFeeder", "LoDArray",
 ]

@@ -5,16 +5,23 @@ nn, io, tensor, control_flow, ops, device, detection, metric modules into one
 flat namespace.
 """
 
-from . import nn, tensor, io, ops
+from . import nn, tensor, io, ops, sequence
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .io import data  # noqa: F401
 from .ops import *  # noqa: F401,F403
+from .sequence import (dynamic_lstm, dynamic_gru, sequence_conv,  # noqa: F401
+                       sequence_pool, sequence_first_step,
+                       sequence_last_step, sequence_softmax, sequence_expand,
+                       sequence_reshape, sequence_concat, sequence_slice,
+                       lod_reset, row_conv, lstm_unit, gru_unit)
 
 from .nn import (fc, embedding, dropout, softmax, cross_entropy,  # noqa: F401
                  softmax_with_cross_entropy, square_error_cost, mean,
                  accuracy, topk, mul, matmul, elementwise_add,
-                 elementwise_sub, elementwise_mul, elementwise_div)
+                 elementwise_sub, elementwise_mul, elementwise_div,
+                 conv2d, conv2d_transpose, pool2d, batch_norm, layer_norm,
+                 lrn)
 from .tensor import (cast, concat, sums, assign, fill_constant,  # noqa: F401
                      fill_constant_batch_size_like, ones, zeros, reshape,
                      transpose, split, argmax, create_tensor)

@@ -268,6 +268,176 @@ def mul(x, y, x_num_col_dims=1, y_num_col_dims=1):
     return out
 
 
+def _pair(v):
+    return list(v) if isinstance(v, (list, tuple)) else [int(v), int(v)]
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    """Conv layer (reference nn.py conv2d → conv2d op, NCHW/MCHW). The
+    use_cudnn flag is accepted for source compatibility and ignored — there is
+    one XLA lowering."""
+    helper = LayerHelper("conv2d", name=name, act=act, bias_attr=bias_attr)
+    c_in = input.shape[1]
+    groups = groups or 1
+    fs = _pair(filter_size)
+    w = helper.create_parameter(
+        param_attr, shape=(num_filters, c_in // groups, fs[0], fs[1]),
+        dtype=input.dtype,
+        default_initializer=Normal(0.0, (2.0 / (fs[0] * fs[1] * c_in)) ** 0.5))
+    attrs = {"strides": _pair(stride), "paddings": _pair(padding),
+             "dilations": _pair(dilation), "groups": groups}
+    pre_bias = helper.create_tmp_variable(input.dtype)
+    helper.append_op("conv2d",
+                     inputs={"Input": [input.name], "Filter": [w.name]},
+                     outputs={"Output": [pre_bias.name]}, attrs=attrs)
+    pre_act = _append_channel_bias(helper, pre_bias, num_filters, bias_attr)
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, param_attr=None,
+                     bias_attr=None, use_cudnn=True, act=None, name=None):
+    """reference nn.py conv2d_transpose → conv2d_transpose op; filter layout
+    [C_in, num_filters, kh, kw] (conv_transpose_op.cc)."""
+    helper = LayerHelper("conv2d_transpose", name=name, act=act,
+                         bias_attr=bias_attr)
+    c_in = input.shape[1]
+    stride, padding, dilation = _pair(stride), _pair(padding), _pair(dilation)
+    if filter_size is None:
+        # derive from requested output size (reference nn.py:…)
+        h, w_ = input.shape[2], input.shape[3]
+        oh, ow = _pair(output_size)
+        filter_size = [oh - (h - 1) * stride[0] + 2 * padding[0],
+                       ow - (w_ - 1) * stride[1] + 2 * padding[1]]
+    fs = _pair(filter_size)
+    w = helper.create_parameter(param_attr,
+                                shape=(c_in, num_filters, fs[0], fs[1]),
+                                dtype=input.dtype)
+    pre_bias = helper.create_tmp_variable(input.dtype)
+    helper.append_op("conv2d_transpose",
+                     inputs={"Input": [input.name], "Filter": [w.name]},
+                     outputs={"Output": [pre_bias.name]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation})
+    pre_act = _append_channel_bias(helper, pre_bias, num_filters, bias_attr)
+    return helper.append_activation(pre_act)
+
+
+def _append_channel_bias(helper, pre_bias, num_channels, bias_attr):
+    """Per-output-channel bias broadcast along dim 1 (the reference conv
+    layers' append_bias_op(dim_start=1, dim_end=2))."""
+    if bias_attr is False:
+        return pre_bias
+    b = helper.create_parameter(ParamAttr.to_attr(bias_attr),
+                                shape=(num_channels,),
+                                dtype=pre_bias.dtype, is_bias=True)
+    out = helper.create_tmp_variable(pre_bias.dtype, shape=pre_bias.shape)
+    helper.append_op("elementwise_add",
+                     inputs={"X": [pre_bias.name], "Y": [b.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": 1})
+    return out
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, use_cudnn=True, ceil_mode=False, name=None):
+    if pool_type not in ("max", "avg"):
+        raise ValueError(f"pool_type must be max|avg, got {pool_type!r}")
+    if not global_pooling and (pool_size == -1 or pool_size is None):
+        raise ValueError(
+            "pool_size must be set when global_pooling is False")
+    helper = LayerHelper("pool2d", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("pool2d", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"pooling_type": pool_type,
+                            "ksize": _pair(pool_size),
+                            "strides": _pair(pool_stride),
+                            "paddings": _pair(pool_padding),
+                            "global_pooling": global_pooling,
+                            "ceil_mode": ceil_mode})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, moving_mean_name=None, moving_variance_name=None):
+    """reference nn.py batch_norm → batch_norm op. Running mean/variance are
+    non-trainable parameters so they checkpoint with the model; MeanOut /
+    VarianceOut write back in place (batch_norm_op.cc reuses the Mean /
+    Variance vars) which under the compiling executor is a state rebind."""
+    helper = LayerHelper("batch_norm", name=name, act=act)
+    c = input.shape[-1] if data_layout == "NHWC" else input.shape[1]
+
+    scale = helper.create_parameter(ParamAttr.to_attr(param_attr), shape=(c,),
+                                    dtype=input.dtype,
+                                    default_initializer=Constant(1.0))
+    bias = helper.create_parameter(ParamAttr.to_attr(bias_attr), shape=(c,),
+                                   dtype=input.dtype, is_bias=True)
+    mean = helper.create_parameter(
+        ParamAttr(name=moving_mean_name, trainable=False), shape=(c,),
+        dtype=input.dtype, default_initializer=Constant(0.0))
+    variance = helper.create_parameter(
+        ParamAttr(name=moving_variance_name, trainable=False), shape=(c,),
+        dtype=input.dtype, default_initializer=Constant(1.0))
+
+    saved_mean = helper.create_tmp_variable(input.dtype, shape=(c,),
+                                            stop_gradient=True)
+    saved_var = helper.create_tmp_variable(input.dtype, shape=(c,),
+                                           stop_gradient=True)
+    out = helper.create_tmp_variable(input.dtype, shape=input.shape)
+    helper.append_op("batch_norm",
+                     inputs={"X": [input.name], "Scale": [scale.name],
+                             "Bias": [bias.name], "Mean": [mean.name],
+                             "Variance": [variance.name]},
+                     outputs={"Y": [out.name], "MeanOut": [mean.name],
+                              "VarianceOut": [variance.name],
+                              "SavedMean": [saved_mean.name],
+                              "SavedVariance": [saved_var.name]},
+                     attrs={"momentum": momentum, "epsilon": epsilon,
+                            "is_test": is_test, "data_layout": data_layout})
+    return helper.append_activation(out)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
+               param_attr=None, bias_attr=None, act=None, name=None):
+    """reference nn.py layer_norm → layer_norm op."""
+    helper = LayerHelper("layer_norm", name=name, act=act)
+    norm_dim = int(np.prod(input.shape[begin_norm_axis:]))
+    inputs = {"X": [input.name]}
+    if scale:
+        s = helper.create_parameter(ParamAttr.to_attr(param_attr),
+                                    shape=(norm_dim,), dtype=input.dtype,
+                                    default_initializer=Constant(1.0))
+        inputs["Scale"] = [s.name]
+    if shift:
+        b = helper.create_parameter(ParamAttr.to_attr(bias_attr),
+                                    shape=(norm_dim,), dtype=input.dtype,
+                                    is_bias=True)
+        inputs["Bias"] = [b.name]
+    mean = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    var = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    out = helper.create_tmp_variable(input.dtype, shape=input.shape)
+    helper.append_op("layer_norm", inputs=inputs,
+                     outputs={"Y": [out.name], "Mean": [mean.name],
+                              "Variance": [var.name]},
+                     attrs={"begin_norm_axis": begin_norm_axis,
+                            "epsilon": epsilon})
+    return helper.append_activation(out)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_tmp_variable(input.dtype, shape=input.shape)
+    mid = helper.create_tmp_variable(input.dtype, shape=input.shape,
+                                     stop_gradient=True)
+    helper.append_op("lrn", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name], "MidOut": [mid.name]},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     helper = LayerHelper("matmul", name=name)
     xs = list(x.shape)

@@ -1,0 +1,222 @@
+"""Sequence & recurrent layer functions.
+
+Reference: /root/reference/python/paddle/fluid/layers/nn.py — dynamic_lstm,
+dynamic_gru, sequence_conv, sequence_pool (+first/last step), sequence_expand,
+sequence_softmax, sequence_reshape, sequence_concat, row_conv, lod_reset,
+lstm_unit (:~), gru_unit. Same calling conventions; ops lower to masked
+computations over padded LoDArrays (ops/sequence_ops.py, ops/rnn_ops.py).
+"""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+
+def dynamic_lstm(input, size, param_attr=None, bias_attr=None,
+                 use_peepholes=False, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """``input`` is the projected gate pre-activation [*, 4*hidden] (apply an
+    fc of width 4*hidden first, like the reference); ``size`` = 4*hidden."""
+    if use_peepholes:
+        raise NotImplementedError("use_peepholes=True is not lowered yet")
+    helper = LayerHelper("lstm", name=name)
+    hidden = size // 4
+    weight = helper.create_parameter(param_attr, shape=(hidden, 4 * hidden),
+                                     dtype=dtype)
+    bias = helper.create_parameter(ParamAttr.to_attr(bias_attr),
+                                   shape=(1, 4 * hidden), dtype=dtype,
+                                   is_bias=True)
+    hidden_out = helper.create_tmp_variable(dtype, lod_level=input.lod_level)
+    cell_out = helper.create_tmp_variable(dtype, lod_level=input.lod_level)
+    helper.append_op(
+        "lstm",
+        inputs={"Input": [input.name], "Weight": [weight.name],
+                "Bias": [bias.name]},
+        outputs={"Hidden": [hidden_out.name], "Cell": [cell_out.name]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation})
+    return hidden_out, cell_out
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, dtype="float32"):
+    """``input`` is the projected [*, 3*size] pre-activation; ``size`` =
+    hidden width (reference nn.py dynamic_gru)."""
+    helper = LayerHelper("gru")
+    weight = helper.create_parameter(param_attr, shape=(size, 3 * size),
+                                     dtype=dtype)
+    bias = helper.create_parameter(ParamAttr.to_attr(bias_attr),
+                                   shape=(1, 3 * size), dtype=dtype,
+                                   is_bias=True)
+    hidden = helper.create_tmp_variable(dtype, lod_level=input.lod_level)
+    inputs = {"Input": [input.name], "Weight": [weight.name],
+              "Bias": [bias.name]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0.name]
+    helper.append_op(
+        "gru", inputs=inputs, outputs={"Hidden": [hidden.name]},
+        attrs={"is_reverse": is_reverse, "gate_activation": gate_activation,
+               "activation": candidate_activation})
+    return hidden
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None):
+    helper = LayerHelper("sequence_conv", act=act, bias_attr=bias_attr)
+    filter_shape = (filter_size * input.shape[-1], num_filters)
+    filter_param = helper.create_parameter(param_attr, shape=filter_shape,
+                                           dtype=input.dtype)
+    pre_bias = helper.create_tmp_variable(input.dtype,
+                                          lod_level=input.lod_level)
+    helper.append_op(
+        "sequence_conv",
+        inputs={"X": [input.name], "Filter": [filter_param.name]},
+        outputs={"Out": [pre_bias.name]},
+        attrs={"contextStride": filter_stride,
+               "contextStart": -int(filter_size // 2),
+               "contextLength": filter_size})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1)
+    return helper.append_activation(pre_act)
+
+
+def sequence_pool(input, pool_type):
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_tmp_variable(input.dtype, lod_level=0)
+    helper.append_op("sequence_pool", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"pooltype": pool_type.upper()})
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_softmax(input, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    out = helper.create_tmp_variable(input.dtype, lod_level=input.lod_level)
+    helper.append_op("sequence_softmax", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def sequence_expand(x, y, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_tmp_variable(x.dtype, lod_level=1)
+    helper.append_op("sequence_expand",
+                     inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper("sequence_reshape")
+    out = helper.create_tmp_variable(input.dtype, lod_level=1)
+    helper.append_op("sequence_reshape", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"new_dim": new_dim})
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat", name=name)
+    out = helper.create_tmp_variable(input[0].dtype, lod_level=1)
+    helper.append_op("sequence_concat",
+                     inputs={"X": [v.name for v in input]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_tmp_variable(input.dtype, lod_level=1)
+    helper.append_op("sequence_slice",
+                     inputs={"X": [input.name], "Offset": [offset.name],
+                             "Length": [length.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def lod_reset(x, y=None, target_lod=None):
+    helper = LayerHelper("lod_reset")
+    out = helper.create_tmp_variable(x.dtype, lod_level=1)
+    inputs = {"X": [x.name]}
+    attrs = {}
+    if y is not None:
+        inputs["Y"] = [y.name]
+    elif target_lod is not None:
+        attrs["target_lod"] = list(target_lod)
+    else:
+        raise ValueError("lod_reset: provide y or target_lod")
+    helper.append_op("lod_reset", inputs=inputs,
+                     outputs={"Out": [out.name]}, attrs=attrs)
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", act=act)
+    filter_shape = (future_context_size + 1, input.shape[-1])
+    filter_param = helper.create_parameter(param_attr, shape=filter_shape,
+                                           dtype=input.dtype)
+    out = helper.create_tmp_variable(input.dtype, lod_level=input.lod_level)
+    helper.append_op("row_conv",
+                     inputs={"X": [input.name],
+                             "Filter": [filter_param.name]},
+                     outputs={"Out": [out.name]})
+    return helper.append_activation(out)
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """One LSTM step from dense inputs (reference nn.py lstm_unit): fc over
+    [x_t, h_prev] to 4H gates, then the fused lstm_unit op."""
+    from . import nn, tensor
+    helper = LayerHelper("lstm_unit", name=name)
+    size = cell_t_prev.shape[-1] * 4
+    concat_out = tensor.concat([x_t, hidden_t_prev], axis=1)
+    fc_out = nn.fc(concat_out, size=size, param_attr=param_attr,
+                   bias_attr=bias_attr)
+    c = helper.create_tmp_variable(x_t.dtype)
+    h = helper.create_tmp_variable(x_t.dtype)
+    helper.append_op("lstm_unit",
+                     inputs={"X": [fc_out.name],
+                             "C_prev": [cell_t_prev.name]},
+                     outputs={"C": [c.name], "H": [h.name]},
+                     attrs={"forget_bias": forget_bias})
+    return h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid"):
+    """One GRU step: ``input`` is [b, 3*H] projected, ``hidden`` [b, H];
+    ``size`` = 3*hidden like the reference gru_unit layer."""
+    helper = LayerHelper("gru_unit")
+    hidden_dim = size // 3
+    weight = helper.create_parameter(param_attr,
+                                     shape=(hidden_dim, 3 * hidden_dim),
+                                     dtype=input.dtype)
+    bias = helper.create_parameter(ParamAttr.to_attr(bias_attr),
+                                   shape=(1, 3 * hidden_dim),
+                                   dtype=input.dtype, is_bias=True)
+    gate = helper.create_tmp_variable(input.dtype)
+    reset_hidden_pre = helper.create_tmp_variable(input.dtype)
+    updated_hidden = helper.create_tmp_variable(input.dtype)
+    helper.append_op(
+        "gru_unit",
+        inputs={"Input": [input.name], "HiddenPrev": [hidden.name],
+                "Weight": [weight.name], "Bias": [bias.name]},
+        outputs={"Gate": [gate.name],
+                 "ResetHiddenPrev": [reset_hidden_pre.name],
+                 "Hidden": [updated_hidden.name]},
+        attrs={"activation": activation,
+               "gate_activation": gate_activation})
+    return updated_hidden, reset_hidden_pre, gate

@@ -49,6 +49,18 @@ def sums(input, out=None):
     return out
 
 
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """out = scale*x + bias (reference scale_op.cc)."""
+    helper = LayerHelper("scale", name=name, act=act)
+    out = helper.create_tmp_variable(x.dtype, shape=x.shape,
+                                     lod_level=x.lod_level)
+    helper.append_op("scale", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"scale": float(scale), "bias": float(bias),
+                            "bias_after_scale": bias_after_scale})
+    return helper.append_activation(out)
+
+
 def assign(input, output=None):
     helper = LayerHelper("assign")
     if output is None:

@@ -14,6 +14,10 @@ from . import (  # noqa: F401
     reduce,
     loss,
     nn_ops,
+    conv_ops,
+    norm_ops,
+    sequence_ops,
+    rnn_ops,
     optimizer_ops,
     metrics,
 )

@@ -1,0 +1,292 @@
+"""Convolution / pooling ops.
+
+Reference semantics: /root/reference/paddle/fluid/operators/conv_op.cc
+(conv2d, depthwise_conv2d; NCHW input, MCHW filter, strides/paddings/
+dilations/groups attrs), conv_transpose_op.cc (filter layout [C_in, C_out,
+kh, kw], output size (H-1)*stride - 2*pad + kh), pool_op.cc (max/avg,
+global_pooling, ceil_mode; avg divides by the window clipped to the input —
+see paddle/fluid/operators/math/pooling.cc Compute loops).
+
+TPU-native design: a conv is ONE ``lax.conv_general_dilated`` — the MXU path —
+instead of the reference's im2col+gemm CPU kernel (operators/math/im2col.cc)
+and cuDNN dispatch (conv_cudnn_op.cu.cc). Gradients are obtained by
+``jax.vjp`` over the same lowering: XLA synthesizes the transposed-conv
+backward kernels the reference hand-registered as conv2d_grad, and fuses them
+into the step computation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op, OpSpec, infer_output
+from .common import G, data_of
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return (int(v[0]), int(v[1] if len(v) > 1 else v[0]))
+    return (int(v), int(v))
+
+
+def _conv2d_compute(x, w, strides, paddings, dilations, groups):
+    return lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+def _conv_attrs(ctx_or_op, attr):
+    strides = _pair(attr("strides", [1, 1]))
+    paddings = _pair(attr("paddings", [0, 0]))
+    dilations = _pair(attr("dilations", [1, 1]))
+    groups = int(attr("groups", 1) or 1)
+    return strides, paddings, dilations, groups
+
+
+def _conv_out_size(h, k, pad, stride, dilation=1):
+    return (h + 2 * pad - (dilation * (k - 1) + 1)) // stride + 1
+
+
+def _conv2d_infer(op, block):
+    x = block.var(op.input("Input")[0])
+    w = block.var(op.input("Filter")[0])
+    if x.shape is None or w.shape is None:
+        return
+    s = _pair(op.attrs.get("strides", [1, 1]))
+    p = _pair(op.attrs.get("paddings", [0, 0]))
+    d = _pair(op.attrs.get("dilations", [1, 1]))
+    n, _, h, wd = x.shape
+    m, _, kh, kw = w.shape
+    infer_output(op, block, "Output",
+                 (n, m, _conv_out_size(h, kh, p[0], s[0], d[0]),
+                  _conv_out_size(wd, kw, p[1], s[1], d[1])),
+                 dtype=x.dtype)
+
+
+def _conv2d_grad_maker(op):
+    return [OpSpec("conv2d_grad",
+                   {"Input": op.input("Input"), "Filter": op.input("Filter"),
+                    "Output@GRAD": G(op.output("Output"))},
+                   {"Input@GRAD": G(op.input("Input")),
+                    "Filter@GRAD": G(op.input("Filter"))},
+                   dict(op.attrs))]
+
+
+@register_op("conv2d", infer_shape=_conv2d_infer, grad=_conv2d_grad_maker)
+def conv2d(ctx):
+    x = data_of(ctx.input("Input"))
+    w = data_of(ctx.input("Filter"))
+    strides, paddings, dilations, groups = _conv_attrs(ctx, ctx.attr)
+    ctx.set_output("Output",
+                   _conv2d_compute(x, w, strides, paddings, dilations, groups))
+
+
+@register_op("conv2d_grad")
+def conv2d_grad(ctx):
+    x = data_of(ctx.input("Input"))
+    w = data_of(ctx.input("Filter"))
+    dy = data_of(ctx.input("Output@GRAD"))
+    strides, paddings, dilations, groups = _conv_attrs(ctx, ctx.attr)
+    _, vjp = jax.vjp(
+        lambda a, b: _conv2d_compute(a, b, strides, paddings, dilations,
+                                     groups), x, w)
+    dx, dw = vjp(dy)
+    ctx.set_output("Input@GRAD", dx)
+    ctx.set_output("Filter@GRAD", dw)
+
+
+def _depthwise_grad_maker(op):
+    spec = _conv2d_grad_maker(op)[0]
+    spec.type = "depthwise_conv2d_grad"
+    return [spec]
+
+
+@register_op("depthwise_conv2d", infer_shape=_conv2d_infer,
+             grad=_depthwise_grad_maker)
+def depthwise_conv2d(ctx):
+    """Reference conv_op.cc registers depthwise_conv2d as conv2d with
+    groups == channels (depthwise_conv_op.cu special kernel); here the same
+    lax conv with feature_group_count covers it."""
+    x = data_of(ctx.input("Input"))
+    w = data_of(ctx.input("Filter"))
+    strides, paddings, dilations, _ = _conv_attrs(ctx, ctx.attr)
+    ctx.set_output("Output",
+                   _conv2d_compute(x, w, strides, paddings, dilations,
+                                   groups=x.shape[1]))
+
+
+@register_op("depthwise_conv2d_grad")
+def depthwise_conv2d_grad(ctx):
+    x = data_of(ctx.input("Input"))
+    w = data_of(ctx.input("Filter"))
+    dy = data_of(ctx.input("Output@GRAD"))
+    strides, paddings, dilations, _ = _conv_attrs(ctx, ctx.attr)
+    _, vjp = jax.vjp(
+        lambda a, b: _conv2d_compute(a, b, strides, paddings, dilations,
+                                     groups=x.shape[1]), x, w)
+    dx, dw = vjp(dy)
+    ctx.set_output("Input@GRAD", dx)
+    ctx.set_output("Filter@GRAD", dw)
+
+
+# ---------------------------------------------------------------------------
+# conv2d_transpose
+# ---------------------------------------------------------------------------
+
+def _conv2d_transpose_compute(x, w, strides, paddings, dilations):
+    # Exactly the gradient-of-conv2d wrt its input, which is
+    # conv_transpose_op.cc's definition (output = (H-1)*stride - 2*pad +
+    # dilated_kernel_extent): dilate the input by stride, swap the paddle
+    # [C_in, C_out, kh, kw] filter to OIHW and rotate it 180°, and pad by
+    # (kernel_extent - 1 - pad) so XLA sees a plain forward conv.
+    kh, kw = w.shape[2], w.shape[3]
+    ke_h = dilations[0] * (kh - 1) + 1
+    ke_w = dilations[1] * (kw - 1) + 1
+    w_t = jnp.flip(w.transpose(1, 0, 2, 3), axis=(2, 3))
+    return lax.conv_general_dilated(
+        x, w_t,
+        window_strides=(1, 1),
+        padding=[(ke_h - 1 - paddings[0],) * 2, (ke_w - 1 - paddings[1],) * 2],
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+def _conv2d_transpose_infer(op, block):
+    x = block.var(op.input("Input")[0])
+    w = block.var(op.input("Filter")[0])
+    if x.shape is None or w.shape is None:
+        return
+    s = _pair(op.attrs.get("strides", [1, 1]))
+    p = _pair(op.attrs.get("paddings", [0, 0]))
+    d = _pair(op.attrs.get("dilations", [1, 1]))
+    n, _, h, wd = x.shape
+    _, m, kh, kw = w.shape
+    ho = (h - 1) * s[0] - 2 * p[0] + (d[0] * (kh - 1) + 1)
+    wo = (wd - 1) * s[1] - 2 * p[1] + (d[1] * (kw - 1) + 1)
+    infer_output(op, block, "Output", (n, m, ho, wo), dtype=x.dtype)
+
+
+@register_op("conv2d_transpose", infer_shape=_conv2d_transpose_infer,
+             grad=lambda op: [OpSpec(
+                 "conv2d_transpose_grad",
+                 {"Input": op.input("Input"), "Filter": op.input("Filter"),
+                  "Output@GRAD": G(op.output("Output"))},
+                 {"Input@GRAD": G(op.input("Input")),
+                  "Filter@GRAD": G(op.input("Filter"))},
+                 dict(op.attrs))])
+def conv2d_transpose(ctx):
+    x = data_of(ctx.input("Input"))
+    w = data_of(ctx.input("Filter"))
+    strides, paddings, dilations, _ = _conv_attrs(ctx, ctx.attr)
+    ctx.set_output("Output",
+                   _conv2d_transpose_compute(x, w, strides, paddings,
+                                             dilations))
+
+
+@register_op("conv2d_transpose_grad")
+def conv2d_transpose_grad(ctx):
+    x = data_of(ctx.input("Input"))
+    w = data_of(ctx.input("Filter"))
+    dy = data_of(ctx.input("Output@GRAD"))
+    strides, paddings, dilations, _ = _conv_attrs(ctx, ctx.attr)
+    _, vjp = jax.vjp(
+        lambda a, b: _conv2d_transpose_compute(a, b, strides, paddings,
+                                               dilations), x, w)
+    dx, dw = vjp(dy)
+    ctx.set_output("Input@GRAD", dx)
+    ctx.set_output("Filter@GRAD", dw)
+
+
+# ---------------------------------------------------------------------------
+# pool2d
+# ---------------------------------------------------------------------------
+
+def _pool2d_compute(x, ksize, strides, paddings, pooling_type, global_pooling,
+                    ceil_mode, exclusive=True):
+    n, c, h, w = x.shape
+    if global_pooling:
+        ksize = (h, w)
+        paddings = (0, 0)
+    kh, kw = ksize
+    ph, pw = paddings
+    sh, sw = strides
+
+    def out_dim(size, k, p, s):
+        if ceil_mode:
+            return -((size - k + 2 * p) // -s) + 1
+        return (size - k + 2 * p) // s + 1
+
+    oh, ow = out_dim(h, kh, ph, sh), out_dim(w, kw, pw, sw)
+    # extra bottom/right padding so the window grid covers the ceil output
+    eh = max(0, (oh - 1) * sh + kh - h - 2 * ph)
+    ew = max(0, (ow - 1) * sw + kw - w - 2 * pw)
+    pads = ((0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew))
+    dims = (1, 1, kh, kw)
+    strides4 = (1, 1, sh, sw)
+
+    # init values must be python scalars: jax only recognizes the
+    # differentiable reduce_window_sum/max special cases for literal inits
+    if pooling_type == "max":
+        neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else int(jnp.iinfo(x.dtype).min)
+        return lax.reduce_window(x, neg, lax.max, dims, strides4, pads)
+
+    sums = lax.reduce_window(x, 0.0, lax.add, dims, strides4, pads)
+    if exclusive and (ph or pw or eh or ew):
+        ones = jnp.ones((1, 1, h, w), x.dtype)
+        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides4, pads)
+        return sums / counts
+    return sums / (kh * kw)
+
+
+def _pool2d_attrs(attr):
+    ksize = _pair(attr("ksize", [2, 2]))
+    strides = _pair(attr("strides", [1, 1]))
+    paddings = _pair(attr("paddings", [0, 0]))
+    return (ksize, strides, paddings, attr("pooling_type", "max"),
+            bool(attr("global_pooling", False)), bool(attr("ceil_mode", False)),
+            bool(attr("exclusive", True)))
+
+
+def _pool2d_infer(op, block):
+    x = block.var(op.input("X")[0])
+    if x.shape is None:
+        return
+    k = _pair(op.attrs.get("ksize", [2, 2]))
+    s = _pair(op.attrs.get("strides", [1, 1]))
+    p = _pair(op.attrs.get("paddings", [0, 0]))
+    ceil = bool(op.attrs.get("ceil_mode", False))
+    n, c, h, w = x.shape
+    if op.attrs.get("global_pooling", False):
+        oh = ow = 1
+    else:
+        def od(size, kk, pp, ss):
+            return (-((size - kk + 2 * pp) // -ss) + 1) if ceil else \
+                ((size - kk + 2 * pp) // ss + 1)
+        oh, ow = od(h, k[0], p[0], s[0]), od(w, k[1], p[1], s[1])
+    infer_output(op, block, "Out", (n, c, oh, ow), dtype=x.dtype)
+
+
+@register_op("pool2d", infer_shape=_pool2d_infer, grad=lambda op: [OpSpec(
+    "pool2d_grad",
+    {"X": op.input("X"), "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
+def pool2d(ctx):
+    x = data_of(ctx.input("X"))
+    ctx.set_output("Out", _pool2d_compute(x, *_pool2d_attrs(ctx.attr)))
+
+
+@register_op("pool2d_grad")
+def pool2d_grad(ctx):
+    x = data_of(ctx.input("X"))
+    dy = data_of(ctx.input("Out@GRAD"))
+    args = _pool2d_attrs(ctx.attr)
+    _, vjp = jax.vjp(lambda a: _pool2d_compute(a, *args), x)
+    ctx.set_output("X@GRAD", vjp(dy)[0])

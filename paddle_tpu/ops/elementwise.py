@@ -12,16 +12,23 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..core.lod import LoDArray
 from ..core.registry import register_op, same_shape, OpSpec
 from .common import G, data_of, like, collapse_to
 
 
-def _align(x, y, axis):
-    """Reshape y so it broadcasts into x per the reference's axis rule."""
+def _align(x, y, axis, x_is_lod=False, y_is_lod=False):
+    """Reshape y so it broadcasts into x per the reference's axis rule.
+
+    The reference axis indexes the LoDTensor's flat [total_rows, *feat]
+    layout; our padded LoD layout [batch, max_len, *feat] has one extra
+    leading dim, so a positive axis against a non-LoD y shifts by one."""
     if x.shape == y.shape:
         return y, 0
     if axis is None or axis == -1:
         axis = x.ndim - y.ndim
+    elif x_is_lod and not y_is_lod and axis >= 1:
+        axis += 1
     new_shape = (1,) * axis + tuple(y.shape) + (1,) * (x.ndim - axis - y.ndim)
     return y.reshape(new_shape), axis
 
@@ -75,16 +82,19 @@ def _register(op_type):
     def forward(ctx, _fwd=fwd):
         xv, yv = ctx.input("X"), ctx.input("Y")
         x, y = data_of(xv), data_of(yv)
-        yb, _ = _align(x, y, ctx.attr("axis", -1))
+        yb, _ = _align(x, y, ctx.attr("axis", -1),
+                       isinstance(xv, LoDArray), isinstance(yv, LoDArray))
         ctx.set_output("Out", like(xv, _fwd(x, yb)))
 
     @register_op(op_type + "_grad")
     def backward(ctx, _dx=dx_fn, _dy=dy_fn):
-        x = data_of(ctx.input("X"))
-        y = data_of(ctx.input("Y"))
+        xv, yv = ctx.input("X"), ctx.input("Y")
+        x = data_of(xv)
+        y = data_of(yv)
         out = data_of(ctx.input("Out"))
         dout = data_of(ctx.input("Out@GRAD"))
-        yb, axis = _align(x, y, ctx.attr("axis", -1))
+        yb, axis = _align(x, y, ctx.attr("axis", -1),
+                          isinstance(xv, LoDArray), isinstance(yv, LoDArray))
         dx = _dx(x, yb, out, dout).astype(x.dtype)
         dy_full = _dy(x, yb, out, dout)
         dy = (collapse_to(dy_full, y.shape, axis)

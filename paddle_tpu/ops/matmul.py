@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..core.lod import LoDArray
 from ..core.registry import register_op, OpSpec
 from .common import G, data_of, like
 
@@ -41,6 +42,11 @@ def mul(ctx):
     x, y = data_of(xv), data_of(ctx.input("Y"))
     xnc = ctx.attr("x_num_col_dims", 1)
     ync = ctx.attr("y_num_col_dims", 1)
+    if isinstance(xv, LoDArray):
+        # the reference sees a LoDTensor as its flat [total_rows, *feat] form
+        # (mul_op.cc flattens from there); our padded [b, L, *feat] layout has
+        # one extra leading dim, so the split point shifts by one
+        xnc = xnc + 1
     x2, y2 = _flat2d(x, xnc), _flat2d(y, ync)
     out = jnp.dot(x2, y2, preferred_element_type=jnp.float32).astype(x.dtype)
     out_shape = x.shape[:xnc] + y.shape[ync:]
@@ -49,10 +55,13 @@ def mul(ctx):
 
 @register_op("mul_grad")
 def mul_grad(ctx):
-    x, y = data_of(ctx.input("X")), data_of(ctx.input("Y"))
+    xv = ctx.input("X")
+    x, y = data_of(xv), data_of(ctx.input("Y"))
     d = data_of(ctx.input("Out@GRAD"))
     xnc = ctx.attr("x_num_col_dims", 1)
     ync = ctx.attr("y_num_col_dims", 1)
+    if isinstance(xv, LoDArray):
+        xnc = xnc + 1
     x2, y2 = _flat2d(x, xnc), _flat2d(y, ync)
     d2 = d.reshape(x2.shape[0], y2.shape[1])
     dx = jnp.dot(d2, y2.T, preferred_element_type=jnp.float32)

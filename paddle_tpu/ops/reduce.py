@@ -5,8 +5,10 @@ Reference: mean_op.cc, reduce_op.cc (/root/reference/paddle/fluid/operators/).
 
 from __future__ import annotations
 
+import numpy as np
 import jax.numpy as jnp
 
+from ..core.lod import LoDArray
 from ..core.registry import register_op, OpSpec
 from .common import G, data_of
 
@@ -15,14 +17,34 @@ from .common import G, data_of
     "mean_grad", {"X": op.input("X"), "Out@GRAD": G(op.output("Out"))},
     {"X@GRAD": G(op.input("X"))})])
 def mean(ctx):
-    x = data_of(ctx.input("X"))
+    """Mean over all elements. For a LoDArray this is the mean over the VALID
+    (unpadded) elements, matching the reference mean over a ragged LoDTensor's
+    real rows (mean_op.cc sees only the concatenated data)."""
+    xv = ctx.input("X")
+    if isinstance(xv, LoDArray):
+        feat = int(np.prod(xv.data.shape[2:])) or 1
+        m = xv.mask(xv.data.dtype).reshape(
+            xv.data.shape[:2] + (1,) * (xv.data.ndim - 2))
+        count = jnp.sum(xv.lens).astype(xv.data.dtype) * feat
+        ctx.set_output("Out", (jnp.sum(xv.data * m) / count).reshape(()))
+        return
+    x = data_of(xv)
     ctx.set_output("Out", jnp.mean(x).reshape(()).astype(x.dtype))
 
 
 @register_op("mean_grad")
 def mean_grad(ctx):
-    x = data_of(ctx.input("X"))
+    xv = ctx.input("X")
     d = data_of(ctx.input("Out@GRAD")).reshape(())
+    if isinstance(xv, LoDArray):
+        feat = int(np.prod(xv.data.shape[2:])) or 1
+        m = xv.mask(xv.data.dtype).reshape(
+            xv.data.shape[:2] + (1,) * (xv.data.ndim - 2))
+        count = jnp.sum(xv.lens).astype(xv.data.dtype) * feat
+        g = jnp.broadcast_to(m * (d / count), xv.data.shape)
+        ctx.set_output("X@GRAD", LoDArray(g, xv.lens))
+        return
+    x = data_of(xv)
     ctx.set_output("X@GRAD", jnp.full(x.shape, d / x.size).astype(x.dtype))
 
 

@@ -1,0 +1,421 @@
+"""Recurrent ops: dynamic_lstm, dynamic_gru, lstm_unit, gru_unit.
+
+Reference: /root/reference/paddle/fluid/operators/lstm_op.cc (dynamic LSTM
+over a ragged batch reordered by math/sequence2batch.h, fused gate kernels in
+math/detail/lstm_kernel.h), gru_op.cc, lstm_unit_op.cc, gru_unit_op.cc.
+
+TPU-native design: the reference reorders the ragged batch time-major and
+launches one fused CUDA kernel per step (hl_cuda_lstm.cu hand-scheduled
+kernels); here each RNN is ONE ``lax.scan`` over the padded LoDArray with a
+length mask — XLA fuses the gate math, and the scanned matmul hits the MXU.
+Gate layouts (documented contract of this framework):
+
+* LSTM projected input / recurrent weight column order: [i, f, c, o]
+  (input, forget, candidate, output), weight shape [H, 4H].
+* GRU projected input order: [u, r, c] (update, reset, candidate);
+  weight [H, 3H] = [W_u | W_r | W_c] like the reference gru_op
+  ("the first 2H columns are update/reset, the last H candidate").
+  h_t = u * h_{t-1} + (1 - u) * c_t.
+
+Gradients flow through ``jax.vjp`` over the scan (XLA reverse-scan), the
+functional analog of the reference's hand-written LstmGradKernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op, OpSpec, same_shape
+from .common import G, data_of
+
+
+def _act(name):
+    return {
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "relu": jax.nn.relu,
+        "identity": lambda x: x,
+    }[name or "identity"]
+
+
+def _reverse_padded(data, lens):
+    """Reverse each row's valid prefix in place (padding stays at the end):
+    the is_reverse attr of lstm/gru ops."""
+    L = data.shape[1]
+    idx = lens[:, None] - 1 - jnp.arange(L)[None, :]
+    valid = idx >= 0
+    idx = jnp.where(valid, idx, jnp.arange(L)[None, :])
+    idx = jnp.broadcast_to(
+        idx.reshape(idx.shape + (1,) * (data.ndim - 2)),
+        idx.shape + data.shape[2:]).astype(jnp.int32)
+    return jnp.take_along_axis(data, idx, axis=1)
+
+
+def _lstm_scan(x, lens, w, h0, c0, gate_act, cell_act, cand_act):
+    """x: [b, L, 4H] projected inputs (+bias already added); w: [H, 4H].
+    Returns hidden [b, L, H], cell [b, L, H]."""
+    b, L, H4 = x.shape
+    H = H4 // 4
+    ga, ca, cda = _act(gate_act), _act(cell_act), _act(cand_act)
+
+    def step(carry, inp):
+        h_prev, c_prev, t = carry
+        xt = inp                                     # [b, 4H]
+        gates = xt + h_prev @ w
+        i = ga(gates[:, :H])
+        f = ga(gates[:, H:2 * H])
+        cand = cda(gates[:, 2 * H:3 * H])
+        o = ga(gates[:, 3 * H:])
+        c = f * c_prev + i * cand
+        h = o * ca(c)
+        alive = (t < lens)[:, None].astype(x.dtype)
+        h = alive * h + (1 - alive) * h_prev
+        c = alive * c + (1 - alive) * c_prev
+        return (h, c, t + 1), (h * alive, c * alive)
+
+    xt = jnp.swapaxes(x, 0, 1)                       # [L, b, 4H]
+    (_, _, _), (hs, cs) = jax.lax.scan(
+        step, (h0, c0, jnp.zeros((), jnp.int32)), xt)
+    return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
+
+
+def _lstm_compute(x, lens, w, bias, h0, c0, attrs):
+    b, L, H4 = x.shape
+    H = H4 // 4
+    if bias is not None:
+        x = x + bias[None, None, :H4]
+        if bias.shape[-1] == 7 * H:
+            raise NotImplementedError(
+                "peephole connections (use_peepholes=True) are not lowered "
+                "yet; pass use_peepholes=False")
+    if h0 is None:
+        h0 = jnp.zeros((b, H), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((b, H), x.dtype)
+    rev = attrs.get("is_reverse", False)
+    if rev:
+        x = _reverse_padded(x, lens)
+    hs, cs = _lstm_scan(x, lens, w,
+                        h0, c0,
+                        attrs.get("gate_activation", "sigmoid"),
+                        attrs.get("cell_activation", "tanh"),
+                        attrs.get("candidate_activation", "tanh"))
+    if rev:
+        hs = _reverse_padded(hs, lens)
+        cs = _reverse_padded(cs, lens)
+    return hs, cs
+
+
+def _lstm_grad_maker(op):
+    inputs = {"Input": op.input("Input"), "Weight": op.input("Weight"),
+              "Hidden@GRAD": G(op.output("Hidden")),
+              "Cell@GRAD": G(op.output("Cell"))}
+    outputs = {"Input@GRAD": G(op.input("Input")),
+               "Weight@GRAD": G(op.input("Weight"))}
+    for slot in ("Bias", "H0", "C0"):
+        if op.input(slot):
+            inputs[slot] = op.input(slot)
+            outputs[slot + "@GRAD"] = G(op.input(slot))
+    return [OpSpec("lstm_grad", inputs, outputs, dict(op.attrs))]
+
+
+def _rnn_infer(out_slots):
+    def infer(op, block):
+        x = block.var(op.input("Input")[0])
+        w = block.var(op.input("Weight")[0])
+        if x.shape is None or w.shape is None:
+            return
+        H = w.shape[0]
+        for slot in out_slots:
+            for name in op.output(slot):
+                v = block.var(name)
+                v.shape = tuple(x.shape[:-1]) + (H,)
+                v.dtype = x.dtype
+                v.lod_level = x.lod_level
+    return infer
+
+
+@register_op("lstm", infer_shape=_rnn_infer(("Hidden", "Cell")),
+             grad=_lstm_grad_maker)
+def lstm(ctx):
+    xv = ctx.input("Input")
+    x = xv.data if isinstance(xv, LoDArray) else data_of(xv)
+    lens = xv.lens if isinstance(xv, LoDArray) else \
+        jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    w = data_of(ctx.input("Weight"))
+    bias = data_of(ctx.input("Bias")) if ctx.has_input("Bias") else None
+    if bias is not None:
+        bias = bias.reshape(-1)
+    h0 = data_of(ctx.input("H0")) if ctx.has_input("H0") else None
+    c0 = data_of(ctx.input("C0")) if ctx.has_input("C0") else None
+    hs, cs = _lstm_compute(x, lens, w, bias, h0, c0, ctx.op.attrs)
+    ctx.set_output("Hidden", LoDArray(hs, lens))
+    ctx.set_output("Cell", LoDArray(cs, lens))
+
+
+@register_op("lstm_grad")
+def lstm_grad(ctx):
+    xv = ctx.input("Input")
+    x = xv.data if isinstance(xv, LoDArray) else data_of(xv)
+    lens = xv.lens if isinstance(xv, LoDArray) else \
+        jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    w = data_of(ctx.input("Weight"))
+    attrs = dict(ctx.op.attrs)
+
+    def gd(slot):
+        v = ctx.input(slot)
+        return v.data if isinstance(v, LoDArray) else data_of(v)
+
+    # differentiate wrt every forward input the op actually consumed
+    operands = {"Input": x, "Weight": w}
+    if ctx.has_input("Bias"):
+        operands["Bias"] = data_of(ctx.input("Bias")).reshape(-1)
+    if ctx.has_input("H0"):
+        operands["H0"] = data_of(ctx.input("H0"))
+    if ctx.has_input("C0"):
+        operands["C0"] = data_of(ctx.input("C0"))
+    names = list(operands)
+
+    def f(*args):
+        kw = dict(zip(names, args))
+        return _lstm_compute(kw["Input"], lens, kw["Weight"], kw.get("Bias"),
+                             kw.get("H0"), kw.get("C0"), attrs)
+
+    _, vjp = jax.vjp(f, *operands.values())
+    grads = dict(zip(names, vjp((gd("Hidden@GRAD"), gd("Cell@GRAD")))))
+    dx = grads["Input"]
+    ctx.set_output("Input@GRAD",
+                   LoDArray(dx, lens) if isinstance(xv, LoDArray) else dx)
+    ctx.set_output("Weight@GRAD", grads["Weight"])
+    if "Bias" in grads:
+        ctx.set_output("Bias@GRAD", grads["Bias"].reshape(1, -1))
+    if "H0" in grads:
+        ctx.set_output("H0@GRAD", grads["H0"])
+    if "C0" in grads:
+        ctx.set_output("C0@GRAD", grads["C0"])
+
+
+# ---------------------------------------------------------------------------
+# dynamic GRU
+# ---------------------------------------------------------------------------
+
+def _gru_compute(x, lens, w, bias, h0, attrs):
+    b, L, H3 = x.shape
+    H = H3 // 3
+    if bias is not None:
+        x = x + bias[None, None, :]
+    if h0 is None:
+        h0 = jnp.zeros((b, H), x.dtype)
+    ga = _act(attrs.get("gate_activation", "sigmoid"))
+    ca = _act(attrs.get("activation", "tanh"))
+    wu, wr, wc = w[:, :H], w[:, H:2 * H], w[:, 2 * H:]
+    rev = attrs.get("is_reverse", False)
+    if rev:
+        x = _reverse_padded(x, lens)
+
+    def step(carry, inp):
+        h_prev, t = carry
+        xt = inp
+        u = ga(xt[:, :H] + h_prev @ wu)
+        r = ga(xt[:, H:2 * H] + h_prev @ wr)
+        c = ca(xt[:, 2 * H:] + (r * h_prev) @ wc)
+        h = u * h_prev + (1.0 - u) * c
+        alive = (t < lens)[:, None].astype(x.dtype)
+        h = alive * h + (1 - alive) * h_prev
+        return (h, t + 1), h * alive
+
+    xt = jnp.swapaxes(x, 0, 1)
+    _, hs = jax.lax.scan(step, (h0, jnp.zeros((), jnp.int32)), xt)
+    hs = jnp.swapaxes(hs, 0, 1)
+    if rev:
+        hs = _reverse_padded(hs, lens)
+    return hs
+
+
+def _gru_grad_maker(op):
+    inputs = {"Input": op.input("Input"), "Weight": op.input("Weight"),
+              "Hidden@GRAD": G(op.output("Hidden"))}
+    outputs = {"Input@GRAD": G(op.input("Input")),
+               "Weight@GRAD": G(op.input("Weight"))}
+    for slot in ("Bias", "H0"):
+        if op.input(slot):
+            inputs[slot] = op.input(slot)
+            outputs[slot + "@GRAD"] = G(op.input(slot))
+    return [OpSpec("gru_grad", inputs, outputs, dict(op.attrs))]
+
+
+@register_op("gru", infer_shape=_rnn_infer(("Hidden",)), grad=_gru_grad_maker)
+def gru(ctx):
+    xv = ctx.input("Input")
+    x = xv.data if isinstance(xv, LoDArray) else data_of(xv)
+    lens = xv.lens if isinstance(xv, LoDArray) else \
+        jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    w = data_of(ctx.input("Weight"))
+    bias = data_of(ctx.input("Bias")).reshape(-1) \
+        if ctx.has_input("Bias") else None
+    h0 = data_of(ctx.input("H0")) if ctx.has_input("H0") else None
+    hs = _gru_compute(x, lens, w, bias, h0, ctx.op.attrs)
+    ctx.set_output("Hidden", LoDArray(hs, lens))
+
+
+@register_op("gru_grad")
+def gru_grad(ctx):
+    xv = ctx.input("Input")
+    x = xv.data if isinstance(xv, LoDArray) else data_of(xv)
+    lens = xv.lens if isinstance(xv, LoDArray) else \
+        jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    w = data_of(ctx.input("Weight"))
+    dh = ctx.input("Hidden@GRAD")
+    dh_data = dh.data if isinstance(dh, LoDArray) else data_of(dh)
+    attrs = dict(ctx.op.attrs)
+
+    operands = {"Input": x, "Weight": w}
+    if ctx.has_input("Bias"):
+        operands["Bias"] = data_of(ctx.input("Bias")).reshape(-1)
+    if ctx.has_input("H0"):
+        operands["H0"] = data_of(ctx.input("H0"))
+    names = list(operands)
+
+    def f(*args):
+        kw = dict(zip(names, args))
+        return _gru_compute(kw["Input"], lens, kw["Weight"], kw.get("Bias"),
+                            kw.get("H0"), attrs)
+
+    _, vjp = jax.vjp(f, *operands.values())
+    grads = dict(zip(names, vjp(dh_data)))
+    dx = grads["Input"]
+    ctx.set_output("Input@GRAD",
+                   LoDArray(dx, lens) if isinstance(xv, LoDArray) else dx)
+    ctx.set_output("Weight@GRAD", grads["Weight"])
+    if "Bias" in grads:
+        ctx.set_output("Bias@GRAD", grads["Bias"].reshape(1, -1))
+    if "H0" in grads:
+        ctx.set_output("H0@GRAD", grads["H0"])
+
+
+# ---------------------------------------------------------------------------
+# single-step units (StaticRNN building blocks)
+# ---------------------------------------------------------------------------
+
+@register_op("lstm_unit", grad=lambda op: [OpSpec(
+    "lstm_unit_grad",
+    {"X": op.input("X"), "C_prev": op.input("C_prev"),
+     "C@GRAD": G(op.output("C")), "H@GRAD": G(op.output("H"))},
+    {"X@GRAD": G(op.input("X")), "C_prev@GRAD": G(op.input("C_prev"))},
+    dict(op.attrs))])
+def lstm_unit(ctx):
+    """One fused LSTM cell step: X=[b,4H] pre-activations, C_prev=[b,H]
+    (lstm_unit_op.cc; forget_bias attr added into the forget gate)."""
+    x = data_of(ctx.input("X"))
+    c_prev = data_of(ctx.input("C_prev"))
+    H = c_prev.shape[-1]
+    fb = ctx.attr("forget_bias", 0.0)
+    i = jax.nn.sigmoid(x[:, :H])
+    f = jax.nn.sigmoid(x[:, H:2 * H] + fb)
+    cand = jnp.tanh(x[:, 2 * H:3 * H])
+    o = jax.nn.sigmoid(x[:, 3 * H:])
+    c = f * c_prev + i * cand
+    h = o * jnp.tanh(c)
+    ctx.set_output("C", c)
+    ctx.set_output("H", h)
+
+
+def _lstm_unit_fwd(x, c_prev, fb):
+    H = c_prev.shape[-1]
+    i = jax.nn.sigmoid(x[:, :H])
+    f = jax.nn.sigmoid(x[:, H:2 * H] + fb)
+    cand = jnp.tanh(x[:, 2 * H:3 * H])
+    o = jax.nn.sigmoid(x[:, 3 * H:])
+    c = f * c_prev + i * cand
+    return c, o * jnp.tanh(c)
+
+
+@register_op("lstm_unit_grad")
+def lstm_unit_grad(ctx):
+    x = data_of(ctx.input("X"))
+    c_prev = data_of(ctx.input("C_prev"))
+    fb = ctx.attr("forget_bias", 0.0)
+    dc = data_of(ctx.input("C@GRAD"))
+    dh = data_of(ctx.input("H@GRAD"))
+    _, vjp = jax.vjp(lambda a, b: _lstm_unit_fwd(a, b, fb), x, c_prev)
+    dx, dcp = vjp((dc, dh))
+    ctx.set_output("X@GRAD", dx)
+    ctx.set_output("C_prev@GRAD", dcp)
+
+
+def _gru_unit_fwd(x, h_prev, w, bias, gate_act, cand_act):
+    H = h_prev.shape[-1]
+    if bias is not None:
+        x = x + bias.reshape(1, -1)
+    u = gate_act(x[:, :H] + h_prev @ w[:, :H])
+    r = gate_act(x[:, H:2 * H] + h_prev @ w[:, H:2 * H])
+    c = cand_act(x[:, 2 * H:] + (r * h_prev) @ w[:, 2 * H:])
+    h = u * h_prev + (1.0 - u) * c
+    return u, r, c, h
+
+
+def _gru_unit_grad_maker(op):
+    inputs = {"Input": op.input("Input"), "HiddenPrev": op.input("HiddenPrev"),
+              "Weight": op.input("Weight"),
+              "Hidden@GRAD": G(op.output("Hidden"))}
+    outputs = {"Input@GRAD": G(op.input("Input")),
+               "HiddenPrev@GRAD": G(op.input("HiddenPrev")),
+               "Weight@GRAD": G(op.input("Weight"))}
+    if op.input("Bias"):
+        inputs["Bias"] = op.input("Bias")
+        outputs["Bias@GRAD"] = G(op.input("Bias"))
+    return [OpSpec("gru_unit_grad", inputs, outputs, dict(op.attrs))]
+
+
+def _gru_unit_acts(ctx):
+    """Resolve the gate/candidate activations; the reference gru_unit_op
+    encodes them as enum ints (0 identity, 1 sigmoid, 2 tanh, 3 relu) while
+    the layer API passes strings — accept both."""
+    codes = {0: "identity", 1: "sigmoid", 2: "tanh", 3: "relu"}
+
+    def resolve(attr, default):
+        v = ctx.attr(attr, default)
+        return _act(codes[v] if isinstance(v, int) else v)
+
+    return resolve("gate_activation", "sigmoid"), resolve("activation", "tanh")
+
+
+@register_op("gru_unit", grad=_gru_unit_grad_maker)
+def gru_unit(ctx):
+    x = data_of(ctx.input("Input"))
+    h_prev = data_of(ctx.input("HiddenPrev"))
+    w = data_of(ctx.input("Weight"))
+    bias = data_of(ctx.input("Bias")) if ctx.has_input("Bias") else None
+    ga, ca = _gru_unit_acts(ctx)
+    u, r, c, h = _gru_unit_fwd(x, h_prev, w, bias, ga, ca)
+    ctx.set_output("Gate", jnp.concatenate([u, r, c], axis=-1))
+    ctx.set_output("ResetHiddenPrev", r * h_prev)
+    ctx.set_output("Hidden", h)
+
+
+@register_op("gru_unit_grad")
+def gru_unit_grad(ctx):
+    x = data_of(ctx.input("Input"))
+    h_prev = data_of(ctx.input("HiddenPrev"))
+    w = data_of(ctx.input("Weight"))
+    has_bias = ctx.has_input("Bias")
+    bias = data_of(ctx.input("Bias")) if has_bias else None
+    dh = data_of(ctx.input("Hidden@GRAD"))
+    ga, ca = _gru_unit_acts(ctx)
+
+    if has_bias:
+        _, vjp = jax.vjp(
+            lambda a, b, ww, bb: _gru_unit_fwd(a, b, ww, bb, ga, ca)[3],
+            x, h_prev, w, bias)
+        dx, dhp, dw, db = vjp(dh)
+        ctx.set_output("Bias@GRAD", db)
+    else:
+        _, vjp = jax.vjp(
+            lambda a, b, ww: _gru_unit_fwd(a, b, ww, None, ga, ca)[3],
+            x, h_prev, w)
+        dx, dhp, dw = vjp(dh)
+    ctx.set_output("Input@GRAD", dx)
+    ctx.set_output("HiddenPrev@GRAD", dhp)
+    ctx.set_output("Weight@GRAD", dw)

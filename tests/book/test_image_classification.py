@@ -1,0 +1,153 @@
+"""Book chapter 3: image classification (VGG + ResNet).
+
+Reference: /root/reference/python/paddle/fluid/tests/book/
+test_image_classification_train.py — vgg16_bn_drop (img_conv_group stacks
+with batch-norm + dropout) and resnet_cifar10 (conv_bn_layer /
+shortcut / basicblock composition), trained until the loss drops.
+Synthetic CIFAR-shaped data keeps CI hermetic; shapes/depths are scaled
+down so the convergence contract runs in seconds while exercising the
+same op graph (conv2d, batch_norm, pool2d, dropout, elementwise_add).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _synthetic_images(n=256, c=3, hw=16, classes=4, seed=5):
+    """Class-dependent blob patterns, learnable by a small convnet."""
+    rng = np.random.RandomState(seed)
+    base = rng.normal(0, 1.0, (classes, c, hw, hw)).astype("float32")
+    labels = rng.randint(0, classes, n)
+    x = base[labels] + rng.normal(0, 0.6, (n, c, hw, hw)).astype("float32")
+    return x, labels.reshape(-1, 1).astype("int64")
+
+
+def vgg_bn_drop(input, classes):
+    def conv_block(ipt, num_filter, groups, dropouts):
+        return fluid.nets.img_conv_group(
+            input=ipt, pool_size=2, pool_stride=2,
+            conv_num_filter=[num_filter] * groups, conv_filter_size=3,
+            conv_act="relu", conv_with_batchnorm=True,
+            conv_batchnorm_drop_rate=dropouts, pool_type="max")
+
+    conv1 = conv_block(input, 16, 2, [0.3, 0.0])
+    conv2 = conv_block(conv1, 32, 2, [0.4, 0.0])
+    drop = fluid.layers.dropout(x=conv2, dropout_prob=0.5)
+    fc1 = fluid.layers.fc(input=drop, size=64, act=None)
+    bn = fluid.layers.batch_norm(input=fc1, act="relu")
+    drop2 = fluid.layers.dropout(x=bn, dropout_prob=0.5)
+    fc2 = fluid.layers.fc(input=drop2, size=64, act=None)
+    return fluid.layers.fc(input=fc2, size=classes, act="softmax")
+
+
+def resnet_cifar10(input, classes, depth=8):
+    def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu"):
+        tmp = fluid.layers.conv2d(input=input, filter_size=filter_size,
+                                  num_filters=ch_out, stride=stride,
+                                  padding=padding, act=None, bias_attr=False)
+        return fluid.layers.batch_norm(input=tmp, act=act)
+
+    def shortcut(input, ch_in, ch_out, stride):
+        if ch_in != ch_out:
+            return conv_bn_layer(input, ch_out, 1, stride, 0, None)
+        return input
+
+    def basicblock(input, ch_in, ch_out, stride):
+        tmp = conv_bn_layer(input, ch_out, 3, stride, 1)
+        tmp = conv_bn_layer(tmp, ch_out, 3, 1, 1, act=None)
+        short = shortcut(input, ch_in, ch_out, stride)
+        return fluid.layers.elementwise_add(x=tmp, y=short, act="relu")
+
+    def layer_warp(block_func, input, ch_in, ch_out, count, stride):
+        tmp = block_func(input, ch_in, ch_out, stride)
+        for _ in range(1, count):
+            tmp = block_func(tmp, ch_out, ch_out, 1)
+        return tmp
+
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    conv1 = conv_bn_layer(input=input, ch_out=8, filter_size=3, stride=1,
+                          padding=1)
+    res1 = layer_warp(basicblock, conv1, 8, 8, n, 1)
+    res2 = layer_warp(basicblock, res1, 8, 16, n, 2)
+    res3 = layer_warp(basicblock, res2, 16, 32, n, 2)
+    pool = fluid.layers.pool2d(input=res3, pool_size=4, pool_type="avg",
+                               pool_stride=1, global_pooling=True)
+    return fluid.layers.fc(input=pool, size=classes, act="softmax")
+
+
+@pytest.mark.parametrize("net", ["resnet", "vgg"])
+def test_image_classification_converges(net):
+    classes, hw = 4, 16
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        images = fluid.layers.data("pixel", shape=[3, hw, hw])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        if net == "vgg":
+            predict = vgg_bn_drop(images, classes)
+        else:
+            predict = resnet_cifar10(images, classes)
+        cost = fluid.layers.cross_entropy(input=predict, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        acc = fluid.layers.accuracy(input=predict, label=label)
+        opt = fluid.optimizer.Adam(learning_rate=0.002)
+        opt.minimize(avg_cost, startup)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    xs, ys = _synthetic_images(classes=classes, hw=hw)
+    batch = 64
+    first_loss, last_acc = None, 0.0
+    for epoch in range(6):
+        accs = []
+        for i in range(0, len(xs), batch):
+            loss_v, acc_v = exe.run(
+                main,
+                feed={"pixel": xs[i:i + batch], "label": ys[i:i + batch]},
+                fetch_list=[avg_cost, acc])
+            if first_loss is None:
+                first_loss = float(loss_v)
+            accs.append(float(acc_v))
+        last_acc = float(np.mean(accs))
+        if last_acc > 0.9:
+            break
+    assert last_acc > 0.7, (
+        f"{net} failed to converge: acc={last_acc}, first loss={first_loss}")
+
+
+def test_image_classification_inference_roundtrip(tmp_path):
+    classes, hw = 4, 16
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        images = fluid.layers.data("pixel", shape=[3, hw, hw])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        predict = resnet_cifar10(images, classes)
+        cost = fluid.layers.cross_entropy(input=predict, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9).minimize(
+            avg_cost, startup)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs, ys = _synthetic_images(n=128, classes=classes, hw=hw)
+    for _ in range(3):
+        exe.run(main, feed={"pixel": xs[:64], "label": ys[:64]},
+                fetch_list=[avg_cost])
+
+    model_dir = str(tmp_path / "resnet.model")
+    fluid.io.save_inference_model(model_dir, ["pixel"], [predict], exe, main)
+    infer_prog, feed_names, fetch_vars = fluid.io.load_inference_model(
+        model_dir, exe)
+    # batch_norm must run in is_test mode in the loaded program
+    bn_ops = [op for op in infer_prog.global_block().ops
+              if op.type == "batch_norm"]
+    assert bn_ops and all(op.attrs["is_test"] for op in bn_ops)
+    pred, = exe.run(infer_prog, feed={"pixel": xs[:16]},
+                    fetch_list=fetch_vars)
+    assert pred.shape == (16, classes)
+    assert np.all(np.isfinite(pred))

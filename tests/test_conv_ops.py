@@ -1,0 +1,259 @@
+"""Conv / pool op numeric tests.
+
+Numpy references mirror /root/reference/python/paddle/fluid/tests/unittests/
+test_conv2d_op.py (conv2d_forward_naive), test_conv2d_transpose_op.py,
+test_pool2d_op.py (max_pool2D_forward_naive / avg_pool2D_forward_naive).
+"""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def conv2d_forward_naive(input, filter, group, stride, pad, dilation=(1, 1)):
+    in_n, in_c, in_h, in_w = input.shape
+    out_c, f_c, f_h, f_w = filter.shape
+    assert f_c * group == in_c
+    sub_out_c = out_c // group
+
+    out_h = (in_h - (dilation[0] * (f_h - 1) + 1) + 2 * pad[0]) // stride[0] + 1
+    out_w = (in_w - (dilation[1] * (f_w - 1) + 1) + 2 * pad[1]) // stride[1] + 1
+    out = np.zeros((in_n, out_c, out_h, out_w), dtype=input.dtype)
+
+    d_bolck_h = dilation[0] * (f_h - 1) + 1
+    d_bolck_w = dilation[1] * (f_w - 1) + 1
+    input_pad = np.pad(input, ((0, 0), (0, 0), (pad[0], pad[0]),
+                               (pad[1], pad[1])), mode="constant")
+    filter_dilation = np.zeros((out_c, f_c, d_bolck_h, d_bolck_w),
+                               dtype=filter.dtype)
+    filter_dilation[:, :, 0:d_bolck_h:dilation[0],
+                    0:d_bolck_w:dilation[1]] = filter
+
+    for i in range(out_h):
+        for j in range(out_w):
+            for g in range(group):
+                input_pad_masked = input_pad[
+                    :, g * f_c:(g + 1) * f_c,
+                    i * stride[0]:i * stride[0] + d_bolck_h,
+                    j * stride[1]:j * stride[1] + d_bolck_w]
+                f_sub = filter_dilation[g * sub_out_c:(g + 1) * sub_out_c]
+                for k in range(sub_out_c):
+                    out[:, g * sub_out_c + k, i, j] = np.sum(
+                        input_pad_masked * f_sub[k], axis=(1, 2, 3))
+    return out
+
+
+class TestConv2d(OpTest):
+    op_type = "conv2d"
+    stride, pad, dilation, groups = [1, 1], [0, 0], [1, 1], 1
+    input_shape, filter_shape = (2, 3, 5, 5), (6, 3, 3, 3)
+
+    def setup_method(self, method):
+        np.random.seed(7)
+        x = np.random.random(self.input_shape).astype("float32")
+        w = np.random.random(self.filter_shape).astype("float32")
+        out = conv2d_forward_naive(x, w, self.groups, self.stride, self.pad,
+                                   self.dilation)
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": self.stride, "paddings": self.pad,
+                      "dilations": self.dilation, "groups": self.groups}
+        self.outputs = {"Output": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=0.03)
+
+
+class TestConv2dStridePad(TestConv2d):
+    stride, pad = [2, 2], [1, 1]
+
+
+class TestConv2dGroups(TestConv2d):
+    groups = 3
+    filter_shape = (6, 1, 3, 3)
+
+
+class TestConv2dDilation(TestConv2d):
+    dilation = [2, 2]
+    input_shape = (2, 3, 7, 7)
+
+
+class TestDepthwiseConv2d(OpTest):
+    op_type = "depthwise_conv2d"
+
+    def setup_method(self, method):
+        np.random.seed(7)
+        x = np.random.random((2, 3, 5, 5)).astype("float32")
+        w = np.random.random((3, 1, 3, 3)).astype("float32")
+        out = conv2d_forward_naive(x, w, 3, [1, 1], [1, 1])
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 3}
+        self.outputs = {"Output": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+def conv2dtranspose_forward_naive(input_, filter_, stride, pad, dilations):
+    in_n, in_c, in_h, in_w = input_.shape
+    f_c, out_c, f_h, f_w = filter_.shape
+    assert in_c == f_c
+
+    d_bolck_h = dilations[0] * (f_h - 1) + 1
+    d_bolck_w = dilations[1] * (f_w - 1) + 1
+    out_h = (in_h - 1) * stride[0] + d_bolck_h
+    out_w = (in_w - 1) * stride[1] + d_bolck_w
+
+    out = np.zeros((in_n, out_c, out_h, out_w), dtype=input_.dtype)
+    for n in range(in_n):
+        for i in range(in_h):
+            for j in range(in_w):
+                input_masked = input_[n, :, i, j]
+                for k in range(out_c):
+                    tmp_out = np.sum(
+                        input_masked.reshape(-1, 1, 1) *
+                        filter_[:, k, :, :], axis=0)
+                    i1, i2 = i * stride[0], i * stride[0] + d_bolck_h
+                    j1, j2 = j * stride[1], j * stride[1] + d_bolck_w
+                    out[n, k, i1:i2:dilations[0], j1:j2:dilations[1]] += tmp_out
+    return out[:, :, pad[0]:out_h - pad[0], pad[1]:out_w - pad[1]]
+
+
+class TestConv2dTranspose(OpTest):
+    op_type = "conv2d_transpose"
+    stride, pad, dilation = [1, 1], [0, 0], [1, 1]
+    input_shape, filter_shape = (2, 3, 5, 5), (3, 6, 3, 3)
+
+    def setup_method(self, method):
+        np.random.seed(7)
+        x = np.random.random(self.input_shape).astype("float32")
+        w = np.random.random(self.filter_shape).astype("float32")
+        out = conv2dtranspose_forward_naive(x, w, self.stride, self.pad,
+                                            self.dilation)
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": self.stride, "paddings": self.pad,
+                      "dilations": self.dilation}
+        self.outputs = {"Output": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=0.03)
+
+
+class TestConv2dTransposeStridePad(TestConv2dTranspose):
+    stride, pad = [2, 2], [1, 1]
+
+
+def max_pool2D_forward_naive(x, ksize, strides, paddings, global_pool=False,
+                             ceil_mode=False):
+    N, C, H, W = x.shape
+    if global_pool:
+        ksize = [H, W]
+        paddings = [0, 0]
+    if ceil_mode:
+        H_out = (H - ksize[0] + 2 * paddings[0] + strides[0] - 1
+                 ) // strides[0] + 1
+        W_out = (W - ksize[1] + 2 * paddings[1] + strides[1] - 1
+                 ) // strides[1] + 1
+    else:
+        H_out = (H - ksize[0] + 2 * paddings[0]) // strides[0] + 1
+        W_out = (W - ksize[1] + 2 * paddings[1]) // strides[1] + 1
+    out = np.zeros((N, C, H_out, W_out), dtype=x.dtype)
+    for i in range(H_out):
+        for j in range(W_out):
+            r_start = max(i * strides[0] - paddings[0], 0)
+            r_end = min(i * strides[0] + ksize[0] - paddings[0], H)
+            c_start = max(j * strides[1] - paddings[1], 0)
+            c_end = min(j * strides[1] + ksize[1] - paddings[1], W)
+            out[:, :, i, j] = np.max(x[:, :, r_start:r_end, c_start:c_end],
+                                     axis=(2, 3))
+    return out
+
+
+def avg_pool2D_forward_naive(x, ksize, strides, paddings, global_pool=False,
+                             ceil_mode=False):
+    N, C, H, W = x.shape
+    if global_pool:
+        ksize = [H, W]
+        paddings = [0, 0]
+    if ceil_mode:
+        H_out = (H - ksize[0] + 2 * paddings[0] + strides[0] - 1
+                 ) // strides[0] + 1
+        W_out = (W - ksize[1] + 2 * paddings[1] + strides[1] - 1
+                 ) // strides[1] + 1
+    else:
+        H_out = (H - ksize[0] + 2 * paddings[0]) // strides[0] + 1
+        W_out = (W - ksize[1] + 2 * paddings[1]) // strides[1] + 1
+    out = np.zeros((N, C, H_out, W_out), dtype=x.dtype)
+    for i in range(H_out):
+        for j in range(W_out):
+            r_start = max(i * strides[0] - paddings[0], 0)
+            r_end = min(i * strides[0] + ksize[0] - paddings[0], H)
+            c_start = max(j * strides[1] - paddings[1], 0)
+            c_end = min(j * strides[1] + ksize[1] - paddings[1], W)
+            field = x[:, :, r_start:r_end, c_start:c_end]
+            out[:, :, i, j] = (np.sum(field, axis=(2, 3)) /
+                               ((r_end - r_start) * (c_end - c_start)))
+    return out
+
+
+class TestPool2dMax(OpTest):
+    op_type = "pool2d"
+    pool_type = "max"
+    ksize, strides, paddings = [3, 3], [1, 1], [0, 0]
+    global_pool = False
+    ceil_mode = False
+    shape = (2, 3, 5, 5)
+
+    def setup_method(self, method):
+        np.random.seed(7)
+        x = np.random.random(self.shape).astype("float32")
+        fwd = (max_pool2D_forward_naive if self.pool_type == "max"
+               else avg_pool2D_forward_naive)
+        out = fwd(x, self.ksize, self.strides, self.paddings,
+                  self.global_pool, self.ceil_mode)
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": self.pool_type, "ksize": self.ksize,
+                      "strides": self.strides, "paddings": self.paddings,
+                      "global_pooling": self.global_pool,
+                      "ceil_mode": self.ceil_mode}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        if self.pool_type == "max":
+            pytest.skip("max pool grad is subgradient; checked via avg")
+        self.check_grad(["X"], "Out", max_relative_error=0.05)
+
+
+class TestPool2dAvg(TestPool2dMax):
+    pool_type = "avg"
+
+
+class TestPool2dAvgPad(TestPool2dMax):
+    pool_type = "avg"
+    strides, paddings = [2, 2], [1, 1]
+
+
+class TestPool2dMaxStride(TestPool2dMax):
+    strides = [2, 2]
+
+
+class TestPool2dGlobal(TestPool2dMax):
+    global_pool = True
+
+
+class TestPool2dCeil(TestPool2dMax):
+    shape = (2, 3, 7, 7)
+    strides = [2, 2]
+    ceil_mode = True

@@ -1,0 +1,138 @@
+"""batch_norm / layer_norm / lrn numeric tests.
+
+Numpy references mirror /root/reference/python/paddle/fluid/tests/unittests/
+test_batch_norm_op.py (_reference_training/_reference_grad),
+test_layer_norm_op.py, test_lrn_op.py.
+"""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _bn_reference_training(x, scale, bias, epsilon):
+    mean = np.mean(x, axis=(0, 2, 3))
+    var = np.var(x, axis=(0, 2, 3))
+    normalized = (x - mean.reshape(1, -1, 1, 1)) / np.sqrt(
+        var.reshape(1, -1, 1, 1) + epsilon)
+    y = normalized * scale.reshape(1, -1, 1, 1) + bias.reshape(1, -1, 1, 1)
+    return y, mean, var
+
+
+class TestBatchNorm(OpTest):
+    op_type = "batch_norm"
+
+    def setup_method(self, method):
+        np.random.seed(7)
+        c = 4
+        x = np.random.random((3, c, 4, 5)).astype("float32")
+        scale = np.random.random(c).astype("float32")
+        bias = np.random.random(c).astype("float32")
+        mean = np.zeros(c, dtype="float32")
+        variance = np.ones(c, dtype="float32")
+        momentum, epsilon = 0.9, 1e-5
+
+        y, saved_mean, saved_var = _bn_reference_training(x, scale, bias,
+                                                          epsilon)
+        mean_out = mean * momentum + saved_mean * (1 - momentum)
+        var_out = variance * momentum + saved_var * (1 - momentum)
+
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": variance}
+        self.attrs = {"momentum": momentum, "epsilon": epsilon,
+                      "is_test": False}
+        self.outputs = {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
+                        "SavedMean": saved_mean, "SavedVariance": saved_var}
+
+    def test_output(self):
+        self.check_output(atol=2e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=0.02)
+
+
+class TestBatchNormInference(OpTest):
+    op_type = "batch_norm"
+
+    def setup_method(self, method):
+        np.random.seed(7)
+        c = 4
+        x = np.random.random((3, c, 4, 5)).astype("float32")
+        scale = np.random.random(c).astype("float32")
+        bias = np.random.random(c).astype("float32")
+        mean = np.random.random(c).astype("float32")
+        variance = np.random.random(c).astype("float32") + 0.5
+        epsilon = 1e-5
+        y = (x - mean.reshape(1, -1, 1, 1)) / np.sqrt(
+            variance.reshape(1, -1, 1, 1) + epsilon)
+        y = y * scale.reshape(1, -1, 1, 1) + bias.reshape(1, -1, 1, 1)
+
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": variance}
+        self.attrs = {"momentum": 0.9, "epsilon": epsilon, "is_test": True}
+        self.outputs = {"Y": y, "MeanOut": mean, "VarianceOut": variance,
+                        "SavedMean": mean, "SavedVariance": variance}
+
+    def test_output(self):
+        self.check_output(atol=2e-4)
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+    begin_norm_axis = 1
+
+    def setup_method(self, method):
+        np.random.seed(7)
+        shape = (2, 3, 4)
+        x = np.random.random(shape).astype("float32")
+        d = int(np.prod(shape[self.begin_norm_axis:]))
+        n = int(np.prod(shape[:self.begin_norm_axis]))
+        scale = np.random.random(d).astype("float32")
+        bias = np.random.random(d).astype("float32")
+        epsilon = 1e-5
+
+        flat = x.reshape(n, d)
+        mean = flat.mean(axis=1)
+        var = flat.var(axis=1)
+        y = (flat - mean[:, None]) / np.sqrt(var[:, None] + epsilon)
+        y = (y * scale[None] + bias[None]).reshape(shape)
+
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"begin_norm_axis": self.begin_norm_axis,
+                      "epsilon": epsilon}
+        self.outputs = {"Y": y, "Mean": mean, "Variance": var}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=0.02)
+
+
+class TestLayerNormAxis2(TestLayerNorm):
+    begin_norm_axis = 2
+
+
+class TestLRN(OpTest):
+    op_type = "lrn"
+
+    def setup_method(self, method):
+        np.random.seed(7)
+        n_win, k, alpha, beta = 5, 2.0, 1e-4, 0.75
+        x = np.random.random((2, 8, 3, 3)).astype("float32")
+        N, C, H, W = x.shape
+        mid = np.full(x.shape, k, dtype="float32")
+        half = n_win // 2
+        for c in range(C):
+            lo, hi = max(0, c - half), min(C, c + n_win - half)
+            mid[:, c] += alpha * np.sum(x[:, lo:hi] ** 2, axis=1)
+        out = x * mid ** (-beta)
+        self.inputs = {"X": x}
+        self.attrs = {"n": n_win, "k": k, "alpha": alpha, "beta": beta}
+        self.outputs = {"Out": out, "MidOut": mid}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.02)

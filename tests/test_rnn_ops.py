@@ -1,0 +1,181 @@
+"""LSTM / GRU op tests against step-by-step numpy recurrences.
+
+Mirrors /root/reference/python/paddle/fluid/tests/unittests/test_lstm_op.py
+and test_gru_op.py in spirit: a python recurrence over each ragged sequence
+is the ground truth. Gate layouts are this framework's documented contract
+(ops/rnn_ops.py): LSTM [i, f, c, o]; GRU [u, r, c] with
+h = u*h_prev + (1-u)*c.
+"""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def lstm_ref(x, lod, w, b):
+    """x: [total, 4H] pre-projected; returns hidden/cell flat arrays."""
+    H = w.shape[0]
+    hs, cs = np.zeros((len(x), H), "float32"), np.zeros((len(x), H), "float32")
+    offs = lod[0]
+    for i in range(len(offs) - 1):
+        h = np.zeros(H, "float32")
+        c = np.zeros(H, "float32")
+        for t in range(offs[i], offs[i + 1]):
+            g = x[t] + h @ w + (b[0] if b is not None else 0.0)
+            ig, fg = sigmoid(g[:H]), sigmoid(g[H:2 * H])
+            cand, og = np.tanh(g[2 * H:3 * H]), sigmoid(g[3 * H:])
+            c = fg * c + ig * cand
+            h = og * np.tanh(c)
+            hs[t], cs[t] = h, c
+    return hs, cs
+
+
+def gru_ref(x, lod, w, b):
+    H = w.shape[0]
+    hs = np.zeros((len(x), H), "float32")
+    offs = lod[0]
+    wu, wr, wc = w[:, :H], w[:, H:2 * H], w[:, 2 * H:]
+    for i in range(len(offs) - 1):
+        h = np.zeros(H, "float32")
+        for t in range(offs[i], offs[i + 1]):
+            g = x[t] + (b[0] if b is not None else 0.0)
+            u = sigmoid(g[:H] + h @ wu)
+            r = sigmoid(g[H:2 * H] + h @ wr)
+            c = np.tanh(g[2 * H:] + (r * h) @ wc)
+            h = u * h + (1 - u) * c
+            hs[t] = h
+    return hs
+
+
+class TestLstm(OpTest):
+    op_type = "lstm"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(21)
+        H = 4
+        lod = [[0, 3, 7]]
+        x = rng.uniform(-0.5, 0.5, (7, 4 * H)).astype("float32")
+        w = rng.uniform(-0.3, 0.3, (H, 4 * H)).astype("float32")
+        b = rng.uniform(-0.2, 0.2, (1, 4 * H)).astype("float32")
+        hs, cs = lstm_ref(x, lod, w, b)
+        self.inputs = {"Input": (x, lod), "Weight": w, "Bias": b}
+        self.attrs = {"use_peepholes": False, "is_reverse": False,
+                      "gate_activation": "sigmoid",
+                      "cell_activation": "tanh",
+                      "candidate_activation": "tanh"}
+        self.outputs = {"Hidden": (hs, lod), "Cell": (cs, lod)}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["Input", "Weight", "Bias"], "Hidden",
+                        max_relative_error=0.06)
+
+
+class TestLstmReverse(OpTest):
+    op_type = "lstm"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(23)
+        H = 3
+        lod = [[0, 2, 5]]
+        x = rng.uniform(-0.5, 0.5, (5, 4 * H)).astype("float32")
+        w = rng.uniform(-0.3, 0.3, (H, 4 * H)).astype("float32")
+        b = rng.uniform(-0.2, 0.2, (1, 4 * H)).astype("float32")
+        # reverse each sequence, run forward, reverse outputs back
+        xr = x.copy()
+        offs = lod[0]
+        for i in range(len(offs) - 1):
+            xr[offs[i]:offs[i + 1]] = x[offs[i]:offs[i + 1]][::-1]
+        hs, cs = lstm_ref(xr, lod, w, b)
+        for i in range(len(offs) - 1):
+            hs[offs[i]:offs[i + 1]] = hs[offs[i]:offs[i + 1]][::-1]
+            cs[offs[i]:offs[i + 1]] = cs[offs[i]:offs[i + 1]][::-1]
+        self.inputs = {"Input": (x, lod), "Weight": w, "Bias": b}
+        self.attrs = {"is_reverse": True}
+        self.outputs = {"Hidden": (hs, lod), "Cell": (cs, lod)}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestGru(OpTest):
+    op_type = "gru"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(29)
+        H = 4
+        lod = [[0, 3, 7]]
+        x = rng.uniform(-0.5, 0.5, (7, 3 * H)).astype("float32")
+        w = rng.uniform(-0.3, 0.3, (H, 3 * H)).astype("float32")
+        b = rng.uniform(-0.2, 0.2, (1, 3 * H)).astype("float32")
+        hs = gru_ref(x, lod, w, b)
+        self.inputs = {"Input": (x, lod), "Weight": w, "Bias": b}
+        self.attrs = {"is_reverse": False}
+        self.outputs = {"Hidden": (hs, lod)}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["Input", "Weight", "Bias"], "Hidden",
+                        max_relative_error=0.06)
+
+
+class TestLstmUnit(OpTest):
+    op_type = "lstm_unit"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(31)
+        b_, H = 5, 4
+        x = rng.uniform(-0.5, 0.5, (b_, 4 * H)).astype("float32")
+        c_prev = rng.uniform(-0.5, 0.5, (b_, H)).astype("float32")
+        fb = 0.5
+        i, f = sigmoid(x[:, :H]), sigmoid(x[:, H:2 * H] + fb)
+        cand, o = np.tanh(x[:, 2 * H:3 * H]), sigmoid(x[:, 3 * H:])
+        c = f * c_prev + i * cand
+        h = o * np.tanh(c)
+        self.inputs = {"X": x, "C_prev": c_prev}
+        self.attrs = {"forget_bias": fb}
+        self.outputs = {"C": c, "H": h}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X", "C_prev"], ["C", "H"],
+                        max_relative_error=0.03)
+
+
+class TestGruUnit(OpTest):
+    op_type = "gru_unit"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(37)
+        b_, H = 5, 4
+        x = rng.uniform(-0.5, 0.5, (b_, 3 * H)).astype("float32")
+        h_prev = rng.uniform(-0.5, 0.5, (b_, H)).astype("float32")
+        w = rng.uniform(-0.3, 0.3, (H, 3 * H)).astype("float32")
+        b = rng.uniform(-0.2, 0.2, (1, 3 * H)).astype("float32")
+        g = x + b
+        u = sigmoid(g[:, :H] + h_prev @ w[:, :H])
+        r = sigmoid(g[:, H:2 * H] + h_prev @ w[:, H:2 * H])
+        c = np.tanh(g[:, 2 * H:] + (r * h_prev) @ w[:, 2 * H:])
+        h = u * h_prev + (1 - u) * c
+        self.inputs = {"Input": x, "HiddenPrev": h_prev, "Weight": w,
+                       "Bias": b}
+        self.outputs = {"Gate": np.concatenate([u, r, c], axis=1),
+                        "ResetHiddenPrev": r * h_prev, "Hidden": h}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, no_check_set=["Gate", "ResetHiddenPrev"])
+
+    def test_grad(self):
+        self.check_grad(["Input", "HiddenPrev", "Weight"], "Hidden",
+                        max_relative_error=0.06)

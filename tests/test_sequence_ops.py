@@ -1,0 +1,256 @@
+"""Sequence-op numeric tests over ragged (LoD) inputs.
+
+Numpy references computed per-sequence on the flat concatenated layout, like
+/root/reference/python/paddle/fluid/tests/unittests/test_seq_pool.py,
+test_sequence_softmax_op.py, test_seq_conv.py, test_sequence_expand.py,
+test_sequence_reshape.py, test_sequence_slice_op.py, test_sequence_erase_op.py,
+test_row_conv_op.py. LoD inputs are (flat_array, lod) tuples.
+"""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _lod():
+    return [[0, 4, 5, 8]]
+
+
+def _flat(dim=3, seed=3):
+    rng = np.random.RandomState(seed)
+    return rng.uniform(0.1, 1, (8, dim)).astype("float32")
+
+
+class TestSeqAvgPool(OpTest):
+    op_type = "sequence_pool"
+    pooltype = "AVERAGE"
+
+    def ref(self, x, offs):
+        out = []
+        for i in range(len(offs) - 1):
+            seq = x[offs[i]:offs[i + 1]]
+            if self.pooltype == "AVERAGE":
+                out.append(seq.mean(axis=0))
+            elif self.pooltype == "SUM":
+                out.append(seq.sum(axis=0))
+            elif self.pooltype == "SQRT":
+                out.append(seq.sum(axis=0) / np.sqrt(len(seq)))
+            elif self.pooltype == "MAX":
+                out.append(seq.max(axis=0))
+            elif self.pooltype == "LAST":
+                out.append(seq[-1])
+            elif self.pooltype == "FIRST":
+                out.append(seq[0])
+        return np.stack(out)
+
+    def setup_method(self, method):
+        x = _flat()
+        lod = _lod()
+        self.inputs = {"X": (x, lod)}
+        self.attrs = {"pooltype": self.pooltype}
+        self.outputs = {"Out": self.ref(x, lod[0])}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        if self.pooltype in ("MAX", "LAST", "FIRST"):
+            pytest.skip("subgradient / selection pools: forward-checked only")
+        self.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+class TestSeqSumPool(TestSeqAvgPool):
+    pooltype = "SUM"
+
+
+class TestSeqSqrtPool(TestSeqAvgPool):
+    pooltype = "SQRT"
+
+
+class TestSeqMaxPool(TestSeqAvgPool):
+    pooltype = "MAX"
+
+
+class TestSeqLastPool(TestSeqAvgPool):
+    pooltype = "LAST"
+
+
+class TestSeqFirstPool(TestSeqAvgPool):
+    pooltype = "FIRST"
+
+
+class TestSequenceSoftmax(OpTest):
+    op_type = "sequence_softmax"
+
+    def setup_method(self, method):
+        x = _flat(dim=1)
+        lod = _lod()
+        out = np.zeros_like(x)
+        for i in range(len(lod[0]) - 1):
+            seq = x[lod[0][i]:lod[0][i + 1], 0]
+            e = np.exp(seq - seq.max())
+            out[lod[0][i]:lod[0][i + 1], 0] = e / e.sum()
+        self.inputs = {"X": (x, lod)}
+        self.outputs = {"Out": (out, lod)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+class TestSequenceExpand(OpTest):
+    op_type = "sequence_expand"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(5)
+        x = rng.uniform(0.1, 1, (3, 4)).astype("float32")  # one row per seq
+        y_lod = [[0, 2, 5, 6]]
+        y = rng.uniform(0.1, 1, (6, 4)).astype("float32")
+        out = np.concatenate([
+            np.tile(x[i], (y_lod[0][i + 1] - y_lod[0][i], 1))
+            for i in range(3)])
+        self.inputs = {"X": x, "Y": (y, y_lod)}
+        self.outputs = {"Out": (out, y_lod)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+class TestSequenceReshape(OpTest):
+    op_type = "sequence_reshape"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(7)
+        x = rng.uniform(0.1, 1, (6, 4)).astype("float32")
+        lod = [[0, 2, 6]]
+        new_dim = 2
+        out = x.reshape(-1, new_dim)
+        out_lod = [[0, 4, 12]]
+        self.inputs = {"X": (x, lod)}
+        self.attrs = {"new_dim": new_dim}
+        self.outputs = {"Out": (out, out_lod)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSequenceConcat(OpTest):
+    op_type = "sequence_concat"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(11)
+        x1 = rng.uniform(0.1, 1, (5, 3)).astype("float32")
+        lod1 = [[0, 2, 5]]
+        x2 = rng.uniform(0.1, 1, (4, 3)).astype("float32")
+        lod2 = [[0, 3, 4]]
+        out = np.concatenate([x1[0:2], x2[0:3], x1[2:5], x2[3:4]])
+        out_lod = [[0, 5, 9]]
+        self.inputs = {"X": [("x1", (x1, lod1)), ("x2", (x2, lod2))]}
+        self.outputs = {"Out": (out, out_lod)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x1", "x2"], "Out", max_relative_error=0.03)
+
+
+class TestSequenceSlice(OpTest):
+    op_type = "sequence_slice"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(13)
+        x = rng.uniform(0.1, 1, (10, 2)).astype("float32")
+        lod = [[0, 4, 10]]
+        offset = np.array([[1], [2]]).astype("int64")
+        length = np.array([[2], [3]]).astype("int64")
+        out = np.concatenate([x[1:3], x[6:9]])
+        out_lod = [[0, 2, 5]]
+        self.inputs = {"X": (x, lod), "Offset": offset, "Length": length}
+        self.outputs = {"Out": (out, out_lod)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSequenceErase(OpTest):
+    op_type = "sequence_erase"
+
+    def setup_method(self, method):
+        x = np.array([1, 2, 3, 2, 5, 2, 7, 0, 2, 0]).astype("int32")
+        lod = [[0, 5, 10]]
+        tokens = [2, 0]
+        out = np.array([1, 3, 5, 7]).astype("int32")
+        out_lod = [[0, 3, 4]]
+        self.inputs = {"X": (x.reshape(-1, 1), lod)}
+        self.attrs = {"tokens": tokens}
+        self.outputs = {"Out": (out.reshape(-1, 1), out_lod)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestRowConv(OpTest):
+    op_type = "row_conv"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(17)
+        x = rng.uniform(0.1, 1, (9, 4)).astype("float32")
+        lod = [[0, 3, 9]]
+        k = 3  # future context 2 + current
+        w = rng.uniform(0.1, 1, (k, 4)).astype("float32")
+        out = np.zeros_like(x)
+        offs = lod[0]
+        for i in range(len(offs) - 1):
+            seq = x[offs[i]:offs[i + 1]]
+            for t in range(len(seq)):
+                for j in range(k):
+                    if t + j < len(seq):
+                        out[offs[i] + t] += seq[t + j] * w[j]
+        self.inputs = {"X": (x, lod), "Filter": w}
+        self.outputs = {"Out": (out, lod)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Filter"], "Out", max_relative_error=0.05)
+
+
+class TestSequenceConv(OpTest):
+    op_type = "sequence_conv"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(19)
+        D, M, ctx = 3, 4, 3
+        x = rng.uniform(0.1, 1, (8, D)).astype("float32")
+        lod = _lod()
+        w = rng.uniform(-0.5, 0.5, (ctx * D, M)).astype("float32")
+        start = -1
+        out = np.zeros((8, M), dtype="float32")
+        offs = lod[0]
+        for i in range(len(offs) - 1):
+            seq = x[offs[i]:offs[i + 1]]
+            for t in range(len(seq)):
+                col = np.zeros(ctx * D, dtype="float32")
+                for j in range(ctx):
+                    src = t + start + j
+                    if 0 <= src < len(seq):
+                        col[j * D:(j + 1) * D] = seq[src]
+                out[offs[i] + t] = col @ w
+        self.inputs = {"X": (x, lod), "Filter": w}
+        self.attrs = {"contextLength": ctx, "contextStart": start,
+                      "contextStride": 1}
+        self.outputs = {"Out": (out, lod)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Filter"], "Out", max_relative_error=0.05)
