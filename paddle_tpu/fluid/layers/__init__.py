@@ -5,7 +5,11 @@ nn, io, tensor, control_flow, ops, device, detection, metric modules into one
 flat namespace.
 """
 
-from . import nn, tensor, io, ops, sequence
+from . import nn, tensor, io, ops, sequence, control_flow
+from .control_flow import (While, Switch, StaticRNN, DynamicRNN,  # noqa: F401
+                           increment, less_than, create_array, array_write,
+                           array_read, array_length, beam_search,
+                           beam_search_decode, batch_gather)
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .io import data  # noqa: F401
@@ -21,7 +25,7 @@ from .nn import (fc, embedding, dropout, softmax, cross_entropy,  # noqa: F401
                  accuracy, topk, mul, matmul, elementwise_add,
                  elementwise_sub, elementwise_mul, elementwise_div,
                  conv2d, conv2d_transpose, pool2d, batch_norm, layer_norm,
-                 lrn)
+                 lrn, cos_sim)
 from .tensor import (cast, concat, sums, assign, fill_constant,  # noqa: F401
                      fill_constant_batch_size_like, ones, zeros, reshape,
                      transpose, split, argmax, create_tensor)

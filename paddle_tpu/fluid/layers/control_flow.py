@@ -1,0 +1,348 @@
+"""Control-flow layer API: While, StaticRNN, DynamicRNN, Switch, tensor
+arrays, counters.
+
+Reference: /root/reference/python/paddle/fluid/layers/control_flow.py —
+StaticRNN (:382), While (:607), DynamicRNN (:1349), Switch, increment,
+array_write/array_read/array_length, less_than. The APIs match; the ops they
+build lower to lax.while_loop / lax.scan (ops/control_flow_ops.py) instead of
+the reference's interpreted sub-scopes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..framework import unique_name
+from ..layer_helper import LayerHelper
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.create_tmp_variable(x.dtype, shape=x.shape)
+    helper.append_op("increment", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"step": value})
+    return out
+
+
+def less_than(x, y, cond=None):
+    helper = LayerHelper("less_than")
+    if cond is None:
+        cond = helper.create_tmp_variable("bool", shape=x.shape)
+    helper.append_op("less_than", inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [cond.name]})
+    return cond
+
+
+def create_array(dtype, cap=64):
+    """LoDTensorArray variable (reference create_array). ``cap`` bounds the
+    number of steps (static pre-allocation for XLA; the runtime buffer is
+    allocated lazily by the first write_to_array)."""
+    helper = LayerHelper("create_array")
+    var = helper.block.create_var(name=unique_name("array"), dtype=dtype)
+    var.is_tensor_array = True
+    var.array_cap = cap
+    return var
+
+
+def array_write(x, i, array=None, cap=64):
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype, cap=cap)
+    # build-time element metadata so array_read outputs have shapes
+    if getattr(array, "elem_shape", None) is None:
+        array.elem_shape = x.shape
+        array.elem_dtype = x.dtype
+    helper.append_op("write_to_array",
+                     inputs={"X": [x.name], "I": [i.name],
+                             "Array": [array.name]},
+                     outputs={"Out": [array.name]},
+                     attrs={"cap": getattr(array, "array_cap", cap)})
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_tmp_variable(
+        getattr(array, "elem_dtype", "float32"),
+        shape=getattr(array, "elem_shape", None))
+    helper.append_op("read_from_array",
+                     inputs={"X": [array.name], "I": [i.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_tmp_variable("int64", shape=(1,))
+    helper.append_op("array_length", inputs={"X": [array.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+class While:
+    """while_op builder (reference control_flow.py:607):
+
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond)
+        with w.block():
+            ...
+            layers.less_than(i, limit, cond=cond)  # update condition
+    """
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        parent_idx = program.current_block().idx
+        sub = program.create_block()
+        yield
+        program.rollback()
+        parent = program.blocks[parent_idx]
+        parent.append_op(
+            "while",
+            inputs={"Condition": [self.cond_var.name]},
+            outputs={},
+            attrs={"sub_block": sub.idx})
+
+
+class Switch:
+    """Scalar-guarded case chain (reference control_flow.py Switch); each
+    case body runs under a conditional_block with select semantics."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self._not_prev = None  # conjunction of negated prior conditions
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        helper = self.helper
+        if self._not_prev is not None:
+            combined = helper.create_tmp_variable("bool")
+            helper.append_op("logical_and",
+                             inputs={"X": [self._not_prev.name],
+                                     "Y": [condition.name]},
+                             outputs={"Out": [combined.name]})
+            cond = combined
+        else:
+            cond = condition
+        notc = helper.create_tmp_variable("bool")
+        helper.append_op("logical_not", inputs={"X": [condition.name]},
+                         outputs={"Out": [notc.name]})
+        if self._not_prev is None:
+            self._not_prev = notc
+        else:
+            acc = helper.create_tmp_variable("bool")
+            helper.append_op("logical_and",
+                             inputs={"X": [self._not_prev.name],
+                                     "Y": [notc.name]},
+                             outputs={"Out": [acc.name]})
+            self._not_prev = acc
+
+        program = helper.main_program
+        parent_idx = program.current_block().idx
+        sub = program.create_block()
+        yield
+        program.rollback()
+        program.blocks[parent_idx].append_op(
+            "conditional_block", inputs={"Cond": [cond.name]}, outputs={},
+            attrs={"sub_block": sub.idx})
+
+    @contextlib.contextmanager
+    def default(self):
+        assert self._not_prev is not None, "default() before any case()"
+        program = self.helper.main_program
+        parent_idx = program.current_block().idx
+        sub = program.create_block()
+        yield
+        program.rollback()
+        program.blocks[parent_idx].append_op(
+            "conditional_block", inputs={"Cond": [self._not_prev.name]},
+            outputs={}, attrs={"sub_block": sub.idx})
+
+
+class _RNNBase:
+    """Shared builder for StaticRNN / DynamicRNN: collects step inputs,
+    memories and outputs, then appends one recurrent/dynamic_recurrent op."""
+
+    OP_TYPE = "recurrent"
+    IN_RNN_BLOCK = False
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper(self.OP_TYPE, name=name)
+        self.step_inputs = []   # outer var names
+        self.step_vars = []     # block-local per-step names
+        self.memories = []      # (mem_name, new_name)
+        self.mem_inits = {}     # mem_name -> init var name
+        self.outputs = []
+        self.out_vars = []
+        self._sub_idx = None
+        self._parent_idx = None
+        self._status = "outside"
+
+    @contextlib.contextmanager
+    def step(self):
+        program = self.helper.main_program
+        self._parent_idx = program.current_block().idx
+        sub = program.create_block()
+        self._sub_idx = sub.idx
+        self._status = "in_block"
+        yield
+        program.rollback()
+        self._status = "done"
+        self._append_op()
+
+    def _append_op(self):
+        parent = self.helper.main_program.blocks[self._parent_idx]
+        parent.append_op(
+            self.OP_TYPE,
+            inputs={"Inputs": self.step_inputs,
+                    "MemInits": list(self.mem_inits.values())},
+            outputs={},
+            attrs={"sub_block": self._sub_idx,
+                   "step_inputs": list(self.step_inputs),
+                   "step_vars": list(self.step_vars),
+                   "memories": [list(m) for m in self.memories],
+                   "mem_inits": {k: v for k, v in self.mem_inits.items()},
+                   "outputs": list(self.outputs)})
+
+    # -- inside-block API --
+    def step_input(self, x):
+        assert self._status == "in_block", "step_input outside rnn.step()"
+        block = self.helper.main_program.current_block()
+        iv = block.create_var(name=unique_name(x.name + "@step"),
+                              dtype=x.dtype,
+                              shape=tuple(x.shape[1:]) if x.shape else None)
+        self.step_inputs.append(x.name)
+        self.step_vars.append(iv.name)
+        return iv
+
+    def memory(self, init=None, shape=None, value=0.0, dtype="float32"):
+        assert self._status == "in_block", "memory outside rnn.step()"
+        if init is None:
+            assert shape is not None
+            from . import tensor as tensor_layers
+            program = self.helper.main_program
+            # build the init in the PARENT block (it is loop state)
+            cur = program._current_block_idx
+            program._current_block_idx = self._parent_idx
+            init = tensor_layers.fill_constant(shape=shape, dtype=dtype,
+                                               value=value)
+            program._current_block_idx = cur
+        block = self.helper.main_program.current_block()
+        mem = block.create_var(name=unique_name("rnn_memory"),
+                               dtype=init.dtype, shape=init.shape)
+        self.mem_inits[mem.name] = init.name
+        return mem
+
+    def update_memory(self, mem, new):
+        assert self._status == "in_block"
+        self.memories.append((mem.name, new.name))
+
+    def step_output(self, o):
+        assert self._status == "in_block"
+        self.outputs.append(o.name)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    # -- outside-block API --
+    def __call__(self):
+        """Stacked step outputs (reference StaticRNN.__call__ /
+        DynamicRNN.__call__)."""
+        parent = self.helper.main_program.blocks[self._parent_idx]
+        lod = 1 if self.OP_TYPE == "dynamic_recurrent" else 0
+        outs = []
+        for o in self.outputs:
+            ov = parent.create_var(name=o + "@STACKED", lod_level=lod)
+            outs.append(ov)
+        return outs[0] if len(outs) == 1 else outs
+
+    def final_memory(self, mem):
+        parent = self.helper.main_program.blocks[self._parent_idx]
+        return parent.create_var(name=mem.name + "@FINAL", dtype=mem.dtype,
+                                 shape=mem.shape)
+
+
+class StaticRNN(_RNNBase):
+    """Fixed-length RNN over dense [batch, T, feat] inputs; the block runs
+    once per timestep via lax.scan (reference StaticRNN, recurrent_op.cc).
+
+    The reference wires memories via rnn_memory_helper ops and boot memories;
+    here memory() records an init var and update_memory() the per-step
+    rebinding, and the scan carries them."""
+    OP_TYPE = "recurrent"
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                name=None):
+    """One beam-search step over dense [batch, beam] state (reference
+    layers beam_search → beam_search_op.h). Returns (selected_ids,
+    selected_scores, parent_idx); parent_idx replaces the reference's
+    LoD-encoded beam provenance."""
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = helper.create_tmp_variable("int64")
+    sel_scores = helper.create_tmp_variable(scores.dtype)
+    parents = helper.create_tmp_variable("int64")
+    helper.append_op(
+        "beam_search",
+        inputs={"pre_ids": [pre_ids.name], "pre_scores": [pre_scores.name],
+                "ids": [ids.name], "scores": [scores.name]},
+        outputs={"selected_ids": [sel_ids.name],
+                 "selected_scores": [sel_scores.name],
+                 "parent_idx": [parents.name]},
+        attrs={"beam_size": beam_size, "end_id": end_id})
+    return sel_ids, sel_scores, parents
+
+
+def batch_gather(x, index):
+    """out[i, j] = x[i, index[i, j]] (beam-state reordering by parent_idx)."""
+    helper = LayerHelper("batch_gather")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("batch_gather",
+                     inputs={"X": [x.name], "Index": [index.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def beam_search_decode(ids, parents, scores, end_id, name=None):
+    """Backtrack a finished beam search: ``ids``/``parents`` are tensor
+    arrays written once per step, ``scores`` the final accumulated scores.
+    Returns (sentence_ids LoD var of batch*beam ragged sequences,
+    sentence_scores)."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent_ids = helper.create_tmp_variable("int64", lod_level=1)
+    sent_scores = helper.create_tmp_variable(scores.dtype)
+    helper.append_op(
+        "beam_search_decode",
+        inputs={"Ids": [ids.name], "Parents": [parents.name],
+                "Scores": [scores.name]},
+        outputs={"SentenceIds": [sent_ids.name],
+                 "SentenceScores": [sent_scores.name]},
+        attrs={"end_id": end_id})
+    return sent_ids, sent_scores
+
+
+class DynamicRNN(_RNNBase):
+    """Ragged RNN over LoD inputs. The reference sorts by length via
+    lod_rank_table and shrinks the live batch as sequences end
+    (shrink_rnn_memory_op.cc); the TPU lowering keeps the batch in place and
+    masks memory updates per row (identical results on valid rows, one fused
+    scan on device)."""
+    OP_TYPE = "dynamic_recurrent"
+
+    @contextlib.contextmanager
+    def block(self):
+        with self.step():
+            yield
+
+    def static_input(self, x):
+        """A non-stepped input read in full every step (reference
+        DynamicRNN.static_input): nothing to do — the block closes over it."""
+        return x

@@ -55,9 +55,11 @@ def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
     helper = LayerHelper("embedding")
     w = helper.create_parameter(param_attr, shape=tuple(size), dtype=dtype,
                                 default_initializer=Xavier())
-    out = helper.create_tmp_variable(
-        dtype, shape=tuple(input.shape[:-1] or input.shape) + (size[1],),
-        lod_level=input.lod_level)
+    out_shape = None
+    if input.shape is not None:
+        out_shape = tuple(input.shape[:-1] or input.shape) + (size[1],)
+    out = helper.create_tmp_variable(dtype, shape=out_shape,
+                                     lod_level=input.lod_level)
     helper.append_op("lookup_table",
                      inputs={"W": [w.name], "Ids": [input.name]},
                      outputs={"Out": [out.name]},
@@ -435,6 +437,16 @@ def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
     helper.append_op("lrn", inputs={"X": [input.name]},
                      outputs={"Out": [out.name], "MidOut": [mid.name]},
                      attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def cos_sim(X, Y):
+    """Row-wise cosine similarity (reference nn.py cos_sim → cos_sim op)."""
+    helper = LayerHelper("cos_sim")
+    out_shape = tuple(X.shape[:-1]) + (1,) if X.shape is not None else None
+    out = helper.create_tmp_variable(X.dtype, shape=out_shape)
+    helper.append_op("cos_sim", inputs={"X": [X.name], "Y": [Y.name]},
+                     outputs={"Out": [out.name]})
     return out
 
 

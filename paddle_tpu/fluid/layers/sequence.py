@@ -185,8 +185,8 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
     concat_out = tensor.concat([x_t, hidden_t_prev], axis=1)
     fc_out = nn.fc(concat_out, size=size, param_attr=param_attr,
                    bias_attr=bias_attr)
-    c = helper.create_tmp_variable(x_t.dtype)
-    h = helper.create_tmp_variable(x_t.dtype)
+    c = helper.create_tmp_variable(x_t.dtype, shape=cell_t_prev.shape)
+    h = helper.create_tmp_variable(x_t.dtype, shape=cell_t_prev.shape)
     helper.append_op("lstm_unit",
                      inputs={"X": [fc_out.name],
                              "C_prev": [cell_t_prev.name]},
@@ -207,9 +207,11 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
     bias = helper.create_parameter(ParamAttr.to_attr(bias_attr),
                                    shape=(1, 3 * hidden_dim),
                                    dtype=input.dtype, is_bias=True)
-    gate = helper.create_tmp_variable(input.dtype)
-    reset_hidden_pre = helper.create_tmp_variable(input.dtype)
-    updated_hidden = helper.create_tmp_variable(input.dtype)
+    gate = helper.create_tmp_variable(input.dtype, shape=input.shape)
+    reset_hidden_pre = helper.create_tmp_variable(input.dtype,
+                                                  shape=hidden.shape)
+    updated_hidden = helper.create_tmp_variable(input.dtype,
+                                                shape=hidden.shape)
     helper.append_op(
         "gru_unit",
         inputs={"Input": [input.name], "HiddenPrev": [hidden.name],

@@ -1,0 +1,384 @@
+"""Control-flow ops: while, recurrent (StaticRNN/DynamicRNN), TensorArray,
+conditional_block, beam_search, beam_search_decode.
+
+Reference: /root/reference/paddle/fluid/operators/while_op.cc (scope-mutating
+loop over a sub-block), recurrent_op.cc:39-103 (StepScopes per timestep),
+tensor_array_read_write ops, conditional_block_op.cc, beam_search_op.h:96-193,
+beam_search_decode_op.cc, and the lod_rank_table/shrink_rnn_memory DynamicRNN
+machinery (lod_rank_table_op.cc, shrink_rnn_memory_op.cc).
+
+TPU-native re-design (SURVEY.md §7 hard part b): the reference mutates step
+scopes imperatively; under XLA everything must functionalize:
+
+* TensorArray (the reference's LoDTensorArray) becomes ``TensorArrayVal`` — a
+  PRE-ALLOCATED [cap, ...] device buffer plus a length counter, a pytree that
+  crosses jit/scan/while_loop. Writes are dynamic_update_slice at a traced
+  index. Arrays carried through a while loop must receive one write before
+  the loop so their shape is known (the reference's decoders all do this).
+* ``while`` lowers to ONE ``lax.while_loop`` whose carry is exactly the set
+  of block-written variables that pre-exist outside, plus the condition.
+* ``recurrent``/``dynamic_recurrent`` (StaticRNN/DynamicRNN) lower to ONE
+  ``lax.scan`` over the time axis. DynamicRNN replaces the reference's
+  lod_rank_table + shrink_rnn_memory batch-shrinking (a GPU-efficiency
+  reordering) with per-row aliveness masking over the padded LoD batch — the
+  TPU equivalent with identical semantics on the valid region.
+* ``conditional_block`` runs its block and select()s outputs against the
+  previous bindings — XLA computes both sides, cond picks (scalar guards
+  like LR schedules and Switch cases).
+* ``beam_search`` works on DENSE [batch, beam] state (scores accumulated in
+  log space, finished beams frozen at end_id) instead of the reference's
+  2-level-LoD layout; ``beam_search_decode`` backtracks stored parent
+  pointers into a LoDArray of [batch*beam] ragged token sequences.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op
+from .common import data_of
+
+
+@jax.tree_util.register_pytree_node_class
+class TensorArrayVal:
+    """Pre-allocated tensor array: data [cap, ...], length scalar int32."""
+
+    __slots__ = ("data", "length")
+
+    def __init__(self, data, length):
+        self.data = data
+        self.length = length
+
+    def tree_flatten(self):
+        return (self.data, self.length), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def cap(self):
+        return self.data.shape[0]
+
+    def __repr__(self):
+        return (f"TensorArrayVal(cap={getattr(self.data, 'shape', None)}, "
+                f"length={self.length})")
+
+
+class EmptyTensorArray:
+    """Build-time placeholder until the first write fixes the element shape."""
+
+    def __init__(self, cap):
+        self.cap = cap
+
+
+def _as_scalar_i32(v):
+    return data_of(v).reshape(()).astype(jnp.int32)
+
+
+@register_op("write_to_array")
+def write_to_array(ctx):
+    x = ctx.input("X")
+    xd = x.data if isinstance(x, LoDArray) else data_of(x)
+    i = _as_scalar_i32(ctx.input("I"))
+    # read-modify-write: the array var is both input "Array" and output "Out"
+    # (the reference write_to_array aliases them); first write allocates the
+    # [cap, ...] buffer from the element's shape
+    arr = ctx.input("Array") if ctx.has_input("Array") else None
+    if arr is None or isinstance(arr, EmptyTensorArray):
+        cap = arr.cap if arr is not None else ctx.attr("cap", 64)
+        data = jnp.zeros((cap,) + xd.shape, xd.dtype)
+        length = jnp.zeros((), jnp.int32)
+        arr = TensorArrayVal(data, length)
+    new_data = jax.lax.dynamic_update_index_in_dim(arr.data, xd.astype(
+        arr.data.dtype), i, axis=0)
+    new_len = jnp.maximum(arr.length, i + 1)
+    ctx.set_output("Out", TensorArrayVal(new_data, new_len))
+
+
+@register_op("read_from_array")
+def read_from_array(ctx):
+    arr = ctx.input("X")
+    i = _as_scalar_i32(ctx.input("I"))
+    ctx.set_output("Out", jax.lax.dynamic_index_in_dim(arr.data, i, axis=0,
+                                                       keepdims=False))
+
+
+@register_op("array_length")
+def array_length(ctx):
+    arr = ctx.input("X")
+    ctx.set_output("Out", arr.length.reshape(1).astype(jnp.int64)
+                   if hasattr(arr.length, "reshape")
+                   else jnp.asarray([arr.length], jnp.int64))
+
+
+@register_op("max_sequence_len")
+def max_sequence_len(ctx):
+    """Max length of a LoD input (max_sequence_len over the rank table in the
+    reference; here directly over lens)."""
+    x = ctx.input("RankTable")
+    lens = x.lens if isinstance(x, LoDArray) else data_of(x)
+    ctx.set_output("Out", jnp.max(lens).reshape(1).astype(jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# while
+# ---------------------------------------------------------------------------
+
+def _block_written(block):
+    """Names written by the block, recursing into nested control-flow
+    sub-blocks (a nested While/Switch writing an outer var must still appear
+    in the enclosing loop's carry)."""
+    seen, out = set(), []
+
+    def walk(blk):
+        for op in blk.ops:
+            for n in op.output_arg_names():
+                if n not in seen:
+                    seen.add(n)
+                    out.append(n)
+            for attr in ("sub_block", "sub_block_false"):
+                if op.has_attr(attr):
+                    walk(blk.program.blocks[op.attr(attr)])
+
+    walk(block)
+    return out
+
+
+@register_op("while", is_control_flow=True)
+def while_op(ctx):
+    """ONE lax.while_loop over the sub-block (vs. the reference's interpreted
+    scope-loop, while_op.cc). Carry = condition + every block-written var
+    that already exists in the enclosing env (loop state); everything else
+    the block writes is a per-iteration temporary."""
+    sub = ctx.sub_block("sub_block")
+    cond_name = ctx.op.input("Condition")[0]
+    env = ctx.env
+
+    written = _block_written(sub)
+    carry_names = [n for n in written if n in env]
+    if cond_name not in carry_names:
+        carry_names.append(cond_name)
+
+    from ..core.executor import _run_ops
+
+    def cond_fn(carry):
+        return data_of(carry[cond_name]).reshape(()).astype(jnp.bool_)
+
+    def body_fn(carry):
+        local = dict(env)
+        local.update(carry)
+        _run_ops(sub, local, ctx._exec)
+        return {n: local[n] for n in carry_names}
+
+    init = {n: env[n] for n in carry_names}
+    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    env.update(final)
+
+
+@register_op("conditional_block", is_control_flow=True)
+def conditional_block(ctx):
+    """Select-semantics conditional (scalar guard): run the block, keep its
+    writes where cond else the previous binding (zeros when unbound). XLA
+    evaluates both sides; cond picks — the jit-compatible lowering of
+    conditional_block_op.cc for scalar conditions (Switch/LR schedules)."""
+    sub = ctx.sub_block("sub_block")
+    cond = data_of(ctx.inputs("Cond")[0]).reshape(()).astype(jnp.bool_)
+    env = ctx.env
+    from ..core.executor import _run_ops
+
+    local = dict(env)
+    _run_ops(sub, local, ctx._exec)
+    for n in _block_written(sub):
+        new = local[n]
+        old = env.get(n)
+        if old is None:
+            old = jax.tree_util.tree_map(jnp.zeros_like, new)
+        env[n] = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(cond, a, b), new, old)
+
+
+# ---------------------------------------------------------------------------
+# recurrent (StaticRNN) and dynamic_recurrent (DynamicRNN)
+# ---------------------------------------------------------------------------
+
+def _scan_recurrent(ctx, lens):
+    """Shared lowering: lax.scan over time with memory carries.
+
+    attrs: sub_block, step_inputs [outer names], step_vars [block-local
+    per-step names], memories [(mem_name, new_name)], outputs [block names].
+    ``lens`` is None for StaticRNN (all rows run full length) or [b] int32
+    for DynamicRNN aliveness masking.
+    """
+    sub = ctx.sub_block("sub_block")
+    env = ctx.env
+    step_inputs = ctx.attr("step_inputs", [])
+    step_vars = ctx.attr("step_vars", [])
+    memories = [tuple(m) for m in ctx.attr("memories", [])]
+    mem_inits = ctx.attr("mem_inits", {})
+    out_names = ctx.attr("outputs", [])
+
+    from ..core.executor import _run_ops
+
+    xs = {}
+    T = None
+    for outer, inner in zip(step_inputs, step_vars):
+        v = env[outer]
+        d = v.data if isinstance(v, LoDArray) else data_of(v)
+        xs[inner] = jnp.swapaxes(d, 0, 1)      # time-major [T, b, ...]
+        T = xs[inner].shape[0]
+
+    init_mems = {mem: data_of(env[mem_inits[mem]]) for mem, _ in memories}
+
+    def body(carry, step):
+        t, slices = step
+        local = dict(env)
+        local.update({mem: val for mem, val in carry.items()})
+        local.update(slices)
+        _run_ops(sub, local, ctx._exec)
+        new_carry = {}
+        for mem, new in memories:
+            new_val = data_of(local[new])
+            if lens is not None:
+                alive = (t < lens).reshape(
+                    (-1,) + (1,) * (new_val.ndim - 1)).astype(new_val.dtype)
+                new_val = alive * new_val + (1 - alive) * carry[mem]
+            new_carry[mem] = new_val
+        outs = {}
+        for o in out_names:
+            ov = data_of(local[o])
+            if lens is not None:
+                alive = (t < lens).reshape(
+                    (-1,) + (1,) * (ov.ndim - 1)).astype(ov.dtype)
+                ov = ov * alive
+            outs[o] = ov
+        return new_carry, outs
+
+    steps = (jnp.arange(T), xs)
+    final_mems, stacked = jax.lax.scan(body, init_mems, steps)
+    for o in out_names:
+        out = jnp.swapaxes(stacked[o], 0, 1)   # back to [b, T, ...]
+        ctx.env[o + "@STACKED"] = LoDArray(out, lens) if lens is not None \
+            else out
+    for mem, _ in memories:
+        ctx.env[mem + "@FINAL"] = final_mems[mem]
+
+
+@register_op("recurrent", is_control_flow=True)
+def recurrent(ctx):
+    _scan_recurrent(ctx, lens=None)
+
+
+@register_op("dynamic_recurrent", is_control_flow=True)
+def dynamic_recurrent(ctx):
+    first = ctx.env[ctx.attr("step_inputs")[0]]
+    if not isinstance(first, LoDArray):
+        raise TypeError("dynamic_recurrent expects LoD step inputs")
+    _scan_recurrent(ctx, lens=first.lens)
+
+
+@register_op("batch_gather")
+def batch_gather(ctx):
+    """Out[i, j] = X[i, Index[i, j]] over the second axis — the beam-state
+    reordering primitive (the reference encodes beam provenance in LoD and
+    re-gathers via sequence_expand; dense beams gather by parent_idx)."""
+    x = data_of(ctx.input("X"))
+    idx = data_of(ctx.input("Index")).astype(jnp.int32)
+    bidx = jnp.arange(x.shape[0])[:, None]
+    ctx.set_output("Out", x[bidx, idx])
+
+
+# ---------------------------------------------------------------------------
+# beam search (dense [batch, beam] layout)
+# ---------------------------------------------------------------------------
+
+@register_op("beam_search")
+def beam_search(ctx):
+    """One beam step. Inputs: pre_ids [b, beam] int, pre_scores [b, beam]
+    (accumulated log-probs), ids [b, beam, k] candidate tokens, scores
+    [b, beam, k] candidate log-probs. Finished beams (pre_id == end_id) emit
+    only end_id with unchanged score. Outputs selected_ids/selected_scores
+    [b, beam] and parent_idx [b, beam] (which source beam each came from).
+    Dense re-design of beam_search_op.h:96-193."""
+    pre_ids = data_of(ctx.input("pre_ids")).astype(jnp.int32)
+    pre_scores = data_of(ctx.input("pre_scores"))
+    cand_ids = data_of(ctx.input("ids")).astype(jnp.int32)
+    cand_scores = data_of(ctx.input("scores"))
+    beam = int(ctx.attr("beam_size"))
+    end_id = int(ctx.attr("end_id"))
+
+    b, bm, k = cand_scores.shape
+    finished = pre_ids == end_id                        # [b, beam]
+    # finished beams: single continuation (end_id, score unchanged)
+    total = pre_scores[:, :, None] + cand_scores        # [b, beam, k]
+    neg_inf = jnp.asarray(-1e9, total.dtype)
+    # mask all but candidate 0 of finished beams; candidate 0 keeps score
+    keep_first = jnp.arange(k)[None, None, :] == 0
+    total = jnp.where(finished[:, :, None],
+                      jnp.where(keep_first, pre_scores[:, :, None], neg_inf),
+                      total)
+    ids_eff = jnp.where(finished[:, :, None], end_id, cand_ids)
+
+    flat_scores = total.reshape(b, bm * k)
+    top_scores, top_idx = jax.lax.top_k(flat_scores, beam)  # [b, beam]
+    parent = (top_idx // k).astype(jnp.int32)
+    sel_ids = jnp.take_along_axis(ids_eff.reshape(b, bm * k), top_idx, axis=1)
+    ctx.set_output("selected_ids", sel_ids)
+    ctx.set_output("selected_scores", top_scores)
+    ctx.set_output("parent_idx", parent)
+
+
+@register_op("beam_search_decode")
+def beam_search_decode(ctx):
+    """Backtrack beams: Ids/Parents are TensorArrays of [b, beam] per step
+    (Ids[0] is the init token), Scores the accumulated scores at the last
+    step. Emits SentenceIds as a LoDArray of batch*beam ragged sequences
+    (eos-trimmed) and SentenceScores [b*beam] — the dense equivalent of
+    beam_search_decode_op.cc's 2-level-LoD backtrack."""
+    ids_arr = ctx.input("Ids")
+    parents_arr = ctx.input("Parents")
+    scores = data_of(ctx.input("Scores"))
+    end_id = int(ctx.attr("end_id"))
+
+    ids = ids_arr.data                # [cap, b, beam]
+    parents = parents_arr.data
+    T = ids.shape[0]
+    b, beam = ids.shape[1], ids.shape[2]
+
+    def back(carry, t):
+        beam_idx = carry              # [b, beam] which beam at step t+1
+        tok = jnp.take_along_axis(ids[t], beam_idx, axis=1)
+        prev = jnp.take_along_axis(parents[t], beam_idx, axis=1)
+        return prev, tok
+
+    last = jnp.broadcast_to(jnp.arange(beam, dtype=jnp.int32)[None, :],
+                            (b, beam))
+    length = ids_arr.length
+    # walk from the last written step back to step 0
+    ts = jnp.arange(T - 1, -1, -1)
+    valid_t = ts < length
+
+    def masked_back(carry, inp):
+        t, ok = inp
+        new_carry, tok = back(carry, t)
+        new_carry = jnp.where(ok, new_carry, carry)
+        return new_carry, (tok, ok)
+
+    _, (toks_rev, oks) = jax.lax.scan(masked_back, last, (ts, valid_t))
+    toks = jnp.flip(toks_rev, axis=0)             # [T, b, beam] time order
+    oks = jnp.flip(oks, axis=0)
+    seqs = jnp.transpose(toks, (1, 2, 0)).reshape(b * beam, T)
+    written = jnp.transpose(
+        jnp.broadcast_to(oks[:, None, None], (T, b, beam)),
+        (1, 2, 0)).reshape(b * beam, T)
+
+    # per-sequence length: first end_id (inclusive) within written steps
+    is_end = (seqs == end_id) & written
+    any_end = is_end.any(axis=1)
+    first_end = jnp.argmax(is_end, axis=1)
+    total = written.sum(axis=1).astype(jnp.int32)
+    lens = jnp.where(any_end, first_end + 1, total).astype(jnp.int32)
+    ctx.set_output("SentenceIds", LoDArray(seqs[..., None], lens))
+    ctx.set_output("SentenceScores", scores.reshape(b * beam))

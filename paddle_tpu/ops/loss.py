@@ -35,9 +35,10 @@ def softmax_grad(ctx):
 
 
 def _take_label(x, label):
-    """Pick per-row probability at int label (label shape [N,1] or [N])."""
-    lab = label.reshape(-1).astype(jnp.int32)
-    return jnp.take_along_axis(x, lab[:, None], axis=-1)
+    """Pick per-row probability at int label; works for any leading rank
+    (dense [N, V] and padded-LoD [b, L, V] layouts alike)."""
+    lab = label.reshape(x.shape[:-1]).astype(jnp.int32)
+    return jnp.take_along_axis(x, lab[..., None], axis=-1)
 
 
 @register_op("cross_entropy", grad=lambda op: [OpSpec(
@@ -46,29 +47,31 @@ def _take_label(x, label):
      "Y@GRAD": G(op.output("Y"))},
     {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
 def cross_entropy(ctx):
-    x = data_of(ctx.input("X"))
+    xv = ctx.input("X")
+    x = data_of(xv)
     label = data_of(ctx.input("Label"))
     eps = 1e-8
     if ctx.attr("soft_label", False):
         y = -jnp.sum(label * jnp.log(jnp.maximum(x, eps)), axis=-1, keepdims=True)
     else:
         y = -jnp.log(jnp.maximum(_take_label(x, label), eps))
-    ctx.set_output("Y", y)
+    ctx.set_output("Y", like(xv, y))
 
 
 @register_op("cross_entropy_grad")
 def cross_entropy_grad(ctx):
-    x = data_of(ctx.input("X"))
+    xv = ctx.input("X")
+    x = data_of(xv)
     label = data_of(ctx.input("Label"))
     d = data_of(ctx.input("Y@GRAD"))
     eps = 1e-8
     if ctx.attr("soft_label", False):
         dx = -d * label / jnp.maximum(x, eps)
     else:
-        onehot = jax.nn.one_hot(label.reshape(-1).astype(jnp.int32),
+        onehot = jax.nn.one_hot(label.reshape(x.shape[:-1]).astype(jnp.int32),
                                 x.shape[-1], dtype=x.dtype)
         dx = -d * onehot / jnp.maximum(x, eps)
-    ctx.set_output("X@GRAD", dx)
+    ctx.set_output("X@GRAD", like(xv, dx))
 
 
 @register_op("softmax_with_cross_entropy", grad=lambda op: [OpSpec(

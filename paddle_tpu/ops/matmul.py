@@ -70,6 +70,35 @@ def mul_grad(ctx):
     ctx.set_output("Y@GRAD", dy.reshape(y.shape).astype(y.dtype))
 
 
+def _cos_sim_compute(x, y):
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    return jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn + 1e-12)
+
+
+@register_op("cos_sim", grad=lambda op: [OpSpec(
+    "cos_sim_grad",
+    {"X": op.input("X"), "Y": op.input("Y"),
+     "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X")), "Y@GRAD": G(op.input("Y"))})])
+def cos_sim(ctx):
+    """Row-wise cosine similarity (cos_sim_op.cc); Y may have one row that
+    broadcasts over X's batch."""
+    x, y = data_of(ctx.input("X")), data_of(ctx.input("Y"))
+    ctx.set_output("Out", _cos_sim_compute(x, y))
+
+
+@register_op("cos_sim_grad")
+def cos_sim_grad(ctx):
+    import jax
+    x, y = data_of(ctx.input("X")), data_of(ctx.input("Y"))
+    d = data_of(ctx.input("Out@GRAD"))
+    _, vjp = jax.vjp(_cos_sim_compute, x, y)
+    dx, dy = vjp(d)
+    ctx.set_output("X@GRAD", dx)
+    ctx.set_output("Y@GRAD", dy)
+
+
 def _matmul_grad_maker(op):
     return [OpSpec(
         "matmul_grad",

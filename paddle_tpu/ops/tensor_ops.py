@@ -164,7 +164,7 @@ def squared_l2_norm_grad(ctx):
 @register_op("increment")
 def increment(ctx):
     x = data_of(ctx.input("X"))
-    ctx.set_output("Out", x + ctx.attr("step", 1.0))
+    ctx.set_output("Out", x + jnp.asarray(ctx.attr("step", 1.0), x.dtype))
 
 
 @register_op("shape")

@@ -1,0 +1,228 @@
+"""Book chapter 8: machine translation (seq2seq + beam-search generation).
+
+Reference: /root/reference/python/paddle/fluid/tests/book/
+test_machine_translation.py — encoder (embedding → fc → dynamic LSTM →
+last step) conditioning a decoder trained with per-token cross entropy, and
+a While-loop beam-search decoder (lod_tensor arrays + beam_search +
+beam_search_decode ops). Here the beam state is dense [batch, beam]
+(ops/control_flow_ops.py) and the toy task is sequence copy-with-shift,
+learnable in seconds, standing in for wmt14.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+layers = fluid.layers
+
+SRC_DICT = 24
+TRG_DICT = 24
+BOS, EOS = 0, 1
+EMB, HID = 24, 48
+BEAM = 3
+MAX_LEN = 8
+BATCH = 16
+
+
+def encoder(src_word):
+    """All parameters explicitly named so the train and decode programs
+    share them through one scope (the reference book test does the same via
+    save/load between its two programs)."""
+    emb = layers.embedding(src_word, size=[SRC_DICT, EMB],
+                           param_attr=fluid.ParamAttr(name="src_emb_w"))
+    fc1 = layers.fc(emb, size=HID * 4, act="tanh",
+                    param_attr=fluid.ParamAttr(name="enc_fc_w"),
+                    bias_attr=fluid.ParamAttr(name="enc_fc_b"))
+    lstm_h, _ = layers.dynamic_lstm(
+        fc1, size=HID * 4, param_attr=fluid.ParamAttr(name="enc_lstm_w"),
+        bias_attr=fluid.ParamAttr(name="enc_lstm_b"))
+    return layers.sequence_last_step(lstm_h)
+
+
+def _boot(enc):
+    return layers.fc(enc, size=HID, act="tanh",
+                     param_attr=fluid.ParamAttr(name="boot_w"),
+                     bias_attr=fluid.ParamAttr(name="boot_b"))
+
+
+def train_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = layers.data("src", shape=[1], dtype="int64", lod_level=1)
+        trg = layers.data("trg", shape=[1], dtype="int64", lod_level=1)
+        trg_next = layers.data("trg_next", shape=[1], dtype="int64",
+                               lod_level=1)
+        enc = encoder(src)
+        boot = _boot(enc)
+        trg_emb = layers.embedding(
+            trg, size=[TRG_DICT, EMB],
+            param_attr=fluid.ParamAttr(name="trg_emb_w"))
+        dec_in = layers.fc(trg_emb, size=HID * 3,
+                           param_attr=fluid.ParamAttr(name="dec_in_w"),
+                           bias_attr=fluid.ParamAttr(name="dec_in_b"))
+        dec_h = layers.dynamic_gru(
+            dec_in, size=HID, h_0=boot,
+            param_attr=fluid.ParamAttr(name="gru_w"),
+            bias_attr=fluid.ParamAttr(name="gru_b"))
+        logits = layers.fc(dec_h, size=TRG_DICT,
+                           param_attr=fluid.ParamAttr(name="out_w"),
+                           bias_attr=fluid.ParamAttr(name="out_b"),
+                           act="softmax")
+        cost = layers.cross_entropy(input=logits, label=trg_next)
+        avg_cost = layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost, startup)
+    return main, startup, avg_cost
+
+
+def decode_program():
+    """Beam-search decoder sharing the trained parameter names."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = layers.data("src", shape=[1], dtype="int64", lod_level=1)
+        enc = encoder(src)
+        boot = _boot(enc)                                   # [b, H]
+
+        init_ids = layers.data("init_ids", shape=[BEAM], dtype="int64")
+        init_scores = layers.data("init_scores", shape=[BEAM],
+                                  dtype="float32")
+        # state per beam: [b, BEAM, H]
+        state = layers.data("state_seed", shape=[BEAM, HID], dtype="float32")
+        state = layers.elementwise_add(
+            state, layers.reshape(boot, [BATCH, 1, HID]))
+
+        counter = layers.fill_constant(shape=(), dtype="int64", value=0)
+        limit = layers.fill_constant(shape=(), dtype="int64", value=MAX_LEN)
+
+        ids_arr = layers.array_write(init_ids, counter, cap=MAX_LEN + 1)
+        parents_arr = layers.array_write(
+            layers.cast(init_scores, "int64"), counter, cap=MAX_LEN + 1)
+        scores_var = init_scores
+
+        cond = layers.less_than(counter, limit)
+        w = layers.While(cond)
+        with w.block():
+            pre_ids = layers.array_read(ids_arr, counter)   # [b, BEAM]
+            emb = layers.embedding(
+                pre_ids, size=[TRG_DICT, EMB],
+                param_attr=fluid.ParamAttr(name="trg_emb_w"))
+            flat_emb = layers.reshape(emb, [BATCH * BEAM, EMB])
+            flat_state = layers.reshape(state, [BATCH * BEAM, HID])
+            gin = layers.fc(flat_emb, size=HID * 3,
+                            param_attr=fluid.ParamAttr(name="dec_in_w"),
+                            bias_attr=fluid.ParamAttr(name="dec_in_b"))
+            new_h, _, _ = layers.gru_unit(
+                gin, flat_state, size=HID * 3,
+                param_attr=fluid.ParamAttr(name="gru_w"),
+                bias_attr=fluid.ParamAttr(name="gru_b"))
+            prob = layers.fc(new_h, size=TRG_DICT,
+                             param_attr=fluid.ParamAttr(name="out_w"),
+                             bias_attr=fluid.ParamAttr(name="out_b"),
+                             act="softmax")
+            logp = layers.log(prob)
+            topk_scores, topk_ids = layers.topk(logp, k=BEAM)
+            cand_scores = layers.reshape(topk_scores, [BATCH, BEAM, BEAM])
+            cand_ids = layers.reshape(topk_ids, [BATCH, BEAM, BEAM])
+            sel_ids, sel_scores, parents = layers.beam_search(
+                pre_ids, scores_var, cand_ids, cand_scores,
+                beam_size=BEAM, end_id=EOS)
+            # reorder state by parent beam, then advance it
+            new_state = layers.batch_gather(
+                layers.reshape(new_h, [BATCH, BEAM, HID]), parents)
+            layers.assign(new_state, state)
+            layers.assign(sel_scores, scores_var)
+            layers.increment(counter, 1)
+            layers.array_write(sel_ids, counter, array=ids_arr)
+            layers.array_write(parents, counter, array=parents_arr)
+            layers.less_than(counter, limit, cond=cond)
+
+        sent_ids, sent_scores = layers.beam_search_decode(
+            ids_arr, parents_arr, scores_var, end_id=EOS)
+    return main, startup, sent_ids, sent_scores
+
+
+TRG_LEN = 4
+_SUCC = None
+
+
+def _succ():
+    global _SUCC
+    if _SUCC is None:
+        r = np.random.RandomState(42)
+        _SUCC = r.permutation(np.arange(2, TRG_DICT))
+    return _SUCC
+
+
+def _chain_pairs(rng, n):
+    """Target = fixed-length successor chain seeded by the LAST source token:
+    trg[0] = succ(src[-1]), trg[i] = succ(trg[i-1]). Teacher forcing makes
+    the per-step mapping learnable fast while generation still needs the
+    encoder state (first step) and the beam loop (rest)."""
+    succ = _succ()
+    pairs = []
+    for _ in range(n):
+        ln = rng.randint(3, 6)
+        src = rng.randint(2, SRC_DICT, ln)
+        trg = []
+        cur = src[-1]
+        for _ in range(TRG_LEN):
+            cur = succ[cur - 2]
+            trg.append(cur)
+        pairs.append((src, np.array(trg)))
+    return pairs
+
+
+def test_machine_translation_train_and_beam_decode():
+    rng = np.random.RandomState(0)
+    main, startup, avg_cost = train_program()
+    dmain, dstartup, sent_ids, sent_scores = decode_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    # both startups BEFORE training: shared (named) params end up trained
+    exe.run(dstartup, scope=scope)
+    exe.run(startup, scope=scope)
+
+    first, last = None, None
+    for it in range(150):
+        pairs = _chain_pairs(rng, BATCH)
+        feed = {
+            "src": [p[0].reshape(-1, 1) for p in pairs],
+            "trg": [np.concatenate([[BOS], p[1]]).reshape(-1, 1)
+                    for p in pairs],
+            "trg_next": [np.concatenate([p[1], [EOS]]).reshape(-1, 1)
+                         for p in pairs],
+        }
+        loss, = exe.run(main, feed=feed, fetch_list=[avg_cost], scope=scope)
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+        if last < 0.1:
+            break
+    assert last < 0.3 * first, f"NMT failed to train: {first} -> {last}"
+
+    # ---- beam-search generation with the trained weights ----
+    pairs = _chain_pairs(rng, BATCH)
+    init_ids = np.full((BATCH, BEAM), BOS, dtype="int64")
+    init_scores = np.zeros((BATCH, BEAM), dtype="float32")
+    init_scores[:, 1:] = -1e9          # distinct beams from step 1
+    feed = {
+        "src": [p[0].reshape(-1, 1) for p in pairs],
+        "init_ids": init_ids,
+        "init_scores": init_scores,
+        "state_seed": np.zeros((BATCH, BEAM, HID), dtype="float32"),
+    }
+    ids_out, scores_out = exe.run(dmain, feed=feed,
+                                  fetch_list=[sent_ids, sent_scores],
+                                  scope=scope)
+    flat, lod = fluid.lodarray_to_flat(ids_out)
+    offs = lod[0]
+    correct = 0
+    for i, (src, trg) in enumerate(pairs):
+        best = i * BEAM     # beam 0 = highest score
+        seq = flat[offs[best]:offs[best + 1], 0]
+        seq = seq[1:]                        # drop BOS
+        if len(seq) and seq[-1] == EOS:
+            seq = seq[:-1]
+        if len(seq) == len(trg) and np.all(seq == trg):
+            correct += 1
+    assert correct >= BATCH * 0.7, (
+        f"beam decode only got {correct}/{BATCH} correct")

@@ -1,0 +1,115 @@
+"""Book chapter 6: recommender system (dual-tower + cosine similarity).
+
+Reference: /root/reference/python/paddle/fluid/tests/book/
+test_recommender_system.py — user tower (id/gender/age/job embeddings → fc)
+and movie tower (id embedding + ragged category pooled + ragged title via
+sequence_conv_pool) combined with cos_sim, trained with square error against
+the rating. Synthetic preference structure stands in for movielens.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+USER_CT, GENDER_CT, AGE_CT, JOB_CT = 30, 2, 7, 10
+MOVIE_CT, CATEGORY_CT, TITLE_DICT = 40, 8, 50
+
+
+def get_usr_combined_features():
+    uid = fluid.layers.data("user_id", shape=[1], dtype="int64")
+    usr_emb = fluid.layers.embedding(uid, size=[USER_CT, 16])
+    usr_fc = fluid.layers.fc(usr_emb, size=16)
+
+    gender = fluid.layers.data("gender_id", shape=[1], dtype="int64")
+    gender_fc = fluid.layers.fc(
+        fluid.layers.embedding(gender, size=[GENDER_CT, 8]), size=8)
+
+    age = fluid.layers.data("age_id", shape=[1], dtype="int64")
+    age_fc = fluid.layers.fc(
+        fluid.layers.embedding(age, size=[AGE_CT, 8]), size=8)
+
+    job = fluid.layers.data("job_id", shape=[1], dtype="int64")
+    job_fc = fluid.layers.fc(
+        fluid.layers.embedding(job, size=[JOB_CT, 8]), size=8)
+
+    concat = fluid.layers.concat([usr_fc, gender_fc, age_fc, job_fc], axis=1)
+    return fluid.layers.fc(concat, size=32, act="tanh")
+
+
+def get_mov_combined_features():
+    mov_id = fluid.layers.data("movie_id", shape=[1], dtype="int64")
+    mov_emb = fluid.layers.embedding(mov_id, size=[MOVIE_CT, 16])
+    mov_fc = fluid.layers.fc(mov_emb, size=16)
+
+    category = fluid.layers.data("category_id", shape=[1], dtype="int64",
+                                 lod_level=1)
+    mov_categories_emb = fluid.layers.embedding(category,
+                                                size=[CATEGORY_CT, 8])
+    mov_categories_hidden = fluid.layers.sequence_pool(mov_categories_emb,
+                                                       pool_type="sum")
+
+    title = fluid.layers.data("movie_title", shape=[1], dtype="int64",
+                              lod_level=1)
+    mov_title_emb = fluid.layers.embedding(title, size=[TITLE_DICT, 16])
+    mov_title_conv = fluid.nets.sequence_conv_pool(
+        input=mov_title_emb, num_filters=16, filter_size=3, act="tanh",
+        pool_type="sum")
+
+    concat = fluid.layers.concat(
+        [mov_fc, mov_categories_hidden, mov_title_conv], axis=1)
+    return fluid.layers.fc(concat, size=32, act="tanh")
+
+
+def _synthetic_interactions(n=512, seed=9):
+    rng = np.random.RandomState(seed)
+    u_vec = rng.normal(0, 1, (USER_CT, 4))
+    m_vec = rng.normal(0, 1, (MOVIE_CT, 4))
+    rows = []
+    for _ in range(n):
+        u, m = rng.randint(USER_CT), rng.randint(MOVIE_CT)
+        score = 2.5 + 2.5 * np.tanh(u_vec[u] @ m_vec[m])
+        rows.append((u, rng.randint(GENDER_CT), rng.randint(AGE_CT),
+                     rng.randint(JOB_CT), m,
+                     rng.randint(0, CATEGORY_CT, rng.randint(1, 4)),
+                     rng.randint(0, TITLE_DICT, rng.randint(2, 6)),
+                     score))
+    return rows
+
+
+def test_recommender_converges():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        usr = get_usr_combined_features()
+        mov = get_mov_combined_features()
+        inference = fluid.layers.cos_sim(X=usr, Y=mov)
+        scale_infer = fluid.layers.scale(x=inference, scale=5.0)
+        label = fluid.layers.data("score", shape=[1], dtype="float32")
+        square_cost = fluid.layers.square_error_cost(input=scale_infer,
+                                                     label=label)
+        avg_cost = fluid.layers.mean(square_cost)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost, startup)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    rows = _synthetic_interactions()
+    batch = 64
+    first, last = None, None
+    for epoch in range(12):
+        for i in range(0, len(rows), batch):
+            chunk = rows[i:i + batch]
+            feed = {
+                "user_id": np.array([[r[0]] for r in chunk], dtype="int64"),
+                "gender_id": np.array([[r[1]] for r in chunk], dtype="int64"),
+                "age_id": np.array([[r[2]] for r in chunk], dtype="int64"),
+                "job_id": np.array([[r[3]] for r in chunk], dtype="int64"),
+                "movie_id": np.array([[r[4]] for r in chunk], dtype="int64"),
+                "category_id": [r[5].reshape(-1, 1) for r in chunk],
+                "movie_title": [r[6].reshape(-1, 1) for r in chunk],
+                "score": np.array([[r[7]] for r in chunk], dtype="float32"),
+            }
+            loss, = exe.run(main, feed=feed, fetch_list=[avg_cost])
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+    assert last < 0.5 * first, f"recommender failed to learn: {first} -> {last}"

@@ -1,0 +1,93 @@
+"""Book chapter 4: sentiment classification (conv net + stacked LSTM).
+
+Reference: /root/reference/python/paddle/fluid/tests/book/
+test_understand_sentiment.py — convolution_net (two parallel
+sequence_conv_pool towers) and stacked_lstm_net (fc+lstm stacked with
+max-pool heads), over ragged token sequences. Synthetic token-class data
+stands in for the IMDB reader.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+DICT_DIM = 60
+CLASS_DIM = 2
+EMB_DIM = 16
+
+
+def convolution_net(data, dict_dim, class_dim=2, emb_dim=16, hid_dim=16):
+    emb = fluid.layers.embedding(input=data, size=[dict_dim, emb_dim])
+    conv_3 = fluid.nets.sequence_conv_pool(input=emb, num_filters=hid_dim,
+                                           filter_size=3, act="tanh",
+                                           pool_type="sum")
+    conv_4 = fluid.nets.sequence_conv_pool(input=emb, num_filters=hid_dim,
+                                           filter_size=4, act="tanh",
+                                           pool_type="sum")
+    return fluid.layers.fc(input=[conv_3, conv_4], size=class_dim,
+                           act="softmax")
+
+
+def stacked_lstm_net(data, dict_dim, class_dim=2, emb_dim=16, hid_dim=32,
+                     stacked_num=3):
+    assert stacked_num % 2 == 1
+    emb = fluid.layers.embedding(input=data, size=[dict_dim, emb_dim])
+    fc1 = fluid.layers.fc(input=emb, size=hid_dim)
+    lstm1, cell1 = fluid.layers.dynamic_lstm(input=fc1, size=hid_dim)
+
+    inputs = [fc1, lstm1]
+    for i in range(2, stacked_num + 1):
+        fc = fluid.layers.fc(input=inputs, size=hid_dim)
+        lstm, cell = fluid.layers.dynamic_lstm(
+            input=fc, size=hid_dim, is_reverse=(i % 2) == 0)
+        inputs = [fc, lstm]
+
+    fc_last = fluid.layers.sequence_pool(input=inputs[0], pool_type="max")
+    lstm_last = fluid.layers.sequence_pool(input=inputs[1], pool_type="max")
+    return fluid.layers.fc(input=[fc_last, lstm_last], size=class_dim,
+                           act="softmax")
+
+
+def _make_batch(rng, n=32):
+    seqs, ys = [], []
+    for _ in range(n):
+        y = rng.randint(0, CLASS_DIM)
+        ln = rng.randint(4, 10)
+        # class-dependent vocabulary halves
+        seqs.append((rng.randint(0, DICT_DIM // 2, (ln, 1))
+                     + (DICT_DIM // 2) * y).astype("int64"))
+        ys.append([y])
+    return seqs, np.array(ys, dtype="int64")
+
+
+@pytest.mark.parametrize("net", ["conv", "stacked_lstm"])
+def test_understand_sentiment_converges(net):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        data = fluid.layers.data("words", shape=[1], dtype="int64",
+                                 lod_level=1)
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        if net == "conv":
+            prediction = convolution_net(data, DICT_DIM, CLASS_DIM)
+        else:
+            prediction = stacked_lstm_net(data, DICT_DIM, CLASS_DIM)
+        cost = fluid.layers.cross_entropy(input=prediction, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        acc = fluid.layers.accuracy(input=prediction, label=label)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost, startup)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    accs = []
+    for it in range(50):
+        seqs, ys = _make_batch(rng)
+        loss, a = exe.run(main, feed={"words": seqs, "label": ys},
+                          fetch_list=[avg_cost, acc])
+        accs.append(float(a))
+        if it > 10 and np.mean(accs[-5:]) > 0.95:
+            break
+    assert np.mean(accs[-5:]) > 0.85, (
+        f"{net} sentiment net failed to learn: acc={np.mean(accs[-5:])}")

@@ -1,0 +1,79 @@
+"""Book chapter 5: word2vec (N-gram language model).
+
+Reference: /root/reference/python/paddle/fluid/tests/book/test_word2vec.py —
+four context words share one embedding table, concat → hidden fc → softmax
+over the vocabulary, trained with SGD until next-word loss drops. Synthetic
+markov-chain text stands in for imikolov until the dataset milestone.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+DICT_SIZE = 40
+EMB_SIZE = 16
+HIDDEN = 32
+N = 5  # 4 context words -> predict 5th
+
+
+def _synthetic_corpus(n_words=4000, seed=3):
+    """Deterministic-ish successor structure so the n-gram model can learn."""
+    rng = np.random.RandomState(seed)
+    succ = rng.permutation(DICT_SIZE)
+    words = [int(rng.randint(DICT_SIZE))]
+    for _ in range(n_words - 1):
+        if rng.rand() < 0.9:
+            words.append(int(succ[words[-1]]))
+        else:
+            words.append(int(rng.randint(DICT_SIZE)))
+    return np.array(words, dtype="int64")
+
+
+def build_ngram_model(words):
+    embs = []
+    for i, w in enumerate(words):
+        embs.append(fluid.layers.embedding(
+            input=w, size=[DICT_SIZE, EMB_SIZE],
+            param_attr=fluid.ParamAttr(name="shared_w")))
+    concat = fluid.layers.concat(input=embs, axis=1)
+    hidden1 = fluid.layers.fc(input=concat, size=HIDDEN, act="sigmoid")
+    predict = fluid.layers.fc(input=hidden1, size=DICT_SIZE, act="softmax")
+    return predict
+
+
+def test_word2vec_converges():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        ws = [fluid.layers.data(f"w{i}", shape=[1], dtype="int64")
+              for i in range(N - 1)]
+        next_word = fluid.layers.data("nextw", shape=[1], dtype="int64")
+        predict = build_ngram_model(ws)
+        cost = fluid.layers.cross_entropy(input=predict, label=next_word)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost, startup)
+
+    # the embedding table is shared across the 4 context inputs
+    shared = [p for p in main.all_parameters() if p.name == "shared_w"]
+    assert len(shared) == 1
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    corpus = _synthetic_corpus()
+    grams = np.stack([corpus[i:len(corpus) - N + 1 + i] for i in range(N)],
+                     axis=1)
+    batch = 256
+    first, last = None, None
+    for epoch in range(8):
+        for i in range(0, len(grams) - batch, batch):
+            g = grams[i:i + batch]
+            feed = {f"w{j}": g[:, j:j + 1] for j in range(N - 1)}
+            feed["nextw"] = g[:, N - 1:N]
+            loss, = exe.run(main, feed=feed, fetch_list=[avg_cost])
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+        if last < 0.45:
+            break
+    assert last < 0.65 * first, f"word2vec failed to learn: {first} -> {last}"
