@@ -440,6 +440,85 @@ def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
     return out
 
 
+def linear_chain_crf(input, label, param_attr=None):
+    """CRF negative log-likelihood cost (reference nn.py linear_chain_crf).
+    The transition parameter is [num_tags + 2, num_tags] (row 0 start, row 1
+    end scores, linear_chain_crf_op.cc)."""
+    helper = LayerHelper("linear_chain_crf")
+    size = input.shape[-1]
+    transition = helper.create_parameter(param_attr, shape=(size + 2, size),
+                                         dtype=input.dtype)
+    log_likelihood = helper.create_tmp_variable(input.dtype)
+    helper.append_op("linear_chain_crf",
+                     inputs={"Emission": [input.name],
+                             "Transition": [transition.name],
+                             "Label": [label.name]},
+                     outputs={"LogLikelihood": [log_likelihood.name]})
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None):
+    helper = LayerHelper("crf_decoding")
+    transition = helper.create_parameter(
+        ParamAttr.to_attr(param_attr), shape=(input.shape[-1] + 2,
+                                              input.shape[-1]),
+        dtype=input.dtype)
+    path = helper.create_tmp_variable("int64", lod_level=1)
+    inputs = {"Emission": [input.name], "Transition": [transition.name]}
+    if label is not None:
+        inputs["Label"] = [label.name]
+    helper.append_op("crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [path.name]})
+    return path
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    """CTC loss over ragged logits/labels (reference nn.py warpctc →
+    warpctc_op dynloading warp-ctc; here a native XLA scan)."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_tmp_variable(input.dtype)
+    helper.append_op("warpctc",
+                     inputs={"Logits": [input.name], "Label": [label.name]},
+                     outputs={"Loss": [loss.name]},
+                     attrs={"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank):
+    """argmax per step then merge/strip (reference nn.py ctc_greedy_decoder =
+    top_k + ctc_align)."""
+    helper = LayerHelper("ctc_greedy_decoder")
+    _, indices = topk(input, k=1)
+    out = helper.create_tmp_variable("int64", lod_level=1)
+    helper.append_op("ctc_align", inputs={"Input": [indices.name]},
+                     outputs={"Output": [out.name]},
+                     attrs={"blank": blank, "merge_repeated": True})
+    return out
+
+
+def edit_distance(input, label, normalized=False, ignored_tokens=None):
+    helper = LayerHelper("edit_distance")
+    if ignored_tokens:
+        erased = helper.create_tmp_variable(input.dtype, lod_level=1)
+        helper.append_op("sequence_erase", inputs={"X": [input.name]},
+                         outputs={"Out": [erased.name]},
+                         attrs={"tokens": list(ignored_tokens)})
+        input = erased
+        erased_l = helper.create_tmp_variable(label.dtype, lod_level=1)
+        helper.append_op("sequence_erase", inputs={"X": [label.name]},
+                         outputs={"Out": [erased_l.name]},
+                         attrs={"tokens": list(ignored_tokens)})
+        label = erased_l
+    out = helper.create_tmp_variable("float32")
+    seq_num = helper.create_tmp_variable("int64")
+    helper.append_op("edit_distance",
+                     inputs={"Hyps": [input.name], "Refs": [label.name]},
+                     outputs={"Out": [out.name],
+                              "SequenceNum": [seq_num.name]},
+                     attrs={"normalized": normalized})
+    return out, seq_num
+
+
 def cos_sim(X, Y):
     """Row-wise cosine similarity (reference nn.py cos_sim → cos_sim op)."""
     helper = LayerHelper("cos_sim")

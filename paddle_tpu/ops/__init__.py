@@ -19,6 +19,8 @@ from . import (  # noqa: F401
     sequence_ops,
     rnn_ops,
     control_flow_ops,
+    crf_ops,
+    ctc_ops,
     optimizer_ops,
     metrics,
 )
