@@ -73,14 +73,38 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
 
     produced = {loss_grad}  # grad names already written by appended grad ops
 
+    # var names that (transitively) depend on a trainable parameter — used to
+    # detect silent gradient-chain cuts at ops with no grad maker
+    derived = {p.name for p in program.global_block().all_parameters()
+               if p.trainable} - stop
+    for i in sorted(path):
+        op = block.ops[i]
+        if any(n in derived for n in op.input_arg_names()):
+            # stop_gradient vars cut the chain deliberately — don't let the
+            # no-grad-maker guard fire past an explicit stop
+            derived.update(n for n in op.output_arg_names() if n not in stop)
+
     for i in reversed(sorted(path)):
         op = block.ops[i]
         info = registry.get_op_info(op.type)
-        if info.grad is None:
-            continue
         # skip if none of this op's outputs have a live upstream gradient
         out_grads = [grad_var_name(n) for n in op.output_arg_names()]
         if not any(g in produced for g in out_grads):
+            continue
+        if info.grad is None:
+            # An op on the needed path with live output grads but no grad
+            # maker silently cuts the gradient chain — upstream parameters
+            # would be dropped from the (param, grad) list and never train.
+            # The reference errors in core.get_grad_op_desc for such ops;
+            # fail loudly unless the op genuinely has no trainable inputs.
+            if any(n in derived for n in op.input_arg_names()):
+                raise RuntimeError(
+                    f"op {op.type!r} (#{i} in block {block.idx}) lies on the "
+                    f"gradient path of {loss.name!r} but registers no grad "
+                    "maker; parameters feeding it would silently stop "
+                    "training. Use a differentiable formulation (e.g. "
+                    "dynamic_lstm/StaticRNN instead of an inference-only "
+                    "While) or mark its inputs stop_gradient=True.")
             continue
         # outputs whose grad was never produced (unused forward outputs, e.g.
         # softmax_with_cross_entropy's Softmax when only Loss is used): feed

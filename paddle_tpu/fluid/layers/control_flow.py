@@ -287,9 +287,12 @@ def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
     selected_scores, parent_idx); parent_idx replaces the reference's
     LoD-encoded beam provenance."""
     helper = LayerHelper("beam_search", name=name)
-    sel_ids = helper.create_tmp_variable("int64")
+    # int32, matching what the op emits: ids/parent come from int32 top_k
+    # arithmetic and JAX truncates int64 when x64 mode is off (the reference
+    # declares int64; the declared-vs-runtime dtype contract matters more)
+    sel_ids = helper.create_tmp_variable("int32")
     sel_scores = helper.create_tmp_variable(scores.dtype)
-    parents = helper.create_tmp_variable("int64")
+    parents = helper.create_tmp_variable("int32")
     helper.append_op(
         "beam_search",
         inputs={"pre_ids": [pre_ids.name], "pre_scores": [pre_scores.name],
@@ -317,7 +320,7 @@ def beam_search_decode(ids, parents, scores, end_id, name=None):
     Returns (sentence_ids LoD var of batch*beam ragged sequences,
     sentence_scores)."""
     helper = LayerHelper("beam_search_decode", name=name)
-    sent_ids = helper.create_tmp_variable("int64", lod_level=1)
+    sent_ids = helper.create_tmp_variable("int32", lod_level=1)
     sent_scores = helper.create_tmp_variable(scores.dtype)
     helper.append_op(
         "beam_search_decode",

@@ -110,9 +110,10 @@ def _ctc_inputs(ctx):
 def warpctc(ctx):
     lv, labels, y_lens = _ctc_inputs(ctx)
     blank = int(ctx.attr("blank", 0))
+    # norm_by_times does NOT scale the forward Loss — the reference scales
+    # only the logits gradient in the backward kernel (warpctc_op.h:217-223,
+    # ScaleLoDTensorFunctor) and returns the unscaled loss.
     loss = _ctc_loss(lv.data, lv.lens, labels, y_lens, blank)
-    if ctx.attr("norm_by_times", False):
-        loss = loss / jnp.maximum(lv.lens[:, None], 1).astype(loss.dtype)
     ctx.set_output("Loss", loss)
 
 
@@ -123,13 +124,15 @@ def warpctc_grad(ctx):
     d = data_of(ctx.input("Loss@GRAD"))
 
     def f(lg):
-        loss = _ctc_loss(lg, lv.lens, labels, y_lens, blank)
-        if ctx.attr("norm_by_times", False):
-            loss = loss / jnp.maximum(lv.lens[:, None], 1).astype(loss.dtype)
-        return loss
+        return _ctc_loss(lg, lv.lens, labels, y_lens, blank)
 
     _, vjp = jax.vjp(f, lv.data)
-    ctx.set_output("Logits@GRAD", LoDArray(vjp(d)[0], lv.lens))
+    dlogits = vjp(d)[0]
+    if ctx.attr("norm_by_times", False):
+        # 1/T scaling applied to the logits gradient only (warpctc_op.h:217)
+        dlogits = dlogits / jnp.maximum(
+            lv.lens[:, None, None], 1).astype(dlogits.dtype)
+    ctx.set_output("Logits@GRAD", LoDArray(dlogits, lv.lens))
 
 
 @register_op("ctc_align")
@@ -199,4 +202,4 @@ def edit_distance(ctx):
     if ctx.attr("normalized", False):
         dist = dist / jnp.maximum(rl, 1).astype(dist.dtype)
     ctx.set_output("Out", dist[:, None])
-    ctx.set_output("SequenceNum", jnp.asarray([b], jnp.int64))
+    ctx.set_output("SequenceNum", jnp.asarray([b], jnp.int32))

@@ -11,11 +11,15 @@ length mask — XLA fuses the gate math, and the scanned matmul hits the MXU.
 Gate layouts (documented contract of this framework):
 
 * LSTM projected input / recurrent weight column order: [i, f, c, o]
-  (input, forget, candidate, output), weight shape [H, 4H].
+  (input, forget, candidate, output), weight shape [H, 4H]. NOTE: the
+  reference stores [c, i, f, o] (lstm_op.cc:125) — reference-trained
+  weights must be permuted via
+  ``paddle_tpu.utils.convert_reference_lstm_weight`` on import.
 * GRU projected input order: [u, r, c] (update, reset, candidate);
   weight [H, 3H] = [W_u | W_r | W_c] like the reference gru_op
   ("the first 2H columns are update/reset, the last H candidate").
-  h_t = u * h_{t-1} + (1 - u) * c_t.
+  h_t = u * c_t + (1 - u) * h_{t-1}, matching the reference kernel
+  ``h = u * (c - h_prev) + h_prev`` (gru_unit_op.h; math/detail/gru_kernel.h).
 
 Gradients flow through ``jax.vjp`` over the scan (XLA reverse-scan), the
 functional analog of the reference's hand-written LstmGradKernel.
@@ -221,7 +225,7 @@ def _gru_compute(x, lens, w, bias, h0, attrs):
         u = ga(xt[:, :H] + h_prev @ wu)
         r = ga(xt[:, H:2 * H] + h_prev @ wr)
         c = ca(xt[:, 2 * H:] + (r * h_prev) @ wc)
-        h = u * h_prev + (1.0 - u) * c
+        h = u * c + (1.0 - u) * h_prev
         alive = (t < lens)[:, None].astype(x.dtype)
         h = alive * h + (1 - alive) * h_prev
         return (h, t + 1), h * alive
@@ -352,7 +356,7 @@ def _gru_unit_fwd(x, h_prev, w, bias, gate_act, cand_act):
     u = gate_act(x[:, :H] + h_prev @ w[:, :H])
     r = gate_act(x[:, H:2 * H] + h_prev @ w[:, H:2 * H])
     c = cand_act(x[:, 2 * H:] + (r * h_prev) @ w[:, 2 * H:])
-    h = u * h_prev + (1.0 - u) * c
+    h = u * c + (1.0 - u) * h_prev
     return u, r, c, h
 
 

@@ -1,0 +1,4 @@
+from .checkpoint_compat import (convert_reference_lstm_weight,
+                                convert_reference_lstm_bias)
+
+__all__ = ["convert_reference_lstm_weight", "convert_reference_lstm_bias"]

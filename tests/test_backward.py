@@ -1,0 +1,48 @@
+"""append_backward behavior tests.
+
+Reference contract: ops on the gradient path must provide grad makers
+(core.get_grad_op_desc errors on ops without one —
+/root/reference/python/paddle/fluid/backward.py:273). Round-1 advisor
+finding: silently skipping such ops cuts the gradient chain and parameters
+quietly stop training; it must fail loudly instead.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.registry import register_op
+
+
+@register_op("_nograd_passthrough")
+def _nograd_passthrough(ctx):  # pragma: no cover - never run
+    ctx.set_output("Out", ctx.input("X"))
+
+
+def test_missing_grad_maker_on_path_raises():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        h = fluid.layers.fc(input=x, size=4)
+        blocked = h.block.create_var(name="blocked", shape=h.shape,
+                                     dtype=h.dtype)
+        h.block.append_op("_nograd_passthrough", inputs={"X": [h.name]},
+                          outputs={"Out": [blocked.name]})
+        loss = fluid.layers.mean(blocked)
+        with pytest.raises(RuntimeError, match="_nograd_passthrough"):
+            fluid.backward.append_backward(loss)
+
+
+def test_missing_grad_maker_off_param_path_ok():
+    """An un-differentiable op whose inputs don't depend on parameters (e.g.
+    feed preprocessing) must not raise."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        pre = x.block.create_var(name="pre", shape=x.shape, dtype=x.dtype)
+        x.block.append_op("_nograd_passthrough", inputs={"X": [x.name]},
+                          outputs={"Out": [pre.name]})
+        h = fluid.layers.fc(input=pre, size=4)
+        loss = fluid.layers.mean(h)
+        pairs = fluid.backward.append_backward(loss)
+        assert len(pairs) == 2  # fc weight + bias still train

@@ -1,0 +1,29 @@
+"""stop_gradient must silence the no-grad-maker guard (review finding): a
+deliberately frozen sub-graph feeding an un-differentiable op is legal."""
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.registry import register_op, has_op
+
+
+if not has_op("_nograd_sink"):
+    @register_op("_nograd_sink")
+    def _nograd_sink(ctx):  # pragma: no cover - build-time only
+        ctx.set_output("Out", ctx.input("X"))
+
+
+def test_stop_gradient_silences_guard():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        h = fluid.layers.fc(input=x, size=4)
+        h.stop_gradient = True
+        frozen = h.block.create_var(name="frozen", shape=h.shape,
+                                    dtype=h.dtype)
+        h.block.append_op("_nograd_sink", inputs={"X": [h.name]},
+                          outputs={"Out": [frozen.name]})
+        # trainable branch alongside the frozen one
+        h2 = fluid.layers.fc(input=x, size=4)
+        merged = fluid.layers.elementwise_add(x=frozen, y=h2)
+        loss = fluid.layers.mean(merged)
+        pairs = fluid.backward.append_backward(loss)
+        assert len(pairs) == 2  # only the live fc trains, and no raise

@@ -1,0 +1,37 @@
+"""Reference-checkpoint gate-permutation helpers (paddle_tpu.utils)."""
+
+import numpy as np
+
+from paddle_tpu.utils import (convert_reference_lstm_weight,
+                              convert_reference_lstm_bias)
+
+
+def test_weight_roundtrip():
+    rng = np.random.RandomState(3)
+    H = 8
+    w_ref = rng.normal(size=(H, 4 * H)).astype("float32")
+    ours = convert_reference_lstm_weight(w_ref)
+    back = convert_reference_lstm_weight(ours, inverse=True)
+    assert np.array_equal(back, w_ref)
+    # ref blocks [c, i, f, o] land at ours [i, f, c, o]
+    c, i, f, o = np.split(w_ref, 4, axis=1)
+    np.testing.assert_array_equal(ours, np.concatenate([i, f, c, o], axis=1))
+
+
+def test_bias_plain_and_peephole():
+    rng = np.random.RandomState(5)
+    H = 4  # multiple of 4 on purpose: 7H is also divisible by 4
+    b_ref = rng.normal(size=(1, 4 * H)).astype("float32")
+    c, i, f, o = np.split(b_ref, 4, axis=1)
+    np.testing.assert_array_equal(convert_reference_lstm_bias(b_ref),
+                                  np.concatenate([i, f, c, o], axis=1))
+
+    bp_ref = rng.normal(size=(1, 7 * H)).astype("float32")
+    out = convert_reference_lstm_bias(bp_ref, peepholes=True)
+    # gate blocks permuted, peephole tail untouched
+    np.testing.assert_array_equal(out[:, 4 * H:], bp_ref[:, 4 * H:])
+    c, i, f, o = np.split(bp_ref[:, :4 * H], 4, axis=1)
+    np.testing.assert_array_equal(out[:, :4 * H],
+                                  np.concatenate([i, f, c, o], axis=1))
+    back = convert_reference_lstm_bias(out, peepholes=True, inverse=True)
+    np.testing.assert_array_equal(back, bp_ref)

@@ -1,0 +1,190 @@
+"""CTC op tests against a brute-force / numpy reference.
+
+Mirrors /root/reference/python/paddle/fluid/tests/unittests/test_warpctc_op.py
+(python CTC forward as ground truth), test_ctc_align_op.py and
+test_edit_distance_op.py.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def softmax(x):
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def ctc_loss_brute(logits, label, blank):
+    """-log P(label | logits) by enumerating all alignments. logits [T, C]."""
+    T, C = logits.shape
+    p = softmax(logits)
+    U = len(label)
+    total = 0.0
+    # enumerate paths of length T over the C symbols whose collapse == label
+    for path in itertools.product(range(C), repeat=T):
+        collapsed = []
+        prev = None
+        for s in path:
+            if s != prev and s != blank:
+                collapsed.append(s)
+            prev = s
+        if collapsed == list(label):
+            prob = 1.0
+            for t, s in enumerate(path):
+                prob *= p[t, s]
+            total += prob
+    return -np.log(total)
+
+
+def ctc_loss_dp(logits, label, blank):
+    """Standard CTC forward DP (log space not needed at test sizes)."""
+    T, C = logits.shape
+    p = softmax(logits)
+    z = []
+    for l in label:
+        z += [blank, l]
+    z.append(blank)
+    S = len(z)
+    alpha = np.zeros((T, S))
+    alpha[0, 0] = p[0, z[0]]
+    if S > 1:
+        alpha[0, 1] = p[0, z[1]]
+    for t in range(1, T):
+        for s in range(S):
+            a = alpha[t - 1, s]
+            if s >= 1:
+                a += alpha[t - 1, s - 1]
+            if s >= 2 and z[s] != blank and z[s] != z[s - 2]:
+                a += alpha[t - 1, s - 2]
+            alpha[t, s] = a * p[t, z[s]]
+    total = alpha[T - 1, S - 1] + (alpha[T - 1, S - 2] if S > 1 else 0.0)
+    return -np.log(total)
+
+
+class TestWarpCTC(OpTest):
+    op_type = "warpctc"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(7)
+        C, blank = 5, 0
+        logits_lod = [[0, 4, 9]]
+        label_lod = [[0, 2, 4]]
+        logits = rng.uniform(-1, 1, (9, C)).astype("float32")
+        labels = np.array([[1], [2], [3], [4]], dtype="int64")
+        losses = []
+        for i in range(2):
+            lg = logits[logits_lod[0][i]:logits_lod[0][i + 1]]
+            lb = labels[label_lod[0][i]:label_lod[0][i + 1], 0]
+            losses.append([ctc_loss_dp(lg, lb, blank)])
+        self.inputs = {"Logits": (logits, logits_lod),
+                       "Label": (labels, label_lod)}
+        self.attrs = {"blank": blank, "norm_by_times": False}
+        self.outputs = {"Loss": np.array(losses, dtype="float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_output_matches_brute_force(self):
+        rng = np.random.RandomState(11)
+        lg = rng.uniform(-1, 1, (4, 3)).astype("float32")
+        assert np.allclose(ctc_loss_dp(lg, [1, 2], 0),
+                           ctc_loss_brute(lg, [1, 2], 0), atol=1e-6)
+
+    def test_grad(self):
+        self.check_grad(["Logits"], "Loss", max_relative_error=0.01)
+
+
+class TestWarpCTCNormByTimes(OpTest):
+    """norm_by_times=True must leave the forward Loss unscaled (the reference
+    scales only the logits gradient: warpctc_op.h:217-223)."""
+    op_type = "warpctc"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(13)
+        C, blank = 4, 0
+        logits_lod = [[0, 3, 8]]
+        label_lod = [[0, 1, 3]]
+        logits = rng.uniform(-1, 1, (8, C)).astype("float32")
+        labels = np.array([[1], [2], [3]], dtype="int64")
+        losses = []
+        for i in range(2):
+            lg = logits[logits_lod[0][i]:logits_lod[0][i + 1]]
+            lb = labels[label_lod[0][i]:label_lod[0][i + 1], 0]
+            losses.append([ctc_loss_dp(lg, lb, blank)])
+        self.inputs = {"Logits": (logits, logits_lod),
+                       "Label": (labels, label_lod)}
+        self.attrs = {"blank": blank, "norm_by_times": True}
+        self.outputs = {"Loss": np.array(losses, dtype="float32")}
+
+    def test_output_unscaled(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad_is_scaled(self):
+        """Analytic grad with norm_by_times=True == (grad without) / T."""
+        import jax
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.core.lod import flat_to_lodarray
+
+        grads = {}
+        for norm in (False, True):
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                lg = fluid.layers.data("lg", shape=[4], lod_level=1)
+                lb = fluid.layers.data("lb", shape=[1], dtype="int64",
+                                       lod_level=1)
+                loss = fluid.layers.warpctc(input=lg, label=lb, blank=0,
+                                            norm_by_times=norm)
+                total = fluid.layers.mean(loss)
+                fluid.backward.append_backward(total)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            out = exe.run(
+                main,
+                feed={"lg": (self.inputs["Logits"][0],
+                             self.inputs["Logits"][1]),
+                      "lb": (self.inputs["Label"][0],
+                             self.inputs["Label"][1])},
+                fetch_list=["lg@GRAD"], return_numpy=False)
+            grads[norm] = out[0]
+        g0, g1 = grads[False].data, grads[True].data
+        lens = np.asarray(grads[False].lens)
+        expected = np.asarray(g0) / lens[:, None, None]
+        assert np.allclose(np.asarray(g1), expected, atol=1e-6)
+
+
+class TestCTCAlign(OpTest):
+    op_type = "ctc_align"
+
+    def setup_method(self, method):
+        x = np.array([[0, 1, 1, 0, 2, 2, 0],
+                      [3, 0, 3, 3, 0, 0, 0]], dtype="int32").reshape(2, 7, 1)
+        lod = [[0, 7, 11]]
+        xs = np.concatenate([x[0, :7], x[1, :4]], axis=0)
+        self.inputs = {"Input": (xs, lod)}
+        self.attrs = {"blank": 0, "merge_repeated": True}
+        out = np.array([[1, 2], [3, 3]], dtype="int32").reshape(-1, 1)
+        self.outputs = {"Output": (out.reshape(4, 1), [[0, 2, 4]])}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestEditDistance(OpTest):
+    op_type = "edit_distance"
+
+    def setup_method(self, method):
+        hyp = np.array([[1], [2], [3], [1], [2]], dtype="int64")
+        ref = np.array([[1], [3], [1], [2], [4]], dtype="int64")
+        hyp_lod = [[0, 3, 5]]
+        ref_lod = [[0, 2, 5]]
+        # seq0: [1,2,3] vs [1,3] -> 1 ; seq1: [1,2] vs [1,2,4] -> 1
+        self.inputs = {"Hyps": (hyp, hyp_lod), "Refs": (ref, ref_lod)}
+        self.attrs = {"normalized": False}
+        self.outputs = {"Out": np.array([[1.0], [1.0]], dtype="float32")}
+
+    def test_output(self):
+        self.check_output(no_check_set=["SequenceNum"])

@@ -4,7 +4,7 @@ Mirrors /root/reference/python/paddle/fluid/tests/unittests/test_lstm_op.py
 and test_gru_op.py in spirit: a python recurrence over each ragged sequence
 is the ground truth. Gate layouts are this framework's documented contract
 (ops/rnn_ops.py): LSTM [i, f, c, o]; GRU [u, r, c] with
-h = u*h_prev + (1-u)*c.
+h = u*c + (1-u)*h_prev (reference gru_unit_op.h: h = u*(c - h_prev) + h_prev).
 """
 
 import numpy as np
@@ -47,7 +47,7 @@ def gru_ref(x, lod, w, b):
             u = sigmoid(g[:H] + h @ wu)
             r = sigmoid(g[H:2 * H] + h @ wr)
             c = np.tanh(g[2 * H:] + (r * h) @ wc)
-            h = u * h + (1 - u) * c
+            h = u * c + (1 - u) * h
             hs[t] = h
     return hs
 
@@ -167,7 +167,7 @@ class TestGruUnit(OpTest):
         u = sigmoid(g[:, :H] + h_prev @ w[:, :H])
         r = sigmoid(g[:, H:2 * H] + h_prev @ w[:, H:2 * H])
         c = np.tanh(g[:, 2 * H:] + (r * h_prev) @ w[:, 2 * H:])
-        h = u * h_prev + (1 - u) * c
+        h = u * c + (1 - u) * h_prev
         self.inputs = {"Input": x, "HiddenPrev": h_prev, "Weight": w,
                        "Bias": b}
         self.outputs = {"Gate": np.concatenate([u, r, c], axis=1),
