@@ -1,0 +1,82 @@
+"""Automatic mixed precision (bf16 compute, fp32 master weights).
+
+The reference has a float16 data-transform path (framework/data_type_transform
+.cc, platform/float16.h) that casts per-kernel when a kernel registers an
+fp16 variant. TPU-native redesign: bfloat16 is the MXU's native input type,
+so AMP is an *autocast at the op-lowering level* —
+
+* MXU ops (mul/matmul/conv2d family) cast their float32 operands to bf16 and
+  accumulate in float32 (``preferred_element_type``) — the standard TPU
+  matmul recipe;
+* normalization/loss/softmax ops compute their reductions in float32 and
+  cast results back to the activation dtype (numerical-stability islands);
+* optimizer ops cast the (bf16) gradient up to the parameter dtype, keeping
+  float32 master weights — parameters, optimizer state and running stats
+  never leave float32.
+
+Because parameter->bf16 casts happen inside the traced step function, XLA
+CSEs them to one cast per parameter per step and fuses them into consumers;
+no bf16 copy of the model is ever materialized in the scope.
+
+Enable per-executor (``fluid.Executor(amp=True)``) or lexically via
+``amp_guard``. The executor sets the flag around tracing, so the jit cache
+key must (and does) include it.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+_state = {"enabled": False, "dtype": jnp.bfloat16}
+
+
+def amp_enabled():
+    return _state["enabled"]
+
+
+def amp_dtype():
+    return _state["dtype"]
+
+
+def set_amp(enabled, dtype=None):
+    prev = (_state["enabled"], _state["dtype"])
+    _state["enabled"] = bool(enabled)
+    if dtype is not None:
+        _state["dtype"] = jnp.dtype(dtype).type
+    return prev
+
+
+@contextmanager
+def amp_guard(enabled=True, dtype="bfloat16"):
+    prev = set_amp(enabled, dtype)
+    try:
+        yield
+    finally:
+        _state["enabled"], _state["dtype"] = prev
+
+
+def cast_compute(*arrays):
+    """Cast float32/float64 arrays to the compute dtype when AMP is on;
+    non-float and already-low-precision inputs pass through."""
+    if not _state["enabled"]:
+        return arrays if len(arrays) > 1 else arrays[0]
+    ct = _state["dtype"]
+    out = tuple(
+        a.astype(ct) if hasattr(a, "dtype") and a.dtype in (jnp.float32,
+                                                            jnp.float64)
+        else a
+        for a in arrays)
+    return out if len(out) > 1 else out[0]
+
+
+def upcast_f32(*arrays):
+    """Cast low-precision float arrays up to float32 (stability islands:
+    losses, softmax, norm statistics)."""
+    out = tuple(
+        a.astype(jnp.float32)
+        if hasattr(a, "dtype") and a.dtype in (jnp.bfloat16, jnp.float16)
+        else a
+        for a in arrays)
+    return out if len(out) > 1 else out[0]

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 
 from . import registry
+from .amp import amp_guard
+from .profiler import profiler_enabled, record_event
 from .lod import LoDArray, flat_to_lodarray, pack_sequences
 from .scope import Scope, global_scope
 from .types import np_dtype
@@ -143,6 +145,18 @@ class ExecContext:
 def _run_ops(block, env, exec_state):
     """Run/trace every op of a block over ``env`` in order. This is both the
     eager interpreter and the function traced by jit."""
+    if profiler_enabled():
+        # per-op host spans, the reference's RecordEvent around op->Run
+        # (executor.cc:317, operator.cc:488). In eager mode these are real
+        # op times; under jit they are trace-time spans (still useful for
+        # finding slow-to-trace ops) while the compiled step is covered by
+        # the jit_compile/jit_step spans in Executor.run.
+        for op in block.ops:
+            with record_event(op.type, kind="op"):
+                info = registry.get_op_info(op.type)
+                ctx = ExecContext(op, block, env, exec_state)
+                info.forward(ctx)
+        return
     for op in block.ops:
         info = registry.get_op_info(op.type)
         ctx = ExecContext(op, block, env, exec_state)
@@ -204,11 +218,14 @@ class Executor:
     mode="eager" : op-at-a-time interpreter (debug / OpTest path)
     """
 
-    def __init__(self, place=None, mode="jit", donate=False):
+    def __init__(self, place=None, mode="jit", donate=False, amp=False):
         self.place = place
         self.device = _resolve_device(place)
         self.mode = mode
         self.donate = donate
+        # AMP: bf16 compute with fp32 master weights (core/amp.py). The flag
+        # is applied around tracing/execution so op lowerings autocast.
+        self.amp = amp
         self._cache = {}
 
     # ------------------------------------------------------------------
@@ -247,32 +264,135 @@ class Executor:
         if self.mode == "eager" or not use_program_cache:
             env = dict(state)
             env.update(feed_vals)
-            _run_ops(block, env, self)
+            with amp_guard(self.amp):
+                _run_ops(block, env, self)
             new_state = {n: env[n] for n in state_out if n in env}
             new_state[_RNG_KEY] = env[_RNG_KEY]
             fetches = [env[n] for n in fetch_names]
         else:
-            fn = self._compiled(program, tuple(sorted(feed_vals)),
-                                tuple(fetch_names), tuple(state_in),
-                                tuple(state_out))
-            # non-traceable state (readers, rank tables) can't cross jit
-            trace_state = {k: v for k, v in state.items() if _is_traceable(v)}
-            if self.place is not None:
-                # explicit place: commit state so jit follows the operands.
-                # (NEVER wrap dispatch in jax.default_device — on the tunneled
-                # TPU backend that context makes every dispatch ~30x slower.)
-                trace_state = {k: jax.device_put(v, self.device)
-                               for k, v in trace_state.items()}
-            new_state, fetches = fn(trace_state, feed_vals)
+            with record_event("executor.prepare", kind="stage"):
+                fn = self._compiled(program, tuple(sorted(feed_vals)),
+                                    tuple(fetch_names), tuple(state_in),
+                                    tuple(state_out))
+                # non-traceable state (readers, rank tables) can't cross jit
+                trace_state = {k: v for k, v in state.items()
+                               if _is_traceable(v)}
+                if self.place is not None:
+                    # explicit place: commit state so jit follows the
+                    # operands. (NEVER wrap dispatch in jax.default_device —
+                    # on the tunneled TPU backend that context makes every
+                    # dispatch ~30x slower.)
+                    trace_state = {k: jax.device_put(v, self.device)
+                                   for k, v in trace_state.items()}
+            # amp guard wraps dispatch because jax traces lazily (first call
+            # and any shape-driven retrace happen inside fn())
+            if profiler_enabled():
+                with record_event("jit_step_dispatch", kind="stage"):
+                    with amp_guard(self.amp):
+                        new_state, fetches = fn(trace_state, feed_vals)
+                with record_event("jit_step_device", kind="stage"):
+                    jax.block_until_ready(fetches)
+            else:
+                with amp_guard(self.amp):
+                    new_state, fetches = fn(trace_state, feed_vals)
 
         for n, v in new_state.items():
             scope.set(n, v)
         return [self._fetch_value(v, return_numpy) for v in fetches]
 
     # ------------------------------------------------------------------
+    def run_steps(self, program=None, feeds=(), fetch_list=None, scope=None,
+                  steps=None, return_numpy=True):
+        """Run ``steps`` training steps as ONE XLA computation (lax.scan over
+        the step body), cycling through ``feeds`` (a list of feed dicts with
+        identical shapes). Returns per-step fetch values stacked on axis 0.
+
+        TPU-native extension with no reference analog: the reference's
+        executor pays a kernel-launch loop per op per step; here even the
+        per-*step* dispatch cost (host→device latency, nontrivial through
+        remote TPU attachments) amortizes across the scan. Parameters and
+        optimizer state thread through the scan carry, so the whole K-step
+        train loop is device-resident.
+        """
+        from ..fluid.framework import default_main_program
+
+        program = program or default_main_program()
+        feeds = list(feeds)
+        if not feeds:
+            raise ValueError("run_steps needs at least one feed dict")
+        K = int(steps or len(feeds))
+        scope = scope or global_scope()
+        fetch_list = list(fetch_list or [])
+        fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
+
+        block = program.global_block()
+        prepared = [self._prepare_feed(block, dict(f)) for f in feeds]
+        stacked = {k: jnp.stack([jnp.asarray(p[k]) for p in prepared])
+                   for k in prepared[0]}
+
+        if scope.find_var(_RNG_KEY) is None:
+            scope.set(_RNG_KEY, jax.random.PRNGKey(program.random_seed or 0))
+
+        free = _collect_free_inputs(program, 0)
+        feed_keys = set(stacked)
+        state_in = [n for n in free if n not in feed_keys and scope.has_var(n)]
+        written = _written_names(program, 0)
+        state_out = [n for n in written
+                     if (block.has_var(n) and block.var(n).persistable)
+                     or scope.has_var(n)]
+        # scan carry must have a fixed structure: carry everything read or
+        # persistently written (all present in scope after startup ran)
+        carry = list(dict.fromkeys(state_in + [n for n in state_out
+                                               if scope.has_var(n)]))
+        state = {n: scope.find_var(n) for n in carry}
+        state[_RNG_KEY] = scope.find_var(_RNG_KEY)
+        state = {k: v for k, v in state.items() if _is_traceable(v)}
+
+        fn = self._compiled_steps(program, tuple(sorted(stacked)),
+                                  tuple(fetch_names), tuple(sorted(state)),
+                                  K, len(prepared))
+        with amp_guard(self.amp):
+            new_state, fetches = fn(state, stacked)
+        for n, v in new_state.items():
+            scope.set(n, v)
+        return [np.asarray(v) if return_numpy else v for v in fetches]
+
+    def _compiled_steps(self, program, feed_names, fetch_names, carry_keys,
+                        K, B):
+        key = ("multi", id(program), program._version, feed_names,
+               fetch_names, carry_keys, K, B, self.donate, self.amp)
+        fn = self._cache.get(key)
+        if fn is not None:
+            return fn
+
+        block = program.global_block()
+        exec_state = self
+
+        def multi(state, stacked):
+            idx = jnp.arange(K, dtype=jnp.int32) % B
+
+            def body(st, i):
+                env = dict(st)
+                for k, v in stacked.items():
+                    env[k] = jax.lax.dynamic_index_in_dim(
+                        v, i, axis=0, keepdims=False)
+                _run_ops(block, env, exec_state)
+                new_st = {n: env.get(n, st[n]) for n in carry_keys}
+                new_st[_RNG_KEY] = env[_RNG_KEY]
+                fetches = [env[n] for n in fetch_names]
+                return new_st, fetches
+
+            return jax.lax.scan(body, state, idx)
+
+        donate = (0,) if self.donate else ()
+        fn = jax.jit(multi, donate_argnums=donate)
+        self._cache[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
     def _compiled(self, program, feed_names, fetch_names, state_in, state_out):
         key = (id(program), program._version, feed_names, fetch_names,
-               state_in, state_out, self.donate)
+               state_in, state_out, self.donate, self.amp)
         fn = self._cache.get(key)
         if fn is not None:
             return fn

@@ -1,0 +1,145 @@
+"""Host-side profiler: per-op/per-step spans + chrome-trace export.
+
+Reference: /root/reference/paddle/fluid/platform/profiler.{h,cc} — RAII
+RecordEvent pairs pushed on a thread-local EventList around every op run
+(operator.cc:488, executor.cc:98), aggregated into a sorted table by
+EnableProfiler/DisableProfiler (profiler.h:153-166); the CUPTI DeviceTracer
+(device_tracer.h:30-102) correlates device kernels to op annotations and
+tools/timeline.py:40-134 converts the proto to chrome://tracing JSON.
+
+TPU-native redesign: there is no per-op device kernel to intercept — a block
+compiles to ONE fused XLA computation. So the host profiler records
+  * per-op spans in eager mode (the interpreter path — true analog of the
+    reference's per-op host events),
+  * trace/compile/dispatch/step spans in jit mode,
+and device-side detail comes from ``jax.profiler`` xplane traces (the CUPTI
+analog), started/stopped by the same context manager. Chrome-trace JSON is
+written directly (no proto intermediary) with the same event schema
+timeline.py emits: ph="X" complete events with pid/tid/ts/dur.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+
+_lock = threading.Lock()
+_enabled = False
+_events: list[tuple[str, str, float, float, int]] = []  # (kind, name, t0, t1, tid)
+_t_origin = 0.0
+
+
+def _now():
+    return time.perf_counter()
+
+
+def profiler_enabled():
+    return _enabled
+
+
+def enable_profiler(state="All"):
+    """Start recording (reference EnableProfiler, profiler.h:153). ``state``
+    kept for API parity — host spans are recorded either way; device detail
+    comes from the jax_trace context manager."""
+    global _enabled, _t_origin
+    with _lock:
+        _events.clear()
+        _t_origin = _now()
+        _enabled = True
+
+
+def reset_profiler():
+    with _lock:
+        _events.clear()
+
+
+def disable_profiler(sorted_key=None, profile_path=None):
+    """Stop recording; return the aggregate table rows and optionally write a
+    chrome trace (reference DisableProfiler + timeline.py)."""
+    global _enabled
+    with _lock:
+        _enabled = False
+        events = list(_events)
+    if profile_path:
+        export_chrome_tracing(profile_path, events)
+    return summarize(events, sorted_key)
+
+
+@contextmanager
+def record_event(name, kind="op"):
+    """RAII span (reference RecordEvent, profiler.h:98). Near-zero cost when
+    profiling is off."""
+    if not _enabled:
+        yield
+        return
+    t0 = _now()
+    try:
+        yield
+    finally:
+        t1 = _now()
+        with _lock:
+            if _enabled:
+                _events.append(
+                    (kind, name, t0, t1, threading.get_ident()))
+
+
+def events():
+    with _lock:
+        return list(_events)
+
+
+def summarize(evs=None, sorted_key=None):
+    """Aggregate spans into per-name rows: calls, total/max/min/avg ms —
+    the reference's printed profiling report (profiler.cc PrintProfiler)."""
+    evs = events() if evs is None else evs
+    agg: dict[str, list[float]] = {}
+    for kind, name, t0, t1, _tid in evs:
+        agg.setdefault(name, []).append((t1 - t0) * 1e3)
+    rows = []
+    for name, durs in agg.items():
+        rows.append({
+            "name": name, "calls": len(durs), "total_ms": sum(durs),
+            "max_ms": max(durs), "min_ms": min(durs),
+            "avg_ms": sum(durs) / len(durs),
+        })
+    key = {None: "name", "default": "name", "calls": "calls",
+           "total": "total_ms", "max": "max_ms", "min": "min_ms",
+           "ave": "avg_ms", "avg": "avg_ms"}[sorted_key]
+    reverse = key != "name"
+    rows.sort(key=lambda r: r[key], reverse=reverse)
+    return rows
+
+
+def print_summary(rows, file=None):
+    hdr = f"{'Event':<32}{'Calls':>8}{'Total(ms)':>12}{'Min(ms)':>10}" \
+          f"{'Max(ms)':>10}{'Ave(ms)':>10}"
+    lines = ["-------------------------->  Profiling Report  "
+             "<--------------------------", hdr]
+    for r in rows:
+        lines.append(f"{r['name']:<32}{r['calls']:>8}{r['total_ms']:>12.4f}"
+                     f"{r['min_ms']:>10.4f}{r['max_ms']:>10.4f}"
+                     f"{r['avg_ms']:>10.4f}")
+    print("\n".join(lines), file=file)
+
+
+def export_chrome_tracing(path, evs=None):
+    """Write chrome://tracing 'Complete' events (ph="X"), the exact schema of
+    the reference's tools/timeline.py:40-134 _ChromeTraceFormatter."""
+    evs = events() if evs is None else evs
+    trace = []
+    for kind, name, t0, t1, tid in evs:
+        trace.append({
+            "ph": "X", "cat": kind, "name": name,
+            "pid": 0, "tid": tid,
+            "ts": int((t0 - _t_origin) * 1e6),
+            "dur": max(1, int((t1 - t0) * 1e6)),
+            "args": {},
+        })
+    meta = [{"ph": "M", "pid": 0, "name": "process_name",
+             "args": {"name": "paddle_tpu host"}}]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": meta + trace,
+                   "displayTimeUnit": "ms"}, f)
+    return path

@@ -9,6 +9,7 @@ from .framework import (Program, Block, Operator, Variable, Parameter,
                         default_startup_program, switch_main_program,
                         switch_startup_program, grad_var_name, unique_name)
 from ..core.executor import Executor, CPUPlace, TPUPlace
+from ..core.amp import amp_guard
 from ..core.scope import Scope, global_scope
 from ..core.lod import LoDArray, pack_sequences, flat_to_lodarray, \
     lodarray_to_flat
@@ -17,6 +18,7 @@ from .. import ops as _ops  # registers all op lowerings
 from . import layers
 from . import nets
 from . import optimizer
+from . import profiler
 from . import initializer
 from . import regularizer
 from . import backward
@@ -34,5 +36,5 @@ __all__ = [
     "default_main_program", "default_startup_program", "Executor", "CPUPlace",
     "TPUPlace", "CUDAPlace", "Scope", "global_scope", "layers", "optimizer",
     "initializer", "regularizer", "backward", "io", "nets", "append_backward",
-    "ParamAttr", "DataFeeder", "LoDArray",
+    "ParamAttr", "DataFeeder", "LoDArray", "profiler", "amp_guard",
 ]

@@ -276,12 +276,14 @@ def _pair(v):
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
-           act=None, name=None):
+           act=None, name=None, data_format="NCHW"):
     """Conv layer (reference nn.py conv2d → conv2d op, NCHW/MCHW). The
     use_cudnn flag is accepted for source compatibility and ignored — there is
-    one XLA lowering."""
+    one XLA lowering. ``data_format="NHWC"`` is a TPU-native extension:
+    channels land in the TPU lane dimension so BN reductions and elementwise
+    tiles align (the filter stays MCHW for checkpoint parity)."""
     helper = LayerHelper("conv2d", name=name, act=act, bias_attr=bias_attr)
-    c_in = input.shape[1]
+    c_in = input.shape[-1] if data_format == "NHWC" else input.shape[1]
     groups = groups or 1
     fs = _pair(filter_size)
     w = helper.create_parameter(
@@ -289,12 +291,14 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
         dtype=input.dtype,
         default_initializer=Normal(0.0, (2.0 / (fs[0] * fs[1] * c_in)) ** 0.5))
     attrs = {"strides": _pair(stride), "paddings": _pair(padding),
-             "dilations": _pair(dilation), "groups": groups}
+             "dilations": _pair(dilation), "groups": groups,
+             "data_format": data_format}
     pre_bias = helper.create_tmp_variable(input.dtype)
     helper.append_op("conv2d",
                      inputs={"Input": [input.name], "Filter": [w.name]},
                      outputs={"Output": [pre_bias.name]}, attrs=attrs)
-    pre_act = _append_channel_bias(helper, pre_bias, num_filters, bias_attr)
+    pre_act = _append_channel_bias(helper, pre_bias, num_filters, bias_attr,
+                                   data_format)
     return helper.append_activation(pre_act)
 
 
@@ -327,23 +331,27 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
     return helper.append_activation(pre_act)
 
 
-def _append_channel_bias(helper, pre_bias, num_channels, bias_attr):
-    """Per-output-channel bias broadcast along dim 1 (the reference conv
-    layers' append_bias_op(dim_start=1, dim_end=2))."""
+def _append_channel_bias(helper, pre_bias, num_channels, bias_attr,
+                         data_format="NCHW"):
+    """Per-output-channel bias broadcast along the channel dim (the reference
+    conv layers' append_bias_op(dim_start=1, dim_end=2); channel dim is last
+    under the NHWC extension)."""
     if bias_attr is False:
         return pre_bias
+    axis = -1 if data_format == "NHWC" else 1
     b = helper.create_parameter(ParamAttr.to_attr(bias_attr),
                                 shape=(num_channels,),
                                 dtype=pre_bias.dtype, is_bias=True)
     out = helper.create_tmp_variable(pre_bias.dtype, shape=pre_bias.shape)
     helper.append_op("elementwise_add",
                      inputs={"X": [pre_bias.name], "Y": [b.name]},
-                     outputs={"Out": [out.name]}, attrs={"axis": 1})
+                     outputs={"Out": [out.name]}, attrs={"axis": axis})
     return out
 
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
-           global_pooling=False, use_cudnn=True, ceil_mode=False, name=None):
+           global_pooling=False, use_cudnn=True, ceil_mode=False, name=None,
+           data_format="NCHW"):
     if pool_type not in ("max", "avg"):
         raise ValueError(f"pool_type must be max|avg, got {pool_type!r}")
     if not global_pooling and (pool_size == -1 or pool_size is None):
@@ -358,7 +366,8 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
                             "strides": _pair(pool_stride),
                             "paddings": _pair(pool_padding),
                             "global_pooling": global_pooling,
-                            "ceil_mode": ceil_mode})
+                            "ceil_mode": ceil_mode,
+                            "data_format": data_format})
     return out
 
 

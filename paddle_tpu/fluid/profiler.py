@@ -1,0 +1,59 @@
+"""User-facing profiler API (reference python/paddle/fluid/profiler.py:33-109).
+
+``fluid.profiler.profiler(...)`` is the reference's context manager: enable,
+run the training loop, print the aggregate per-op table and optionally dump a
+chrome://tracing JSON. ``cuda_profiler`` becomes ``device_tracer`` — a
+``jax.profiler`` xplane trace (view in TensorBoard / xprof, or Perfetto),
+the TPU analog of the reference's CUPTI DeviceTracer.
+"""
+
+from __future__ import annotations
+
+import sys
+from contextlib import contextmanager
+
+from ..core import profiler as _core
+
+
+@contextmanager
+def profiler(state="All", sorted_key=None, profile_path=None, file=None):
+    """Profile the enclosed region; on exit print the per-span report (sorted
+    by ``sorted_key`` in {'calls','total','max','min','ave'}) and, if
+    ``profile_path`` is given, write chrome://tracing JSON there
+    (reference profiler.py:33 profile_context)."""
+    _core.enable_profiler(state)
+    try:
+        yield
+    finally:
+        rows = _core.disable_profiler(sorted_key, profile_path)
+        _core.print_summary(rows, file=file or sys.stdout)
+
+
+def start_profiler(state="All"):
+    _core.enable_profiler(state)
+
+
+def stop_profiler(sorted_key=None, profile_path=None, file=None):
+    rows = _core.disable_profiler(sorted_key, profile_path)
+    _core.print_summary(rows, file=file or sys.stdout)
+    return rows
+
+
+def reset_profiler():
+    _core.reset_profiler()
+
+
+@contextmanager
+def device_tracer(logdir):
+    """Capture a device-level xplane trace via jax.profiler (CUPTI analog:
+    device_tracer.h:30). View with TensorBoard's profile plugin."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+# reference-name alias: cuda_profiler(output_file, ...) traced GPU kernels
+cuda_profiler = device_tracer

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core.amp import cast_compute
 from ..core.registry import register_op, OpSpec, infer_output
 from .common import G, data_of
 
@@ -31,14 +32,25 @@ def _pair(v):
     return (int(v), int(v))
 
 
-def _conv2d_compute(x, w, strides, paddings, dilations, groups):
+def _conv2d_compute(x, w, strides, paddings, dilations, groups, df="NCHW"):
+    # under AMP both operands become bf16; the TPU MXU still accumulates in
+    # float32 internally, so no explicit preferred_element_type is needed
+    # (and conv's transpose rule can't differentiate through one).
+    # data_format="NHWC" is the TPU-native layout (channels in the lane
+    # dimension — BN reductions and elementwise tiles align); the filter
+    # stays OIHW for reference checkpoint parity and XLA relayouts it once.
+    x, w = cast_compute(x, w)
     return lax.conv_general_dilated(
         x, w,
         window_strides=strides,
         padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
         rhs_dilation=dilations,
         feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        dimension_numbers=(df, "OIHW", df))
+
+
+def _channel_dim(df):
+    return 3 if df == "NHWC" else 1
 
 
 def _conv_attrs(ctx_or_op, attr):
@@ -47,6 +59,10 @@ def _conv_attrs(ctx_or_op, attr):
     dilations = _pair(attr("dilations", [1, 1]))
     groups = int(attr("groups", 1) or 1)
     return strides, paddings, dilations, groups
+
+
+def _conv_df(attr):
+    return attr("data_format", "NCHW") or "NCHW"
 
 
 def _conv_out_size(h, k, pad, stride, dilation=1):
@@ -61,12 +77,16 @@ def _conv2d_infer(op, block):
     s = _pair(op.attrs.get("strides", [1, 1]))
     p = _pair(op.attrs.get("paddings", [0, 0]))
     d = _pair(op.attrs.get("dilations", [1, 1]))
-    n, _, h, wd = x.shape
+    df = op.attrs.get("data_format", "NCHW") or "NCHW"
+    if df == "NHWC":
+        n, h, wd, _ = x.shape
+    else:
+        n, _, h, wd = x.shape
     m, _, kh, kw = w.shape
-    infer_output(op, block, "Output",
-                 (n, m, _conv_out_size(h, kh, p[0], s[0], d[0]),
-                  _conv_out_size(wd, kw, p[1], s[1], d[1])),
-                 dtype=x.dtype)
+    oh = _conv_out_size(h, kh, p[0], s[0], d[0])
+    ow = _conv_out_size(wd, kw, p[1], s[1], d[1])
+    shape = (n, oh, ow, m) if df == "NHWC" else (n, m, oh, ow)
+    infer_output(op, block, "Output", shape, dtype=x.dtype)
 
 
 def _conv2d_grad_maker(op):
@@ -84,7 +104,8 @@ def conv2d(ctx):
     w = data_of(ctx.input("Filter"))
     strides, paddings, dilations, groups = _conv_attrs(ctx, ctx.attr)
     ctx.set_output("Output",
-                   _conv2d_compute(x, w, strides, paddings, dilations, groups))
+                   _conv2d_compute(x, w, strides, paddings, dilations, groups,
+                                   _conv_df(ctx.attr)))
 
 
 @register_op("conv2d_grad")
@@ -93,11 +114,17 @@ def conv2d_grad(ctx):
     w = data_of(ctx.input("Filter"))
     dy = data_of(ctx.input("Output@GRAD"))
     strides, paddings, dilations, groups = _conv_attrs(ctx, ctx.attr)
-    _, vjp = jax.vjp(
+    df = _conv_df(ctx.attr)
+    out, vjp = jax.vjp(
         lambda a, b: _conv2d_compute(a, b, strides, paddings, dilations,
-                                     groups), x, w)
-    dx, dw = vjp(dy)
-    ctx.set_output("Input@GRAD", dx)
+                                     groups, df), x, w)
+    # upstream grads may arrive fp32 (loss islands) while the forward ran
+    # bf16 under AMP — align the cotangent dtype with the primal output
+    dx, dw = vjp(dy.astype(out.dtype))
+    # activation grads stay in the compute dtype (the vjp cast boundary
+    # upcasts them to fp32 — wasted HBM writes under AMP); the filter grad
+    # keeps fp32 as the optimizer's master-gradient
+    ctx.set_output("Input@GRAD", cast_compute(dx))
     ctx.set_output("Filter@GRAD", dw)
 
 
@@ -116,9 +143,10 @@ def depthwise_conv2d(ctx):
     x = data_of(ctx.input("Input"))
     w = data_of(ctx.input("Filter"))
     strides, paddings, dilations, _ = _conv_attrs(ctx, ctx.attr)
+    df = _conv_df(ctx.attr)
     ctx.set_output("Output",
                    _conv2d_compute(x, w, strides, paddings, dilations,
-                                   groups=x.shape[1]))
+                                   groups=x.shape[_channel_dim(df)], df=df))
 
 
 @register_op("depthwise_conv2d_grad")
@@ -127,11 +155,13 @@ def depthwise_conv2d_grad(ctx):
     w = data_of(ctx.input("Filter"))
     dy = data_of(ctx.input("Output@GRAD"))
     strides, paddings, dilations, _ = _conv_attrs(ctx, ctx.attr)
-    _, vjp = jax.vjp(
+    df = _conv_df(ctx.attr)
+    out, vjp = jax.vjp(
         lambda a, b: _conv2d_compute(a, b, strides, paddings, dilations,
-                                     groups=x.shape[1]), x, w)
-    dx, dw = vjp(dy)
-    ctx.set_output("Input@GRAD", dx)
+                                     groups=x.shape[_channel_dim(df)], df=df),
+        x, w)
+    dx, dw = vjp(dy.astype(out.dtype))
+    ctx.set_output("Input@GRAD", cast_compute(dx))
     ctx.set_output("Filter@GRAD", dw)
 
 
@@ -148,6 +178,7 @@ def _conv2d_transpose_compute(x, w, strides, paddings, dilations):
     kh, kw = w.shape[2], w.shape[3]
     ke_h = dilations[0] * (kh - 1) + 1
     ke_w = dilations[1] * (kw - 1) + 1
+    x, w = cast_compute(x, w)
     w_t = jnp.flip(w.transpose(1, 0, 2, 3), axis=(2, 3))
     return lax.conv_general_dilated(
         x, w_t,
@@ -196,11 +227,11 @@ def conv2d_transpose_grad(ctx):
     w = data_of(ctx.input("Filter"))
     dy = data_of(ctx.input("Output@GRAD"))
     strides, paddings, dilations, _ = _conv_attrs(ctx, ctx.attr)
-    _, vjp = jax.vjp(
+    out, vjp = jax.vjp(
         lambda a, b: _conv2d_transpose_compute(a, b, strides, paddings,
                                                dilations), x, w)
-    dx, dw = vjp(dy)
-    ctx.set_output("Input@GRAD", dx)
+    dx, dw = vjp(dy.astype(out.dtype))
+    ctx.set_output("Input@GRAD", cast_compute(dx))
     ctx.set_output("Filter@GRAD", dw)
 
 
@@ -209,8 +240,11 @@ def conv2d_transpose_grad(ctx):
 # ---------------------------------------------------------------------------
 
 def _pool2d_compute(x, ksize, strides, paddings, pooling_type, global_pooling,
-                    ceil_mode, exclusive=True):
-    n, c, h, w = x.shape
+                    ceil_mode, exclusive=True, df="NCHW"):
+    if df == "NHWC":
+        n, h, w, c = x.shape
+    else:
+        n, c, h, w = x.shape
     if global_pooling:
         ksize = (h, w)
         paddings = (0, 0)
@@ -227,9 +261,16 @@ def _pool2d_compute(x, ksize, strides, paddings, pooling_type, global_pooling,
     # extra bottom/right padding so the window grid covers the ceil output
     eh = max(0, (oh - 1) * sh + kh - h - 2 * ph)
     ew = max(0, (ow - 1) * sw + kw - w - 2 * pw)
-    pads = ((0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew))
-    dims = (1, 1, kh, kw)
-    strides4 = (1, 1, sh, sw)
+    if df == "NHWC":
+        pads = ((0, 0), (ph, ph + eh), (pw, pw + ew), (0, 0))
+        dims = (1, kh, kw, 1)
+        strides4 = (1, sh, sw, 1)
+        ones_shape = (1, h, w, 1)
+    else:
+        pads = ((0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew))
+        dims = (1, 1, kh, kw)
+        strides4 = (1, 1, sh, sw)
+        ones_shape = (1, 1, h, w)
 
     # init values must be python scalars: jax only recognizes the
     # differentiable reduce_window_sum/max special cases for literal inits
@@ -240,7 +281,7 @@ def _pool2d_compute(x, ksize, strides, paddings, pooling_type, global_pooling,
 
     sums = lax.reduce_window(x, 0.0, lax.add, dims, strides4, pads)
     if exclusive and (ph or pw or eh or ew):
-        ones = jnp.ones((1, 1, h, w), x.dtype)
+        ones = jnp.ones(ones_shape, x.dtype)
         counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides4, pads)
         return sums / counts
     return sums / (kh * kw)
@@ -252,7 +293,7 @@ def _pool2d_attrs(attr):
     paddings = _pair(attr("paddings", [0, 0]))
     return (ksize, strides, paddings, attr("pooling_type", "max"),
             bool(attr("global_pooling", False)), bool(attr("ceil_mode", False)),
-            bool(attr("exclusive", True)))
+            bool(attr("exclusive", True)), _conv_df(attr))
 
 
 def _pool2d_infer(op, block):
@@ -263,7 +304,11 @@ def _pool2d_infer(op, block):
     s = _pair(op.attrs.get("strides", [1, 1]))
     p = _pair(op.attrs.get("paddings", [0, 0]))
     ceil = bool(op.attrs.get("ceil_mode", False))
-    n, c, h, w = x.shape
+    df = op.attrs.get("data_format", "NCHW") or "NCHW"
+    if df == "NHWC":
+        n, h, w, c = x.shape
+    else:
+        n, c, h, w = x.shape
     if op.attrs.get("global_pooling", False):
         oh = ow = 1
     else:
@@ -271,7 +316,8 @@ def _pool2d_infer(op, block):
             return (-((size - kk + 2 * pp) // -ss) + 1) if ceil else \
                 ((size - kk + 2 * pp) // ss + 1)
         oh, ow = od(h, k[0], p[0], s[0]), od(w, k[1], p[1], s[1])
-    infer_output(op, block, "Out", (n, c, oh, ow), dtype=x.dtype)
+    shape = (n, oh, ow, c) if df == "NHWC" else (n, c, oh, ow)
+    infer_output(op, block, "Out", shape, dtype=x.dtype)
 
 
 @register_op("pool2d", infer_shape=_pool2d_infer, grad=lambda op: [OpSpec(
@@ -288,5 +334,7 @@ def pool2d_grad(ctx):
     x = data_of(ctx.input("X"))
     dy = data_of(ctx.input("Out@GRAD"))
     args = _pool2d_attrs(ctx.attr)
-    _, vjp = jax.vjp(lambda a: _pool2d_compute(a, *args), x)
-    ctx.set_output("X@GRAD", vjp(dy)[0])
+    out, vjp = jax.vjp(lambda a: _pool2d_compute(a, *args), x)
+    # upstream grads can arrive in a different float dtype than the forward
+    # output under AMP (e.g. bf16 grad meeting an fp32-promoted forward)
+    ctx.set_output("X@GRAD", vjp(dy.astype(out.dtype))[0])

@@ -14,6 +14,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..core.amp import upcast_f32
 from ..core.registry import register_op, same_shape, OpSpec
 from .common import G, data_of, like
 
@@ -48,7 +49,7 @@ def _take_label(x, label):
     {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
 def cross_entropy(ctx):
     xv = ctx.input("X")
-    x = data_of(xv)
+    x = upcast_f32(data_of(xv))
     label = data_of(ctx.input("Label"))
     eps = 1e-8
     if ctx.attr("soft_label", False):
@@ -83,7 +84,8 @@ def softmax_with_cross_entropy(ctx):
     """Fused, numerically-stable version (reference
     softmax_with_cross_entropy_op.cc) — on TPU the fusion happens in XLA, but
     we keep the stable log-sum-exp formulation."""
-    logits = data_of(ctx.input("Logits"))
+    # float32 stability island: bf16 logits (AMP) are upcast before the LSE
+    logits = upcast_f32(data_of(ctx.input("Logits")))
     label = data_of(ctx.input("Label"))
     lse = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
     log_probs = logits - lse

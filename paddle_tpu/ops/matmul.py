@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..core.amp import cast_compute
 from ..core.lod import LoDArray
 from ..core.registry import register_op, OpSpec
 from .common import G, data_of, like
@@ -47,6 +48,7 @@ def mul(ctx):
         # (mul_op.cc flattens from there); our padded [b, L, *feat] layout has
         # one extra leading dim, so the split point shifts by one
         xnc = xnc + 1
+    x, y = cast_compute(x, y)
     x2, y2 = _flat2d(x, xnc), _flat2d(y, ync)
     out = jnp.dot(x2, y2, preferred_element_type=jnp.float32).astype(x.dtype)
     out_shape = x.shape[:xnc] + y.shape[ync:]
@@ -62,6 +64,7 @@ def mul_grad(ctx):
     ync = ctx.attr("y_num_col_dims", 1)
     if isinstance(xv, LoDArray):
         xnc = xnc + 1
+    x, y, d = cast_compute(x, y, d)
     x2, y2 = _flat2d(x, xnc), _flat2d(y, ync)
     d2 = d.reshape(x2.shape[0], y2.shape[1])
     dx = jnp.dot(d2, y2.T, preferred_element_type=jnp.float32)
@@ -123,7 +126,7 @@ def _mm(x, y, tx, ty):
 @register_op("matmul", grad=_matmul_grad_maker)
 def matmul(ctx):
     xv = ctx.input("X")
-    x, y = data_of(xv), data_of(ctx.input("Y"))
+    x, y = cast_compute(data_of(xv), data_of(ctx.input("Y")))
     out = _mm(x, y, ctx.attr("transpose_X", False), ctx.attr("transpose_Y", False))
     if x.ndim == 1 and y.ndim == 1:
         out = out.reshape(())
@@ -134,6 +137,7 @@ def matmul(ctx):
 def matmul_grad(ctx):
     x, y = data_of(ctx.input("X")), data_of(ctx.input("Y"))
     d = data_of(ctx.input("Out@GRAD"))
+    x, y, d = cast_compute(x, y, d)
     tx, ty = ctx.attr("transpose_X", False), ctx.attr("transpose_Y", False)
     if x.ndim == 1 and y.ndim == 1:
         d = d.reshape(1, 1)

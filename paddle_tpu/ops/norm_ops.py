@@ -81,18 +81,34 @@ def batch_norm(ctx):
     axes = _bn_axes(x, layout)
     bshape = _bn_bshape(x, layout)
 
+    # stability island: statistics accumulate in float32 straight out of the
+    # (possibly bf16) activations — single pass via E[x²]-E[x]², reductions
+    # carry an fp32 accumulator (dtype=) so no upcast copy of x is ever
+    # materialized; the normalize is one fused elementwise kernel emitting
+    # the activation dtype.
+    out_dtype = x.dtype
+
     if ctx.attr("is_test", False):
         mean, var = running_mean, running_var
         new_mean, new_var = running_mean, running_var
     else:
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.var(x, axis=axes)
+        mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+        if x.dtype == jnp.bfloat16:
+            # AMP fast path: single-pass E[x²]-E[x]² with fp32 accumulators
+            # (the flax recipe) — one read of x instead of two; cancellation
+            # only bites when |mean|/std exceeds ~3e3, beyond bf16 training
+            # regimes
+            mean_sq = jnp.mean(jnp.square(x), axis=axes, dtype=jnp.float32)
+            var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
+        else:
+            # fp32 path keeps the numerically robust centered two-pass form
+            var = jnp.var(x, axis=axes)
         new_mean = momentum * running_mean + (1.0 - momentum) * mean
         new_var = momentum * running_var + (1.0 - momentum) * var
 
     inv_std = jax.lax.rsqrt(var + eps)
-    y = (x - mean.reshape(bshape)) * (scale * inv_std).reshape(bshape) \
-        + bias.reshape(bshape)
+    y = (x.astype(jnp.float32) * (scale * inv_std).reshape(bshape)
+         + (bias - mean * scale * inv_std).reshape(bshape)).astype(out_dtype)
     ctx.set_output("Y", y)
     ctx.set_output("MeanOut", new_mean)
     ctx.set_output("VarianceOut", new_var)
@@ -113,6 +129,11 @@ def batch_norm_grad(ctx):
     bshape = _bn_bshape(x, layout)
     m = x.size // x.shape[_bn_channel_axis(x, layout)]
 
+    # float32 stability island mirroring the forward; dX returns in the
+    # activation dtype so the bf16 backward chain stays bf16
+    out_dtype = x.dtype
+    x = x.astype(jnp.float32)
+    dy = dy.astype(jnp.float32)
     inv_std = jax.lax.rsqrt(var + eps).reshape(bshape)
     xhat = (x - mean.reshape(bshape)) * inv_std
     dbias = jnp.sum(dy, axis=axes)
@@ -122,7 +143,7 @@ def batch_norm_grad(ctx):
     else:
         dx = (scale.reshape(bshape) * inv_std / m) * (
             m * dy - dbias.reshape(bshape) - xhat * dscale.reshape(bshape))
-    ctx.set_output("X@GRAD", dx)
+    ctx.set_output("X@GRAD", dx.astype(out_dtype))
     ctx.set_output("Scale@GRAD", dscale)
     ctx.set_output("Bias@GRAD", dbias)
 

@@ -24,17 +24,25 @@ def _lr(ctx):
     return data_of(ctx.input("LearningRate")).reshape(())
 
 
+def _param_grad(ctx):
+    """Param + Grad with the gradient cast up to the parameter dtype: under
+    AMP the backward produces bf16 grads while master weights and optimizer
+    state stay float32 (the mixed-precision contract)."""
+    p = data_of(ctx.input("Param"))
+    g = data_of(ctx.input("Grad")).astype(p.dtype)
+    return p, g
+
+
+
 @register_op("sgd", in_place=True)
 def sgd(ctx):
-    p = data_of(ctx.input("Param"))
-    g = data_of(ctx.input("Grad"))
+    p, g = _param_grad(ctx)
     ctx.set_output("ParamOut", p - _lr(ctx) * g)
 
 
 @register_op("momentum", in_place=True)
 def momentum(ctx):
-    p = data_of(ctx.input("Param"))
-    g = data_of(ctx.input("Grad"))
+    p, g = _param_grad(ctx)
     v = data_of(ctx.input("Velocity"))
     mu = ctx.attr("mu")
     lr = _lr(ctx)
@@ -49,8 +57,7 @@ def momentum(ctx):
 
 @register_op("adam", in_place=True)
 def adam(ctx):
-    p = data_of(ctx.input("Param"))
-    g = data_of(ctx.input("Grad"))
+    p, g = _param_grad(ctx)
     m1 = data_of(ctx.input("Moment1"))
     m2 = data_of(ctx.input("Moment2"))
     b1p = data_of(ctx.input("Beta1Pow")).reshape(())
@@ -67,8 +74,7 @@ def adam(ctx):
 
 @register_op("adagrad", in_place=True)
 def adagrad(ctx):
-    p = data_of(ctx.input("Param"))
-    g = data_of(ctx.input("Grad"))
+    p, g = _param_grad(ctx)
     m = data_of(ctx.input("Moment"))
     eps = ctx.attr("epsilon", 1e-6)
     m_new = m + g * g
@@ -78,8 +84,7 @@ def adagrad(ctx):
 
 @register_op("decayed_adagrad", in_place=True)
 def decayed_adagrad(ctx):
-    p = data_of(ctx.input("Param"))
-    g = data_of(ctx.input("Grad"))
+    p, g = _param_grad(ctx)
     m = data_of(ctx.input("Moment"))
     decay = ctx.attr("decay", 0.95)
     eps = ctx.attr("epsilon", 1e-6)
@@ -90,8 +95,7 @@ def decayed_adagrad(ctx):
 
 @register_op("adadelta", in_place=True)
 def adadelta(ctx):
-    p = data_of(ctx.input("Param"))
-    g = data_of(ctx.input("Grad"))
+    p, g = _param_grad(ctx)
     avg_sq_grad = data_of(ctx.input("AvgSquaredGrad"))
     avg_sq_upd = data_of(ctx.input("AvgSquaredUpdate"))
     rho = ctx.attr("rho", 0.95)
@@ -106,8 +110,7 @@ def adadelta(ctx):
 
 @register_op("rmsprop", in_place=True)
 def rmsprop(ctx):
-    p = data_of(ctx.input("Param"))
-    g = data_of(ctx.input("Grad"))
+    p, g = _param_grad(ctx)
     ms = data_of(ctx.input("MeanSquare"))
     mom = data_of(ctx.input("Moment"))
     rho = ctx.attr("decay", 0.9)
@@ -122,8 +125,7 @@ def rmsprop(ctx):
 
 @register_op("adamax", in_place=True)
 def adamax(ctx):
-    p = data_of(ctx.input("Param"))
-    g = data_of(ctx.input("Grad"))
+    p, g = _param_grad(ctx)
     m = data_of(ctx.input("Moment"))
     inf_norm = data_of(ctx.input("InfNorm"))
     b1p = data_of(ctx.input("Beta1Pow")).reshape(())
@@ -139,8 +141,7 @@ def adamax(ctx):
 
 @register_op("ftrl", in_place=True)
 def ftrl(ctx):
-    p = data_of(ctx.input("Param"))
-    g = data_of(ctx.input("Grad"))
+    p, g = _param_grad(ctx)
     sq = data_of(ctx.input("SquaredAccumulator"))
     lin = data_of(ctx.input("LinearAccumulator"))
     l1 = ctx.attr("l1", 0.0)
@@ -165,8 +166,7 @@ def ftrl(ctx):
 
 @register_op("proximal_gd", in_place=True)
 def proximal_gd(ctx):
-    p = data_of(ctx.input("Param"))
-    g = data_of(ctx.input("Grad"))
+    p, g = _param_grad(ctx)
     l1 = ctx.attr("l1", 0.0)
     l2 = ctx.attr("l2", 0.0)
     lr = _lr(ctx)
@@ -178,8 +178,7 @@ def proximal_gd(ctx):
 
 @register_op("proximal_adagrad", in_place=True)
 def proximal_adagrad(ctx):
-    p = data_of(ctx.input("Param"))
-    g = data_of(ctx.input("Grad"))
+    p, g = _param_grad(ctx)
     m = data_of(ctx.input("Moment"))
     l1 = ctx.attr("l1", 0.0)
     l2 = ctx.attr("l2", 0.0)
