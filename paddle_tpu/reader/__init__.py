@@ -1,0 +1,10 @@
+from .decorator import (map_readers, buffered, compose, chain, shuffle,
+                        ComposeNotAligned, firstn, xmap_readers, cache)
+from .minibatch import batch
+from .prefetch import DeviceFeedIterator, double_buffer
+
+__all__ = [
+    "map_readers", "buffered", "compose", "chain", "shuffle",
+    "ComposeNotAligned", "firstn", "xmap_readers", "cache", "batch",
+    "DeviceFeedIterator", "double_buffer",
+]

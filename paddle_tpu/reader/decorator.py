@@ -1,0 +1,211 @@
+"""Reader decorators — composable ``() -> iterator`` transforms.
+
+Mirrors the API surface of the reference's
+/root/reference/python/paddle/v2/reader/decorator.py:29-337 (map_readers,
+shuffle, chain, compose, buffered, firstn, xmap_readers) with the same
+contract: a *reader* is a zero-arg callable returning a fresh iterator over
+samples; a *reader creator/decorator* builds readers from readers. This
+composability is what lets datasets, augmentation, shuffling and batching
+stack without touching the training loop.
+
+Implementation is original (py3 threads/queues; the reference is py2
+Queue/itertools.imap); ``cache`` is an extension used by benchmarks to
+freeze a finite reader's output in memory.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import queue as _queue
+
+
+def map_readers(func, *readers):
+    """Reader yielding ``func(*samples)`` drawn in lockstep from ``readers``
+    (decorator.py:29)."""
+
+    def reader():
+        its = [r() for r in readers]
+        for args in zip(*its):
+            yield func(*args)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffling (decorator.py:52): fill a ``buf_size`` buffer,
+    shuffle it, emit, repeat. The classic streaming-shuffle compromise."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers' outputs in sequence (decorator.py:82)."""
+
+    def reader():
+        return itertools.chain(*[r() for r in readers])
+
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into tuple samples (decorator.py:110): outputs
+    (r1_sample, *r2_sample...) flattened one level. check_alignment=True
+    (default) raises ComposeNotAligned when readers end at different
+    lengths."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        its = [r() for r in readers]
+        if check_alignment:
+            for outputs in itertools.zip_longest(*its):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            for outputs in zip(*its):
+                yield sum((make_tuple(o) for o in outputs), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Decouple producer and consumer with a bounded queue filled by a
+    background thread (decorator.py:160) — host-side pipelining."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        r = reader()
+        q = _queue.Queue(maxsize=size)
+
+        def feed():
+            try:
+                for d in r:
+                    q.put(d)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=feed, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """First ``n`` samples only (decorator.py:191)."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with ``process_num`` worker threads
+    (decorator.py:211 XmapEndSignal machinery). ``order=True`` preserves
+    input order via sequence numbers."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(_End)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is _End:
+                    out_q.put(_End)
+                    break
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+
+        finished = 0
+        if order:
+            pending = {}
+            next_idx = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is _End:
+                    finished += 1
+                    continue
+                i, mapped = item
+                pending[i] = mapped
+                while next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is _End:
+                    finished += 1
+                    continue
+                yield item[1]
+
+    return data_reader
+
+
+def cache(reader):
+    """Materialize a finite reader once and replay from memory afterwards
+    (TPU extension — used to amortize host decode in benchmarks)."""
+    memo = []
+    filled = [False]
+
+    def cached_reader():
+        if filled[0]:
+            yield from memo
+            return
+        for s in reader():
+            memo.append(s)
+            yield s
+        filled[0] = True
+
+    return cached_reader

@@ -1,0 +1,23 @@
+"""``batch`` — group samples into mini-batch lists.
+
+Reference: /root/reference/python/paddle/v2/minibatch.py:18. Same contract:
+the batched reader yields lists of samples; the trailing partial batch is
+emitted (drop it with ``drop_last=True``, an extension the reference's
+fluid-era batch gained later — static-shape XLA steps want it).
+"""
+
+from __future__ import annotations
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batch_reader():
+        b = []
+        for instance in reader():
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader
