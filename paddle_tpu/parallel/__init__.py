@@ -9,6 +9,7 @@ pairs communicating over RPC, the planner annotates the compiled step function
 with jax.sharding shardings over a Mesh and lets GSPMD insert ICI collectives.
 """
 
-from .sharding import ShardingPlan, make_mesh, shard_program_step
+from .sharding import (ShardingPlan, make_mesh, shard_program_step,
+                       place_feed)
 
-__all__ = ["ShardingPlan", "make_mesh", "shard_program_step"]
+__all__ = ["ShardingPlan", "make_mesh", "shard_program_step", "place_feed"]

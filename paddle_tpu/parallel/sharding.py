@@ -45,44 +45,82 @@ def make_mesh(n_devices=None, axes=("dp",), shape=None, devices=None):
     return Mesh(mesh_devs, axes)
 
 
+# optimizer-accumulator name suffixes (fluid/optimizer.py _add_accumulator
+# names them "{param}_{acc}"), used to make optimizer state follow its param
+_ACC_SUFFIX = re.compile(
+    r"_(velocity|moment1|moment2|moment|inf_norm|mean_square|momentum_acc"
+    r"|avg_squared_grad|avg_squared_update|squared|linear|beta1_pow"
+    r"|beta2_pow)(_\d+)?$")
+
+
 class ShardingPlan:
     """Assigns PartitionSpecs to program variables.
 
     Default policy (overridable per-name):
       * feed (data) vars: batch dim sharded over the data axis ("dp")
-      * 2-D parameters: output dim sharded over the model axis ("tp") when the
-        mesh has one and the dim divides evenly — tensor parallelism
+      * 2-D parameters (fc weights, embedding tables): output dim sharded over
+        the model axis ("tp") when the mesh has one and the dim divides evenly
+        — tensor parallelism. Conv filters (>=3-D, spatial trailing dims) are
+        NEVER sharded on spatial dims; with ``shard_conv_filters`` their
+        output-channel dim 0 is sharded instead.
       * optimizer accumulators follow their parameter (suffix matching, the
         way the reference pserver keeps optimizer state with the shard,
         SURVEY.md §2.3 "pserver-style sharded optimizer state")
+      * with ``shard_opt_state`` (ZeRO-1 analog of the reference's
+        pserver-side param-block split, distribute_transpiler.py:92):
+        otherwise-replicated optimizer accumulators shard dim 0 over the
+        data axis; GSPMD turns the optimizer update into reduce-scatter +
+        all-gather style collectives.
       * everything else replicated
     """
 
     def __init__(self, mesh, data_axis="dp", model_axis="tp", rules=None,
-                 shard_params=True):
+                 shard_params=True, shard_conv_filters=False,
+                 shard_opt_state=False):
         self.mesh = mesh
         self.data_axis = data_axis if data_axis in mesh.axis_names else None
         self.model_axis = model_axis if model_axis in mesh.axis_names else None
         self.rules = list(rules or [])  # (regex, PartitionSpec)
         self.shard_params = shard_params
-        self._tp = (dict(zip(mesh.axis_names, mesh.devices.shape))
-                    .get(model_axis, 1))
+        self.shard_conv_filters = shard_conv_filters
+        self.shard_opt_state = shard_opt_state
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        self._tp = sizes.get(model_axis, 1)
+        self._dp = sizes.get(data_axis, 1)
+
+    def _base_spec(self, name, shape):
+        """TP spec for a parameter-shaped array (shared by a param and its
+        same-shaped accumulators so state stays aligned with the param)."""
+        if not (self.shard_params and self.model_axis and self._tp > 1
+                and shape is not None):
+            return P()
+        if (len(shape) == 2 and shape[-1] % self._tp == 0
+                and shape[-1] >= 2 * self._tp):
+            return P(None, self.model_axis)
+        if (self.shard_conv_filters and len(shape) == 4
+                and shape[0] % self._tp == 0 and shape[0] >= 2 * self._tp):
+            # OIHW conv filter: shard output channels, never kh/kw
+            return P(self.model_axis)
+        return P()
 
     def spec_for_param(self, name, shape):
         for pat, spec in self.rules:
             if re.search(pat, name):
                 return spec
-        if (self.shard_params and self.model_axis and shape is not None
-                and len(shape) >= 2 and self._tp > 1
-                and shape[-1] % self._tp == 0 and shape[-1] >= 2 * self._tp):
-            return P(*([None] * (len(shape) - 1) + [self.model_axis]))
-        return P()
+        spec = self._base_spec(name, shape)
+        if (spec == P() and self.shard_opt_state and self.data_axis
+                and self._dp > 1 and shape is not None and len(shape) >= 1
+                and _ACC_SUFFIX.search(name)
+                and shape[0] % self._dp == 0 and shape[0] >= 2 * self._dp):
+            return P(*([self.data_axis] + [None] * (len(shape) - 1)))
+        return spec
 
     def spec_for_feed(self, name, shape):
         for pat, spec in self.rules:
             if re.search(pat, name):
                 return spec
-        if self.data_axis and shape is not None and len(shape) >= 1:
+        if (self.data_axis and shape is not None and len(shape) >= 1
+                and shape[0] % self._dp == 0):
             return P(*([self.data_axis] + [None] * (len(shape) - 1)))
         return P()
 
@@ -92,6 +130,24 @@ class ShardingPlan:
 
 def _shape_of(v):
     return getattr(v, "shape", None)
+
+
+def place_feed(v, plan, name):
+    """Place one feed value by the plan. LoDArray (padded ragged feed) shards
+    its batch dim on both leaves — data [batch, max_len, ...] and lens
+    [batch] — the SplitLoDTensor-across-devices semantics of the reference's
+    parallel_do (operators/parallel_do_op.cc:39-69) done by GSPMD."""
+    from ..core.lod import LoDArray
+
+    if isinstance(v, LoDArray):
+        data_spec = plan.spec_for_feed(name, getattr(v.data, "shape", None))
+        # lens is rank-1 [batch]: take only the batch axis of the data spec
+        # (a per-name rule spec is written for the data leaf's rank)
+        lens_spec = P(data_spec[0]) if len(data_spec) else P()
+        return LoDArray(jax.device_put(v.data, plan.named(data_spec)),
+                        jax.device_put(v.lens, plan.named(lens_spec)))
+    return jax.device_put(v, plan.named(
+        plan.spec_for_feed(name, _shape_of(v))))
 
 
 def shard_program_step(executor, program, feed_example, fetch_list, plan,
@@ -131,11 +187,11 @@ def shard_program_step(executor, program, feed_example, fetch_list, plan,
             state_shardings[n] = plan.named(P())
             continue
         state_shardings[n] = plan.named(plan.spec_for_param(n, _shape_of(v)))
-    feed_shardings = {n: plan.named(plan.spec_for_feed(n, _shape_of(v)))
-                      for n, v in feeds.items()}
 
     state = {n: jax.device_put(v, state_shardings[n]) for n, v in state.items()}
-    feeds = {n: jax.device_put(v, feed_shardings[n]) for n, v in feeds.items()}
+    feeds = {n: place_feed(v, plan, n) for n, v in feeds.items()}
+    # per-leaf shardings (LoDArray feeds carry two leaves of different rank)
+    feed_shardings = jax.tree_util.tree_map(lambda x: x.sharding, feeds)
 
     def step(st, fd):
         env = dict(st)

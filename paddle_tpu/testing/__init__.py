@@ -1,0 +1,13 @@
+"""Shared tiny model builders for tests and the driver dryrun.
+
+The reference keeps its model zoo for tests in the book chapters
+(/root/reference/python/paddle/fluid/tests/book/); these are the cut-down
+op-mix slices of those models used wherever a full program is needed at
+toy shapes (sharding tests, the multi-chip dryrun, convergence smoke tests).
+"""
+
+from .models import (build_mlp, build_convnet_slice, build_seq_slice,
+                     mlp_feed, convnet_feed, seq_feed)
+
+__all__ = ["build_mlp", "build_convnet_slice", "build_seq_slice",
+           "mlp_feed", "convnet_feed", "seq_feed"]

@@ -1,0 +1,121 @@
+"""Tiny parameterized training programs (model + optimizer) + matching feeds.
+
+Each builder returns (main_program, startup_program, avg_loss_var). They are
+the op-mix slices of the flagship benchmark / book models at toy shapes:
+
+* build_mlp           — fc stack + softmax CE (recognize_digits MLP path)
+* build_convnet_slice — conv+BN (NHWC) bottleneck with residual add, pooling,
+                        fc head, momentum (bench.py resnet50 cut down)
+* build_seq_slice     — ragged LoD tokens -> embedding -> fc -> dynamic GRU ->
+                        per-token CE, Adam (machine_translation encoder mix)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def build_mlp(dim=16, classes=4, hidden=32, opt="momentum", lr=0.1, seed=7):
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[dim])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(img, size=hidden, act="relu")
+        logits = fluid.layers.fc(h, size=classes, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        if opt == "momentum":
+            fluid.optimizer.Momentum(learning_rate=lr, momentum=0.9).minimize(
+                loss, startup)
+        else:
+            fluid.optimizer.Adam(learning_rate=min(lr, 1e-2)).minimize(
+                loss, startup)
+    return main, startup, loss
+
+
+def mlp_feed(batch, dim=16, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "img": rng.normal(0, 1, (batch, dim)).astype("float32"),
+        "label": rng.randint(0, classes, (batch, 1)).astype("int64"),
+    }
+
+
+def build_convnet_slice(size=8, classes=4, nf=8, lr=0.05, seed=7,
+                        bottleneck=False):
+    """conv+BN NHWC + residual + pools + fc + momentum. With ``bottleneck``,
+    adds the stem/1x1-3x3-1x1/projection structure of bench.py's ResNet."""
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+
+    def conv_bn(x, filters, k, stride=1, act="relu"):
+        c = fluid.layers.conv2d(x, num_filters=filters, filter_size=k,
+                                stride=stride, padding=(k - 1) // 2,
+                                bias_attr=False, data_format="NHWC")
+        return fluid.layers.batch_norm(c, act=act, data_layout="NHWC")
+
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[size, size, 3])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        if bottleneck:
+            stem = conv_bn(img, nf, 3, stride=2)
+            pool = fluid.layers.pool2d(stem, pool_size=3, pool_stride=2,
+                                       pool_padding=1, pool_type="max",
+                                       data_format="NHWC")
+            b = conv_bn(pool, nf // 2, 1)
+            b = conv_bn(b, nf // 2, 3)
+            b = conv_bn(b, nf * 2, 1, act=None)
+            short = conv_bn(pool, nf * 2, 1, act=None)
+            x = fluid.layers.elementwise_add(x=b, y=short, act="relu")
+        else:
+            c = conv_bn(img, nf, 3)
+            c2 = conv_bn(c, nf, 3, act=None)
+            x = fluid.layers.elementwise_add(x=c2, y=c, act="relu")
+            x = fluid.layers.pool2d(x, pool_size=2, pool_stride=2,
+                                    pool_type="avg", data_format="NHWC")
+        x = fluid.layers.pool2d(x, pool_size=2, global_pooling=True,
+                                pool_type="avg", data_format="NHWC")
+        logits = fluid.layers.fc(x, size=classes, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Momentum(learning_rate=lr, momentum=0.9).minimize(
+            loss, startup)
+    return main, startup, loss
+
+
+def convnet_feed(batch, size=8, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "img": rng.normal(0, 1, (batch, size, size, 3)).astype("float32"),
+        "label": rng.randint(0, classes, (batch, 1)).astype("int64"),
+    }
+
+
+def build_seq_slice(vocab=12, emb=8, hid=8, lr=1e-2, seed=7):
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data("src", shape=[1], dtype="int64", lod_level=1)
+        tgt = fluid.layers.data("tgt", shape=[1], dtype="int64", lod_level=1)
+        e = fluid.layers.embedding(src, size=[vocab, emb])
+        h = fluid.layers.fc(e, size=hid * 3)
+        h = fluid.layers.dynamic_gru(h, size=hid)
+        logits = fluid.layers.fc(h, size=vocab, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=logits, label=tgt))
+        fluid.optimizer.Adam(learning_rate=lr).minimize(loss, startup)
+    return main, startup, loss
+
+
+def seq_feed(batch, vocab=12, min_len=2, max_len=7, seed=0):
+    rng = np.random.RandomState(seed)
+    lens = [int(rng.randint(min_len, max_len)) for _ in range(batch)]
+    seqs = [rng.randint(0, vocab, (ln, 1)).astype("int64") for ln in lens]
+    return {"src": list(seqs), "tgt": list(seqs)}
