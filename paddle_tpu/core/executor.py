@@ -208,7 +208,9 @@ def _written_names(program, block_idx):
 
 
 def _is_traceable(v):
-    return isinstance(v, (jax.Array, np.ndarray, LoDArray, int, float, np.number))
+    from .sparse import SparseRows
+    return isinstance(v, (jax.Array, np.ndarray, LoDArray, SparseRows, int,
+                          float, np.number))
 
 
 class Executor:
@@ -459,8 +461,9 @@ class Executor:
 
     @staticmethod
     def _fetch_value(v, return_numpy):
-        if isinstance(v, LoDArray):
-            return v  # caller unpacks via core.lod.lodarray_to_flat
+        from .sparse import SparseRows
+        if isinstance(v, (LoDArray, SparseRows)):
+            return v  # caller unpacks (core.lod.lodarray_to_flat / .to_dense)
         if return_numpy:
             return np.asarray(v)
         return v

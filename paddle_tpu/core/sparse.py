@@ -1,0 +1,126 @@
+"""SparseRows: the TPU-native SelectedRows equivalent.
+
+The reference's SelectedRows (/root/reference/paddle/fluid/framework/
+selected_rows.h:19) is a sparse-row tensor — a vector of row indices plus a
+dense value block — produced by lookup_table's backward
+(operators/lookup_table_op.cc W@GRAD when is_sparse) and consumed by the
+sparse branches of every optimizer kernel (operators/adam_op.h,
+operators/sgd_op.cu) after duplicate rows are combined with MergeAdd
+(operators/math/selected_rows_functor.cc).
+
+TPU-native redesign: XLA needs static shapes, so ``SparseRows`` keeps a FIXED
+number of entries n (= the number of ids in the batch, known at trace time).
+``rows`` may contain duplicates and sentinel entries equal to ``nrows``
+(out-of-range), which XLA scatters silently drop — that is the padding story.
+``merge_rows`` is the MergeAdd equivalent: a sort + segment-sum that combines
+duplicates entirely with static shapes, leaving unique rows (padded with the
+sentinel). Optimizer sparse branches then gather state rows, apply the
+per-row update, and scatter back — duplicates already merged, so scatters
+never collide.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SparseRows:
+    """Sparse-row gradient: ``values[i]`` is the partial gradient for row
+    ``rows[i]`` of a dense [nrows, ...] tensor. Entries with
+    ``rows[i] >= nrows`` are padding and must be ignored (XLA scatter drops
+    them). ``merged`` marks rows as duplicate-free (post MergeAdd)."""
+
+    __slots__ = ("rows", "values", "nrows", "merged")
+
+    def __init__(self, rows, values, nrows, merged=False):
+        self.rows = rows
+        self.values = values
+        self.nrows = int(nrows)
+        self.merged = bool(merged)
+
+    def tree_flatten(self):
+        return (self.rows, self.values), (self.nrows, self.merged)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1])
+
+    @property
+    def shape(self):
+        # dense logical shape (used by planners / debug printing)
+        return (self.nrows,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def astype(self, dtype):
+        return SparseRows(self.rows, self.values.astype(dtype), self.nrows,
+                          self.merged)
+
+    def to_dense(self):
+        """Densify: zeros [nrows, ...] with values scatter-added (sentinel
+        rows dropped by XLA's out-of-bounds scatter semantics)."""
+        dense = jnp.zeros((self.nrows,) + tuple(self.values.shape[1:]),
+                          self.values.dtype)
+        return dense.at[self.rows].add(self.values, mode="drop")
+
+    def __repr__(self):
+        return (f"SparseRows(n={self.rows.shape[0]}, nrows={self.nrows}, "
+                f"dim={tuple(self.values.shape[1:])}, merged={self.merged})")
+
+
+def merge_rows(sr: SparseRows) -> SparseRows:
+    """Combine duplicate rows by summation — the reference's MergeAdd
+    (operators/math/selected_rows_functor.cc scatter::MergeAdd) with static
+    shapes: sort entries by row, segment-sum runs of equal rows, emit unique
+    rows at the run heads and the sentinel ``nrows`` everywhere else."""
+    if sr.merged:
+        return sr
+    n = sr.rows.shape[0]
+    order = jnp.argsort(sr.rows)
+    srows = sr.rows[order]
+    svals = sr.values[order]
+    # head[i] = 1 where a new row value starts
+    head = jnp.concatenate([jnp.ones((1,), jnp.int32),
+                            (srows[1:] != srows[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(head) - 1  # segment id per sorted entry
+    merged_vals = jax.ops.segment_sum(svals, seg, num_segments=n)
+    # rows for each segment: row value at the run head; unused segments get
+    # the sentinel (nrows) so downstream scatters drop them
+    sentinel = jnp.int32(sr.nrows)
+    merged_rows = jnp.full((n,), sentinel, dtype=srows.dtype)
+    merged_rows = merged_rows.at[seg].set(srows, mode="drop")
+    # already-sentinel input rows stay sentinel (they formed their own runs)
+    return SparseRows(merged_rows, merged_vals, sr.nrows, merged=True)
+
+
+def sparse_rows_from_grad(ids, grad_2d, nrows):
+    """Build the W@GRAD SparseRows from flat ids [n] + per-id grads [n, d]."""
+    return SparseRows(ids.astype(jnp.int32), grad_2d, nrows)
+
+
+def apply_rowwise(sr: SparseRows, states, update_fn):
+    """Run a per-row optimizer update on the rows touched by ``sr``.
+
+    states: list of dense [nrows, ...] tensors (param + accumulators).
+    update_fn(g_rows, *state_rows) -> new state_rows (same order/shapes).
+    Returns the updated dense states. Duplicates are merged first; gathers
+    clamp sentinel rows (XLA gather clamps out-of-bounds) and the final
+    scatter drops them, so padding rows never corrupt state. This is the
+    shape every reference sparse optimizer kernel has (adam_op.h
+    SparseAdamFunctor: merge grad, then per-row moment/param update).
+    """
+    m = merge_rows(sr)
+    gathered = [s.at[m.rows].get(mode="clip") for s in states]
+    new_rows = update_fn(m.values, *gathered)
+    out = []
+    for s, nr in zip(states, new_rows):
+        out.append(s.at[m.rows].set(nr, mode="drop"))
+    return out
+
+
+def is_sparse(v):
+    return isinstance(v, SparseRows)

@@ -1,10 +1,10 @@
 """NN ops that aren't conv/pool/norm: dropout, lookup_table (embedding).
 
 Reference: dropout_op.cc, lookup_table_op.cc
-(/root/reference/paddle/fluid/operators/). lookup_table's grad in the reference
-can produce a SelectedRows sparse gradient (lookup_table_op.cc W@GRAD); here
-the dense scatter-add path is the default, with the sparse path provided later
-via the SelectedRows-equivalent segment-sum design (SURVEY.md hard part c).
+(/root/reference/paddle/fluid/operators/). lookup_table's grad produces a
+dense scatter-add by default, or — with is_sparse — a SparseRows gradient
+(core/sparse.py), the SelectedRows equivalent the reference emits from
+lookup_table_op.cc's sparse W@GRAD path.
 """
 
 from __future__ import annotations
@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 from ..core.registry import register_op, same_shape, OpSpec
 from ..core.lod import LoDArray
+from ..core.sparse import sparse_rows_from_grad
 from .common import G, data_of, like
 
 
@@ -63,6 +64,10 @@ def lookup_table(ctx):
 
 @register_op("lookup_table_grad")
 def lookup_table_grad(ctx):
+    """W@GRAD: dense scatter-add by default; with is_sparse a SparseRows
+    (the reference's SelectedRows output, lookup_table_op.cc
+    LookupTableGradKernel sparse path) that optimizer sparse branches
+    consume without ever materializing the [vocab, dim] dense gradient."""
     w = data_of(ctx.input("W"))
     ids = data_of(ctx.input("Ids")).astype(jnp.int32)
     if ids.ndim >= 2 and ids.shape[-1] == 1:
@@ -72,6 +77,16 @@ def lookup_table_grad(ctx):
     if isinstance(d_v, LoDArray):
         # padded positions carry garbage grads — mask them out
         d = d * d_v.mask(d.dtype).reshape(d.shape[:2] + (1,) * (d.ndim - 2))
-    dw = jnp.zeros_like(w).at[ids.reshape(-1)].add(
-        d.reshape(-1, w.shape[-1]))
+    flat_ids = ids.reshape(-1)
+    flat_d = d.reshape(-1, w.shape[-1])
+    if ctx.attr("is_sparse", False):
+        if isinstance(d_v, LoDArray):
+            # padded positions: send their (zeroed) grads to the sentinel
+            # row so merge/scatter drop them entirely
+            valid = d_v.mask(jnp.int32).reshape(-1)
+            flat_ids = jnp.where(valid > 0, flat_ids, w.shape[0])
+        ctx.set_output("W@GRAD",
+                       sparse_rows_from_grad(flat_ids, flat_d, w.shape[0]))
+        return
+    dw = jnp.zeros_like(w).at[flat_ids].add(flat_d)
     ctx.set_output("W@GRAD", dw)

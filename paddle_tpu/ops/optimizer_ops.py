@@ -10,6 +10,15 @@ pass — no separate "optimizer step" launch ever exists on TPU.
 
 Each op's ``*Out`` aliases follow the reference exactly so that
 optimizer.py-built programs are structurally identical to the reference's.
+
+Sparse (SelectedRows) branches: every reference optimizer kernel has a
+SelectedRows path that merges duplicate gradient rows then updates ONLY the
+touched rows of the parameter/accumulators ("lazy" updates —
+operators/adam_op.h SparseAdamFunctor, operators/sgd_op.cu sparse branch,
+operators/adagrad_op.cc). Here sgd/momentum/adagrad/adam consume a
+``SparseRows`` gradient the same way via core.sparse.apply_rowwise (gather
+touched rows → per-row update → scatter back); the remaining optimizers
+densify the gradient first (correct, just not lazy).
 """
 
 from __future__ import annotations
@@ -17,6 +26,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core.registry import register_op
+from ..core.sparse import SparseRows, merge_rows, apply_rowwise, is_sparse
 from .common import data_of
 
 
@@ -27,27 +37,61 @@ def _lr(ctx):
 def _param_grad(ctx):
     """Param + Grad with the gradient cast up to the parameter dtype: under
     AMP the backward produces bf16 grads while master weights and optimizer
-    state stay float32 (the mixed-precision contract)."""
+    state stay float32 (the mixed-precision contract). A SparseRows grad
+    reaching an optimizer without a sparse branch is densified here."""
     p = data_of(ctx.input("Param"))
-    g = data_of(ctx.input("Grad")).astype(p.dtype)
+    g = ctx.input("Grad")
+    if is_sparse(g):
+        g = g.to_dense()
+    g = data_of(g).astype(p.dtype)
     return p, g
+
+
+def _sparse_grad(ctx, p):
+    """The Grad input as a merged SparseRows in the param dtype, or None."""
+    g = ctx.input("Grad")
+    if not is_sparse(g):
+        return None
+    return merge_rows(g.astype(p.dtype))
 
 
 
 @register_op("sgd", in_place=True)
 def sgd(ctx):
+    p = data_of(ctx.input("Param"))
+    g = ctx.input("Grad")
+    if is_sparse(g):
+        # sgd_op.cu sparse branch: scatter-subtract, no MergeAdd needed —
+        # the update is linear, so duplicate rows accumulate correctly
+        vals = g.values.astype(p.dtype)
+        ctx.set_output("ParamOut",
+                       p.at[g.rows].add(-_lr(ctx) * vals, mode="drop"))
+        return
     p, g = _param_grad(ctx)
     ctx.set_output("ParamOut", p - _lr(ctx) * g)
 
 
 @register_op("momentum", in_place=True)
 def momentum(ctx):
-    p, g = _param_grad(ctx)
+    p = data_of(ctx.input("Param"))
     v = data_of(ctx.input("Velocity"))
     mu = ctx.attr("mu")
     lr = _lr(ctx)
+    nesterov = ctx.attr("use_nesterov", False)
+    sg = _sparse_grad(ctx, p)
+    if sg is not None:
+        def upd(g, p_r, v_r):
+            v_new = mu * v_r + g
+            if nesterov:
+                return p_r - (g + mu * v_new) * lr, v_new
+            return p_r - lr * v_new, v_new
+        p_new, v_new = apply_rowwise(sg, [p, v], upd)
+        ctx.set_output("ParamOut", p_new)
+        ctx.set_output("VelocityOut", v_new)
+        return
+    p, g = _param_grad(ctx)
     v_new = mu * v + g
-    if ctx.attr("use_nesterov", False):
+    if nesterov:
         p_new = p - (g + mu * v_new) * lr
     else:
         p_new = p - lr * v_new
@@ -57,7 +101,7 @@ def momentum(ctx):
 
 @register_op("adam", in_place=True)
 def adam(ctx):
-    p, g = _param_grad(ctx)
+    p = data_of(ctx.input("Param"))
     m1 = data_of(ctx.input("Moment1"))
     m2 = data_of(ctx.input("Moment2"))
     b1p = data_of(ctx.input("Beta1Pow")).reshape(())
@@ -65,6 +109,19 @@ def adam(ctx):
     b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
     lr = _lr(ctx) * jnp.sqrt(1 - b2p) / (1 - b1p)
+    sg = _sparse_grad(ctx, p)
+    if sg is not None:
+        # adam_op.h SparseAdamFunctor: lazy per-row moment/param update
+        def upd(g, p_r, m1_r, m2_r):
+            m1n = b1 * m1_r + (1 - b1) * g
+            m2n = b2 * m2_r + (1 - b2) * g * g
+            return p_r - lr * m1n / (jnp.sqrt(m2n) + eps), m1n, m2n
+        p_new, m1_new, m2_new = apply_rowwise(sg, [p, m1, m2], upd)
+        ctx.set_output("ParamOut", p_new)
+        ctx.set_output("Moment1Out", m1_new)
+        ctx.set_output("Moment2Out", m2_new)
+        return
+    p, g = _param_grad(ctx)
     m1n = b1 * m1 + (1 - b1) * g
     m2n = b2 * m2 + (1 - b2) * g * g
     ctx.set_output("ParamOut", p - lr * m1n / (jnp.sqrt(m2n) + eps))
@@ -74,11 +131,22 @@ def adam(ctx):
 
 @register_op("adagrad", in_place=True)
 def adagrad(ctx):
-    p, g = _param_grad(ctx)
+    p = data_of(ctx.input("Param"))
     m = data_of(ctx.input("Moment"))
     eps = ctx.attr("epsilon", 1e-6)
+    lr = _lr(ctx)
+    sg = _sparse_grad(ctx, p)
+    if sg is not None:
+        def upd(g, p_r, m_r):
+            m_new = m_r + g * g
+            return p_r - lr * g / (jnp.sqrt(m_new) + eps), m_new
+        p_new, m_new = apply_rowwise(sg, [p, m], upd)
+        ctx.set_output("ParamOut", p_new)
+        ctx.set_output("MomentOut", m_new)
+        return
+    p, g = _param_grad(ctx)
     m_new = m + g * g
-    ctx.set_output("ParamOut", p - _lr(ctx) * g / (jnp.sqrt(m_new) + eps))
+    ctx.set_output("ParamOut", p - lr * g / (jnp.sqrt(m_new) + eps))
     ctx.set_output("MomentOut", m_new)
 
 

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.registry import register_op, same_shape, OpSpec
+from ..core.sparse import SparseRows, is_sparse
 from ..core.types import np_dtype
 from .common import G, data_of, like, G_slot
 
@@ -246,8 +247,25 @@ def split(ctx):
     "assign", {"X": G(op.output("Out"))}, {"Out": [g]})
     for g in G(op.input("X"))])
 def sum_op(ctx):
-    """Variadic sum (reference sum_op.cc — also handles SelectedRows)."""
-    xs = [data_of(v) for v in ctx.inputs("X")]
+    """Variadic sum (reference sum_op.cc — also handles SelectedRows).
+
+    All-SparseRows inputs concatenate entries (the reference's
+    sum_op over SelectedRows appends rows); mixed dense+sparse densifies
+    the sparse terms (sum_op.cc LoDTensor+SelectedRows mix)."""
+    vs = ctx.inputs("X")
+    if any(is_sparse(v) for v in vs):
+        if all(is_sparse(v) for v in vs):
+            rows = jnp.concatenate([v.rows for v in vs])
+            vals = jnp.concatenate([v.values for v in vs])
+            ctx.set_output("Out", SparseRows(rows, vals, vs[0].nrows))
+            return
+        xs = [v.to_dense() if is_sparse(v) else data_of(v) for v in vs]
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        ctx.set_output("Out", out)
+        return
+    xs = [data_of(v) for v in vs]
     out = xs[0]
     for x in xs[1:]:
         out = out + x

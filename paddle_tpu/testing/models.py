@@ -15,7 +15,8 @@ from __future__ import annotations
 import numpy as np
 
 
-def build_mlp(dim=16, classes=4, hidden=32, opt="momentum", lr=0.1, seed=7):
+def build_mlp(dim=16, classes=4, hidden=32, opt="momentum", lr=0.1, seed=7,
+              depth=1, return_logits=False):
     import paddle_tpu.fluid as fluid
 
     main, startup = fluid.Program(), fluid.Program()
@@ -23,7 +24,9 @@ def build_mlp(dim=16, classes=4, hidden=32, opt="momentum", lr=0.1, seed=7):
     with fluid.program_guard(main, startup):
         img = fluid.layers.data("img", shape=[dim])
         label = fluid.layers.data("label", shape=[1], dtype="int64")
-        h = fluid.layers.fc(img, size=hidden, act="relu")
+        h = img
+        for _ in range(depth):
+            h = fluid.layers.fc(h, size=hidden, act="relu")
         logits = fluid.layers.fc(h, size=classes, act=None)
         loss = fluid.layers.mean(
             fluid.layers.softmax_with_cross_entropy(logits, label))
@@ -33,6 +36,8 @@ def build_mlp(dim=16, classes=4, hidden=32, opt="momentum", lr=0.1, seed=7):
         else:
             fluid.optimizer.Adam(learning_rate=min(lr, 1e-2)).minimize(
                 loss, startup)
+    if return_logits:
+        return main, startup, loss, logits
     return main, startup, loss
 
 

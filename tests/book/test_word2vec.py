@@ -29,11 +29,11 @@ def _synthetic_corpus(n_words=4000, seed=3):
     return np.array(words, dtype="int64")
 
 
-def build_ngram_model(words):
+def build_ngram_model(words, is_sparse=False):
     embs = []
     for i, w in enumerate(words):
         embs.append(fluid.layers.embedding(
-            input=w, size=[DICT_SIZE, EMB_SIZE],
+            input=w, size=[DICT_SIZE, EMB_SIZE], is_sparse=is_sparse,
             param_attr=fluid.ParamAttr(name="shared_w")))
     concat = fluid.layers.concat(input=embs, axis=1)
     hidden1 = fluid.layers.fc(input=concat, size=HIDDEN, act="sigmoid")
@@ -41,14 +41,22 @@ def build_ngram_model(words):
     return predict
 
 
-def test_word2vec_converges():
+import pytest
+
+
+# is_sparse=True runs the SelectedRows path end-to-end: four lookups share
+# one table, backward concat-sums four SparseRows grads, adam takes its lazy
+# sparse branch (the reference book test's IS_SPARSE axis,
+# reference tests/book/test_word2vec.py:33-46)
+@pytest.mark.parametrize("is_sparse", [False, True])
+def test_word2vec_converges(is_sparse):
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
         ws = [fluid.layers.data(f"w{i}", shape=[1], dtype="int64")
               for i in range(N - 1)]
         next_word = fluid.layers.data("nextw", shape=[1], dtype="int64")
-        predict = build_ngram_model(ws)
+        predict = build_ngram_model(ws, is_sparse)
         cost = fluid.layers.cross_entropy(input=predict, label=next_word)
         avg_cost = fluid.layers.mean(cost)
         fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost, startup)

@@ -1,0 +1,259 @@
+"""SparseRows (SelectedRows-equivalent) tests.
+
+Reference contracts: lookup_table_op.cc emits a SelectedRows W@GRAD when
+is_sparse; operators/math/selected_rows_functor.cc MergeAdd combines
+duplicate rows; every optimizer kernel's sparse branch updates ONLY touched
+rows (lazy) — operators/adam_op.h SparseAdamFunctor, operators/sgd_op.cu.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.sparse import SparseRows, merge_rows
+
+
+def test_merge_rows_combines_duplicates():
+    rows = jnp.array([3, 1, 3, 7, 1, 10], dtype=jnp.int32)  # 10 = sentinel
+    vals = jnp.arange(12, dtype=jnp.float32).reshape(6, 2)
+    sr = SparseRows(rows, vals, nrows=10)
+    m = merge_rows(sr)
+    dense = np.asarray(m.to_dense())
+    expect = np.zeros((10, 2), np.float32)
+    for r, v in zip(np.asarray(rows), np.asarray(vals)):
+        if r < 10:
+            expect[r] += v
+    np.testing.assert_allclose(dense, expect)
+    # merged rows are unique (ignoring sentinels)
+    mr = np.asarray(m.rows)
+    real = mr[mr < 10]
+    assert len(real) == len(set(real.tolist()))
+    assert m.merged
+
+
+def test_to_dense_drops_sentinel_rows():
+    sr = SparseRows(jnp.array([0, 5, 5], dtype=jnp.int32),
+                    jnp.ones((3, 4), jnp.float32), nrows=5)
+    dense = np.asarray(sr.to_dense())
+    assert dense.shape == (5, 4)
+    np.testing.assert_allclose(dense[0], 1.0)
+    np.testing.assert_allclose(dense[1:], 0.0)
+
+
+def _embedding_program(vocab, emb, optimizer, is_sparse, seed=11):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        label = fluid.layers.data("y", shape=[4])
+        e = fluid.layers.embedding(ids, size=[vocab, emb], is_sparse=is_sparse)
+        e = fluid.layers.reshape(e, [-1, emb])
+        pred = fluid.layers.fc(e, size=4, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(pred, label)))
+        optimizer().minimize(loss, startup)
+    return main, startup, loss
+
+
+def _train(main, startup, loss, feeds, fetch_extra=()):
+    scope = fluid.Scope()
+    exe = fluid.Executor(mode="jit")
+    exe.run(startup, scope=scope)
+    losses = []
+    for f in feeds:
+        losses.append(float(exe.run(main, feed=f, fetch_list=[loss],
+                                    scope=scope)[0]))
+    extras = {n: np.asarray(scope.find_var(n)) for n in fetch_extra}
+    return losses, extras
+
+
+def _emb_param_name(main):
+    return [v.name for v in main.global_block().all_parameters()
+            if "emb" in v.name or "w_0" in v.name][0]
+
+
+def _feeds(vocab, n=4, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{
+        "ids": rng.randint(0, vocab, (batch, 1)).astype("int64"),
+        "y": rng.normal(0, 1, (batch, 4)).astype("float32"),
+    } for _ in range(n)]
+
+
+@pytest.mark.parametrize("opt", ["sgd", "momentum", "adagrad", "adam"])
+def test_sparse_matches_dense_when_rows_covered(opt):
+    """With identical feeds, the sparse path must match the dense path
+    exactly for SGD, and for the stateful optimizers as long as every step's
+    untouched rows carry zero accumulator state (true from zero init when the
+    same rows repeat each step)."""
+    vocab, emb = 12, 6
+    mk = {
+        "sgd": lambda: fluid.optimizer.SGD(learning_rate=0.1),
+        "momentum": lambda: fluid.optimizer.Momentum(learning_rate=0.1,
+                                                     momentum=0.9),
+        "adagrad": lambda: fluid.optimizer.Adagrad(learning_rate=0.1),
+        "adam": lambda: fluid.optimizer.Adam(learning_rate=0.05),
+    }[opt]
+    # fixed batch repeated: every touched row is touched every step, so lazy
+    # (sparse) and dense trajectories coincide on touched rows; untouched
+    # rows never move in either path (zero grad, zero accumulators)
+    feeds = [_feeds(vocab, n=1)[0]] * 4
+
+    main_d, start_d, loss_d = _embedding_program(vocab, emb, mk, False)
+    wd_name = _emb_param_name(main_d)
+    losses_d, extras_d = _train(main_d, start_d, loss_d, feeds, [wd_name])
+
+    main_s, start_s, loss_s = _embedding_program(vocab, emb, mk, True)
+    ws_name = _emb_param_name(main_s)
+    losses_s, extras_s = _train(main_s, start_s, loss_s, feeds, [ws_name])
+
+    np.testing.assert_allclose(losses_s, losses_d, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(extras_s[ws_name], extras_d[wd_name],
+                               rtol=1e-5, atol=1e-6)
+    assert losses_s[-1] < losses_s[0]
+
+
+def test_sparse_adam_is_lazy():
+    """Rows touched at step 1 but absent at step 2 must NOT move at step 2
+    under sparse adam (reference lazy semantics), while dense adam moves them
+    through the decayed first moment."""
+    vocab, emb = 10, 4
+    mk = lambda: fluid.optimizer.Adam(learning_rate=0.1)
+    feeds = [
+        {"ids": np.array([[1], [2], [1], [3]], dtype=np.int64),
+         "y": np.ones((4, 4), np.float32)},
+        {"ids": np.array([[4], [5], [4], [5]], dtype=np.int64),
+         "y": np.ones((4, 4), np.float32)},
+    ]
+
+    def run(is_sparse):
+        main, start, loss = _embedding_program(vocab, emb, mk, is_sparse)
+        w_name = _emb_param_name(main)
+        scope = fluid.Scope()
+        exe = fluid.Executor(mode="jit")
+        exe.run(start, scope=scope)
+        exe.run(main, feed=feeds[0], fetch_list=[loss], scope=scope)
+        w_after1 = np.asarray(scope.find_var(w_name)).copy()
+        exe.run(main, feed=feeds[1], fetch_list=[loss], scope=scope)
+        w_after2 = np.asarray(scope.find_var(w_name)).copy()
+        return w_after1, w_after2
+
+    w1_s, w2_s = run(True)
+    # sparse: rows 1,2,3 (touched only in step 1) are identical after step 2
+    np.testing.assert_allclose(w2_s[[1, 2, 3]], w1_s[[1, 2, 3]])
+    # and rows 4,5 moved in step 2
+    assert np.abs(w2_s[[4, 5]] - w1_s[[4, 5]]).max() > 1e-6
+
+    w1_d, w2_d = run(False)
+    # dense adam: step-2 zero grad still moves rows 1-3 via decayed moment
+    assert np.abs(w2_d[[1, 2, 3]] - w1_d[[1, 2, 3]]).max() > 1e-7
+
+
+def test_sparse_embedding_with_lod_feed():
+    """Ragged (LoD) token feeds: padding positions route to the sentinel row
+    and must leave the table untouched."""
+    vocab, emb = 14, 6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data("src", shape=[1], dtype="int64", lod_level=1)
+        e = fluid.layers.embedding(src, size=[vocab, emb], is_sparse=True)
+        h = fluid.layers.sequence_pool(e, pool_type="sum")
+        pred = fluid.layers.fc(h, size=2, act=None)
+        label = fluid.layers.data("y", shape=[2])
+        loss = fluid.layers.mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(pred, label)))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss, startup)
+    w_name = _emb_param_name(main)
+
+    rng = np.random.RandomState(5)
+    # tokens only from {0..5}; rows 6+ must never change
+    seqs = [rng.randint(0, 6, (int(rng.randint(1, 5)), 1)).astype("int64")
+            for _ in range(6)]
+    feed = {"src": seqs, "y": rng.normal(0, 1, (6, 2)).astype("float32")}
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(mode="jit")
+    exe.run(startup, scope=scope)
+    w0 = np.asarray(scope.find_var(w_name)).copy()
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss],
+                            scope=scope)[0]) for _ in range(3)]
+    w1 = np.asarray(scope.find_var(w_name))
+    assert losses[-1] < losses[0]
+    np.testing.assert_allclose(w1[6:], w0[6:])  # untouched rows unchanged
+    assert np.abs(w1[:6] - w0[:6]).max() > 1e-6  # touched rows updated
+
+
+def test_tp_sharded_embedding_sparse_matches_single_device():
+    """Embedding table sharded over the model axis (the reference's
+    distributed lookup table / split_ids capability,
+    doc/fluid/design/dist_train/distributed_lookup_table_design.md): sparse
+    grads scatter into the sharded table under GSPMD and numerics match the
+    unsharded run."""
+    from paddle_tpu.parallel import (make_mesh, ShardingPlan,
+                                     shard_program_step, place_feed)
+    import jax
+
+    vocab, emb = 12, 16  # emb divides tp=2
+    mk = lambda: fluid.optimizer.SGD(learning_rate=0.1)
+    feeds = [_feeds(vocab, n=1)[0]] * 3
+
+    main, start, loss = _embedding_program(vocab, emb, mk, True)
+    w_name = _emb_param_name(main)
+    ref_losses, ref_extras = _train(main, start, loss, feeds, [w_name])
+
+    main2, start2, loss2 = _embedding_program(vocab, emb, mk, True)
+    w2_name = _emb_param_name(main2)
+    scope = fluid.Scope()
+    exe = fluid.Executor(mode="jit")
+    exe.run(start2, scope=scope)
+    mesh = make_mesh(8, axes=("dp", "tp"))
+    plan = ShardingPlan(mesh)
+    fn, state, _ = shard_program_step(exe, main2, feeds[0], [loss2], plan,
+                                      scope=scope)
+    # the table really is TP-sharded
+    from jax.sharding import PartitionSpec as P
+    assert plan.spec_for_param(w2_name, (vocab, emb)) == P(None, "tp")
+    got = []
+    block = main2.global_block()
+    with mesh:
+        for f in feeds:
+            fd = exe._prepare_feed(block, dict(f))
+            fd = {n: place_feed(v, plan, n) for n, v in fd.items()}
+            state, fetches = fn(state, fd)
+            got.append(float(np.asarray(fetches[0])))
+    np.testing.assert_allclose(got, ref_losses, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(state[w2_name]),
+                               ref_extras[w_name], rtol=2e-5, atol=2e-6)
+
+
+def test_sparse_grad_through_double_use():
+    """One table looked up twice: backward sums two SparseRows grads
+    (sum_op SelectedRows concat path)."""
+    vocab, emb = 8, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[1], dtype="int64")
+        b = fluid.layers.data("b", shape=[1], dtype="int64")
+        w_attr = fluid.ParamAttr(name="shared_emb")
+        ea = fluid.layers.embedding(a, size=[vocab, emb], is_sparse=True,
+                                    param_attr=w_attr)
+        eb = fluid.layers.embedding(b, size=[vocab, emb], is_sparse=True,
+                                    param_attr=w_attr)
+        s = fluid.layers.elementwise_add(fluid.layers.reshape(ea, [-1, emb]),
+                                         fluid.layers.reshape(eb, [-1, emb]))
+        loss = fluid.layers.mean(fluid.layers.square(s))
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss, startup)
+
+    feed = {"a": np.array([[0], [1]], dtype=np.int64),
+            "b": np.array([[1], [2]], dtype=np.int64)}
+    scope = fluid.Scope()
+    exe = fluid.Executor(mode="jit")
+    exe.run(startup, scope=scope)
+    w0 = np.asarray(scope.find_var("shared_emb")).copy()
+    l0 = float(exe.run(main, feed=feed, fetch_list=[loss], scope=scope)[0])
+    l1 = float(exe.run(main, feed=feed, fetch_list=[loss], scope=scope)[0])
+    w1 = np.asarray(scope.find_var("shared_emb"))
+    assert l1 < l0
+    np.testing.assert_allclose(w1[3:], w0[3:])  # rows 3+ untouched
+    assert np.abs(w1[:3] - w0[:3]).max() > 1e-6
