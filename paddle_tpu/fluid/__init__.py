@@ -21,6 +21,7 @@ from . import optimizer
 from . import profiler
 from . import initializer
 from . import regularizer
+from . import clip
 from . import backward
 from . import io
 from .backward import append_backward
@@ -36,5 +37,5 @@ __all__ = [
     "default_main_program", "default_startup_program", "Executor", "CPUPlace",
     "TPUPlace", "CUDAPlace", "Scope", "global_scope", "layers", "optimizer",
     "initializer", "regularizer", "backward", "io", "nets", "append_backward",
-    "ParamAttr", "DataFeeder", "LoDArray", "profiler", "amp_guard",
+    "ParamAttr", "DataFeeder", "LoDArray", "profiler", "amp_guard", "clip",
 ]

@@ -6,6 +6,11 @@ flat namespace.
 """
 
 from . import nn, tensor, io, ops, sequence, control_flow
+from . import learning_rate_scheduler
+from .learning_rate_scheduler import (noam_decay, exponential_decay,  # noqa: F401
+                                      natural_exp_decay, inverse_time_decay,
+                                      polynomial_decay, piecewise_decay,
+                                      autoincreased_step_counter)
 from .control_flow import (While, Switch, StaticRNN, DynamicRNN,  # noqa: F401
                            increment, less_than, create_array, array_write,
                            array_read, array_length, beam_search,

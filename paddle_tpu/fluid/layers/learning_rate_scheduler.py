@@ -1,0 +1,166 @@
+"""Learning-rate decay schedules as graph ops over a global step counter.
+
+Reference: /root/reference/python/paddle/fluid/layers/
+learning_rate_scheduler.py — each schedule appends ops computing the decayed
+LR into a [1]-shaped variable every step, driven by an auto-incremented
+``@LR_DECAY_COUNTER@`` (layers/tensor.py autoincreased_step_counter). The
+optimizer then consumes the variable instead of a constant
+(optimizer.py global_learning_rate). Under the jit executor the whole
+schedule computation fuses into the step — it costs nothing.
+"""
+
+from __future__ import annotations
+
+from ..framework import default_main_program, default_startup_program
+from . import tensor
+from . import ops as _ops
+
+__all__ = ["noam_decay", "exponential_decay", "natural_exp_decay",
+           "inverse_time_decay", "polynomial_decay", "piecewise_decay",
+           "autoincreased_step_counter"]
+
+_COUNTER_NAME = "@LR_DECAY_COUNTER@"
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Persistable integer [1] counter incremented by ``step`` every run
+    (reference layers/tensor.py:autoincreased_step_counter, which also keeps
+    it integral — a float32 counter would freeze at 2^24 when x+1 == x).
+    Starts so that its value DURING the first step is ``begin``."""
+    name = counter_name or _COUNTER_NAME
+    main_block = default_main_program().global_block()
+    if main_block.has_var(name):
+        return main_block.var(name)
+    counter = main_block.create_var(name=name, shape=(1,), dtype="int64",
+                                    persistable=True)
+    startup_block = default_startup_program().global_block()
+    startup_block.create_var(name=name, shape=(1,), dtype="int64",
+                             persistable=True)
+    startup_block.append_op(
+        "fill_constant", outputs={"Out": [name]},
+        attrs={"shape": [1], "value": float(begin - step),
+               "dtype": "int64"})
+    main_block.prepend_op("increment", inputs={"X": [name]},
+                          outputs={"Out": [name]},
+                          attrs={"step": float(step)})
+    counter.stop_gradient = True
+    return counter
+
+
+def _float_step(counter_name=None):
+    return tensor.cast(autoincreased_step_counter(counter_name), "float32")
+
+
+def _scalar(value):
+    return tensor.fill_constant(shape=[1], dtype="float32",
+                                value=float(value))
+
+
+def noam_decay(d_model, warmup_steps):
+    """lr = d_model^-0.5 * min(step^-0.5, step * warmup^-1.5)
+    (reference learning_rate_scheduler.py:noam_decay)."""
+    step = _float_step()
+    a = _ops.pow(step, factor=-0.5)
+    b = tensor.scale(step, scale=float(warmup_steps) ** -1.5)
+    from .nn import elementwise_min
+    return tensor.scale(elementwise_min(a, b),
+                        scale=float(d_model) ** -0.5)
+
+
+def _div_steps(decay_steps, staircase):
+    step = _float_step()
+    div = tensor.scale(step, scale=1.0 / float(decay_steps))
+    if staircase:
+        div = _ops.floor(div)
+    return div
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * decay_rate ^ (step / decay_steps)."""
+    div = _div_steps(decay_steps, staircase)
+    # rate^x = exp(x * ln(rate))
+    import math
+    return tensor.scale(_ops.exp(tensor.scale(
+        div, scale=math.log(float(decay_rate)))),
+        scale=float(learning_rate))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * exp(-decay_rate * step / decay_steps)."""
+    div = _div_steps(decay_steps, staircase)
+    return tensor.scale(_ops.exp(tensor.scale(div,
+                                              scale=-float(decay_rate))),
+                        scale=float(learning_rate))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    """lr / (1 + decay_rate * step / decay_steps)."""
+    div = _div_steps(decay_steps, staircase)
+    denom = tensor.scale(div, scale=float(decay_rate), bias=1.0)
+    return tensor.scale(_ops.reciprocal(denom), scale=float(learning_rate))
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    """(lr - end_lr) * (1 - step/decay_steps)^power + end_lr, with the step
+    clamped to decay_steps (or the horizon stretched when cycle)."""
+    from .nn import elementwise_min, elementwise_max, elementwise_div
+
+    step = _float_step()
+    if cycle:
+        # decay_steps * max(1, ceil(step / decay_steps))
+        ratio = _ops.ceil(tensor.scale(step, scale=1.0 / float(decay_steps)))
+        ratio = elementwise_max(ratio, _scalar(1.0))
+        horizon = tensor.scale(ratio, scale=float(decay_steps))
+    else:
+        horizon = _scalar(float(decay_steps))
+        step = elementwise_min(step, horizon)
+    frac = elementwise_div(step, horizon)
+    poly = _ops.pow(tensor.scale(frac, scale=-1.0, bias=1.0),
+                    factor=float(power))
+    return tensor.scale(poly,
+                        scale=float(learning_rate) - float(end_learning_rate),
+                        bias=float(end_learning_rate))
+
+
+def piecewise_decay(boundaries, values):
+    """Stepwise constant LR: values[i] while step < boundaries[i]
+    (reference learning_rate_scheduler.py:piecewise_decay). Built
+    arithmetically — lr = Σ values[i]·[b_{i-1} ≤ step < b_i] — instead of the
+    reference's Switch block: branchless, so it fuses under jit."""
+    if len(values) != len(boundaries) + 1:
+        raise ValueError("len(values) must be len(boundaries) + 1")
+    from .control_flow import less_than
+    from .nn import elementwise_sub
+
+    # compare in the counter's integer dtype: float32 comparison would
+    # misorder boundaries beyond 2^24
+    step = autoincreased_step_counter()
+
+    def below(b):
+        bv = tensor.fill_constant(shape=[1], dtype="int64", value=float(b))
+        return tensor.cast(less_than(step, bv), "float32")
+
+    # lr = values[-1] + Σ_i (values[i] - values[-1]) * [step < b_i] ... built
+    # incrementally from the largest boundary down so each indicator is used
+    # once: lr_i = lr_{i+1} + (v_i - lr_known...)  — arithmetic telescoping:
+    # [b_{i-1} <= step < b_i] = below(b_i) - below(b_{i-1})
+    lr = _scalar(float(values[-1]))
+    prev_below = None
+    terms = []
+    for i, b in enumerate(boundaries):
+        ind = below(b)
+        if prev_below is not None:
+            seg = elementwise_sub(ind, prev_below)
+        else:
+            seg = ind
+        terms.append(tensor.scale(seg,
+                                  scale=float(values[i]) - float(values[-1])))
+        prev_below = ind
+    for t in terms:
+        from .nn import elementwise_add
+        lr = elementwise_add(lr, t)
+    return lr

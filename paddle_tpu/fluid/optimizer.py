@@ -18,6 +18,7 @@ from .framework import (Program, Parameter, default_main_program,
                         default_startup_program, unique_name)
 from .backward import append_backward
 from . import regularizer as _regularizer_mod
+from . import clip as _clip_mod
 
 
 class Optimizer:
@@ -81,6 +82,9 @@ class Optimizer:
         program = loss.block.program
         block = program.global_block()
         self._create_lr_var(program, startup)
+        # gradient clipping first (reference optimizer.py minimize ->
+        # clip.append_gradient_clip_ops), honoring ParamAttr.gradient_clip
+        params_grads = _clip_mod.append_gradient_clip_ops(params_grads)
         # weight decay / regularization appended as grad = grad + coef*param
         params_grads = _regularizer_mod.append_regularization_ops(
             params_grads, self.regularization)

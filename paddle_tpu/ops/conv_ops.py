@@ -239,12 +239,11 @@ def conv2d_transpose_grad(ctx):
 # pool2d
 # ---------------------------------------------------------------------------
 
-def _pool2d_compute(x, ksize, strides, paddings, pooling_type, global_pooling,
-                    ceil_mode, exclusive=True, df="NCHW"):
-    if df == "NHWC":
-        n, h, w, c = x.shape
-    else:
-        n, c, h, w = x.shape
+def _pool_geometry(h, w, ksize, strides, paddings, global_pooling,
+                   ceil_mode):
+    """Shared window geometry for pool2d forward and the maxpool grad:
+    effective ksize/paddings, output dims, and the extra bottom/right padding
+    that makes the window grid cover a ceil-mode output."""
     if global_pooling:
         ksize = (h, w)
         paddings = (0, 0)
@@ -258,9 +257,24 @@ def _pool2d_compute(x, ksize, strides, paddings, pooling_type, global_pooling,
         return (size - k + 2 * p) // s + 1
 
     oh, ow = out_dim(h, kh, ph, sh), out_dim(w, kw, pw, sw)
-    # extra bottom/right padding so the window grid covers the ceil output
     eh = max(0, (oh - 1) * sh + kh - h - 2 * ph)
     ew = max(0, (ow - 1) * sw + kw - w - 2 * pw)
+    return (kh, kw), (ph, pw), (sh, sw), (oh, ow), (eh, ew)
+
+
+def _pool_pad_value(x):
+    return -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else int(jnp.iinfo(x.dtype).min)
+
+
+def _pool2d_compute(x, ksize, strides, paddings, pooling_type, global_pooling,
+                    ceil_mode, exclusive=True, df="NCHW"):
+    if df == "NHWC":
+        n, h, w, c = x.shape
+    else:
+        n, c, h, w = x.shape
+    (kh, kw), (ph, pw), (sh, sw), (oh, ow), (eh, ew) = _pool_geometry(
+        h, w, ksize, strides, paddings, global_pooling, ceil_mode)
     if df == "NHWC":
         pads = ((0, 0), (ph, ph + eh), (pw, pw + ew), (0, 0))
         dims = (1, kh, kw, 1)
@@ -275,9 +289,8 @@ def _pool2d_compute(x, ksize, strides, paddings, pooling_type, global_pooling,
     # init values must be python scalars: jax only recognizes the
     # differentiable reduce_window_sum/max special cases for literal inits
     if pooling_type == "max":
-        neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
-            else int(jnp.iinfo(x.dtype).min)
-        return lax.reduce_window(x, neg, lax.max, dims, strides4, pads)
+        return lax.reduce_window(x, _pool_pad_value(x), lax.max, dims,
+                                 strides4, pads)
 
     sums = lax.reduce_window(x, 0.0, lax.add, dims, strides4, pads)
     if exclusive and (ph or pw or eh or ew):
@@ -329,11 +342,65 @@ def pool2d(ctx):
     ctx.set_output("Out", _pool2d_compute(x, *_pool2d_attrs(ctx.attr)))
 
 
+def _maxpool2d_grad(x, dy, ksize, strides, paddings, global_pooling,
+                    ceil_mode, df):
+    """Max-pool gradient with the reference's semantics: EVERY input position
+    equal to its window max receives the window's dy
+    (operators/math/pooling.cc MaxPool2dGradFunctor: `if (input == output)
+    input_grad += output_grad`). jax's reduce_window vjp lowers to
+    select_and_scatter, which routes each window's gradient to the FIRST
+    maximum only — a semantic difference that shows with tied values (common
+    for quantized/int inputs). This exact-reference mode is opt-in via
+    PDTPU_MAXPOOL_COMPARE_GRAD: on TPU the kh*kw strided scatter passes
+    measured ~12 ms slower than select_and_scatter on the flagship bench, so
+    the default keeps the fast first-match lowering (ties are measure-zero
+    for float activations)."""
+    if df == "NHWC":
+        n, h, w, c = x.shape
+    else:
+        n, c, h, w = x.shape
+    (kh, kw), (ph, pw), (sh, sw), (oh, ow), (eh, ew) = _pool_geometry(
+        h, w, ksize, strides, paddings, global_pooling, ceil_mode)
+    neg = _pool_pad_value(x)
+    # window maxima (recomputed; cheaper than saving the fwd output across
+    # the bwd region) and padded input on the window grid
+    y = _pool2d_compute(x, (kh, kw), (sh, sw), (ph, pw), "max", False,
+                        ceil_mode, df=df)
+    if df == "NHWC":
+        pads = ((0, 0), (ph, ph + eh), (pw, pw + ew), (0, 0))
+        hax, wax = 1, 2
+    else:
+        pads = ((0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew))
+        hax, wax = 2, 3
+    xp = jnp.pad(x, pads, constant_values=neg)
+    dxp = jnp.zeros(xp.shape, dy.dtype)
+    idx = [slice(None)] * 4
+    for i in range(kh):
+        for j in range(kw):
+            idx[hax] = slice(i, i + sh * (oh - 1) + 1, sh)
+            idx[wax] = slice(j, j + sw * (ow - 1) + 1, sw)
+            sl = tuple(idx)
+            contrib = jnp.where(xp[sl] == y, dy, 0)
+            dxp = dxp.at[sl].add(contrib)
+    idx[hax] = slice(ph, ph + h)
+    idx[wax] = slice(pw, pw + w)
+    return dxp[tuple(idx)]
+
+
 @register_op("pool2d_grad")
 def pool2d_grad(ctx):
     x = data_of(ctx.input("X"))
     dy = data_of(ctx.input("Out@GRAD"))
     args = _pool2d_attrs(ctx.attr)
+    (ksize, strides, paddings, pooling_type, global_pooling, ceil_mode,
+     _exclusive, df) = args
+    import os
+    if pooling_type == "max" and os.environ.get("PDTPU_MAXPOOL_COMPARE_GRAD"):
+        ctx.set_output("X@GRAD",
+                       _maxpool2d_grad(x, dy.astype(x.dtype), ksize, strides,
+                                       paddings, global_pooling, ceil_mode,
+                                       df))
+        return
     out, vjp = jax.vjp(lambda a: _pool2d_compute(a, *args), x)
     # upstream grads can arrive in a different float dtype than the forward
     # output under AMP (e.g. bf16 grad meeting an fp32-promoted forward)

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 from ..core.lod import LoDArray
 from ..core.registry import register_op, same_shape, OpSpec
+from ..core.sparse import SparseRows, is_sparse
 from .common import G, data_of, like, collapse_to
 
 
@@ -79,8 +80,18 @@ def _register(op_type):
 
     @register_op(op_type, infer_shape=same_shape("X", "Out"),
                  grad=_make_grad_maker(op_type))
-    def forward(ctx, _fwd=fwd):
+    def forward(ctx, _fwd=fwd, _t=op_type):
         xv, yv = ctx.input("X"), ctx.input("Y")
+        if is_sparse(xv) and _t in ("elementwise_mul", "elementwise_div"):
+            # sparse grad × scalar (gradient-clip scale factor): these ops
+            # are linear per-element in X, so they apply to the value block
+            # (reference selected_rows_functor scale path)
+            y = data_of(yv)
+            if getattr(y, "size", None) == 1:
+                ctx.set_output("Out", SparseRows(
+                    xv.rows, _fwd(xv.values, y.reshape(())), xv.nrows,
+                    xv.merged))
+                return
         x, y = data_of(xv), data_of(yv)
         yb, _ = _align(x, y, ctx.attr("axis", -1),
                        isinstance(xv, LoDArray), isinstance(yv, LoDArray))

@@ -95,9 +95,12 @@ def batch_norm(ctx):
         mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
         if x.dtype == jnp.bfloat16:
             # AMP fast path: single-pass E[x²]-E[x]² with fp32 accumulators
-            # (the flax recipe) — one read of x instead of two; cancellation
-            # only bites when |mean|/std exceeds ~3e3, beyond bf16 training
-            # regimes
+            # (the flax recipe). Two separate jnp reductions beat a variadic
+            # lax.reduce here: XLA's specialized column-reduce emitter only
+            # kicks in for plain monoid reduces (a variadic (Σx, Σx²) reduce
+            # measured 2185 vs 2463 img/s on the flagship bench).
+            # Cancellation only bites when |mean|/std exceeds ~3e3, beyond
+            # bf16 training regimes.
             mean_sq = jnp.mean(jnp.square(x), axis=axes, dtype=jnp.float32)
             var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
         else:

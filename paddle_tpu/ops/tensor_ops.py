@@ -125,6 +125,15 @@ def sign(ctx):
              grad=_unary_grad("clip", extra=("X",)))
 def clip(ctx):
     x = ctx.input("X")
+    if is_sparse(x):
+        # SelectedRows input (sparse grad clipping): merge duplicates first —
+        # clip(v1+v2) != clip(v1)+clip(v2) — then clip the value block
+        from ..core.sparse import merge_rows
+        m = merge_rows(x)
+        ctx.set_output("Out", SparseRows(
+            m.rows, jnp.clip(m.values, ctx.attr("min"), ctx.attr("max")),
+            m.nrows, merged=True))
+        return
     ctx.set_output("Out", like(x, jnp.clip(data_of(x), ctx.attr("min"),
                                            ctx.attr("max"))))
 
@@ -139,11 +148,23 @@ def clip_grad(ctx):
 
 @register_op("clip_by_norm", infer_shape=same_shape("X", "Out"))
 def clip_by_norm(ctx):
-    x = data_of(ctx.input("X"))
+    xv = ctx.input("X")
     max_norm = ctx.attr("max_norm")
+    if is_sparse(xv):
+        # reference clip_by_norm_op.cc SelectedRows path: MergeAdd, then
+        # clip by the norm of the merged value block
+        from ..core.sparse import merge_rows
+        m = merge_rows(xv)
+        norm = jnp.sqrt(jnp.sum(jnp.square(m.values)))
+        scale_f = jnp.where(norm > max_norm,
+                            max_norm / jnp.maximum(norm, 1e-12), 1.0)
+        ctx.set_output("Out", SparseRows(m.rows, m.values * scale_f,
+                                         m.nrows, merged=True))
+        return
+    x = data_of(xv)
     norm = jnp.sqrt(jnp.sum(jnp.square(x)))
     scale_f = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
-    ctx.set_output("Out", like(ctx.input("X"), x * scale_f))
+    ctx.set_output("Out", like(xv, x * scale_f))
 
 
 @register_op("squared_l2_norm", grad=lambda op: [OpSpec(
@@ -151,7 +172,16 @@ def clip_by_norm(ctx):
     {"X": op.input("X"), "Out@GRAD": G(op.output("Out"))},
     {"X@GRAD": G(op.input("X"))})])
 def squared_l2_norm(ctx):
-    x = data_of(ctx.input("X"))
+    xv = ctx.input("X")
+    if is_sparse(xv):
+        # merged value block's norm == the dense gradient's norm (duplicate
+        # rows must be summed before squaring; sentinel segments sum to the
+        # zeroed padding grads, contributing 0)
+        from ..core.sparse import merge_rows
+        ctx.set_output("Out", jnp.sum(
+            jnp.square(merge_rows(xv).values)).reshape((1,)))
+        return
+    x = data_of(xv)
     ctx.set_output("Out", jnp.sum(jnp.square(x)).reshape((1,)))
 
 

@@ -257,3 +257,67 @@ class TestPool2dCeil(TestPool2dMax):
     shape = (2, 3, 7, 7)
     strides = [2, 2]
     ceil_mode = True
+
+
+def max_pool2D_grad_naive(x, dy, ksize, strides, paddings, global_pool=False,
+                          ceil_mode=False):
+    """Reference MaxPool2dGradFunctor (operators/math/pooling.cc): EVERY
+    position equal to the window max gets the window's dy."""
+    N, C, H, W = x.shape
+    if global_pool:
+        ksize, paddings = [H, W], [0, 0]
+    out = max_pool2D_forward_naive(x, ksize, strides, paddings, global_pool,
+                                   ceil_mode)
+    _, _, OH, OW = out.shape
+    dx = np.zeros_like(x)
+    for n in range(N):
+        for c in range(C):
+            for oh in range(OH):
+                for ow in range(OW):
+                    hs = oh * strides[0] - paddings[0]
+                    ws = ow * strides[1] - paddings[1]
+                    he, we = hs + ksize[0], ws + ksize[1]
+                    for i in range(max(hs, 0), min(he, H)):
+                        for j in range(max(ws, 0), min(we, W)):
+                            if x[n, c, i, j] == out[n, c, oh, ow]:
+                                dx[n, c, i, j] += dy[n, c, oh, ow]
+    return dx
+
+
+@pytest.mark.parametrize("case", [
+    dict(shape=(2, 3, 6, 6), ksize=[2, 2], strides=[2, 2], paddings=[0, 0]),
+    dict(shape=(2, 3, 7, 7), ksize=[3, 3], strides=[2, 2], paddings=[1, 1]),
+    dict(shape=(2, 2, 5, 5), ksize=[3, 3], strides=[1, 1], paddings=[0, 0]),
+    dict(shape=(2, 2, 7, 7), ksize=[3, 3], strides=[2, 2], paddings=[0, 0],
+         ceil_mode=True),
+    dict(shape=(2, 2, 5, 5), ksize=[2, 2], strides=[1, 1], paddings=[0, 0],
+         global_pool=True),
+])
+@pytest.mark.parametrize("df", ["NCHW", "NHWC"])
+def test_maxpool_grad_all_match_semantics(case, df):
+    """The shifted-compare maxpool grad must give dy to ALL tied maxima
+    (reference semantics) — exercised with heavily quantized inputs so ties
+    are common."""
+    from paddle_tpu.ops.conv_ops import _maxpool2d_grad
+    import jax.numpy as jnp
+
+    np.random.seed(3)
+    shape = case["shape"]
+    ks, st, pd = case["ksize"], case["strides"], case["paddings"]
+    gp = case.get("global_pool", False)
+    cm = case.get("ceil_mode", False)
+    # quantized values -> many exact ties inside windows
+    x = np.random.randint(0, 3, shape).astype("float32")
+    out = max_pool2D_forward_naive(x, ks, st, pd, gp, cm)
+    dy = np.random.random(out.shape).astype("float32")
+    expect = max_pool2D_grad_naive(x, dy, ks, st, pd, gp, cm)
+
+    xx, dd = x, dy
+    if df == "NHWC":
+        xx, dd = x.transpose(0, 2, 3, 1), dy.transpose(0, 2, 3, 1)
+    got = np.asarray(_maxpool2d_grad(jnp.asarray(xx), jnp.asarray(dd),
+                                     tuple(ks), tuple(st), tuple(pd), gp, cm,
+                                     df))
+    if df == "NHWC":
+        got = got.transpose(0, 3, 1, 2)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,272 @@
+"""LR schedulers + gradient clipping tests.
+
+Reference: python/paddle/fluid/layers/learning_rate_scheduler.py (decay as
+graph ops over @LR_DECAY_COUNTER@), python/paddle/fluid/clip.py
+(ByValue/ByNorm/ByGlobalNorm), operators/clip_op.cc, clip_by_norm_op.cc.
+Scheduler values are checked against closed forms for several steps; clipping
+is checked against numpy on fetched gradients and in a training run.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+layers = fluid.layers
+
+
+def _run_schedule(build_lr, steps=7):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lr = build_lr()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    return [float(exe.run(main, fetch_list=[lr], scope=scope)[0])
+            for _ in range(steps)]
+
+
+def test_exponential_decay():
+    got = _run_schedule(lambda: layers.exponential_decay(
+        learning_rate=0.5, decay_steps=3, decay_rate=0.7))
+    expect = [0.5 * 0.7 ** (s / 3.0) for s in range(1, 8)]
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_exponential_decay_staircase():
+    got = _run_schedule(lambda: layers.exponential_decay(
+        learning_rate=0.5, decay_steps=3, decay_rate=0.7, staircase=True))
+    expect = [0.5 * 0.7 ** (s // 3) for s in range(1, 8)]
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_natural_exp_decay():
+    got = _run_schedule(lambda: layers.natural_exp_decay(
+        learning_rate=1.0, decay_steps=2, decay_rate=0.5))
+    expect = [math.exp(-0.5 * s / 2.0) for s in range(1, 8)]
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_inverse_time_decay():
+    got = _run_schedule(lambda: layers.inverse_time_decay(
+        learning_rate=1.0, decay_steps=2, decay_rate=0.5))
+    expect = [1.0 / (1 + 0.5 * s / 2.0) for s in range(1, 8)]
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_polynomial_decay():
+    got = _run_schedule(lambda: layers.polynomial_decay(
+        learning_rate=1.0, decay_steps=4, end_learning_rate=0.1, power=2.0))
+    expect = [(1.0 - 0.1) * (1 - min(s, 4) / 4.0) ** 2 + 0.1
+              for s in range(1, 8)]
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_polynomial_decay_cycle():
+    got = _run_schedule(lambda: layers.polynomial_decay(
+        learning_rate=1.0, decay_steps=3, end_learning_rate=0.1, power=1.0,
+        cycle=True), steps=8)
+    expect = []
+    for s in range(1, 9):
+        horizon = 3 * max(1, math.ceil(s / 3.0))
+        expect.append((1.0 - 0.1) * (1 - s / horizon) + 0.1)
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_piecewise_decay():
+    got = _run_schedule(lambda: layers.piecewise_decay(
+        boundaries=[3, 6], values=[1.0, 0.5, 0.1]), steps=8)
+    expect = [1.0 if s < 3 else (0.5 if s < 6 else 0.1)
+              for s in range(1, 9)]
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_noam_decay():
+    got = _run_schedule(lambda: layers.noam_decay(d_model=64,
+                                                  warmup_steps=4), steps=8)
+    expect = [64 ** -0.5 * min(s ** -0.5, s * 4 ** -1.5)
+              for s in range(1, 9)]
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_optimizer_with_decayed_lr_trains():
+    """An optimizer driven by a schedule variable must train and must apply
+    the decayed LR (checked by observing the counter advances)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        y = fluid.layers.data("y", shape=[1])
+        pred = fluid.layers.fc(x, size=1, act=None)
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+        lr = layers.exponential_decay(0.1, decay_steps=5, decay_rate=0.9)
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    w = rng.normal(0, 1, (8, 1)).astype("float32")
+    losses = []
+    for _ in range(12):
+        xs = rng.normal(0, 1, (32, 8)).astype("float32")
+        feed = {"x": xs, "y": xs @ w}
+        losses.append(float(exe.run(main, feed=feed, fetch_list=[loss],
+                                    scope=scope)[0]))
+    assert losses[-1] < 0.2 * losses[0]
+    counter = np.asarray(scope.find_var("@LR_DECAY_COUNTER@"))
+    assert counter[0] == 12.0
+
+
+# ---------------------------------------------------------------------------
+# gradient clipping
+# ---------------------------------------------------------------------------
+
+def _clip_program(clip, fetch_grad=True):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6])
+        y = fluid.layers.data("y", shape=[1])
+        pred = fluid.layers.fc(
+            x, size=1, act=None,
+            param_attr=fluid.ParamAttr(name="w", gradient_clip=clip),
+            bias_attr=fluid.ParamAttr(name="b", gradient_clip=clip))
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+        fluid.optimizer.SGD(learning_rate=0.0).minimize(loss, startup)
+    return main, startup, loss
+
+
+def _grads_after_clip(clip):
+    """Run one step with lr=0 and inspect the clipped grad fed to sgd."""
+    main, startup, loss = _clip_program(clip)
+    block = main.global_block()
+    sgd_ops = [op for op in block.ops if op.type == "sgd"]
+    grad_names = {op.input("Param")[0]: op.input("Grad")[0] for op in sgd_ops}
+    raw_names = {"w": "w@GRAD", "b": "b@GRAD"}
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.normal(0, 3, (16, 6)).astype("float32"),
+            "y": rng.normal(0, 3, (16, 1)).astype("float32")}
+    fetch = [grad_names["w"], grad_names["b"], raw_names["w"], raw_names["b"]]
+    vals = exe.run(main, feed=feed, fetch_list=fetch, scope=scope)
+    return {"w_clipped": vals[0], "b_clipped": vals[1],
+            "w_raw": vals[2], "b_raw": vals[3]}
+
+
+def test_clip_by_value():
+    r = _grads_after_clip(fluid.clip.GradientClipByValue(max=0.05))
+    np.testing.assert_allclose(r["w_clipped"],
+                               np.clip(r["w_raw"], -0.05, 0.05), rtol=1e-6)
+    assert np.abs(r["w_raw"]).max() > 0.05  # the clip actually bit
+
+
+def test_clip_by_norm():
+    r = _grads_after_clip(fluid.clip.GradientClipByNorm(clip_norm=0.1))
+    raw = r["w_raw"]
+    n = np.linalg.norm(raw)
+    expect = raw * (0.1 / max(n, 0.1))
+    np.testing.assert_allclose(r["w_clipped"], expect, rtol=1e-5)
+    assert n > 0.1
+
+
+def test_clip_by_global_norm():
+    r = _grads_after_clip(
+        fluid.clip.GradientClipByGlobalNorm(clip_norm=0.1))
+    gnorm = math.sqrt((r["w_raw"] ** 2).sum() + (r["b_raw"] ** 2).sum())
+    factor = 0.1 / max(gnorm, 0.1)
+    np.testing.assert_allclose(r["w_clipped"], r["w_raw"] * factor,
+                               rtol=1e-5)
+    np.testing.assert_allclose(r["b_clipped"], r["b_raw"] * factor,
+                               rtol=1e-5)
+    assert gnorm > 0.1
+
+
+def test_set_gradient_clip_and_training():
+    """set_gradient_clip applies to all params; training stays stable with
+    exploding-scale targets."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1])
+        h = fluid.layers.fc(x, size=16, act="relu")
+        pred = fluid.layers.fc(h, size=1, act=None)
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+        fluid.clip.set_gradient_clip(
+            fluid.clip.GradientClipByGlobalNorm(clip_norm=1.0))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(1)
+    losses = []
+    for _ in range(80):
+        xs = rng.normal(0, 1, (32, 4)).astype("float32")
+        feed = {"x": xs, "y": 5.0 * xs[:, :1]}
+        losses.append(float(exe.run(main, feed=feed, fetch_list=[loss],
+                                    scope=scope)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.3 * losses[0]
+
+def test_global_norm_group_conflict_raises():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1])
+        h = fluid.layers.fc(x, size=4, act=None, param_attr=fluid.ParamAttr(
+            name="w1", gradient_clip=fluid.clip.GradientClipByGlobalNorm(1.0)))
+        pred = fluid.layers.fc(h, size=1, act=None,
+                               param_attr=fluid.ParamAttr(
+            name="w2", gradient_clip=fluid.clip.GradientClipByGlobalNorm(5.0)))
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+        with pytest.raises(ValueError, match="conflicting clip_norm"):
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss, startup)
+
+
+@pytest.mark.parametrize("clip_cls", ["value", "norm", "global"])
+def test_clip_on_sparse_embedding_grad(clip_cls):
+    """Clipping a SparseRows gradient (is_sparse embedding) must work and
+    keep untouched rows untouched (reference clip_by_norm_op.cc SelectedRows
+    path)."""
+    clip = {
+        "value": fluid.clip.GradientClipByValue(max=0.01),
+        "norm": fluid.clip.GradientClipByNorm(clip_norm=0.05),
+        "global": fluid.clip.GradientClipByGlobalNorm(clip_norm=0.05),
+    }[clip_cls]
+    vocab, emb = 10, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        y = fluid.layers.data("y", shape=[4])
+        e = fluid.layers.embedding(
+            ids, size=[vocab, emb], is_sparse=True,
+            param_attr=fluid.ParamAttr(name="emb_w", gradient_clip=clip))
+        e = fluid.layers.reshape(e, [-1, emb])
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(e, y)))
+        fluid.optimizer.SGD(learning_rate=1.0).minimize(loss, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    w0 = np.asarray(scope.find_var("emb_w")).copy()
+    feed = {"ids": np.array([[1], [2], [1]], dtype=np.int64),
+            "y": 100.0 * np.ones((3, 4), np.float32)}
+    l0 = float(exe.run(main, feed=feed, fetch_list=[loss], scope=scope)[0])
+    w1 = np.asarray(scope.find_var("emb_w"))
+    assert np.isfinite(w1).all()
+    np.testing.assert_allclose(w1[[0, 3, 4, 5, 6, 7, 8, 9]],
+                               w0[[0, 3, 4, 5, 6, 7, 8, 9]])
+    moved = np.abs(w1[[1, 2]] - w0[[1, 2]])
+    assert moved.max() > 0  # clipped grads still applied
+    if clip_cls == "value":
+        # lr=1.0: per-element step bounded by clip max
+        assert moved.max() <= 0.01 + 1e-6
+    else:
+        # total step norm bounded by clip_norm
+        assert np.sqrt((moved ** 2).sum()) <= 0.05 + 1e-5
