@@ -167,44 +167,13 @@ def _collect_free_inputs(program, block_idx):
     """Names a block (and its sub-blocks) reads before writing — the state +
     feed surface of the compiled function. Mirrors what the reference resolves
     dynamically through Scope parent lookup (executor.cc:286-315)."""
-    free: list[str] = []
-    seen = set()
-
-    def walk(bidx, defined):
-        block = program.blocks[bidx]
-        defined = set(defined)
-        for op in block.ops:
-            for name in op.input_arg_names():
-                if name not in defined and name not in seen:
-                    seen.add(name)
-                    free.append(name)
-            for attr in ("sub_block", "sub_block_false"):
-                if op.has_attr(attr):
-                    walk(op.attr(attr), defined)
-            for name in op.output_arg_names():
-                defined.add(name)
-
-    walk(block_idx, set())
-    return free
+    from .block_walk import free_reads
+    return free_reads(program, block_idx)
 
 
 def _written_names(program, block_idx):
-    out = []
-    seen = set()
-
-    def walk(bidx):
-        block = program.blocks[bidx]
-        for op in block.ops:
-            for name in op.output_arg_names():
-                if name not in seen:
-                    seen.add(name)
-                    out.append(name)
-            for attr in ("sub_block", "sub_block_false"):
-                if op.has_attr(attr):
-                    walk(op.attr(attr))
-
-    walk(block_idx)
-    return out
+    from .block_walk import written_names
+    return written_names(program, block_idx)
 
 
 def _is_traceable(v):

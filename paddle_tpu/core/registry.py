@@ -25,11 +25,18 @@ from typing import Callable, Optional
 
 @dataclasses.dataclass
 class OpSpec:
-    """A to-be-appended op description returned by grad makers."""
+    """A to-be-appended op description returned by grad makers.
+
+    ``overwrite_outputs``: output grads REPLACE any already-produced grad of
+    the same name instead of rename-and-sum accumulation — the in-place
+    loop-state contract (a while op rebinds its carried names, so the grad
+    w.r.t. the pre-loop value supersedes the post-loop cotangent once the
+    loop's grad op has consumed it)."""
     type: str
     inputs: dict
     outputs: dict
     attrs: dict = dataclasses.field(default_factory=dict)
+    overwrite_outputs: bool = False
 
 
 @dataclasses.dataclass

@@ -120,7 +120,8 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
                     produced.add(g)
 
         for spec in info.grad(op):
-            # rename-and-sum for repeated gradients (backward.py:117)
+            # rename-and-sum for repeated gradients (backward.py:117);
+            # overwrite_outputs specs (in-place loop state) replace instead
             renames = {}
             for slot, names in spec.outputs.items():
                 new_names = []
@@ -130,7 +131,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
                         # still produce it (XLA DCEs it); cheaper than
                         # rewriting the grad op's outputs
                         pass
-                    if n in produced:
+                    if n in produced and not spec.overwrite_outputs:
                         tmp = unique_name(n + "@RENAME")
                         _create_grad_var(block, fwd, tmp)
                         renames[n] = tmp

@@ -16,6 +16,27 @@ from ..framework import unique_name
 from ..layer_helper import LayerHelper
 
 
+def _is_float_dtype(dtype):
+    return dtype is None or str(dtype).startswith("float") \
+        or str(dtype) == "bfloat16"
+
+
+def _free_float_reads(program, sub_idx, locals_):
+    """Float-typed outer vars a sub-block reads before writing (the weights)
+    — the grad surface of a control-flow op."""
+    from ...core.block_walk import free_reads
+
+    blk = program.blocks[sub_idx]
+    return [n for n in free_reads(program, sub_idx, locals_)
+            if blk.has_var(n) and _is_float_dtype(blk.var(n).dtype)
+            and not getattr(blk.var(n), "is_tensor_array", False)]
+
+
+def _block_written_names(program, sub_idx):
+    from ...core.block_walk import written_names
+    return written_names(program, sub_idx)
+
+
 def increment(x, value=1.0, in_place=True):
     helper = LayerHelper("increment")
     if in_place:
@@ -90,11 +111,17 @@ class While:
         with w.block():
             ...
             layers.less_than(i, limit, cond=cond)  # update condition
+
+    ``max_iters`` makes the loop differentiable: while_grad re-executes it as
+    a masked bounded scan of that many steps and reverse-differentiates the
+    free weights (the reference's WhileGrad, while_op.cc:35, interprets a
+    generated backward block instead). Without it the loop is forward-only.
     """
 
-    def __init__(self, cond, name=None):
+    def __init__(self, cond, name=None, max_iters=None):
         self.helper = LayerHelper("while", name=name)
         self.cond_var = cond
+        self.max_iters = max_iters
 
     @contextlib.contextmanager
     def block(self):
@@ -104,11 +131,33 @@ class While:
         yield
         program.rollback()
         parent = program.blocks[parent_idx]
+        written = _block_written_names(program, sub.idx)
+        # loop state: block-written vars that pre-exist outside the loop
+        carried = [n for n in written if parent.has_var(n)]
+        if self.cond_var.name not in carried:
+            carried.append(self.cond_var.name)
+        free_vars = [n for n in _free_float_reads(program, sub.idx, set())
+                     if n not in carried]
+        # pre-loop state snapshots consumed by while_grad (the grad op runs
+        # after the loop has rebound the carried names in place). Names are
+        # unique per While op: two loops carrying the same var must not
+        # clobber each other's snapshots.
+        preloop = []
+        for n in carried:
+            cv = parent.var(n)
+            pv = parent.create_var(name=unique_name(n + "@PRELOOP"),
+                                   dtype=cv.dtype, shape=cv.shape,
+                                   lod_level=cv.lod_level)
+            preloop.append(pv.name)
         parent.append_op(
             "while",
-            inputs={"Condition": [self.cond_var.name]},
-            outputs={},
-            attrs={"sub_block": sub.idx})
+            inputs={"Condition": [self.cond_var.name], "Carried": carried,
+                    "FreeVars": free_vars},
+            outputs={"Out": carried, "PreLoop": preloop},
+            attrs={"sub_block": sub.idx,
+                   "carried": carried,
+                   "diff_vars": free_vars,
+                   "max_iters": self.max_iters})
 
 
 class Switch:
@@ -197,27 +246,99 @@ class _RNNBase:
         self._status = "done"
         self._append_op()
 
+    def _free_float_vars(self):
+        """Outer float vars the step block reads beyond step inputs/memories
+        — the weights. They join the grad surface (attr diff_vars) so
+        recurrent_grad produces their gradients (the reference's backward
+        sub-block recursion collects them the same way,
+        python backward.py:273)."""
+        locals_ = set(self.step_vars) | {m for m, _ in self.memories} \
+            | set(self.mem_inits.keys())
+        return _free_float_reads(self.helper.main_program, self._sub_idx,
+                                 locals_)
+
     def _append_op(self):
-        parent = self.helper.main_program.blocks[self._parent_idx]
+        program = self.helper.main_program
+        parent = program.blocks[self._parent_idx]
+        sub = program.blocks[self._sub_idx]
+        free_vars = self._free_float_vars()
+        is_dyn = self.OP_TYPE == "dynamic_recurrent"
+
+        # declare stacked outputs with real metadata (shape [b, T, feat] from
+        # the outer input and the block-local output var)
+        outer0 = parent.var(self.step_inputs[0]) if self.step_inputs else None
+        stacked_names, self._stacked_vars = [], []
+        for o in self.outputs:
+            ov = sub.var(o) if sub.has_var(o) else None
+            feat = tuple(ov.shape[1:]) if ov is not None and ov.shape else None
+            if is_dyn:
+                # LoD build-shape convention is the reference's FLAT rows
+                # form [-1, *feat] (lod_level carries the ragged time dim);
+                # downstream fc/softmax flatten from dim 1
+                shape = ((-1,) + feat) if feat is not None else None
+            else:
+                bt = tuple(outer0.shape[:2]) \
+                    if outer0 is not None and outer0.shape is not None \
+                    else None
+                shape = bt + feat if (feat is not None and bt is not None) \
+                    else None
+            sv = parent.create_var(
+                name=o + "@STACKED", shape=shape,
+                dtype=(ov.dtype if ov is not None else None) or "float32",
+                lod_level=1 if is_dyn else 0)
+            stacked_names.append(sv.name)
+            self._stacked_vars.append(sv)
+        final_names = []
+        for mem, _new in self.memories:
+            init = parent.var(self.mem_inits[mem]) \
+                if parent.has_var(self.mem_inits[mem]) else None
+            parent.create_var(
+                name=mem + "@FINAL",
+                shape=init.shape if init is not None else None,
+                dtype=(init.dtype if init is not None else None) or "float32")
+            final_names.append(mem + "@FINAL")
+
+        # grad surface: float step inputs + memory inits + free weights
+        diff_vars = []
+        for n in list(self.step_inputs) + list(self.mem_inits.values()) \
+                + free_vars:
+            if n in diff_vars:
+                continue
+            v = parent.var(n) if parent.has_var(n) else None
+            if v is not None and not _is_float_dtype(v.dtype):
+                continue
+            diff_vars.append(n)
+
         parent.append_op(
             self.OP_TYPE,
             inputs={"Inputs": self.step_inputs,
-                    "MemInits": list(self.mem_inits.values())},
-            outputs={},
+                    "MemInits": list(self.mem_inits.values()),
+                    "FreeVars": free_vars},
+            outputs={"Stacked": stacked_names, "FinalMems": final_names},
             attrs={"sub_block": self._sub_idx,
                    "step_inputs": list(self.step_inputs),
                    "step_vars": list(self.step_vars),
                    "memories": [list(m) for m in self.memories],
                    "mem_inits": {k: v for k, v in self.mem_inits.items()},
-                   "outputs": list(self.outputs)})
+                   "outputs": list(self.outputs),
+                   "diff_vars": diff_vars})
 
     # -- inside-block API --
     def step_input(self, x):
         assert self._status == "in_block", "step_input outside rnn.step()"
         block = self.helper.main_program.current_block()
+        # per-step slice is [batch, *feat]: a dense StaticRNN input is built
+        # [batch, T, *feat] (drop the time dim); a ragged DynamicRNN input's
+        # build shape is the reference's flat [-1, *feat] rows form, which
+        # already matches the slice
+        if x.shape is None:
+            shape = None
+        elif self.OP_TYPE == "dynamic_recurrent":
+            shape = tuple(x.shape)
+        else:
+            shape = (x.shape[0],) + tuple(x.shape[2:])
         iv = block.create_var(name=unique_name(x.name + "@step"),
-                              dtype=x.dtype,
-                              shape=tuple(x.shape[1:]) if x.shape else None)
+                              dtype=x.dtype, shape=shape)
         self.step_inputs.append(x.name)
         self.step_vars.append(iv.name)
         return iv
@@ -255,19 +376,14 @@ class _RNNBase:
     # -- outside-block API --
     def __call__(self):
         """Stacked step outputs (reference StaticRNN.__call__ /
-        DynamicRNN.__call__)."""
-        parent = self.helper.main_program.blocks[self._parent_idx]
-        lod = 1 if self.OP_TYPE == "dynamic_recurrent" else 0
-        outs = []
-        for o in self.outputs:
-            ov = parent.create_var(name=o + "@STACKED", lod_level=lod)
-            outs.append(ov)
+        DynamicRNN.__call__) — the vars were declared (with dtype/shape) as
+        the recurrent op's Stacked outputs in _append_op."""
+        outs = list(self._stacked_vars)
         return outs[0] if len(outs) == 1 else outs
 
     def final_memory(self, mem):
         parent = self.helper.main_program.blocks[self._parent_idx]
-        return parent.create_var(name=mem.name + "@FINAL", dtype=mem.dtype,
-                                 shape=mem.shape)
+        return parent.var(mem.name + "@FINAL")
 
 
 class StaticRNN(_RNNBase):

@@ -37,8 +37,8 @@ import jax
 import jax.numpy as jnp
 
 from ..core.lod import LoDArray
-from ..core.registry import register_op
-from .common import data_of
+from ..core.registry import register_op, OpSpec
+from .common import G, data_of
 
 
 @jax.tree_util.register_pytree_node_class
@@ -131,36 +131,92 @@ def _block_written(block):
     """Names written by the block, recursing into nested control-flow
     sub-blocks (a nested While/Switch writing an outer var must still appear
     in the enclosing loop's carry)."""
-    seen, out = set(), []
-
-    def walk(blk):
-        for op in blk.ops:
-            for n in op.output_arg_names():
-                if n not in seen:
-                    seen.add(n)
-                    out.append(n)
-            for attr in ("sub_block", "sub_block_false"):
-                if op.has_attr(attr):
-                    walk(blk.program.blocks[op.attr(attr)])
-
-    walk(block)
-    return out
+    from ..core.block_walk import written_names
+    return written_names(block.program, block.idx)
 
 
-@register_op("while", is_control_flow=True)
+def _while_grad_maker(op):
+    """while_grad consumes the pre-loop state snapshots + post-loop output
+    grads and produces (a) grads for the free weights read by the body and
+    (b) grads w.r.t. the PRE-loop carried state, which OVERWRITE the carried
+    names' post-loop cotangents — ops before the loop that produced the
+    inits must see d/d(pre-loop value), not d/d(post-loop value). Requires a
+    max_iters bound so the loop is a reverse-differentiable masked lax.scan
+    (the reference's WhileGrad, while_op.cc:35, interprets a generated
+    backward block instead)."""
+    if op.attrs.get("max_iters") is None:
+        raise RuntimeError(
+            "while op lies on a gradient path but has no max_iters bound; "
+            "build it as fluid.layers.While(cond, max_iters=N) to train "
+            "through it (lax.while_loop itself is not reverse-"
+            "differentiable)")
+    diff = op.attrs.get("diff_vars", [])
+    carried = op.attrs.get("carried", [])
+    return [OpSpec(
+        "while_grad",
+        {"Condition": op.input("Condition"), "Carried": op.input("Carried"),
+         "FreeVars": op.input("FreeVars"), "PreLoop": op.output("PreLoop"),
+         "OutGrads": G(op.output("Out"))},
+        {"DiffGrads": G(diff), "CarriedGrads": G(carried)},
+        dict(op.attrs),
+        overwrite_outputs=True)]
+
+
+def _while_scan(exec_state, sub, env_base, pre, carried, cond_name,
+                max_iters):
+    """The bounded-loop functional core: max_iters masked steps (state holds
+    once the condition goes false). Used by BOTH the bounded forward and
+    while_grad, so the gradient differentiates exactly the function that ran
+    — a max_iters bound is a visible semantic of the loop, never a silent
+    grad-only truncation."""
+    from ..core.executor import _run_ops
+
+    def body(carry, _):
+        cond = data_of(carry[cond_name]).reshape(()).astype(jnp.bool_)
+        local = dict(env_base)
+        local.update(carry)
+        _run_ops(sub, local, exec_state)
+        new = {}
+        for n in carried:
+            new[n] = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(cond, a, b), local[n], carry[n])
+        return new, None
+
+    final, _ = jax.lax.scan(body, pre, None, length=max_iters)
+    return final
+
+
+@register_op("while", is_control_flow=True, grad=_while_grad_maker)
 def while_op(ctx):
-    """ONE lax.while_loop over the sub-block (vs. the reference's interpreted
-    scope-loop, while_op.cc). Carry = condition + every block-written var
-    that already exists in the enclosing env (loop state); everything else
-    the block writes is a per-iteration temporary."""
+    """Loop over the sub-block (vs. the reference's interpreted scope-loop,
+    while_op.cc). Carry = condition + every block-written var that already
+    exists in the enclosing env (loop state); everything else the block
+    writes is a per-iteration temporary. With a max_iters bound the loop is
+    a masked lax.scan of exactly that many steps (differentiable; identical
+    to the unbounded form whenever the trip count fits the bound); without
+    one it is a lax.while_loop (forward-only). Pre-loop carried values are
+    snapshotted into the declared PreLoop outputs for while_grad."""
     sub = ctx.sub_block("sub_block")
     cond_name = ctx.op.input("Condition")[0]
     env = ctx.env
+    max_iters = ctx.attr("max_iters", None)
 
     written = _block_written(sub)
     carry_names = [n for n in written if n in env]
     if cond_name not in carry_names:
         carry_names.append(cond_name)
+
+    init = {n: env[n] for n in carry_names}
+    # snapshot pre-loop state under this op's unique PreLoop names
+    for n, pname in zip(ctx.attr("carried", []), ctx.op.output("PreLoop")):
+        if n in init:
+            env[pname] = init[n]
+
+    if max_iters is not None:
+        final = _while_scan(ctx._exec, sub, env, init, carry_names,
+                            cond_name, int(max_iters))
+        env.update(final)
+        return
 
     from ..core.executor import _run_ops
 
@@ -173,9 +229,106 @@ def while_op(ctx):
         _run_ops(sub, local, ctx._exec)
         return {n: local[n] for n in carry_names}
 
-    init = {n: env[n] for n in carry_names}
     final = jax.lax.while_loop(cond_fn, body_fn, init)
     env.update(final)
+
+
+@register_op("while_grad", is_control_flow=True)
+def while_grad(ctx):
+    """Reverse-mode through the bounded loop: jax.vjp over the SAME masked
+    scan the forward ran, w.r.t. both the free weights and the pre-loop
+    carried state. CarriedGrads overwrite the carried names' grads (in-place
+    loop-state contract, see _while_grad_maker)."""
+    env = ctx.env
+    attr = ctx.attr
+    sub = ctx.sub_block("sub_block")
+    cond_name = ctx.op.input("Condition")[0]
+    carried = list(attr("carried", []))
+    max_iters = int(attr("max_iters"))
+    all_diff = list(attr("diff_vars", []))
+    diff_names = [n for n in all_diff if jnp.issubdtype(
+        jnp.asarray(data_of(env[n])).dtype, jnp.floating)]
+
+    from ..fluid.framework import grad_var_name
+
+    preloop_names = dict(zip(carried, ctx.op.input("PreLoop")))
+    pre = {n: env[preloop_names[n]] for n in carried
+           if preloop_names[n] in env}
+    carried = [n for n in carried if n in pre]
+    # differentiable pre-loop state: float-leaf carried values
+    pre_float = {n: v for n, v in pre.items()
+                 if all(jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+                        for l in jax.tree_util.tree_leaves(v))}
+    prim_w = {n: data_of(env[n]) for n in diff_names}
+
+    def fwd(weights, pre_diff):
+        base = dict(env)
+        for n, v in weights.items():
+            old = env[n]
+            base[n] = LoDArray(v, old.lens) if isinstance(old, LoDArray) \
+                else v
+        start = dict(pre)
+        start.update(pre_diff)
+        return _while_scan(ctx._exec, sub, base, start, carried, cond_name,
+                           max_iters)
+
+    final, vjp = jax.vjp(fwd, prim_w, pre_float)
+
+    import numpy as _np
+
+    def ct_leaf(out_leaf, grad_leaf):
+        if not jnp.issubdtype(out_leaf.dtype, jnp.floating):
+            return _np.zeros(out_leaf.shape, jax.dtypes.float0)
+        if grad_leaf is None:
+            return jnp.zeros_like(out_leaf)
+        return jnp.asarray(grad_leaf).astype(out_leaf.dtype).reshape(
+            out_leaf.shape)
+
+    cts = {}
+    for n in carried:
+        g = env.get(grad_var_name(n))
+        out_v = final[n]
+        out_leaves, treedef = jax.tree_util.tree_flatten(out_v)
+        if g is None or len(out_leaves) != len(
+                jax.tree_util.tree_leaves(g)):
+            g_leaves = [None] * len(out_leaves)
+        else:
+            g_leaves = jax.tree_util.tree_leaves(g)
+        cts[n] = jax.tree_util.tree_unflatten(
+            treedef, [ct_leaf(o, gl) for o, gl in zip(out_leaves, g_leaves)])
+
+    (w_grads, pre_grads) = vjp(cts)
+
+    def _zero_float0(g, like_v):
+        """Replace float0 leaves (ints) with integer zeros so downstream
+        consumers see well-typed values."""
+        return jax.tree_util.tree_map(
+            lambda gl, ol: jnp.zeros_like(ol)
+            if getattr(gl, "dtype", None) == jax.dtypes.float0 else gl,
+            g, like_v)
+
+    out_vals = []
+    for n in all_diff:
+        old = env[n]
+        if n in w_grads:
+            g = w_grads[n]
+            if isinstance(old, LoDArray):
+                g = LoDArray(g, old.lens)
+        else:
+            g = jax.tree_util.tree_map(jnp.zeros_like, old)
+        out_vals.append(g)
+    ctx.set_outputs("DiffGrads", out_vals)
+
+    carried_grad_vals = []
+    for n in attr("carried", []):
+        if n in pre_grads:
+            carried_grad_vals.append(_zero_float0(pre_grads[n], pre[n]))
+        elif n in pre:
+            carried_grad_vals.append(
+                jax.tree_util.tree_map(jnp.zeros_like, pre[n]))
+        else:
+            carried_grad_vals.append(jnp.zeros(()))
+    ctx.set_outputs("CarriedGrads", carried_grad_vals)
 
 
 @register_op("conditional_block", is_control_flow=True)
@@ -204,21 +357,21 @@ def conditional_block(ctx):
 # recurrent (StaticRNN) and dynamic_recurrent (DynamicRNN)
 # ---------------------------------------------------------------------------
 
-def _scan_recurrent(ctx, lens):
-    """Shared lowering: lax.scan over time with memory carries.
+def _run_recurrent(exec_state, sub, attr, env, lens):
+    """Shared pure lowering: lax.scan over time with memory carries.
 
     attrs: sub_block, step_inputs [outer names], step_vars [block-local
     per-step names], memories [(mem_name, new_name)], outputs [block names].
     ``lens`` is None for StaticRNN (all rows run full length) or [b] int32
-    for DynamicRNN aliveness masking.
+    for DynamicRNN aliveness masking. Returns ({out_name: stacked [b,T,...]},
+    {mem_name: final [b, ...]}) WITHOUT touching env — the functional core
+    both the forward op and jax.vjp (the grad op) trace through.
     """
-    sub = ctx.sub_block("sub_block")
-    env = ctx.env
-    step_inputs = ctx.attr("step_inputs", [])
-    step_vars = ctx.attr("step_vars", [])
-    memories = [tuple(m) for m in ctx.attr("memories", [])]
-    mem_inits = ctx.attr("mem_inits", {})
-    out_names = ctx.attr("outputs", [])
+    step_inputs = attr("step_inputs", [])
+    step_vars = attr("step_vars", [])
+    memories = [tuple(m) for m in attr("memories", [])]
+    mem_inits = attr("mem_inits", {})
+    out_names = attr("outputs", [])
 
     from ..core.executor import _run_ops
 
@@ -237,7 +390,7 @@ def _scan_recurrent(ctx, lens):
         local = dict(env)
         local.update({mem: val for mem, val in carry.items()})
         local.update(slices)
-        _run_ops(sub, local, ctx._exec)
+        _run_ops(sub, local, exec_state)
         new_carry = {}
         for mem, new in memories:
             new_val = data_of(local[new])
@@ -258,25 +411,119 @@ def _scan_recurrent(ctx, lens):
 
     steps = (jnp.arange(T), xs)
     final_mems, stacked = jax.lax.scan(body, init_mems, steps)
-    for o in out_names:
-        out = jnp.swapaxes(stacked[o], 0, 1)   # back to [b, T, ...]
-        ctx.env[o + "@STACKED"] = LoDArray(out, lens) if lens is not None \
-            else out
-    for mem, _ in memories:
-        ctx.env[mem + "@FINAL"] = final_mems[mem]
+    stacked_out = {o: jnp.swapaxes(stacked[o], 0, 1) for o in out_names}
+    return stacked_out, {mem: final_mems[mem] for mem, _ in memories}
 
 
-@register_op("recurrent", is_control_flow=True)
+def _recurrent_fwd(ctx, lens):
+    stacked, finals = _run_recurrent(ctx._exec, ctx.sub_block("sub_block"),
+                                     ctx.attr, ctx.env, lens)
+    for o, v in stacked.items():
+        ctx.env[o + "@STACKED"] = LoDArray(v, lens) if lens is not None else v
+    for m, v in finals.items():
+        ctx.env[m + "@FINAL"] = v
+
+
+def _recurrent_grad_maker(op):
+    """Grad op consumes the forward's inputs + output grads and produces
+    grads for every recorded differentiable outer var."""
+    diff = op.attrs.get("diff_vars", [])
+    spec = OpSpec(
+        op.type + "_grad",
+        {"Inputs": op.input("Inputs"), "MemInits": op.input("MemInits"),
+         "FreeVars": op.input("FreeVars"),
+         "StackedGrad": G(op.output("Stacked")),
+         "FinalGrad": G(op.output("FinalMems"))},
+        {"DiffGrads": G(diff)},
+        dict(op.attrs))
+    return [spec]
+
+
+@register_op("recurrent", is_control_flow=True,
+             grad=_recurrent_grad_maker)
 def recurrent(ctx):
-    _scan_recurrent(ctx, lens=None)
+    _recurrent_fwd(ctx, lens=None)
 
 
-@register_op("dynamic_recurrent", is_control_flow=True)
-def dynamic_recurrent(ctx):
+def _dyn_lens(ctx):
     first = ctx.env[ctx.attr("step_inputs")[0]]
     if not isinstance(first, LoDArray):
         raise TypeError("dynamic_recurrent expects LoD step inputs")
-    _scan_recurrent(ctx, lens=first.lens)
+    return first.lens
+
+
+@register_op("dynamic_recurrent", is_control_flow=True,
+             grad=_recurrent_grad_maker)
+def dynamic_recurrent(ctx):
+    _recurrent_fwd(ctx, lens=_dyn_lens(ctx))
+
+
+def _recurrent_grad(ctx, lens):
+    """Gradient THROUGH the scan: jax.vjp over the functionalized forward
+    with respect to every differentiable outer input — step inputs, memory
+    inits, and the free variables (weights) the sub-block reads. The
+    reference interprets a generated backward sub-block step-by-step
+    (operators/recurrent_op.cc RecurrentGradOp, while_op.cc:35 WhileGrad,
+    python backward.py:273 sub-block recursion); here reverse-mode AD of the
+    scan gives the same result with XLA managing the saved activations."""
+    env = ctx.env
+    attr = ctx.attr
+    sub = ctx.sub_block("sub_block")
+    out_names = attr("outputs", [])
+    memories = [tuple(m) for m in attr("memories", [])]
+
+    # differentiable outer vars (recorded float-typed at build time);
+    # non-float runtime values (defensive) get zero grads
+    all_diff = list(attr("diff_vars", []))
+    diff_names = [n for n in all_diff if jnp.issubdtype(
+        jnp.asarray(data_of(env[n])).dtype, jnp.floating)]
+
+    prim = {n: data_of(env[n]) for n in diff_names}
+
+    def fwd(vals):
+        local = dict(env)
+        for n, v in vals.items():
+            old = env[n]
+            local[n] = LoDArray(v, old.lens) if isinstance(old, LoDArray) \
+                else v
+        return _run_recurrent(ctx._exec, sub, attr, local, lens)
+
+    (stacked, finals), vjp = jax.vjp(fwd, prim)
+
+    def cotangent(name, like_val):
+        g = env.get(name)
+        if g is None:
+            return jnp.zeros_like(like_val)
+        return data_of(g).astype(like_val.dtype).reshape(like_val.shape)
+
+    ct_stacked = {o: cotangent(o + "@STACKED@GRAD", stacked[o])
+                  for o in out_names}
+    ct_finals = {m: cotangent(m + "@FINAL@GRAD", finals[m])
+                 for m, _ in memories}
+    (grads,) = vjp((ct_stacked, ct_finals))
+    # write to the DECLARED output names in diff_vars order (backward.py may
+    # have renamed an output for rename-and-sum accumulation)
+    out_vals = []
+    for n in all_diff:
+        old = env[n]
+        if n in grads:
+            g = grads[n]
+            if isinstance(old, LoDArray):
+                g = LoDArray(g, old.lens)
+        else:
+            g = jax.tree_util.tree_map(jnp.zeros_like, old)
+        out_vals.append(g)
+    ctx.set_outputs("DiffGrads", out_vals)
+
+
+@register_op("recurrent_grad", is_control_flow=True)
+def recurrent_grad(ctx):
+    _recurrent_grad(ctx, lens=None)
+
+
+@register_op("dynamic_recurrent_grad", is_control_flow=True)
+def dynamic_recurrent_grad(ctx):
+    _recurrent_grad(ctx, lens=_dyn_lens(ctx))
 
 
 @register_op("batch_gather")

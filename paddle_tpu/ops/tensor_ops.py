@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.lod import LoDArray
 from ..core.registry import register_op, same_shape, OpSpec
 from ..core.sparse import SparseRows, is_sparse
 from ..core.types import np_dtype
@@ -51,7 +52,12 @@ def fill_constant_batch_size_like(ctx):
 @register_op("fill_zeros_like", infer_shape=same_shape("X", "Out"))
 def fill_zeros_like(ctx):
     x = ctx.input("X")
-    ctx.set_output("Out", like(x, jnp.zeros_like(data_of(x))))
+    if isinstance(x, LoDArray):
+        ctx.set_output("Out", like(x, jnp.zeros_like(data_of(x))))
+        return
+    # generic pytrees too (TensorArrayVal and other control-flow state get
+    # zero-filled grads when backward reaches a while/recurrent op)
+    ctx.set_output("Out", jax.tree_util.tree_map(jnp.zeros_like, x))
 
 
 @register_op("uniform_random")
