@@ -27,16 +27,18 @@ from typing import Callable, Optional
 class OpSpec:
     """A to-be-appended op description returned by grad makers.
 
-    ``overwrite_outputs``: output grads REPLACE any already-produced grad of
-    the same name instead of rename-and-sum accumulation — the in-place
-    loop-state contract (a while op rebinds its carried names, so the grad
-    w.r.t. the pre-loop value supersedes the post-loop cotangent once the
-    loop's grad op has consumed it)."""
+    ``overwrite_slots``: output slots whose grads REPLACE any already-
+    produced grad of the same name instead of rename-and-sum accumulation —
+    the in-place loop-state contract (a while op rebinds its carried names,
+    so the grad w.r.t. the pre-loop value supersedes the post-loop cotangent
+    once the loop's grad op has consumed it). Slots NOT listed keep normal
+    accumulation (a weight shared between the loop body and outside ops must
+    sum both contributions)."""
     type: str
     inputs: dict
     outputs: dict
     attrs: dict = dataclasses.field(default_factory=dict)
-    overwrite_outputs: bool = False
+    overwrite_slots: frozenset = frozenset()
 
 
 @dataclasses.dataclass

@@ -1,0 +1,10 @@
+"""Canonical dataset modules in the v2 API shape (reference
+python/paddle/v2/dataset/__init__.py): each module exposes reader creators
+(train()/test()) yielding the reference's exact sample schema, reading real
+cached files from DATA_HOME when present and deterministic synthetic
+stand-ins otherwise (no network egress here — see common.download).
+"""
+
+from . import common, mnist, cifar, imdb, uci_housing
+
+__all__ = ["common", "mnist", "cifar", "imdb", "uci_housing"]

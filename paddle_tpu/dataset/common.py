@@ -1,0 +1,59 @@
+"""Dataset plumbing (reference python/paddle/v2/dataset/common.py):
+DATA_HOME cache dir, md5-checked download, and reader→recordio conversion.
+
+This environment has no network egress, so ``download`` only serves files
+already placed in DATA_HOME (with md5 verification, the reference contract);
+each dataset module falls back to a deterministic synthetic generator of the
+same sample schema when the canonical files are absent, keeping the v2
+dataset API usable offline (the shapes/dtypes/readers are the parity
+surface; the bytes are stand-ins).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum):
+    """Return the cached path for ``url`` if present and md5-valid.
+    Raises FileNotFoundError otherwise (no egress here — drop the file into
+    DATA_HOME/<module_name>/ manually to use the real dataset)."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(dirname, url.split("/")[-1])
+    if os.path.exists(filename):
+        if md5sum is None or md5file(filename) == md5sum:
+            return filename
+        raise IOError(f"{filename}: md5 mismatch")
+    raise FileNotFoundError(
+        f"{filename} not cached and this environment has no network; "
+        f"place the file there or use the synthetic fallback readers")
+
+
+def have_file(url, module_name, md5sum=None):
+    try:
+        download(url, module_name, md5sum)
+        return True
+    except (FileNotFoundError, IOError):
+        return False
+
+
+def convert(output_dir, reader, name, max_records=1000):
+    """reader → recordio shards (reference common.convert)."""
+    from ..reader.creator import convert_reader_to_recordio_file
+
+    path = os.path.join(output_dir, name + ".recordio")
+    os.makedirs(output_dir, exist_ok=True)
+    convert_reader_to_recordio_file(path, reader, max_records=max_records)
+    return path

@@ -131,7 +131,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
                         # still produce it (XLA DCEs it); cheaper than
                         # rewriting the grad op's outputs
                         pass
-                    if n in produced and not spec.overwrite_outputs:
+                    if n in produced and slot not in spec.overwrite_slots:
                         tmp = unique_name(n + "@RENAME")
                         _create_grad_var(block, fwd, tmp)
                         renames[n] = tmp

@@ -22,14 +22,15 @@ def _is_float_dtype(dtype):
 
 
 def _free_float_reads(program, sub_idx, locals_):
-    """Float-typed outer vars a sub-block reads before writing (the weights)
-    — the grad surface of a control-flow op."""
+    """Float-typed outer vars a sub-block reads before writing — the grad
+    surface of a control-flow op: weights AND float tensor arrays (values
+    staged through array_write from trainable computations must backprop;
+    write_to_array_grad routes the array grad back to its producers)."""
     from ...core.block_walk import free_reads
 
     blk = program.blocks[sub_idx]
     return [n for n in free_reads(program, sub_idx, locals_)
-            if blk.has_var(n) and _is_float_dtype(blk.var(n).dtype)
-            and not getattr(blk.var(n), "is_tensor_array", False)]
+            if blk.has_var(n) and _is_float_dtype(blk.var(n).dtype)]
 
 
 def _block_written_names(program, sub_idx):

@@ -78,7 +78,22 @@ def _as_scalar_i32(v):
     return data_of(v).reshape(()).astype(jnp.int32)
 
 
-@register_op("write_to_array")
+def _write_to_array_grad_maker(op):
+    """Backward of the in-place array write: the element grad is the array
+    grad's slot i; the array grad loses slot i (overwrite — the array name
+    is rebound in place, like while's carried state). This is what lets
+    parameters STAGED through tensor arrays into a While loop train
+    (reference write_to_array's grad in backward.py sub-block handling)."""
+    return [OpSpec(
+        "write_to_array_grad",
+        {"I": op.input("I"), "Out@GRAD": G(op.output("Out"))},
+        {"X@GRAD": G(op.input("X")),
+         "Array@GRAD": G(op.input("Array")) if op.input("Array") else []},
+        dict(op.attrs),
+        overwrite_slots=frozenset({"Array@GRAD"}))]
+
+
+@register_op("write_to_array", grad=_write_to_array_grad_maker)
 def write_to_array(ctx):
     x = ctx.input("X")
     xd = x.data if isinstance(x, LoDArray) else data_of(x)
@@ -98,12 +113,40 @@ def write_to_array(ctx):
     ctx.set_output("Out", TensorArrayVal(new_data, new_len))
 
 
-@register_op("read_from_array")
+@register_op("write_to_array_grad")
+def write_to_array_grad(ctx):
+    g = ctx.input("Out@GRAD")          # TensorArrayVal-shaped grad
+    i = _as_scalar_i32(ctx.input("I"))
+    ctx.set_output("X@GRAD", jax.lax.dynamic_index_in_dim(
+        g.data, i, axis=0, keepdims=False))
+    if ctx.op.output("Array@GRAD"):
+        zero_slot = jnp.zeros(g.data.shape[1:], g.data.dtype)
+        ctx.set_output("Array@GRAD", TensorArrayVal(
+            jax.lax.dynamic_update_index_in_dim(g.data, zero_slot, i,
+                                                axis=0), g.length))
+
+
+@register_op("read_from_array", grad=lambda op: [OpSpec(
+    "read_from_array_grad",
+    {"X": op.input("X"), "I": op.input("I"),
+     "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))})])
 def read_from_array(ctx):
     arr = ctx.input("X")
     i = _as_scalar_i32(ctx.input("I"))
     ctx.set_output("Out", jax.lax.dynamic_index_in_dim(arr.data, i, axis=0,
                                                        keepdims=False))
+
+
+@register_op("read_from_array_grad")
+def read_from_array_grad(ctx):
+    arr = ctx.input("X")
+    i = _as_scalar_i32(ctx.input("I"))
+    dy = data_of(ctx.input("Out@GRAD"))
+    zeros = jnp.zeros_like(arr.data)
+    ctx.set_output("X@GRAD", TensorArrayVal(
+        jax.lax.dynamic_update_index_in_dim(zeros, dy.astype(zeros.dtype),
+                                            i, axis=0), arr.length))
 
 
 @register_op("array_length")
@@ -159,7 +202,7 @@ def _while_grad_maker(op):
          "OutGrads": G(op.output("Out"))},
         {"DiffGrads": G(diff), "CarriedGrads": G(carried)},
         dict(op.attrs),
-        overwrite_outputs=True)]
+        overwrite_slots=frozenset({"CarriedGrads"}))]
 
 
 def _while_scan(exec_state, sub, env_base, pre, carried, cond_name,
@@ -246,8 +289,7 @@ def while_grad(ctx):
     carried = list(attr("carried", []))
     max_iters = int(attr("max_iters"))
     all_diff = list(attr("diff_vars", []))
-    diff_names = [n for n in all_diff if jnp.issubdtype(
-        jnp.asarray(data_of(env[n])).dtype, jnp.floating)]
+    diff_names = [n for n in all_diff if _has_float_leaf(env[n])]
 
     from ..fluid.framework import grad_var_name
 
@@ -298,26 +340,7 @@ def while_grad(ctx):
             treedef, [ct_leaf(o, gl) for o, gl in zip(out_leaves, g_leaves)])
 
     (w_grads, pre_grads) = vjp(cts)
-
-    def _zero_float0(g, like_v):
-        """Replace float0 leaves (ints) with integer zeros so downstream
-        consumers see well-typed values."""
-        return jax.tree_util.tree_map(
-            lambda gl, ol: jnp.zeros_like(ol)
-            if getattr(gl, "dtype", None) == jax.dtypes.float0 else gl,
-            g, like_v)
-
-    out_vals = []
-    for n in all_diff:
-        old = env[n]
-        if n in w_grads:
-            g = w_grads[n]
-            if isinstance(old, LoDArray):
-                g = LoDArray(g, old.lens)
-        else:
-            g = jax.tree_util.tree_map(jnp.zeros_like, old)
-        out_vals.append(g)
-    ctx.set_outputs("DiffGrads", out_vals)
+    _emit_diff_grads(ctx, env, all_diff, w_grads)
 
     carried_grad_vals = []
     for n in attr("carried", []):
@@ -458,6 +481,38 @@ def dynamic_recurrent(ctx):
     _recurrent_fwd(ctx, lens=_dyn_lens(ctx))
 
 
+def _has_float_leaf(v):
+    return any(jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+               for l in jax.tree_util.tree_leaves(v))
+
+
+def _zero_float0(g, like_v):
+    """Replace float0 leaves (cotangents of integer leaves, e.g. a
+    TensorArrayVal's length) with typed zeros so downstream consumers see
+    well-formed values."""
+    return jax.tree_util.tree_map(
+        lambda gl, ol: jnp.zeros_like(ol)
+        if getattr(gl, "dtype", None) == jax.dtypes.float0 else gl,
+        g, like_v)
+
+
+def _emit_diff_grads(ctx, env, all_diff, grads):
+    """Write grads to the DECLARED DiffGrads output names in diff_vars order
+    (backward.py may have renamed an output for rename-and-sum
+    accumulation); missing/non-float entries get zeros."""
+    out_vals = []
+    for n in all_diff:
+        old = env[n]
+        if n in grads:
+            g = _zero_float0(grads[n], data_of(old))
+            if isinstance(old, LoDArray):
+                g = LoDArray(g, old.lens)
+        else:
+            g = jax.tree_util.tree_map(jnp.zeros_like, old)
+        out_vals.append(g)
+    ctx.set_outputs("DiffGrads", out_vals)
+
+
 def _recurrent_grad(ctx, lens):
     """Gradient THROUGH the scan: jax.vjp over the functionalized forward
     with respect to every differentiable outer input — step inputs, memory
@@ -475,8 +530,7 @@ def _recurrent_grad(ctx, lens):
     # differentiable outer vars (recorded float-typed at build time);
     # non-float runtime values (defensive) get zero grads
     all_diff = list(attr("diff_vars", []))
-    diff_names = [n for n in all_diff if jnp.issubdtype(
-        jnp.asarray(data_of(env[n])).dtype, jnp.floating)]
+    diff_names = [n for n in all_diff if _has_float_leaf(env[n])]
 
     prim = {n: data_of(env[n]) for n in diff_names}
 
@@ -501,19 +555,7 @@ def _recurrent_grad(ctx, lens):
     ct_finals = {m: cotangent(m + "@FINAL@GRAD", finals[m])
                  for m, _ in memories}
     (grads,) = vjp((ct_stacked, ct_finals))
-    # write to the DECLARED output names in diff_vars order (backward.py may
-    # have renamed an output for rename-and-sum accumulation)
-    out_vals = []
-    for n in all_diff:
-        old = env[n]
-        if n in grads:
-            g = grads[n]
-            if isinstance(old, LoDArray):
-                g = LoDArray(g, old.lens)
-        else:
-            g = jax.tree_util.tree_map(jnp.zeros_like, old)
-        out_vals.append(g)
-    ctx.set_outputs("DiffGrads", out_vals)
+    _emit_diff_grads(ctx, env, all_diff, grads)
 
 
 @register_op("recurrent_grad", is_control_flow=True)

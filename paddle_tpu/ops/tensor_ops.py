@@ -289,6 +289,17 @@ def sum_op(ctx):
     sum_op over SelectedRows appends rows); mixed dense+sparse densifies
     the sparse terms (sum_op.cc LoDTensor+SelectedRows mix)."""
     vs = ctx.inputs("X")
+    if vs and all(hasattr(v, "tree_flatten") and not isinstance(v, LoDArray)
+                  and not is_sparse(v) for v in vs):
+        # generic pytree values (TensorArrayVal grads accumulated across
+        # multiple array reads): leafwise sum, aux from the first
+        out = vs[0]
+        for v in vs[1:]:
+            out = jax.tree_util.tree_map(
+                lambda a, b: a + b if jnp.issubdtype(
+                    jnp.asarray(a).dtype, jnp.floating) else a, out, v)
+        ctx.set_output("Out", out)
+        return
     if any(is_sparse(v) for v in vs):
         if all(is_sparse(v) for v in vs):
             rows = jnp.concatenate([v.rows for v in vs])

@@ -2,9 +2,12 @@ from .decorator import (map_readers, buffered, compose, chain, shuffle,
                         ComposeNotAligned, firstn, xmap_readers, cache)
 from .minibatch import batch
 from .prefetch import DeviceFeedIterator, double_buffer
+from . import creator
+from .creator import convert_reader_to_recordio_file
 
 __all__ = [
     "map_readers", "buffered", "compose", "chain", "shuffle",
     "ComposeNotAligned", "firstn", "xmap_readers", "cache", "batch",
-    "DeviceFeedIterator", "double_buffer",
+    "DeviceFeedIterator", "double_buffer", "creator",
+    "convert_reader_to_recordio_file",
 ]

@@ -1,0 +1,72 @@
+"""Reader creators (reference python/paddle/v2/reader/creator.py):
+np_array, text_file, recordio — plus the fluid-side
+convert_reader_to_recordio_file (reference python/paddle/fluid/
+recordio_writer.py) so any sample reader round-trips through recordio files.
+
+Samples serialize as pickled tuples of numpy arrays/scalars — framework-
+independent, like the reference's LoDTensor wire form but without the
+protobuf dependency.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+__all__ = ["np_array", "text_file", "recordio",
+           "convert_reader_to_recordio_file"]
+
+
+def np_array(x):
+    """Reader yielding rows of a numpy array (reference creator.np_array)."""
+    import numpy as np
+
+    arr = np.asarray(x)
+
+    def reader():
+        for row in arr:
+            yield row
+
+    return reader
+
+
+def text_file(path):
+    """Reader yielding stripped lines (reference creator.text_file)."""
+
+    def reader():
+        with open(path) as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+    return reader
+
+
+def recordio(paths, decoder=pickle.loads):
+    """Reader over one or more recordio files (reference creator.recordio /
+    recordio(paths) with the cloud variant elided). ``decoder`` maps raw
+    record bytes to a sample."""
+    if isinstance(paths, str):
+        paths = paths.split(",")
+
+    def reader():
+        from ..recordio import Scanner
+        for p in paths:
+            for rec in Scanner(p):
+                yield decoder(rec)
+
+    return reader
+
+
+def convert_reader_to_recordio_file(path, reader, compressor="deflate",
+                                    max_records=1000,
+                                    encoder=pickle.dumps):
+    """Serialize every sample of ``reader`` into one recordio file; returns
+    the record count (reference recordio_writer.py
+    convert_reader_to_recordio_file)."""
+    from ..recordio import Writer
+
+    n = 0
+    with Writer(path, compressor=compressor, max_records=max_records) as w:
+        for sample in reader():
+            w.write(encoder(sample))
+            n += 1
+    return n

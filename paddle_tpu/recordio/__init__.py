@@ -1,0 +1,245 @@
+"""RecordIO: chunked record files with CRC + optional deflate compression.
+
+Native core: paddle_tpu/native/recordio.cc (C ABI, built on demand into
+librecordio.so with g++ -shared -lz), the TPU-framework analog of the
+reference's /root/reference/paddle/fluid/recordio/ (header.h:39,
+chunk.h:26, writer.h, scanner.h). A pure-Python implementation of the
+IDENTICAL on-disk format (struct + zlib) is the fallback when no compiler
+is available; both paths are covered by tests/test_recordio.py including
+cross-backend round-trips and checksum-corruption detection (the
+reference's WrongChecksum contract, go/pserver/service.go:53).
+
+API:
+    with Writer(path, compressor="deflate") as w: w.write(b"...")
+    for rec in Scanner(path): ...
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import zlib
+
+_FILE_MAGIC = b"PTRC0001"
+_CHUNK_MAGIC = 0x43485054
+_RAW, _DEFLATE = 0, 1
+_COMPRESSORS = {"raw": _RAW, "deflate": _DEFLATE}
+
+
+class CorruptRecordIO(Exception):
+    pass
+
+
+class WrongChecksum(CorruptRecordIO):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# native backend (ctypes over librecordio.so, compiled lazily)
+# ---------------------------------------------------------------------------
+
+_LIB = None
+_LIB_TRIED = False
+
+
+def _native_lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(here, "..", "native", "recordio.cc")
+    so = os.path.join(here, "librecordio.so")
+    try:
+        if not os.path.exists(so) or (os.path.exists(src) and
+                                      os.path.getmtime(src)
+                                      > os.path.getmtime(so)):
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", so, src, "-lz"],
+                check=True, capture_output=True)
+        lib = ctypes.CDLL(so)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    lib.ptrc_writer_open.restype = ctypes.c_void_p
+    lib.ptrc_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                     ctypes.c_int, ctypes.c_uint64]
+    lib.ptrc_writer_write.restype = ctypes.c_int
+    lib.ptrc_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint64]
+    lib.ptrc_writer_close.restype = ctypes.c_int
+    lib.ptrc_writer_close.argtypes = [ctypes.c_void_p]
+    lib.ptrc_scanner_open.restype = ctypes.c_void_p
+    lib.ptrc_scanner_open.argtypes = [ctypes.c_char_p]
+    lib.ptrc_scanner_next.restype = ctypes.c_int64
+    lib.ptrc_scanner_next.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_char_p)]
+    lib.ptrc_scanner_close.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return _LIB
+
+
+class Writer:
+    """Append records; chunks flush at max_records/max_bytes boundaries
+    (reference recordio/writer.h)."""
+
+    def __init__(self, path, compressor="deflate", max_records=1000,
+                 max_bytes=1 << 20, backend=None):
+        self._comp = _COMPRESSORS[compressor]
+        self._closed = False
+        lib = _native_lib() if backend in (None, "native") else None
+        if lib is not None:
+            self._lib = lib
+            self._h = lib.ptrc_writer_open(path.encode(), self._comp,
+                                           max_records, max_bytes)
+            if not self._h:
+                raise OSError(f"cannot open {path!r} for writing")
+            return
+        if backend == "native":
+            raise RuntimeError("native recordio backend unavailable")
+        # pure-python fallback, identical format
+        self._lib = None
+        self._f = open(path, "wb")
+        self._f.write(_FILE_MAGIC)
+        self._buf = bytearray()
+        self._n = 0
+        self._max_records = max_records
+        self._max_bytes = max_bytes
+
+    def write(self, data: bytes):
+        assert not self._closed
+        if self._lib is not None:
+            rc = self._lib.ptrc_writer_write(self._h, data, len(data))
+            if rc != 0:
+                raise OSError("recordio write failed")
+            return
+        self._buf += struct.pack("<I", len(data)) + data
+        self._n += 1
+        if self._n >= self._max_records or len(self._buf) >= self._max_bytes:
+            self._flush()
+
+    def _flush(self):
+        if self._n == 0:
+            return
+        raw = bytes(self._buf)
+        payload = zlib.compress(raw) if self._comp == _DEFLATE else raw
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        self._f.write(struct.pack("<IIIQQI", _CHUNK_MAGIC, self._n,
+                                  self._comp, len(raw), len(payload), crc))
+        self._f.write(payload)
+        self._buf = bytearray()
+        self._n = 0
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self._lib is not None:
+            rc = self._lib.ptrc_writer_close(self._h)
+            if rc != 0:
+                raise OSError("recordio close failed")
+            return
+        self._flush()
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Scanner:
+    """Iterate records; verifies each chunk's CRC before use (reference
+    recordio/scanner.h + WrongChecksum)."""
+
+    def __init__(self, path, backend=None):
+        self._path = path
+        lib = _native_lib() if backend in (None, "native") else None
+        if lib is not None:
+            self._lib = lib
+            self._h = lib.ptrc_scanner_open(path.encode())
+            if not self._h:
+                raise OSError(f"{path!r}: not a recordio file")
+            return
+        if backend == "native":
+            raise RuntimeError("native recordio backend unavailable")
+        self._lib = None
+        self._f = open(path, "rb")
+        if self._f.read(8) != _FILE_MAGIC:
+            self._f.close()
+            raise OSError(f"{path!r}: not a recordio file")
+        self._chunk = b""
+        self._pos = 0
+        self._remaining = 0
+        self._eof = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._lib is not None:
+            if self._h is None:       # already exhausted and closed
+                raise StopIteration
+            out = ctypes.c_char_p()
+            n = self._lib.ptrc_scanner_next(self._h, ctypes.byref(out))
+            if n == -1:
+                self._lib.ptrc_scanner_close(self._h)
+                self._h = None
+                raise StopIteration
+            if n == -3:
+                raise WrongChecksum(self._path)
+            if n < 0:
+                raise CorruptRecordIO(self._path)
+            return ctypes.string_at(out, n)
+        if self._eof:
+            raise StopIteration
+        if self._remaining == 0 and not self._load_chunk():
+            self._eof = True
+            raise StopIteration
+        if self._pos + 4 > len(self._chunk):
+            raise CorruptRecordIO(self._path)
+        (ln,) = struct.unpack_from("<I", self._chunk, self._pos)
+        self._pos += 4
+        if self._pos + ln > len(self._chunk):
+            raise CorruptRecordIO(self._path)
+        rec = self._chunk[self._pos:self._pos + ln]
+        self._pos += ln
+        self._remaining -= 1
+        return rec
+
+    def _load_chunk(self):
+        head = self._f.read(32)
+        if not head:
+            self._f.close()
+            return False
+        if len(head) < 32:
+            raise CorruptRecordIO(self._path)
+        magic, n, comp, raw_len, pay_len, crc = struct.unpack("<IIIQQI",
+                                                              head)
+        if magic != _CHUNK_MAGIC:
+            raise CorruptRecordIO(self._path)
+        payload = self._f.read(pay_len)
+        if len(payload) != pay_len:
+            raise CorruptRecordIO(self._path)
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            raise WrongChecksum(self._path)
+        self._chunk = zlib.decompress(payload) if comp == _DEFLATE \
+            else payload
+        if len(self._chunk) != raw_len:
+            raise CorruptRecordIO(self._path)
+        self._pos = 0
+        self._remaining = n
+        return True
+
+
+def write_records(path, records, **kw):
+    with Writer(path, **kw) as w:
+        for r in records:
+            w.write(r)
+
+
+def read_records(path, **kw):
+    return list(Scanner(path, **kw))

@@ -68,9 +68,8 @@ def test_static_rnn_grad_matches_finite_difference():
     feed = {"x": rng.normal(0, 1, (batch, T, feat)).astype("float32"),
             "y": rng.normal(0, 0.5, (batch, hid)).astype("float32")}
 
-    # freeze a copy of all params; fetch analytic grads (lr=0 not needed --
-    # fetch before the sgd update applies? grads are fetched from the same
-    # run; sgd updates params after, so re-init scope per evaluation)
+    # each evaluation re-inits a fresh scope so the sgd update inside the
+    # program never perturbs the weights the finite difference probes
     def loss_at(param_name=None, idx=None, eps=0.0):
         s = fluid.Scope()
         exe.run(startup, scope=s)
@@ -78,8 +77,8 @@ def test_static_rnn_grad_matches_finite_difference():
             w = np.asarray(s.find_var(param_name)).copy()
             w.flat[idx] += eps
             s.set(param_name, w)
-        vals = s and exe.run(main, feed=feed,
-                             fetch_list=[loss, "hw@GRAD", "rw@GRAD"], scope=s)
+        vals = exe.run(main, feed=feed,
+                       fetch_list=[loss, "hw@GRAD", "rw@GRAD"], scope=s)
         return float(vals[0]), np.asarray(vals[1]), np.asarray(vals[2])
 
     _, ghw, grw = loss_at()
@@ -347,3 +346,57 @@ def test_while_carried_init_gradient(two_loops):
             num = (loss_np(wp) - loss_np(wm)) / (2 * eps)
             np.testing.assert_allclose(grads[n].flat[idx], num, rtol=5e-2,
                                        atol=1e-4), (n, idx)
+
+
+def test_while_param_staged_through_array_trains():
+    """Parameters whose values are STAGED through array_write and read
+    inside the While body must receive gradients (array grads route through
+    write_to_array_grad): the embedding below is only ever consumed via a
+    tensor array."""
+    batch, T, emb, hid = 4, 3, 5, 3
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 17
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[T], dtype="int64")
+        y = layers.data("y", shape=[hid])
+        pieces = layers.split(ids, T, dim=1)
+        arr = None
+        for t in range(T):
+            it = layers.fill_constant(shape=[1], dtype="int64", value=t)
+            e = layers.embedding(pieces[t], size=[11, emb],
+                                 param_attr=fluid.ParamAttr(name="staged_emb"))
+            e = layers.reshape(e, [batch, emb])
+            arr = layers.array_write(e, it, array=arr, cap=T)
+        i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+        limit = layers.fill_constant(shape=[1], dtype="int64", value=T)
+        acc = layers.fill_constant(shape=[batch, hid], dtype="float32",
+                                   value=0.0)
+        cond = layers.less_than(i, limit)
+        w = fluid.layers.While(cond, max_iters=T)
+        with w.block():
+            et = layers.array_read(arr, i)
+            h = layers.fc(et, size=hid, act="tanh",
+                          param_attr=fluid.ParamAttr(name="sw"),
+                          bias_attr=False)
+            layers.assign(layers.elementwise_add(acc, h), output=acc)
+            layers.increment(i, value=1)
+            layers.less_than(i, limit, cond=cond)
+        loss = layers.mean(layers.square(layers.elementwise_sub(acc, y)))
+        params_grads = fluid.optimizer.SGD(learning_rate=0.1).minimize(
+            loss, startup)
+
+    # the staged embedding must be in the trainable surface
+    assert "staged_emb" in {p.name for p, _ in params_grads}
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(9)
+    feed = {"ids": rng.randint(0, 11, (batch, T)).astype("int64"),
+            "y": rng.normal(0, 1, (batch, hid)).astype("float32")}
+    w0 = np.asarray(scope.find_var("staged_emb")).copy()
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss],
+                            scope=scope)[0]) for _ in range(40)]
+    w1 = np.asarray(scope.find_var("staged_emb"))
+    assert losses[-1] < 0.3 * losses[0], losses[::10]
+    assert np.abs(w1 - w0).max() > 1e-4  # the staged embedding moved
