@@ -1,0 +1,20 @@
+"""Host-side distributed services: parameter server (sync/async/bounded-
+staleness, sharded) and the elastic data-dispatch master.
+
+These complement the compile-time GSPMD sharding in paddle_tpu.parallel
+(which replaces the reference's NCCL/sync-gRPC data path with ICI
+collectives): what CANNOT be a collective — asynchronous SGD semantics,
+parameter-server-resident optimizer state, and elastic/fault-tolerant data
+dispatch with task leases and retries — runs as host services, mirroring
+the reference's listen_and_serv/ParameterServer2/Go-master designs
+(SURVEY.md §2.3). Everything is testable multiprocess-on-localhost
+(reference test_recv_op.py pattern).
+"""
+
+from .param_server import (ParameterServer, ParamClient, serve, shard_names,
+                           OPTIMIZERS)
+from .master import Master, MasterClient
+from .rpc import RpcServer, RpcClient
+
+__all__ = ["ParameterServer", "ParamClient", "serve", "shard_names",
+           "OPTIMIZERS", "Master", "MasterClient", "RpcServer", "RpcClient"]

@@ -1,0 +1,287 @@
+"""Host-side parameter server: sync barriers, async SGD, bounded staleness.
+
+Reference capabilities reproduced (SURVEY.md §2.3 "DP (sync+async)"):
+
+* sync mode — the listen_and_serv loop: block until ``fan_in`` trainers have
+  pushed gradients + batch barriers, aggregate, run the optimizer, release
+  (operators/listen_and_serv_op.cc:102-165; trainer side send_op.cc:52-103
+  send-all -> batch barrier -> get-all).
+* async mode — ParameterServer2-style asyncSGD (pserver/ParameterServer2.h:
+  468): each push applies immediately; trainers proceed without waiting for
+  each other, bounded by ``max_staleness`` (a trainer more than that many
+  steps ahead of the slowest blocks — the async-SGD staleness control the
+  legacy controlRate/protection logic provides).
+* sharding — parameters round-robin across servers by name
+  (distribute_transpiler.py:92 split_dense_variable + round robin
+  distributed_spliter.py:16), optimizer state living WITH the shard
+  (the Go pserver runs the optimizer in-server, go/pserver/optimizer.go).
+
+The server is pure numpy (no jax): it runs as a plain OS process, the way
+the reference pserver is a separate binary; trainers are this framework's
+executors pushing fetched gradients.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .rpc import RpcServer, RpcClient
+
+
+# ---------------------------------------------------------------------------
+# server-side optimizers (the paddle/optimizer C++ lib the Go pserver links,
+# /root/reference/paddle/optimizer/parameter_optimizer.h — numpy here)
+# ---------------------------------------------------------------------------
+
+class SgdRule:
+    def __init__(self, lr=0.01):
+        self.lr = lr
+
+    def init(self, value):
+        return {}
+
+    def apply(self, value, grad, state):
+        return value - self.lr * grad
+
+
+class MomentumRule:
+    def __init__(self, lr=0.01, mu=0.9):
+        self.lr, self.mu = lr, mu
+
+    def init(self, value):
+        return {"velocity": np.zeros_like(value)}
+
+    def apply(self, value, grad, state):
+        state["velocity"] = self.mu * state["velocity"] + grad
+        return value - self.lr * state["velocity"]
+
+
+class AdamRule:
+    def __init__(self, lr=0.001, b1=0.9, b2=0.999, eps=1e-8):
+        self.lr, self.b1, self.b2, self.eps = lr, b1, b2, eps
+
+    def init(self, value):
+        return {"m1": np.zeros_like(value), "m2": np.zeros_like(value),
+                "t": 0}
+
+    def apply(self, value, grad, state):
+        state["t"] += 1
+        state["m1"] = self.b1 * state["m1"] + (1 - self.b1) * grad
+        state["m2"] = self.b2 * state["m2"] + (1 - self.b2) * grad * grad
+        lr = self.lr * np.sqrt(1 - self.b2 ** state["t"]) \
+            / (1 - self.b1 ** state["t"])
+        return value - lr * state["m1"] / (np.sqrt(state["m2"]) + self.eps)
+
+
+OPTIMIZERS = {"sgd": SgdRule, "momentum": MomentumRule, "adam": AdamRule}
+
+
+class ParameterServer:
+    """One shard server. mode="sync" aggregates fan_in pushes per step;
+    mode="async" applies each push immediately with bounded staleness."""
+
+    def __init__(self, optimizer="sgd", opt_kwargs=None, mode="async",
+                 fan_in=1, max_staleness=None):
+        self._rule = OPTIMIZERS[optimizer](**(opt_kwargs or {}))
+        self._mode = mode
+        self._fan_in = fan_in
+        self._max_staleness = max_staleness
+        self._params = {}
+        self._opt_state = {}
+        self._lock = threading.Condition()
+        # sync-mode accumulation
+        self._pending = {}
+        self._push_count = 0
+        self._round = 0
+        self._broken_round = -1  # round invalidated by a barrier timeout
+        # async-mode staleness tracking
+        self._trainer_steps = {}
+
+    # ---- RPC surface ----
+    def init_params(self, params):
+        """First trainer wins (reference: startup program runs once;
+        go/pserver InitParam)."""
+        with self._lock:
+            for name, value in params.items():
+                if name not in self._params:
+                    self._params[name] = np.asarray(value, np.float32)
+                    self._opt_state[name] = self._rule.init(self._params[name])
+            return True
+
+    def pull(self, names=None):
+        with self._lock:
+            names = names or list(self._params)
+            return {n: self._params[n] for n in names}
+
+    def push(self, grads, trainer_id=0):
+        if self._mode == "sync":
+            return self._push_sync(grads)
+        return self._push_async(grads, trainer_id)
+
+    def _push_sync(self, grads):
+        """Accumulate; the fan_in-th push triggers the optimize step and
+        wakes all waiters (the batch-barrier contract)."""
+        with self._lock:
+            my_round = self._round
+            for n, g in grads.items():
+                acc = self._pending.get(n)
+                self._pending[n] = np.asarray(g, np.float32) if acc is None \
+                    else acc + np.asarray(g, np.float32)
+            self._push_count += 1
+            if self._push_count >= self._fan_in:
+                for n, g in self._pending.items():
+                    self._params[n] = self._rule.apply(
+                        self._params[n], g / self._fan_in,
+                        self._opt_state[n])
+                self._pending = {}
+                self._push_count = 0
+                self._round += 1
+                self._lock.notify_all()
+            else:
+                while (self._round == my_round
+                       and self._broken_round != my_round):
+                    if not self._lock.wait(timeout=60.0):
+                        # a dead trainer broke the barrier: discard the
+                        # whole round's partial aggregation so the next
+                        # round starts clean, and fail every waiter
+                        self._broken_round = my_round
+                        self._pending = {}
+                        self._push_count = 0
+                        self._lock.notify_all()
+                        raise TimeoutError("sync barrier timed out")
+                if self._broken_round == my_round:
+                    raise TimeoutError("sync barrier broken by a peer "
+                                       "timeout; round discarded")
+            return self._round
+
+    def _push_async(self, grads, trainer_id):
+        with self._lock:
+            if self._max_staleness is not None and self._trainer_steps:
+                # block while this trainer is too far ahead of the slowest
+                def too_fast():
+                    # check the step count AFTER this push would apply
+                    me = self._trainer_steps.get(trainer_id, 0) + 1
+                    others = [s for t, s in self._trainer_steps.items()
+                              if t != trainer_id]
+                    if not others:
+                        return False
+                    return me - min(others) > self._max_staleness
+
+                while too_fast():
+                    if not self._lock.wait(timeout=60.0):
+                        raise TimeoutError("staleness wait timed out")
+            for n, g in grads.items():
+                self._params[n] = self._rule.apply(
+                    self._params[n], np.asarray(g, np.float32),
+                    self._opt_state[n])
+            self._trainer_steps[trainer_id] = \
+                self._trainer_steps.get(trainer_id, 0) + 1
+            self._lock.notify_all()
+            return self._trainer_steps[trainer_id]
+
+    def stats(self):
+        with self._lock:
+            return {"params": sorted(self._params), "round": self._round,
+                    "trainer_steps": dict(self._trainer_steps)}
+
+
+def shard_names(names, n_shards):
+    """Round-robin placement (reference distributed_spliter.py:16
+    round_robin)."""
+    shards = [[] for _ in range(n_shards)]
+    for i, n in enumerate(sorted(names)):
+        shards[i % n_shards].append(n)
+    return shards
+
+
+def serve(optimizer="sgd", opt_kwargs=None, mode="async", fan_in=1,
+          max_staleness=None, address=("127.0.0.1", 0)):
+    """Start a ParameterServer's RPC loop in this process (call in a forked
+    child, the reference test_recv_op pattern). Returns (server, rpc)."""
+    ps = ParameterServer(optimizer, opt_kwargs, mode, fan_in, max_staleness)
+    rpc = RpcServer(ps, address)
+    return ps, rpc
+
+
+class ParamClient:
+    """Trainer-side client over one or more shard servers (reference
+    ParameterClient2 sharding, pserver/ParameterClient2.h:216).
+
+    Placement is DERIVED, not negotiated: round-robin over the sorted full
+    parameter-name list, so every trainer that knows the names (via
+    ``param_names`` or by calling ``init_params``) computes the identical
+    layout. Multi-shard pushes go out concurrently — sequential pushes in
+    trainer-specific orders would deadlock sync-mode barriers across shards
+    (a lock-order inversion between trainers)."""
+
+    def __init__(self, addresses, trainer_id=0, param_names=None):
+        self._clients = [RpcClient(a) for a in addresses]
+        self._placement = {}  # name -> client index
+        self._trainer_id = trainer_id
+        if param_names is not None:
+            self._set_placement(param_names)
+
+    def _set_placement(self, names):
+        for idx, shard in enumerate(shard_names(names, len(self._clients))):
+            for n in shard:
+                self._placement[n] = idx
+
+    def _client_for(self, name):
+        if name not in self._placement:
+            raise KeyError(
+                f"unplaced parameter {name!r}: pass param_names= at "
+                "construction or call init_params first")
+        return self._clients[self._placement[name]]
+
+    def init_params(self, params):
+        self._set_placement(params)
+        by_client = {}
+        for n, v in params.items():
+            by_client.setdefault(self._placement[n], {})[n] = v
+        for idx, shard in by_client.items():
+            self._clients[idx].call("init_params", params=shard)
+
+    def push(self, grads):
+        by_client = {}
+        for n, g in grads.items():
+            self._client_for(n)  # raise the friendly error on misuse
+            by_client.setdefault(self._placement[n], {})[n] = g
+        if len(by_client) == 1:
+            (idx, shard), = by_client.items()
+            return {idx: self._clients[idx].call(
+                "push", grads=shard, trainer_id=self._trainer_id)}
+        out, errors = {}, []
+
+        def push_shard(idx, shard):
+            try:
+                out[idx] = self._clients[idx].call(
+                    "push", grads=shard, trainer_id=self._trainer_id)
+            except Exception as e:
+                errors.append(e)
+
+        ts = [threading.Thread(target=push_shard, args=(idx, shard))
+              for idx, shard in by_client.items()]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errors:
+            raise errors[0]
+        return out
+
+    def pull(self):
+        if not self._placement:
+            raise KeyError("no placement: pass param_names= at construction "
+                           "or call init_params first")
+        params = {}
+        for idx, c in enumerate(self._clients):
+            names = [n for n, i in self._placement.items() if i == idx]
+            if names:
+                params.update(c.call("pull", names=names))
+        return params
+
+    def close(self):
+        for c in self._clients:
+            c.close()

@@ -1,0 +1,118 @@
+"""Minimal request/response RPC over localhost TCP.
+
+The transport role of the reference's gRPC layer (/root/reference/paddle/
+fluid/operators/detail/grpc_server.h, grpc_client.h) and the legacy epoll
+ProtoServer (paddle/pserver/LightNetwork.h), scoped to what the TPU-native
+framework needs: the heavy tensor traffic rides ICI via GSPMD collectives
+(parallel/sharding.py); this host-side channel carries parameter-server and
+elastic-master control/payload messages between local processes, the way the
+reference tests them multiprocess-on-localhost
+(python/paddle/fluid/tests/unittests/test_recv_op.py:25-67).
+
+Wire form: pickled (method, kwargs) requests, pickled (ok, payload)
+responses over multiprocessing.connection (length-prefixed, authenticated).
+"""
+
+from __future__ import annotations
+
+import threading
+from multiprocessing.connection import Listener, Client
+
+AUTHKEY = b"paddle-tpu-rpc"
+
+
+class RpcServer:
+    """Serve ``handler`` (an object whose public methods are the RPC
+    surface) on ``address`` until ``shutdown`` is called or the process
+    dies. One thread per connection — the reference's completion-queue
+    concurrency scoped to localhost control traffic."""
+
+    def __init__(self, handler, address=("127.0.0.1", 0)):
+        self._handler = handler
+        self._listener = Listener(address, authkey=AUTHKEY)
+        self._stop = threading.Event()
+        self._threads = []
+
+    @property
+    def address(self):
+        return self._listener.address
+
+    def serve_forever(self):
+        while not self._stop.is_set():
+            try:
+                conn = self._listener.accept()
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def serve_in_thread(self):
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def _serve_conn(self, conn):
+        try:
+            while not self._stop.is_set():
+                try:
+                    method, kwargs = conn.recv()
+                except (EOFError, OSError):
+                    return
+                if method == "__shutdown__":
+                    conn.send((True, None))
+                    self.shutdown()
+                    return
+                try:
+                    fn = getattr(self._handler, method)
+                    conn.send((True, fn(**kwargs)))
+                except Exception as e:  # surface remote errors to the caller
+                    conn.send((False, f"{type(e).__name__}: {e}"))
+        finally:
+            conn.close()
+
+    def shutdown(self):
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class RpcClient:
+    """Blocking stub: client.call("method", key=value) -> payload.
+
+    A timed-out call DISCARDS the connection (the late response would
+    otherwise sit in the pipe and be returned as the answer to the next,
+    unrelated request); the next call reconnects."""
+
+    def __init__(self, address, timeout=90.0):
+        self._address = tuple(address) if isinstance(address, (list, tuple)) \
+            else address
+        self._conn = Client(self._address, authkey=AUTHKEY)
+        self._lock = threading.Lock()
+        self._timeout = timeout
+
+    def call(self, method, **kwargs):
+        with self._lock:
+            if self._conn is None:
+                self._conn = Client(self._address, authkey=AUTHKEY)
+            self._conn.send((method, kwargs))
+            if not self._conn.poll(self._timeout):
+                try:
+                    self._conn.close()
+                finally:
+                    self._conn = None
+                raise TimeoutError(f"rpc {method} timed out")
+            ok, payload = self._conn.recv()
+        if not ok:
+            raise RuntimeError(f"remote {method} failed: {payload}")
+        return payload
+
+    def close(self):
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
