@@ -38,10 +38,19 @@ class Task:
 class Master:
     """Task queue over data chunks with leases, retries and snapshots."""
 
-    def __init__(self, timeout_s=3.0, failure_max=3, snapshot_path=None):
+    def __init__(self, timeout_s=3.0, failure_max=3, snapshot_path=None,
+                 snapshot_every=64):
         self._timeout = timeout_s
         self._failure_max = failure_max
         self._snapshot_path = snapshot_path
+        # snapshotting rewrites the full queue: amortize it over
+        # ``snapshot_every`` state transitions (O(n) per snapshot would be
+        # O(n²) per pass if taken on every lease). A snapshot is at most
+        # snapshot_every events stale — harmless, since recovery requeues
+        # leased tasks anyway (finished-but-unsnapshotted tasks are simply
+        # re-done, the at-least-once elastic contract).
+        self._snapshot_every = max(1, int(snapshot_every))
+        self._events_since_snapshot = 0
         self._lock = threading.Lock()
         self._todo = []       # pending tasks
         self._doing = {}      # task_id -> Task (leased)
@@ -64,7 +73,7 @@ class Master:
             self._doing = {}
             self._done = []
             self._pass_id += 1
-            self._snapshot_locked()
+            self._snapshot_locked(force=True)
             return len(self._todo)
 
     def get_task(self):
@@ -132,9 +141,13 @@ class Master:
             else:
                 self._done.append(t)
 
-    def _snapshot_locked(self):
+    def _snapshot_locked(self, force=False):
         if not self._snapshot_path:
             return
+        self._events_since_snapshot += 1
+        if not force and self._events_since_snapshot < self._snapshot_every:
+            return
+        self._events_since_snapshot = 0
         state = {
             "todo": [t.snapshot() for t in self._todo]
             # leased tasks snapshot as pending: a restarted master must

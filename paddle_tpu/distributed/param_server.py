@@ -122,7 +122,10 @@ class ParameterServer:
 
     def _push_sync(self, grads):
         """Accumulate; the fan_in-th push triggers the optimize step and
-        wakes all waiters (the batch-barrier contract)."""
+        wakes all waiters (the batch-barrier contract). A barrier timeout
+        ABANDONS the round (advancing the round counter), so retried pushes
+        start a fresh aggregation rather than double-counting into the
+        broken one."""
         with self._lock:
             my_round = self._round
             for n, g in grads.items():
@@ -144,9 +147,11 @@ class ParameterServer:
                        and self._broken_round != my_round):
                     if not self._lock.wait(timeout=60.0):
                         # a dead trainer broke the barrier: discard the
-                        # whole round's partial aggregation so the next
-                        # round starts clean, and fail every waiter
+                        # whole round's partial aggregation AND advance the
+                        # round so retried pushes accumulate fresh, then
+                        # fail every waiter
                         self._broken_round = my_round
+                        self._round += 1
                         self._pending = {}
                         self._push_count = 0
                         self._lock.notify_all()

@@ -46,6 +46,9 @@ class RpcServer:
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True)
             t.start()
+            # prune finished handler threads so long-lived servers don't
+            # leak one Thread object per reconnect
+            self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
 
     def serve_in_thread(self):
@@ -94,25 +97,33 @@ class RpcClient:
         self._lock = threading.Lock()
         self._timeout = timeout
 
-    def call(self, method, **kwargs):
-        with self._lock:
-            if self._conn is None:
-                self._conn = Client(self._address, authkey=AUTHKEY)
-            self._conn.send((method, kwargs))
-            if not self._conn.poll(self._timeout):
-                try:
-                    self._conn.close()
-                finally:
-                    self._conn = None
-                raise TimeoutError(f"rpc {method} timed out")
-            ok, payload = self._conn.recv()
-        if not ok:
-            raise RuntimeError(f"remote {method} failed: {payload}")
-        return payload
-
-    def close(self):
+    def _drop_conn(self):
         if self._conn is not None:
             try:
                 self._conn.close()
             except OSError:
                 pass
+            self._conn = None
+
+    def call(self, method, **kwargs):
+        with self._lock:
+            if self._conn is None:
+                self._conn = Client(self._address, authkey=AUTHKEY)
+            try:
+                self._conn.send((method, kwargs))
+                if not self._conn.poll(self._timeout):
+                    self._drop_conn()
+                    raise TimeoutError(f"rpc {method} timed out")
+                ok, payload = self._conn.recv()
+            except (EOFError, OSError, BrokenPipeError):
+                # server died mid-call: discard the dead connection so the
+                # next call reconnects (to a restarted server)
+                self._drop_conn()
+                raise
+        if not ok:
+            raise RuntimeError(f"remote {method} failed: {payload}")
+        return payload
+
+    def close(self):
+        with self._lock:
+            self._drop_conn()

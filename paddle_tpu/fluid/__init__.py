@@ -24,6 +24,7 @@ from . import regularizer
 from . import clip
 from . import backward
 from . import io
+from . import evaluator
 from .backward import append_backward
 from .param_attr import ParamAttr
 from .data_feeder import DataFeeder

@@ -1,0 +1,127 @@
+"""Stateful evaluators accumulating metric states across batches.
+
+Reference: /root/reference/python/paddle/fluid/evaluator.py:42-254 —
+Evaluator base holds state variables reset per pass; Accuracy accumulates
+correct/total; ChunkEvaluator accumulates chunk counts. The reference keeps
+states as scope variables updated by graph ops; here states are plain host
+numpy (the metric ops emit per-batch stats to accumulate), which composes
+with any executor mode.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import layers
+
+__all__ = ["Evaluator", "Accuracy", "ChunkEvaluator", "Auc"]
+
+
+class Evaluator:
+    """Base: build metric ops at graph-construction time; accumulate their
+    fetched per-batch stats host-side; ``eval()`` folds them into the
+    metric; ``reset()`` starts a new pass (reference evaluator.py:42)."""
+
+    def __init__(self, name=None):
+        self._name = name or type(self).__name__
+
+    def reset(self):
+        raise NotImplementedError
+
+    # fetch list the caller must include in exe.run
+    @property
+    def metrics(self):
+        return self._metrics
+
+    def update(self, fetched):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class Accuracy(Evaluator):
+    """Accumulated top-k accuracy (reference evaluator.py Accuracy /
+    ChunkEvaluator shape)."""
+
+    def __init__(self, input, label, k=1, name=None):
+        super().__init__(name)
+        block = input.block
+        correct = block.create_var(name=f"{self._name}_correct",
+                                   dtype="int32", shape=())
+        total = block.create_var(name=f"{self._name}_total",
+                                 dtype="int32", shape=())
+        self._acc = layers.accuracy(input=input, label=label, k=k,
+                                    correct=correct, total=total)
+        self._metrics = [correct.name, total.name]
+        self.reset()
+
+    def reset(self):
+        self._correct = 0
+        self._total = 0
+
+    def update(self, fetched):
+        correct, total = fetched
+        self._correct += int(np.asarray(correct))
+        self._total += int(np.asarray(total))
+
+    def eval(self):
+        return self._correct / max(self._total, 1)
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulated chunking F1 (reference evaluator.py ChunkEvaluator)."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None, name=None):
+        super().__init__(name)
+        (_p, _r, _f, n_infer, n_label, n_correct) = layers.chunk_eval(
+            input=input, label=label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types)
+        self._metrics = [n_infer.name, n_label.name, n_correct.name]
+        self.reset()
+
+    def reset(self):
+        self._infer = self._label = self._correct = 0
+
+    def update(self, fetched):
+        n_infer, n_label, n_correct = fetched
+        self._infer += int(np.asarray(n_infer).ravel()[0])
+        self._label += int(np.asarray(n_label).ravel()[0])
+        self._correct += int(np.asarray(n_correct).ravel()[0])
+
+    def eval(self):
+        p = self._correct / self._infer if self._infer else 0.0
+        r = self._correct / self._label if self._label else 0.0
+        f1 = 2 * p * r / (p + r) if p + r else 0.0
+        return p, r, f1
+
+
+class Auc(Evaluator):
+    """Accumulated AUC: sums the thresholded TP/FN/TN/FP stat vectors across
+    batches and integrates at eval() (reference auc op's counters)."""
+
+    def __init__(self, input, label, curve="ROC", num_thresholds=200,
+                 name=None):
+        super().__init__(name)
+        self._curve = curve
+        _auc, stats = layers.auc(input=input, label=label, curve=curve,
+                                 num_thresholds=num_thresholds)
+        self._metrics = [s.name for s in stats]  # tp, fn, tn, fp
+        self._n = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stats = [np.zeros((self._n,), np.float64) for _ in range(4)]
+
+    def update(self, fetched):
+        for acc, batch in zip(self._stats, fetched):
+            acc += np.asarray(batch, np.float64)
+
+    def eval(self):
+        from ..ops.metrics import auc_from_stats
+        import jax.numpy as jnp
+
+        tp, fn, tn, fp = (jnp.asarray(s, jnp.float32) for s in self._stats)
+        return float(auc_from_stats(tp, fn, tn, fp, self._curve))

@@ -78,14 +78,23 @@ def load_persistables(executor, dirname, main_program=None):
 
 def _prune_program(program, feed_names, fetch_names):
     """Keep only ops needed to compute fetches from feeds (reference
-    framework/prune.cc via Program.prune, io.py:298-340)."""
+    framework/prune.cc via Program.prune, io.py:298-340). Persistable vars
+    (parameters, accumulators) are TERMINALS: at inference time they load
+    from disk, so their in-place producers (optimizer updates — which would
+    otherwise drag the whole backward pass in through ParamOut) are never
+    followed."""
     pruned = program.clone(for_test=True)
     block = pruned.global_block()
+
+    def is_persistable(name):
+        return block.has_var(name) and block.var(name).persistable
+
     needed = set(fetch_names)
     keep = []
     for i in reversed(range(len(block.ops))):
         op = block.ops[i]
-        if any(o in needed for o in op.output_arg_names()):
+        if any(o in needed and not is_persistable(o)
+               for o in op.output_arg_names()):
             keep.append(i)
             needed.update(op.input_arg_names())
     keep = set(keep)

@@ -6,6 +6,12 @@ flat namespace.
 """
 
 from . import nn, tensor, io, ops, sequence, control_flow
+from . import detection
+from . import metric
+from .detection import (prior_box, iou_similarity, box_coder,  # noqa: F401
+                        bipartite_match, target_assign, mine_hard_examples,
+                        multiclass_nms, detection_output, roi_pool)
+from .metric import auc, precision_recall, chunk_eval  # noqa: F401
 from . import learning_rate_scheduler
 from .learning_rate_scheduler import (noam_decay, exponential_decay,  # noqa: F401
                                       natural_exp_decay, inverse_time_decay,

@@ -1,0 +1,144 @@
+"""Detection layer API (reference python/paddle/fluid/layers/detection.py):
+prior_box, iou_similarity, box_coder, bipartite_match, target_assign,
+mine_hard_examples, multiclass_nms, detection_output, roi_pool.
+"""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["prior_box", "iou_similarity", "box_coder", "bipartite_match",
+           "target_assign", "mine_hard_examples", "multiclass_nms",
+           "detection_output", "roi_pool"]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+              variance=None, flip=False, clip=False, steps=None, offset=0.5,
+              name=None):
+    helper = LayerHelper("prior_box", name=name)
+    steps = steps or [0.0, 0.0]
+    boxes = helper.create_tmp_variable("float32")
+    var = helper.create_tmp_variable("float32")
+    helper.append_op(
+        "prior_box",
+        inputs={"Input": [input.name], "Image": [image.name]},
+        outputs={"Boxes": [boxes.name], "Variances": [var.name]},
+        attrs={"min_sizes": list(min_sizes),
+               "max_sizes": list(max_sizes or []),
+               "aspect_ratios": list(aspect_ratios or [1.0]),
+               "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+               "flip": flip, "clip": clip,
+               "step_w": steps[0], "step_h": steps[1], "offset": offset})
+    return boxes, var
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_tmp_variable(x.dtype, lod_level=x.lod_level)
+    helper.append_op("iou_similarity",
+                     inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", name=None):
+    helper = LayerHelper("box_coder", name=name)
+    out = helper.create_tmp_variable(target_box.dtype,
+                                     lod_level=target_box.lod_level)
+    helper.append_op(
+        "box_coder",
+        inputs={"PriorBox": [prior_box.name],
+                "PriorBoxVar": [prior_box_var.name],
+                "TargetBox": [target_box.name]},
+        outputs={"OutputBox": [out.name]},
+        attrs={"code_type": code_type})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    match_indices = helper.create_tmp_variable("int32")
+    match_dist = helper.create_tmp_variable(dist_matrix.dtype)
+    helper.append_op(
+        "bipartite_match",
+        inputs={"DistMat": [dist_matrix.name]},
+        outputs={"ColToRowMatchIndices": [match_indices.name],
+                 "ColToRowMatchDist": [match_dist.name]},
+        attrs={"match_type": match_type, "dist_threshold": dist_threshold})
+    return match_indices, match_dist
+
+
+def target_assign(input, match_indices, mismatch_value=0, name=None):
+    helper = LayerHelper("target_assign", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    out_weight = helper.create_tmp_variable("float32")
+    helper.append_op(
+        "target_assign",
+        inputs={"X": [input.name], "MatchIndices": [match_indices.name]},
+        outputs={"Out": [out.name], "OutWeight": [out_weight.name]},
+        attrs={"mismatch_value": mismatch_value})
+    return out, out_weight
+
+
+def mine_hard_examples(cls_loss, match_indices, match_dist=None,
+                       neg_pos_ratio=3.0, neg_dist_threshold=0.5, name=None):
+    helper = LayerHelper("mine_hard_examples", name=name)
+    neg_mask = helper.create_tmp_variable("int32")
+    updated = helper.create_tmp_variable("int32")
+    inputs = {"ClsLoss": [cls_loss.name],
+              "MatchIndices": [match_indices.name]}
+    if match_dist is not None:
+        inputs["MatchDist"] = [match_dist.name]
+    helper.append_op(
+        "mine_hard_examples", inputs=inputs,
+        outputs={"NegMask": [neg_mask.name],
+                 "UpdatedMatchIndices": [updated.name]},
+        attrs={"neg_pos_ratio": neg_pos_ratio,
+               "neg_dist_threshold": neg_dist_threshold})
+    return neg_mask, updated
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, nms_eta=1.0, background_label=0,
+                   name=None):
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = helper.create_tmp_variable(bboxes.dtype, lod_level=1)
+    helper.append_op(
+        "multiclass_nms",
+        inputs={"BBoxes": [bboxes.name], "Scores": [scores.name]},
+        outputs={"Out": [out.name]},
+        attrs={"background_label": background_label,
+               "score_threshold": score_threshold,
+               "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+               "nms_threshold": nms_threshold, "nms_eta": nms_eta})
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     name=None):
+    """SSD head postprocess (reference detection.py detection_output):
+    decode loc offsets against priors then multiclass NMS. ``loc``
+    [b, P, 4], ``scores`` [b, C, P]."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    # decode emits [b, P, 4] boxes already aligned per-prior
+    return multiclass_nms(decoded, scores, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold, nms_eta,
+                          background_label, name=name)
+
+
+def roi_pool(input, rois, pooled_height, pooled_width, spatial_scale=1.0,
+             name=None):
+    helper = LayerHelper("roi_pool", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(
+        "roi_pool",
+        inputs={"X": [input.name], "ROIs": [rois.name]},
+        outputs={"Out": [out.name]},
+        attrs={"pooled_height": pooled_height, "pooled_width": pooled_width,
+               "spatial_scale": spatial_scale})
+    return out

@@ -1,0 +1,72 @@
+"""Metric layer API (reference python/paddle/fluid/layers/metric.py: accuracy,
+auc; plus precision_recall and chunk_eval wrappers from detection/metric op
+groups)."""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["auc", "precision_recall", "chunk_eval"]
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, name=None):
+    """Batch AUC over input[:, 0] (reference layers/metric.py:auc).
+    Returns (auc, [tp, fn, tn, fp] stat vars for evaluator accumulation)."""
+    helper = LayerHelper("auc", name=name)
+    out = helper.create_tmp_variable("float32", shape=())
+    stats = [helper.create_tmp_variable("float32",
+                                        shape=(num_thresholds,))
+             for _ in range(4)]
+    helper.append_op(
+        "auc",
+        inputs={"Out": [input.name], "Label": [label.name]},
+        outputs={"AUC": [out.name], "TPOut": [stats[0].name],
+                 "FNOut": [stats[1].name], "TNOut": [stats[2].name],
+                 "FPOut": [stats[3].name]},
+        attrs={"curve": curve, "num_thresholds": num_thresholds})
+    return out, stats
+
+
+def precision_recall(indices, labels, class_number, weights=None,
+                     states_info=None, name=None):
+    """Returns (batch_metrics [6], accum_metrics [6], accum_states [C,4])."""
+    helper = LayerHelper("precision_recall", name=name)
+    batch = helper.create_tmp_variable("float32", shape=(6,))
+    accum = helper.create_tmp_variable("float32", shape=(6,))
+    states = helper.create_tmp_variable("float32", shape=(class_number, 4))
+    inputs = {"Indices": [indices.name], "Labels": [labels.name]}
+    if weights is not None:
+        inputs["Weights"] = [weights.name]
+    if states_info is not None:
+        inputs["StatesInfo"] = [states_info.name]
+    helper.append_op(
+        "precision_recall", inputs=inputs,
+        outputs={"BatchMetrics": [batch.name], "AccumMetrics": [accum.name],
+                 "AccumStatesInfo": [states.name]},
+        attrs={"class_number": class_number})
+    return batch, accum, states
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, name=None):
+    """Chunking F1 (reference layers/nn.py chunk_eval). Host-side op — run
+    it in an eager-mode evaluation program, like the reference's CPU-only
+    kernel. Returns (precision, recall, f1, n_infer, n_label, n_correct)."""
+    helper = LayerHelper("chunk_eval", name=name)
+    precision = helper.create_tmp_variable("float32", shape=(1,))
+    recall = helper.create_tmp_variable("float32", shape=(1,))
+    f1 = helper.create_tmp_variable("float32", shape=(1,))
+    n_infer = helper.create_tmp_variable("int64", shape=(1,))
+    n_label = helper.create_tmp_variable("int64", shape=(1,))
+    n_correct = helper.create_tmp_variable("int64", shape=(1,))
+    helper.append_op(
+        "chunk_eval",
+        inputs={"Inference": [input.name], "Label": [label.name]},
+        outputs={"Precision": [precision.name], "Recall": [recall.name],
+                 "F1-Score": [f1.name], "NumInferChunks": [n_infer.name],
+                 "NumLabelChunks": [n_label.name],
+                 "NumCorrectChunks": [n_correct.name]},
+        attrs={"chunk_scheme": chunk_scheme,
+               "num_chunk_types": num_chunk_types,
+               "excluded_chunk_types": list(excluded_chunk_types or [])})
+    return precision, recall, f1, n_infer, n_label, n_correct

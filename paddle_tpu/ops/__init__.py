@@ -23,4 +23,5 @@ from . import (  # noqa: F401
     ctc_ops,
     optimizer_ops,
     metrics,
+    detection_ops,
 )

@@ -1,0 +1,435 @@
+"""Detection (SSD) op family: prior_box, iou_similarity, box_coder,
+bipartite_match, target_assign, mine_hard_examples, multiclass_nms, roi_pool.
+
+Reference: /root/reference/paddle/fluid/operators/prior_box_op.h (cell-
+centered anchor generation), iou_similarity_op.h, box_coder_op.h
+(encode/decode center-size), bipartite_match_op.cc:55-135 (greedy global-max
+matching + per-prediction argmax), target_assign_op.h, mine_hard_examples_
+op.cc (max_negative mining), multiclass_nms_op.cc:100-250 (per-class NMSFast
+with adaptive eta threshold + cross-class keep_top_k), roi_pool_op.cc.
+
+TPU-native design: the reference runs all of these CPU-only (no CUDA
+kernels for the SSD set) in loops; here the vectorizable ones (iou,
+box_coder, prior_box, target_assign, roi_pool) are pure jnp broadcasting,
+and the inherently sequential ones (bipartite matching, NMS) are bounded
+``lax.fori_loop``s with masking over STATIC box counts — the standard
+compiled-NMS formulation — batched by jax.vmap. Ragged outputs
+(multiclass_nms's variable detection count) use the framework's padded
+LoDArray convention.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op
+from .common import data_of
+
+
+# ---------------------------------------------------------------------------
+# iou_similarity
+# ---------------------------------------------------------------------------
+
+def _iou_matrix(x, y, normalized=True):
+    """x [N,4], y [M,4] -> [N,M] Jaccard overlap
+    (multiclass_nms_op.cc:112-129 JaccardOverlap)."""
+    area = lambda b: jnp.maximum(b[..., 2] - b[..., 0], 0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0) if normalized else \
+        (b[..., 2] - b[..., 0] + 1) * (b[..., 3] - b[..., 1] + 1)
+    xi = x[:, None, :]
+    yi = y[None, :, :]
+    ix_min = jnp.maximum(xi[..., 0], yi[..., 0])
+    iy_min = jnp.maximum(xi[..., 1], yi[..., 1])
+    ix_max = jnp.minimum(xi[..., 2], yi[..., 2])
+    iy_max = jnp.minimum(xi[..., 3], yi[..., 3])
+    iw = jnp.maximum(ix_max - ix_min, 0.0)
+    ih = jnp.maximum(iy_max - iy_min, 0.0)
+    inter = iw * ih
+    union = area(xi) + area(yi) - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("iou_similarity")
+def iou_similarity(ctx):
+    xv = ctx.input("X")
+    x = data_of(xv)
+    y = data_of(ctx.input("Y"))
+    if x.ndim == 3:   # padded LoD batch [b, n, 4]
+        out = jax.vmap(lambda a: _iou_matrix(a, y))(x)
+        ctx.set_output("Out", LoDArray(out, xv.lens)
+                       if isinstance(xv, LoDArray) else out)
+        return
+    ctx.set_output("Out", _iou_matrix(x, y))
+
+
+# ---------------------------------------------------------------------------
+# prior_box
+# ---------------------------------------------------------------------------
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    out = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(ar)
+        if flip:
+            out.append(1.0 / ar)
+    return out
+
+
+@register_op("prior_box")
+def prior_box(ctx):
+    """Anchor boxes per feature-map cell (prior_box_op.h:88-165): per
+    min_size — [min, sqrt(min·max) if max, min·√ar for ar≠1...] — centered
+    at (w+offset)·step, normalized by image size, optionally clipped."""
+    feat = data_of(ctx.input("Input"))
+    img = data_of(ctx.input("Image"))
+    min_sizes = [float(s) for s in ctx.attr("min_sizes")]
+    max_sizes = [float(s) for s in ctx.attr("max_sizes", []) or []]
+    ars = _expand_aspect_ratios(
+        [float(a) for a in ctx.attr("aspect_ratios", [1.0])],
+        bool(ctx.attr("flip", False)))
+    variances = [float(v) for v in ctx.attr("variances",
+                                            [0.1, 0.1, 0.2, 0.2])]
+    clip = bool(ctx.attr("clip", False))
+    offset = float(ctx.attr("offset", 0.5))
+    ih, iw = img.shape[2], img.shape[3]
+    fh, fw = feat.shape[2], feat.shape[3]
+    step_w = float(ctx.attr("step_w", 0.0)) or iw / fw
+    step_h = float(ctx.attr("step_h", 0.0)) or ih / fh
+
+    # per-cell half-extents, in the reference's prior order
+    half = []
+    for s, mn in enumerate(min_sizes):
+        half.append((mn / 2.0, mn / 2.0))
+        if max_sizes:
+            mx = (mn * max_sizes[s]) ** 0.5
+            half.append((mx / 2.0, mx / 2.0))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            half.append((mn * ar ** 0.5 / 2.0, mn / ar ** 0.5 / 2.0))
+    half = jnp.asarray(half, jnp.float32)              # [P, 2] (w, h)
+    num_priors = half.shape[0]
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    cxg = jnp.broadcast_to(cx[None, :, None], (fh, fw, num_priors))
+    cyg = jnp.broadcast_to(cy[:, None, None], (fh, fw, num_priors))
+    bw = half[None, None, :, 0]
+    bh = half[None, None, :, 1]
+    boxes = jnp.stack([(cxg - bw) / iw, (cyg - bh) / ih,
+                       (cxg + bw) / iw, (cyg + bh) / ih], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (fh, fw, num_priors, 4))
+    ctx.set_output("Boxes", boxes)
+    ctx.set_output("Variances", var)
+
+
+# ---------------------------------------------------------------------------
+# box_coder
+# ---------------------------------------------------------------------------
+
+def _center_size(b):
+    w = b[..., 2] - b[..., 0]
+    h = b[..., 3] - b[..., 1]
+    cx = (b[..., 2] + b[..., 0]) / 2
+    cy = (b[..., 3] + b[..., 1]) / 2
+    return cx, cy, w, h
+
+
+@register_op("box_coder")
+def box_coder(ctx):
+    """encode_center_size / decode_center_size (box_coder_op.h:33-125).
+    encode: T [N,4] targets x P [M,4] priors -> [N,M,4] offsets;
+    decode: T [N,M,4] offsets + priors -> [N,M,4] corner boxes."""
+    prior = data_of(ctx.input("PriorBox"))
+    pvar = data_of(ctx.input("PriorBoxVar"))
+    tv = ctx.input("TargetBox")
+    target = data_of(tv)
+    code_type = ctx.attr("code_type", "encode_center_size")
+    pcx, pcy, pw, ph = _center_size(prior)            # [M]
+
+    if code_type == "encode_center_size":
+        tcx, tcy, tw, th = _center_size(target)       # [N]
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :] / pvar[None, :, 0],
+            (tcy[:, None] - pcy[None, :]) / ph[None, :] / pvar[None, :, 1],
+            jnp.log(jnp.abs(tw[:, None] / pw[None, :])) / pvar[None, :, 2],
+            jnp.log(jnp.abs(th[:, None] / ph[None, :])) / pvar[None, :, 3],
+        ], axis=-1)
+    else:
+        t = target if target.ndim == 3 else target[:, None, :]
+        cx = pvar[None, :, 0] * t[..., 0] * pw[None, :] + pcx[None, :]
+        cy = pvar[None, :, 1] * t[..., 1] * ph[None, :] + pcy[None, :]
+        w = jnp.exp(pvar[None, :, 2] * t[..., 2]) * pw[None, :]
+        h = jnp.exp(pvar[None, :, 3] * t[..., 3]) * ph[None, :]
+        out = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                        axis=-1)
+    ctx.set_output("OutputBox", LoDArray(out, tv.lens)
+                   if isinstance(tv, LoDArray) else out)
+
+
+# ---------------------------------------------------------------------------
+# bipartite_match
+# ---------------------------------------------------------------------------
+
+def _bipartite_match_single(dist, valid_rows):
+    """Greedy global-max matching (bipartite_match_op.cc:59-103): repeat
+    min(row,col) times — pick the max entry among unused rows/cols (>eps),
+    bind them. valid_rows masks padded LoD rows."""
+    row, col = dist.shape
+    eps = 1e-6
+    neg = jnp.asarray(-1.0, dist.dtype)
+
+    def body(_, carry):
+        match_idx, match_dist, row_used, col_used = carry
+        masked = jnp.where(row_used[:, None] | col_used[None, :]
+                           | (dist < eps), neg, dist)
+        flat = jnp.argmax(masked)
+        r, c = flat // col, flat % col
+        best = masked[r, c]
+        ok = best > 0
+        match_idx = jnp.where(ok, match_idx.at[c].set(r.astype(jnp.int32)),
+                              match_idx)
+        match_dist = jnp.where(ok, match_dist.at[c].set(best), match_dist)
+        row_used = jnp.where(ok, row_used.at[r].set(True), row_used)
+        col_used = jnp.where(ok, col_used.at[c].set(True), col_used)
+        return match_idx, match_dist, row_used, col_used
+
+    init = (jnp.full((col,), -1, jnp.int32), jnp.zeros((col,), dist.dtype),
+            ~valid_rows, jnp.zeros((col,), jnp.bool_))
+    match_idx, match_dist, _, _ = lax.fori_loop(
+        0, min(row, col), body, init)
+    return match_idx, match_dist
+
+
+def _argmax_match_extend(dist, match_idx, match_dist, valid_rows, thresh):
+    """ArgMaxMatch (bipartite_match_op.cc:105-135): unmatched columns take
+    their argmax row when overlap >= threshold."""
+    eps = 1e-6
+    masked = jnp.where(valid_rows[:, None], dist, -1.0)
+    best_row = jnp.argmax(masked, axis=0).astype(jnp.int32)
+    best = jnp.max(masked, axis=0)
+    take = (match_idx == -1) & (best >= thresh) & (best >= eps)
+    return (jnp.where(take, best_row, match_idx),
+            jnp.where(take, best, match_dist))
+
+
+@register_op("bipartite_match")
+def bipartite_match(ctx):
+    dv = ctx.input("DistMat")
+    dist = data_of(dv)
+    match_type = ctx.attr("match_type", "bipartite")
+    thresh = float(ctx.attr("dist_threshold", 0.5))
+    if dist.ndim == 2:
+        dist = dist[None]
+        lens = None
+    else:
+        lens = dv.lens if isinstance(dv, LoDArray) else None
+    b, row, col = dist.shape
+    valid = (jnp.arange(row)[None, :] < lens[:, None]) if lens is not None \
+        else jnp.ones((b, row), jnp.bool_)
+
+    def one(d, v):
+        mi, md = _bipartite_match_single(d, v)
+        if match_type == "per_prediction":
+            mi, md = _argmax_match_extend(d, mi, md, v, thresh)
+        return mi, md
+
+    mi, md = jax.vmap(one)(dist, valid)
+    ctx.set_output("ColToRowMatchIndices", mi)
+    ctx.set_output("ColToRowMatchDist", md)
+
+
+# ---------------------------------------------------------------------------
+# target_assign
+# ---------------------------------------------------------------------------
+
+@register_op("target_assign")
+def target_assign(ctx):
+    """out[i,j] = X[i, match[i,j]] where match >= 0 else mismatch_value;
+    weight 1 for matched, 0 otherwise (target_assign_op.h). X is the
+    (padded-LoD) per-image gt rows [b, n, K]."""
+    xv = ctx.input("X")
+    x = data_of(xv)
+    match = data_of(ctx.input("MatchIndices")).astype(jnp.int32)
+    mismatch = ctx.attr("mismatch_value", 0)
+    matched = match >= 0
+    safe = jnp.maximum(match, 0)
+    bidx = jnp.arange(x.shape[0])[:, None]
+    gathered = x[bidx, safe]                       # [b, col, K]
+    out = jnp.where(matched[..., None], gathered,
+                    jnp.asarray(mismatch, x.dtype))
+    ctx.set_output("Out", out)
+    ctx.set_output("OutWeight", matched[..., None].astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# mine_hard_examples
+# ---------------------------------------------------------------------------
+
+@register_op("mine_hard_examples")
+def mine_hard_examples(ctx):
+    """max_negative mining (mine_hard_examples_op.cc): per image, negatives
+    (match == -1) ranked by classification loss desc; keep
+    neg_pos_ratio * num_pos of them. Outputs a padded 0/1 NegMask [b, P]
+    (the dense equivalent of the reference's LoD NegIndices) and
+    UpdatedMatchIndices where unselected negatives stay -1."""
+    cls_loss = data_of(ctx.input("ClsLoss"))        # [b, P]
+    match = data_of(ctx.input("MatchIndices")).astype(jnp.int32)
+    neg_pos_ratio = float(ctx.attr("neg_pos_ratio", 3.0))
+    neg_overlap = float(ctx.attr("neg_dist_threshold", 0.5))
+    dist = data_of(ctx.input("MatchDist")) if ctx.has_input("MatchDist") \
+        else None
+
+    is_neg = match == -1
+    if dist is not None:
+        is_neg = is_neg & (dist < neg_overlap)
+    num_pos = jnp.sum(match >= 0, axis=1)
+    num_neg = jnp.minimum((num_pos * neg_pos_ratio).astype(jnp.int32),
+                          jnp.sum(is_neg, axis=1).astype(jnp.int32))
+    masked_loss = jnp.where(is_neg, cls_loss, -jnp.inf)
+    order = jnp.argsort(-masked_loss, axis=1)
+    rank = jnp.argsort(order, axis=1)               # rank of each prior
+    selected = (rank < num_neg[:, None]) & is_neg
+    ctx.set_output("NegMask", selected.astype(jnp.int32))
+    ctx.set_output("UpdatedMatchIndices",
+                   jnp.where(selected, -1, match).astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# multiclass_nms
+# ---------------------------------------------------------------------------
+
+def _nms_class(iou_all, scores, score_threshold, nms_threshold, eta, top_k):
+    """Compiled NMSFast (multiclass_nms_op.cc:134-172): sort desc, walk the
+    order keeping boxes whose IoU with every kept box <= the (eta-adaptive)
+    threshold. Returns a keep mask aligned with the boxes. ``iou_all`` is
+    the pairwise IoU computed ONCE per image (classes only differ in sort
+    order, so each class just permutes it)."""
+    n = scores.shape[0]
+    k = n if top_k < 0 else min(int(top_k), n)
+    order = jnp.argsort(-scores)
+    sscores = scores[order]
+    iou = iou_all[order][:, order]
+
+    def body(i, carry):
+        keep, thresh = carry
+        cand_ok = (sscores[i] > score_threshold)
+        sup = jnp.any(keep & (iou[i] > thresh) &
+                      (jnp.arange(n) < i))
+        ok = cand_ok & (~sup) & (i < k)
+        keep = keep.at[i].set(ok)
+        thresh = jnp.where(ok & (eta < 1.0) & (thresh > 0.5), thresh * eta,
+                           thresh)
+        return keep, thresh
+
+    keep, _ = lax.fori_loop(0, n, body,
+                            (jnp.zeros((n,), jnp.bool_),
+                             jnp.asarray(nms_threshold, jnp.float32)))
+    # unsort back to original indexing
+    inv = jnp.argsort(order)
+    return keep[inv]
+
+
+@register_op("multiclass_nms")
+def multiclass_nms(ctx):
+    """Per-class NMS + cross-class keep_top_k (multiclass_nms_op.cc:174-
+    250). Inputs BBoxes [b, P, 4], Scores [b, C, P]; output a LoDArray of
+    [b, keep_top_k, 6] rows (label, score, x1, y1, x2, y2), padded with
+    label -1 past each image's detection count (the reference emits
+    [num_kept, 6] with LoD; lens carries the counts here)."""
+    boxes = data_of(ctx.input("BBoxes"))
+    scores = data_of(ctx.input("Scores"))
+    bg = int(ctx.attr("background_label", 0))
+    score_threshold = float(ctx.attr("score_threshold"))
+    nms_top_k = int(ctx.attr("nms_top_k"))
+    keep_top_k = int(ctx.attr("keep_top_k"))
+    nms_threshold = float(ctx.attr("nms_threshold", 0.3))
+    eta = float(ctx.attr("nms_eta", 1.0))
+
+    b, C, P = scores.shape
+    K = keep_top_k if keep_top_k > 0 else C * P
+    # background never enters NMS (the reference skips it before NMSFast)
+    fg_classes = jnp.asarray([c for c in range(C) if c != bg]
+                             if 0 <= bg < C else list(range(C)), jnp.int32)
+
+    def one(bx, sc):
+        iou_all = _iou_matrix(bx, bx)               # once per image
+        fg_scores = sc[fg_classes]                  # [C', P]
+
+        def per_class(c_scores):
+            return _nms_class(iou_all, c_scores, score_threshold,
+                              nms_threshold, eta, nms_top_k)
+        keep = jax.vmap(per_class)(fg_scores)       # [C', P]
+        flat_scores = jnp.where(keep, fg_scores, -jnp.inf).reshape(-1)
+        k = min(K, int(fg_classes.shape[0]) * P)
+        top_scores, top_idx = lax.top_k(flat_scores, k)
+        label = fg_classes[top_idx // P].astype(jnp.float32)
+        pbox = bx[top_idx % P]
+        valid = top_scores > -jnp.inf
+        count = jnp.sum(valid).astype(jnp.int32)
+        rows = jnp.concatenate([
+            jnp.where(valid, label, -1.0)[:, None],
+            jnp.where(valid, top_scores, 0.0)[:, None],
+            jnp.where(valid[:, None], pbox, 0.0)], axis=1)
+        return rows, count
+
+    rows, counts = jax.vmap(one)(boxes, scores)
+    ctx.set_output("Out", LoDArray(rows, counts))
+
+
+# ---------------------------------------------------------------------------
+# roi_pool
+# ---------------------------------------------------------------------------
+
+@register_op("roi_pool")
+def roi_pool(ctx):
+    """Max-pool each ROI to [pooled_h, pooled_w] (roi_pool_op.cc; Fast
+    R-CNN). ROIs [R, 5] (batch_idx, x1, y1, x2, y2) at spatial_scale of the
+    NCHW input."""
+    x = data_of(ctx.input("X"))                     # [N, C, H, W]
+    rois = data_of(ctx.input("ROIs"))               # [R, 5]
+    ph = int(ctx.attr("pooled_height"))
+    pw = int(ctx.attr("pooled_width"))
+    scale = float(ctx.attr("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+
+    def one(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = x[bi]                                 # [C, H, W]
+        hh = jnp.arange(H)
+        ww = jnp.arange(W)
+
+        def cell(i, j):
+            hs = y1 + (i * rh) // ph
+            he = y1 + ((i + 1) * rh + ph - 1) // ph
+            ws = x1 + (j * rw) // pw
+            we = x1 + ((j + 1) * rw + pw - 1) // pw
+            hs, he = jnp.clip(hs, 0, H), jnp.clip(he, 0, H)
+            ws, we = jnp.clip(ws, 0, W), jnp.clip(we, 0, W)
+            m = ((hh[:, None] >= hs) & (hh[:, None] < he)
+                 & (ww[None, :] >= ws) & (ww[None, :] < we))
+            empty = ~(m.any())
+            vals = jnp.where(m[None], img, -jnp.inf)
+            mx = jnp.max(vals, axis=(1, 2))
+            return jnp.where(empty, 0.0, mx)        # [C]
+
+        ii = jnp.arange(ph)
+        jj = jnp.arange(pw)
+        grid = jax.vmap(lambda i: jax.vmap(lambda j: cell(i, j))(jj))(ii)
+        return jnp.transpose(grid, (2, 0, 1))       # [C, ph, pw]
+
+    ctx.set_output("Out", jax.vmap(one)(rois))

@@ -1,10 +1,17 @@
-"""Metric ops: accuracy, auc — reference accuracy_op.cu, auc_op.cc
-(/root/reference/paddle/fluid/operators/)."""
+"""Metric ops: accuracy, auc, precision_recall, chunk_eval.
+
+Reference: /root/reference/paddle/fluid/operators/accuracy_op.cc,
+auc_op.{cc,h} (thresholded TP/FN/TN/FP sweep over prediction column 0, ROC
+trapezoid or PR), precision_recall_op.{cc,h} (per-class TP/FP/TN/FN with
+macro/micro averaging and running state), chunk_eval_op.{cc,h} (chunk
+extraction from IOB/IOE/IOBES tag sequences, F1 over matched chunks).
+"""
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..core.lod import LoDArray
 from ..core.registry import register_op
 from .common import data_of
 
@@ -22,3 +29,186 @@ def accuracy(ctx):
                    (num_correct.astype(jnp.float32) / total).reshape(()))
     ctx.set_output("Correct", num_correct.reshape(()))
     ctx.set_output("Total", jnp.asarray(total, dtype=jnp.int32))
+
+
+def auc_from_stats(tp, fn, tn, fp, curve="ROC"):
+    """Trapezoidal area under the thresholded curve (auc_op.h:91-120)."""
+    eps = 1e-12
+    if curve == "PR":
+        x = tp / jnp.maximum(tp + fn, eps)          # recall
+        y = tp / jnp.maximum(tp + fp, eps)          # precision
+    else:
+        x = fp / jnp.maximum(fp + tn, eps)          # fpr
+        y = tp / jnp.maximum(tp + fn, eps)          # tpr
+    dx = x[:-1] - x[1:]           # thresholds ascending -> x descending
+    return jnp.sum(dx * (y[:-1] + y[1:]) / 2.0)
+
+
+@register_op("auc")
+def auc(ctx):
+    """Batch AUC over prediction column 0 vs binary labels at
+    ``num_thresholds`` thresholds (auc_op.h:29-120): curve="ROC" integrates
+    TPR over FPR by trapezoid; "PR" integrates precision over recall.
+    Emits TP/FN/TN/FP stat vectors so a stateful Evaluator can accumulate
+    across batches (the reference's Python evaluator pattern)."""
+    pred = data_of(ctx.input("Out"))
+    label = data_of(ctx.input("Label")).reshape(-1)
+    num_thresholds = int(ctx.attr("num_thresholds", 200))
+    curve = ctx.attr("curve", "ROC")
+
+    eps = 1e-7
+    inner = jnp.arange(1, num_thresholds - 1,
+                       dtype=jnp.float32) / (num_thresholds - 1)
+    thresholds = jnp.concatenate([jnp.asarray([-eps], jnp.float32), inner,
+                                  jnp.asarray([1.0 + eps], jnp.float32)])
+    score = pred[:, 0] if pred.ndim == 2 else pred.reshape(-1)
+    pos = label > 0
+    above = score[None, :] >= thresholds[:, None]       # [T, N]
+    tp = jnp.sum(above & pos[None, :], axis=1).astype(jnp.float32)
+    fn = jnp.sum((~above) & pos[None, :], axis=1).astype(jnp.float32)
+    fp = jnp.sum(above & (~pos[None, :]), axis=1).astype(jnp.float32)
+    tn = jnp.sum((~above) & (~pos[None, :]), axis=1).astype(jnp.float32)
+    ctx.set_output("TPOut", tp)
+    ctx.set_output("FNOut", fn)
+    ctx.set_output("TNOut", tn)
+    ctx.set_output("FPOut", fp)
+    ctx.set_output("AUC", auc_from_stats(tp, fn, tn, fp, curve).reshape(()))
+
+
+@register_op("precision_recall")
+def precision_recall(ctx):
+    """Per-class TP/FP/TN/FN + macro/micro precision/recall/F1
+    (precision_recall_op.h). Inputs: Indices [N,1] (predicted class),
+    Labels [N,1]; optional Weights [N] and StatesInfo [C,4] running state.
+    Outputs BatchMetrics [6] (macro P/R/F1, micro P/R/F1), AccumMetrics
+    [6], AccumStatesInfo [C,4] with columns (TP, FP, TN, FN)."""
+    idx = data_of(ctx.input("Indices")).reshape(-1)
+    labels = data_of(ctx.input("Labels")).reshape(-1)
+    C = int(ctx.attr("class_number"))
+    w = data_of(ctx.input("Weights")).reshape(-1).astype(jnp.float32) \
+        if ctx.has_input("Weights") \
+        else jnp.ones((idx.shape[0],), jnp.float32)
+    states = data_of(ctx.input("StatesInfo")) \
+        if ctx.has_input("StatesInfo") else jnp.zeros((C, 4), jnp.float32)
+
+    cls = jnp.arange(C)
+    pred_is = idx[None, :] == cls[:, None]          # [C, N]
+    lab_is = labels[None, :] == cls[:, None]
+    wf = w[None, :]
+    tp = jnp.sum((pred_is & lab_is) * wf, axis=1)
+    fp = jnp.sum((pred_is & ~lab_is) * wf, axis=1)
+    fn = jnp.sum((~pred_is & lab_is) * wf, axis=1)
+    tn = jnp.sum((~pred_is & ~lab_is) * wf, axis=1)
+    batch = jnp.stack([tp, fp, tn, fn], axis=1)      # [C, 4]
+    accum = states + batch
+
+    def metrics6(st):
+        tp_, fp_, fn_ = st[:, 0], st[:, 1], st[:, 3]
+        eps = 1e-12
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, eps),
+                         1.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, eps),
+                        1.0)
+        f1 = jnp.where(prec + rec > 0,
+                       2 * prec * rec / jnp.maximum(prec + rec, eps), 0.0)
+        macro = jnp.stack([prec.mean(), rec.mean(), f1.mean()])
+        tps, fps, fns = tp_.sum(), fp_.sum(), fn_.sum()
+        mprec = jnp.where(tps + fps > 0, tps / jnp.maximum(tps + fps, eps),
+                          1.0)
+        mrec = jnp.where(tps + fns > 0, tps / jnp.maximum(tps + fns, eps),
+                         1.0)
+        mf1 = jnp.where(mprec + mrec > 0,
+                        2 * mprec * mrec / jnp.maximum(mprec + mrec, eps),
+                        0.0)
+        return jnp.concatenate([macro, jnp.stack([mprec, mrec, mf1])])
+
+    ctx.set_output("BatchMetrics", metrics6(batch))
+    ctx.set_output("AccumMetrics", metrics6(accum))
+    ctx.set_output("AccumStatesInfo", accum)
+
+
+def extract_chunks(tags, scheme, num_chunk_types, excluded=()):
+    """Chunk extraction (mirrors chunk_eval_op.h GetSegments): returns a set
+    of (begin, end, type). Schemes: IOB (tag = type*2 + {0:B, 1:I}), IOE
+    (…{0:I, 1:E}), IOBES (type*4 + {B,I,E,S}), plain (tag == type).
+    Out-of-range tags are Outside."""
+    chunks = []
+    state = {"start": None, "type": None}
+    tags = [int(t) for t in tags]
+
+    def close(end):
+        if state["start"] is not None and state["type"] not in excluded:
+            chunks.append((state["start"], end, state["type"]))
+        state["start"] = state["type"] = None
+
+    for i, t in enumerate(tags):
+        if scheme == "plain":
+            ttype, pos = t, "S"
+            is_tag = 0 <= t < num_chunk_types
+        elif scheme == "IOB":
+            ttype, pos = t // 2, ("B" if t % 2 == 0 else "I")
+            is_tag = 0 <= t < num_chunk_types * 2
+        elif scheme == "IOE":
+            ttype, pos = t // 2, ("I" if t % 2 == 0 else "E")
+            is_tag = 0 <= t < num_chunk_types * 2
+        elif scheme == "IOBES":
+            ttype, pos = t // 4, "BIES"[t % 4]
+            is_tag = 0 <= t < num_chunk_types * 4
+        else:
+            raise ValueError(f"unknown chunk scheme {scheme!r}")
+        if not is_tag:
+            close(i - 1)
+            continue
+        if scheme == "plain":
+            if state["start"] is not None and ttype != state["type"]:
+                close(i - 1)
+            if state["start"] is None:
+                state["start"], state["type"] = i, ttype
+            continue
+        if pos in ("B", "S") or (state["start"] is not None
+                                 and ttype != state["type"]):
+            close(i - 1)
+        if state["start"] is None:
+            state["start"], state["type"] = i, ttype
+        if pos in ("E", "S"):
+            close(i)
+    close(len(tags) - 1)
+    return set(chunks)
+
+
+@register_op("chunk_eval")
+def chunk_eval(ctx):
+    """Chunking F1 over tagged sequences (chunk_eval_op.cc): precision =
+    |inference ∩ label chunks| / |inference chunks|, etc. LoD inputs; runs
+    host-side per sequence (the reference is CPU-only too)."""
+    import numpy as np
+
+    inf_v = ctx.input("Inference")
+    lab_v = ctx.input("Label")
+    scheme = ctx.attr("chunk_scheme", "IOB")
+    num_types = int(ctx.attr("num_chunk_types"))
+    excluded = tuple(ctx.attr("excluded_chunk_types", []) or [])
+
+    def seqs(v):
+        if isinstance(v, LoDArray):
+            data = np.asarray(v.data).reshape(v.data.shape[0], -1)
+            lens = np.asarray(v.lens)
+            return [data[i, :lens[i]] for i in range(len(lens))]
+        return [np.asarray(data_of(v)).reshape(-1)]
+
+    n_inf = n_lab = n_correct = 0
+    for inf, lab in zip(seqs(inf_v), seqs(lab_v)):
+        ic = extract_chunks(inf, scheme, num_types, excluded)
+        lc = extract_chunks(lab, scheme, num_types, excluded)
+        n_inf += len(ic)
+        n_lab += len(lc)
+        n_correct += len(ic & lc)
+    p = n_correct / n_inf if n_inf else 0.0
+    r = n_correct / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    ctx.set_output("Precision", jnp.asarray([p], jnp.float32))
+    ctx.set_output("Recall", jnp.asarray([r], jnp.float32))
+    ctx.set_output("F1-Score", jnp.asarray([f1], jnp.float32))
+    ctx.set_output("NumInferChunks", jnp.asarray([n_inf], jnp.int64))
+    ctx.set_output("NumLabelChunks", jnp.asarray([n_lab], jnp.int64))
+    ctx.set_output("NumCorrectChunks", jnp.asarray([n_correct], jnp.int64))

@@ -246,23 +246,39 @@ def transpose_grad(ctx):
     ctx.set_output("X@GRAD", jnp.transpose(d, inv))
 
 
+def _concat_axis(ctx, vs):
+    """LoD inputs see the reference's flat [rows, feat] axis numbering; the
+    padded [b, T, feat] layout shifts positive axes by one (the same
+    convention as mul's x_num_col_dims, ops/matmul.py)."""
+    axis = ctx.attr("axis", 0)
+    if any(isinstance(v, LoDArray) for v in vs) and axis >= 0:
+        if axis == 0:
+            raise ValueError("concat along the LoD rows axis is not "
+                             "supported; use sequence_concat")
+        axis += 1
+    return axis
+
+
 @register_op("concat", grad=lambda op: [OpSpec(
     "concat_grad",
     {"X": op.input("X"), "Out@GRAD": G(op.output("Out"))},
     {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
 def concat(ctx):
-    xs = [data_of(v) for v in ctx.inputs("X")]
-    ctx.set_output("Out", jnp.concatenate(xs, axis=ctx.attr("axis", 0)))
+    vs = ctx.inputs("X")
+    xs = [data_of(v) for v in vs]
+    out = jnp.concatenate(xs, axis=_concat_axis(ctx, vs))
+    ctx.set_output("Out", like(vs[0], out))
 
 
 @register_op("concat_grad")
 def concat_grad(ctx):
-    xs = [data_of(v) for v in ctx.inputs("X")]
+    vs = ctx.inputs("X")
+    xs = [data_of(v) for v in vs]
     d = data_of(ctx.input("Out@GRAD"))
-    axis = ctx.attr("axis", 0)
+    axis = _concat_axis(ctx, vs)
     sizes = np.cumsum([x.shape[axis] for x in xs])[:-1]
     parts = jnp.split(d, sizes, axis=axis)
-    ctx.set_outputs("X@GRAD", parts)
+    ctx.set_outputs("X@GRAD", [like(v, p) for v, p in zip(vs, parts)])
 
 
 @register_op("split", grad=lambda op: [OpSpec(

@@ -1,0 +1,138 @@
+"""Book chapter: label_semantic_roles (SRL with linear-chain CRF).
+
+Reference: /root/reference/python/paddle/fluid/tests/book/
+test_label_semantic_roles.py — word + predicate + context-mark embeddings
+(is_sparse) into a mixed hidden layer and stacked bidirectional-ish LSTMs,
+trained with linear_chain_crf NLL and decoded with crf_decoding (viterbi).
+The conll05 corpus stands in as a synthetic taggable task: each token's
+IOB tag is a deterministic function of (word class, predicate, position
+parity) plus noise, which a CRF over LSTM features learns in seconds.
+Decoded tags are scored with the ChunkEvaluator (IOB), like the
+reference's chunk_eval pipeline.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.ops.metrics import extract_chunks
+
+layers = fluid.layers
+
+WORD_DICT = 30
+PRED_DICT = 6
+LABEL_TYPES = 2                  # chunk types -> 2*2+1 IOB tags
+NUM_TAGS = LABEL_TYPES * 2 + 1   # B0 I0 B1 I1 O
+EMB, HID = 16, 24
+BATCH = 12
+
+
+def _synthetic_batch(rng, batch=BATCH):
+    """Tokens tagged by a learnable rule: word class w%3==0 starts a chunk
+    of type (pred % 2); a following w%3==1 continues it; else Outside."""
+    words, preds, labels = [], [], []
+    for _ in range(batch):
+        ln = int(rng.randint(4, 9))
+        w = rng.randint(0, WORD_DICT, ln)
+        p = int(rng.randint(0, PRED_DICT))
+        tags = []
+        prev_in = False
+        for t in w:
+            if t % 3 == 0:
+                tags.append((p % 2) * 2)          # B of type p%2
+                prev_in = True
+            elif t % 3 == 1 and prev_in:
+                tags.append(tags[-1] // 2 * 2 + 1)  # I, same type
+            else:
+                tags.append(NUM_TAGS - 1)         # Outside
+                prev_in = False
+        words.append(w.reshape(-1, 1).astype("int64"))
+        preds.append(np.full((ln, 1), p, "int64"))
+        labels.append(np.array(tags, "int64").reshape(-1, 1))
+    return words, preds, labels
+
+
+def _build_train():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        word = layers.data("word", shape=[1], dtype="int64", lod_level=1)
+        pred = layers.data("pred", shape=[1], dtype="int64", lod_level=1)
+        label = layers.data("label", shape=[1], dtype="int64", lod_level=1)
+        w_emb = layers.embedding(word, size=[WORD_DICT, EMB], is_sparse=True,
+                                 param_attr=fluid.ParamAttr(name="word_emb"))
+        p_emb = layers.embedding(pred, size=[PRED_DICT, EMB], is_sparse=True,
+                                 param_attr=fluid.ParamAttr(name="pred_emb"))
+        mix = layers.fc(layers.concat([w_emb, p_emb], axis=-1),
+                        size=HID, act="tanh",
+                        param_attr=fluid.ParamAttr(name="mix_w"))
+        lstm_in = layers.fc(mix, size=HID * 4,
+                            param_attr=fluid.ParamAttr(name="lstm_in_w"))
+        h, _ = layers.dynamic_lstm(
+            lstm_in, size=HID * 4,
+            param_attr=fluid.ParamAttr(name="lstm_w"),
+            bias_attr=fluid.ParamAttr(name="lstm_b"))
+        feature = layers.fc(h, size=NUM_TAGS,
+                            param_attr=fluid.ParamAttr(name="feat_w"),
+                            bias_attr=fluid.ParamAttr(name="feat_b"))
+        crf_cost = layers.linear_chain_crf(
+            input=feature, label=label,
+            param_attr=fluid.ParamAttr(name="crfw"))
+        avg_cost = layers.mean(crf_cost)
+        opt = fluid.optimizer.Adam(
+            learning_rate=layers.exponential_decay(
+                learning_rate=0.01, decay_steps=100000, decay_rate=0.5,
+                staircase=True))
+        opt.minimize(avg_cost, startup)
+
+        decode = layers.crf_decoding(
+            input=feature, param_attr=fluid.ParamAttr(name="crfw"))
+    return main, startup, avg_cost, decode, label
+
+
+def test_label_semantic_roles_converges_and_decodes():
+    main, startup, avg_cost, decode, label_var = _build_train()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)                      # global scope, like the reference
+    rng = np.random.RandomState(0)
+
+    first = last = None
+    for step in range(120):
+        words, preds, labels = _synthetic_batch(rng)
+        feed = {"word": words, "pred": preds, "label": labels}
+        cost, = exe.run(main, feed=feed, fetch_list=[avg_cost])
+        if first is None:
+            first = float(cost)
+        last = float(cost)
+    assert last < 0.35 * first, (first, last)
+
+    # viterbi decode + chunk F1 on fresh data (the reference evaluates with
+    # chunk_eval over crf_decoding output)
+    words, preds, labels = _synthetic_batch(rng)
+    out = exe.run(main, feed={"word": words, "pred": preds,
+                              "label": labels}, fetch_list=[decode],
+                  )[0]
+    path = np.asarray(out.data).reshape(out.data.shape[0], -1)
+    lens = np.asarray(out.lens)
+    n_inf = n_lab = n_cor = 0
+    for i in range(len(lens)):
+        inf = extract_chunks(path[i, :lens[i]], "IOB", LABEL_TYPES)
+        lab = extract_chunks(labels[i].reshape(-1), "IOB", LABEL_TYPES)
+        n_inf += len(inf)
+        n_lab += len(lab)
+        n_cor += len(inf & lab)
+    p = n_cor / max(n_inf, 1)
+    r = n_cor / max(n_lab, 1)
+    f1 = 2 * p * r / max(p + r, 1e-9)
+    assert f1 > 0.75, (p, r, f1)
+
+    # round-trip the trained model through save/load_inference_model
+    import tempfile
+    from paddle_tpu.core.scope import reset_global_scope
+    d = tempfile.mkdtemp()
+    fluid.io.save_inference_model(d, ["word", "pred"], [decode], exe,
+                                  main_program=main)
+    reset_global_scope()
+    prog2, feeds2, fetches2 = fluid.io.load_inference_model(d, exe)
+    out2 = exe.run(prog2, feed={"word": words, "pred": preds},
+                   fetch_list=fetches2)[0]
+    np.testing.assert_array_equal(np.asarray(out2.data),
+                                  np.asarray(out.data))

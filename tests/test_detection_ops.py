@@ -1,0 +1,351 @@
+"""Detection op numeric tests vs numpy references.
+
+Reference OpTests: test_iou_similarity_op.py, test_prior_box_op.py,
+test_box_coder_op.py, test_bipartite_match_op.py, test_target_assign_op.py,
+test_multiclass_nms_op.py (python/paddle/fluid/tests/unittests/) — the
+numpy reference implementations here are written independently from the
+C++ kernel semantics.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu.fluid as fluid
+
+layers = fluid.layers
+
+
+def _rand_boxes(rng, n):
+    """n proper [x1, y1, x2, y2] boxes in [0, 1]."""
+    p1 = rng.rand(n, 2) * 0.6
+    wh = rng.rand(n, 2) * 0.35 + 0.05
+    return np.concatenate([p1, p1 + wh], axis=1).astype("float32")
+
+
+def _run(program_builder, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetch = program_builder()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    return exe.run(main, feed=feed, fetch_list=list(fetch), scope=scope)
+
+
+def _iou_np(a, b):
+    out = np.zeros((len(a), len(b)), np.float32)
+    for i, x in enumerate(a):
+        for j, y in enumerate(b):
+            ix1, iy1 = max(x[0], y[0]), max(x[1], y[1])
+            ix2, iy2 = min(x[2], y[2]), min(x[3], y[3])
+            iw, ih = max(ix2 - ix1, 0), max(iy2 - iy1, 0)
+            inter = iw * ih
+            ua = (x[2] - x[0]) * (x[3] - x[1]) \
+                + (y[2] - y[0]) * (y[3] - y[1]) - inter
+            out[i, j] = inter / ua if ua > 0 else 0.0
+    return out
+
+
+def test_iou_similarity():
+    rng = np.random.RandomState(0)
+    x = _rand_boxes(rng, 5)
+    y = _rand_boxes(rng, 7)
+
+    def build():
+        xv = layers.data("x", shape=[4])
+        yv = layers.data("y", shape=[4])
+        return [layers.iou_similarity(xv, yv)]
+
+    got, = _run(build, {"x": x, "y": y})
+    np.testing.assert_allclose(got, _iou_np(x, y), rtol=1e-5, atol=1e-6)
+
+
+def test_prior_box_matches_reference_formula():
+    min_sizes, max_sizes = [4.0], [9.0]
+    ars, flip = [2.0], True
+    fh, fw, ih, iw = 3, 4, 32, 48
+
+    def build():
+        feat = layers.data("feat", shape=[8, fh, fw])
+        img = layers.data("img", shape=[3, ih, iw])
+        boxes, var = layers.prior_box(
+            feat, img, min_sizes=min_sizes, max_sizes=max_sizes,
+            aspect_ratios=ars, flip=flip, clip=True,
+            variance=[0.1, 0.1, 0.2, 0.2])
+        return [boxes, var]
+
+    feed = {"feat": np.zeros((1, 8, fh, fw), "float32"),
+            "img": np.zeros((1, 3, ih, iw), "float32")}
+    boxes, var = _run(build, feed)
+    # priors per cell: min, sqrt(min*max), min*sqrt(2), min/sqrt(2)
+    assert boxes.shape == (fh, fw, 4, 4)
+    step_w, step_h = iw / fw, ih / fh
+    # check cell (1, 2), prior 0 (min_size)
+    cx, cy = (2 + 0.5) * step_w, (1 + 0.5) * step_h
+    exp = np.array([(cx - 2) / iw, (cy - 2) / ih,
+                    (cx + 2) / iw, (cy + 2) / ih], "float32")
+    np.testing.assert_allclose(boxes[1, 2, 0], np.clip(exp, 0, 1),
+                               rtol=1e-5)
+    # prior 1: sqrt(min*max) = 6
+    exp1 = np.array([(cx - 3) / iw, (cy - 3) / ih,
+                     (cx + 3) / iw, (cy + 3) / ih], "float32")
+    np.testing.assert_allclose(boxes[1, 2, 1], np.clip(exp1, 0, 1),
+                               rtol=1e-5)
+    # prior 2: ar=2 -> w = 4*sqrt(2)/2, h = 4/sqrt(2)/2
+    hw, hh = 2 * math.sqrt(2), 2 / math.sqrt(2)
+    exp2 = np.array([(cx - hw) / iw, (cy - hh) / ih,
+                     (cx + hw) / iw, (cy + hh) / ih], "float32")
+    np.testing.assert_allclose(boxes[1, 2, 2], np.clip(exp2, 0, 1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(1)
+    prior = _rand_boxes(rng, 6)
+    pvar = np.abs(rng.rand(6, 4).astype("float32")) + 0.1
+    target = _rand_boxes(rng, 5)
+
+    def build_enc():
+        pb = layers.data("pb", shape=[4])
+        pv = layers.data("pv", shape=[4])
+        tb = layers.data("tb", shape=[4])
+        return [layers.box_coder(pb, pv, tb, "encode_center_size")]
+
+    enc, = _run(build_enc, {"pb": prior, "pv": pvar, "tb": target})
+    assert enc.shape == (5, 6, 4)
+
+    # numpy encode reference (box_coder_op.h:33-77)
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = (prior[:, 2] + prior[:, 0]) / 2
+    pcy = (prior[:, 3] + prior[:, 1]) / 2
+    tw = target[:, 2] - target[:, 0]
+    th = target[:, 3] - target[:, 1]
+    tcx = (target[:, 2] + target[:, 0]) / 2
+    tcy = (target[:, 3] + target[:, 1]) / 2
+    exp = np.zeros((5, 6, 4), "float32")
+    for i in range(5):
+        for j in range(6):
+            exp[i, j, 0] = (tcx[i] - pcx[j]) / pw[j] / pvar[j, 0]
+            exp[i, j, 1] = (tcy[i] - pcy[j]) / ph[j] / pvar[j, 1]
+            exp[i, j, 2] = math.log(abs(tw[i] / pw[j])) / pvar[j, 2]
+            exp[i, j, 3] = math.log(abs(th[i] / ph[j])) / pvar[j, 3]
+    np.testing.assert_allclose(enc, exp, rtol=1e-4, atol=1e-5)
+
+    # decode(encode(x)) == x for the diagonal (each target vs its own prior
+    # requires row-count == prior-count; use the [N,M,4] decode form)
+    def build_dec():
+        pb = layers.data("pb", shape=[4])
+        pv = layers.data("pv", shape=[4])
+        tb = layers.data("tb", shape=[6, 4])
+        return [layers.box_coder(pb, pv, tb, "decode_center_size")]
+
+    dec, = _run(build_dec, {"pb": prior, "pv": pvar, "tb": enc})
+    for i in range(5):
+        for j in range(6):
+            np.testing.assert_allclose(dec[i, j], target[i], rtol=1e-4,
+                                       atol=1e-5)
+
+
+def _bipartite_np(dist):
+    """Greedy global max (bipartite_match_op.cc:59-103)."""
+    dist = dist.copy()
+    row, col = dist.shape
+    match = np.full((col,), -1, np.int32)
+    mdist = np.zeros((col,), np.float32)
+    rows = set(range(row))
+    while rows:
+        best, bi, bj = -1.0, -1, -1
+        for j in range(col):
+            if match[j] != -1:
+                continue
+            for i in rows:
+                if dist[i, j] < 1e-6:
+                    continue
+                if dist[i, j] > best:
+                    best, bi, bj = dist[i, j], i, j
+        if bj == -1:
+            break
+        match[bj] = bi
+        mdist[bj] = best
+        rows.remove(bi)
+    return match, mdist
+
+
+@pytest.mark.parametrize("match_type", ["bipartite", "per_prediction"])
+def test_bipartite_match(match_type):
+    rng = np.random.RandomState(3)
+    dist = rng.rand(2, 5, 9).astype("float32")
+    dist[0, 2, :] = 0.0  # a gt row with no overlap anywhere
+
+    def build():
+        d = layers.data("d", shape=[5, 9])
+        mi, md = layers.bipartite_match(d, match_type=match_type,
+                                        dist_threshold=0.5)
+        return [mi, md]
+
+    mi, md = _run(build, {"d": dist})
+    for b in range(2):
+        exp_mi, exp_md = _bipartite_np(dist[b])
+        if match_type == "per_prediction":
+            for j in range(9):
+                if exp_mi[j] == -1:
+                    col = dist[b, :, j]
+                    best = col.argmax()
+                    if col[best] >= 0.5:
+                        exp_mi[j] = best
+                        exp_md[j] = col[best]
+        np.testing.assert_array_equal(mi[b], exp_mi)
+        np.testing.assert_allclose(md[b], exp_md, rtol=1e-5)
+
+
+def test_target_assign():
+    rng = np.random.RandomState(4)
+    x = rng.rand(2, 3, 4).astype("float32")
+    match = np.array([[0, -1, 2, 1], [-1, -1, 0, 0]], np.int32)
+
+    def build():
+        xv = layers.data("x", shape=[3, 4])
+        mv = layers.data("m", shape=[4], dtype="int32")
+        out, w = layers.target_assign(xv, mv, mismatch_value=0)
+        return [out, w]
+
+    out, w = _run(build, {"x": x, "m": match})
+    for b in range(2):
+        for j in range(4):
+            if match[b, j] >= 0:
+                np.testing.assert_allclose(out[b, j], x[b, match[b, j]])
+                assert w[b, j, 0] == 1.0
+            else:
+                np.testing.assert_allclose(out[b, j], 0.0)
+                assert w[b, j, 0] == 0.0
+
+
+def _nms_np(boxes, scores, score_th, nms_th, top_k):
+    order = np.argsort(-scores)
+    if top_k >= 0:
+        order = order[:top_k]
+    kept = []
+    for idx in order:
+        if scores[idx] <= score_th:
+            continue
+        ok = True
+        for k in kept:
+            if _iou_np(boxes[idx:idx + 1], boxes[k:k + 1])[0, 0] > nms_th:
+                ok = False
+                break
+        if ok:
+            kept.append(int(idx))
+    return kept
+
+
+def test_multiclass_nms():
+    rng = np.random.RandomState(5)
+    P, C = 12, 3
+    boxes = _rand_boxes(rng, P)[None]
+    scores = rng.rand(1, C, P).astype("float32")
+
+    def build():
+        b = layers.data("b", shape=[P, 4])
+        s = layers.data("s", shape=[C, P])
+        return [layers.multiclass_nms(b, s, score_threshold=0.3,
+                                      nms_top_k=10, keep_top_k=8,
+                                      nms_threshold=0.4,
+                                      background_label=0)]
+
+    out, = _run(build, {"b": boxes, "s": scores})
+    rows = np.asarray(out.data)[0]
+    count = int(np.asarray(out.lens)[0])
+
+    # numpy reference: per non-background class NMS, then global keep_top_k
+    pairs = []
+    for c in range(1, C):
+        for idx in _nms_np(boxes[0], scores[0, c], 0.3, 0.4, 10):
+            pairs.append((float(scores[0, c, idx]), c, idx))
+    pairs.sort(key=lambda t: -t[0])
+    pairs = pairs[:8]
+    assert count == len(pairs)
+    got = rows[:count]
+    exp_set = {(c, round(s, 5)) for s, c, _ in pairs}
+    got_set = {(int(r[0]), round(float(r[1]), 5)) for r in got}
+    assert got_set == exp_set
+    # rows are globally score-sorted; boxes match their indices
+    for r, (s, c, idx) in zip(got, pairs):
+        np.testing.assert_allclose(r[2:], boxes[0, idx], rtol=1e-5)
+    # padding rows carry label -1
+    assert np.all(rows[count:, 0] == -1)
+
+
+def test_mine_hard_examples():
+    cls_loss = np.array([[0.9, 0.1, 0.8, 0.3, 0.7, 0.2]], "float32")
+    match = np.array([[0, -1, -1, -1, -1, -1]], np.int32)
+
+    def build():
+        l = layers.data("l", shape=[6])
+        m = layers.data("m", shape=[6], dtype="int32")
+        neg, upd = layers.mine_hard_examples(l, m, neg_pos_ratio=3.0)
+        return [neg, upd]
+
+    neg, upd = _run(build, {"l": cls_loss, "m": match})
+    # 1 positive -> 3 negatives, the highest-loss ones among match==-1
+    np.testing.assert_array_equal(neg[0], [0, 0, 1, 0, 1, 0][:6]
+                                  if False else neg[0])
+    assert neg[0].sum() == 3
+    assert set(np.where(neg[0] == 1)[0]) == {2, 4, 3}  # losses .8 .7 .3
+    assert upd[0, 0] == 0  # positive kept
+
+
+def test_roi_pool():
+    x = np.arange(1 * 1 * 6 * 6, dtype="float32").reshape(1, 1, 6, 6)
+    rois = np.array([[0, 0, 0, 3, 3], [0, 2, 2, 5, 5]], "float32")
+
+    def build():
+        xv = layers.data("x", shape=[1, 6, 6])
+        rv = layers.data("r", shape=[5])
+        return [layers.roi_pool(xv, rv, pooled_height=2, pooled_width=2,
+                                spatial_scale=1.0)]
+
+    out, = _run(build, {"x": x, "r": rois})
+    assert out.shape == (2, 1, 2, 2)
+    # roi 0 covers rows/cols 0..3 (4x4), 2x2 pooling -> max of quadrants
+    img = x[0, 0]
+    np.testing.assert_allclose(out[0, 0],
+                               [[img[:2, :2].max(), img[:2, 2:4].max()],
+                                [img[2:4, :2].max(), img[2:4, 2:4].max()]])
+    np.testing.assert_allclose(out[1, 0],
+                               [[img[2:4, 2:4].max(), img[2:4, 4:6].max()],
+                                [img[4:6, 2:4].max(), img[4:6, 4:6].max()]])
+
+
+def test_ssd_head_forward():
+    """detection_output: decode + NMS over a tiny SSD head, end to end."""
+    rng = np.random.RandomState(7)
+    P, C = 8, 4
+    prior = _rand_boxes(rng, P)
+    pvar = np.full((P, 4), 0.1, "float32")
+    loc = rng.normal(0, 0.1, (1, P, 4)).astype("float32")
+    scores = rng.rand(1, C, P).astype("float32")
+
+    def build():
+        pb = layers.data("pb", shape=[4])
+        pv = layers.data("pv", shape=[4])
+        lc = layers.data("lc", shape=[P, 4])
+        sc = layers.data("sc", shape=[C, P])
+        out = layers.detection_output(lc, sc, pb, pv, score_threshold=0.2,
+                                      nms_top_k=6, keep_top_k=5,
+                                      nms_threshold=0.45)
+        return [out]
+
+    out, = _run(build, {"pb": prior, "pv": pvar, "lc": loc, "sc": scores})
+    rows = np.asarray(out.data)[0]
+    count = int(np.asarray(out.lens)[0])
+    assert 0 < count <= 5
+    assert np.all(rows[:count, 0] >= 1)          # no background detections
+    assert np.all(rows[:count, 1] > 0.2)         # above score threshold
+    # scores sorted descending
+    assert np.all(np.diff(rows[:count, 1]) <= 1e-6)

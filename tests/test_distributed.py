@@ -257,7 +257,7 @@ def test_master_snapshot_recovery(tmp_path):
     """Restarted master resumes the pass from its snapshot with leased
     tasks re-queued (service.go:166-227)."""
     snap = str(tmp_path / "master.snap")
-    m, rpc = _start_master(snapshot_path=snap)
+    m, rpc = _start_master(snapshot_path=snap, snapshot_every=1)
     c = MasterClient(rpc.address)
     c.set_dataset(["a", "b", "c"])
     t = c._rpc.call("get_task")

@@ -1,0 +1,197 @@
+"""Metric op + Evaluator tests vs numpy/sklearn-style references.
+
+Reference OpTests: test_auc_op.py, test_precision_recall_op.py,
+test_chunk_eval_op.py (python/paddle/fluid/tests/unittests/);
+evaluators per python/paddle/fluid/evaluator.py:42-254.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.ops.metrics import extract_chunks
+
+layers = fluid.layers
+
+
+def _run(builder, feed, mode="jit"):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetch = builder()
+    exe = fluid.Executor(fluid.CPUPlace(), mode=mode)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    return exe.run(main, feed=feed, fetch_list=list(fetch), scope=scope)
+
+
+def _auc_np(scores, labels, num_thresholds, curve="ROC"):
+    eps = 1e-7
+    ths = [0.0 - eps] + [i / (num_thresholds - 1)
+                         for i in range(1, num_thresholds - 1)] + [1.0 + eps]
+    xs, ys = [], []
+    for t in ths:
+        tp = ((scores >= t) & (labels > 0)).sum()
+        fn = ((scores < t) & (labels > 0)).sum()
+        fp = ((scores >= t) & (labels == 0)).sum()
+        tn = ((scores < t) & (labels == 0)).sum()
+        if curve == "ROC":
+            xs.append(fp / max(fp + tn, 1e-12))
+            ys.append(tp / max(tp + fn, 1e-12))
+        else:
+            xs.append(tp / max(tp + fn, 1e-12))
+            ys.append(tp / max(tp + fp, 1e-12))
+    a = 0.0
+    for i in range(len(ths) - 1):
+        a += (xs[i] - xs[i + 1]) * (ys[i] + ys[i + 1]) / 2
+    return a
+
+
+@pytest.mark.parametrize("curve", ["ROC", "PR"])
+def test_auc_matches_numpy(curve):
+    rng = np.random.RandomState(0)
+    n = 200
+    labels = rng.randint(0, 2, (n, 1)).astype("int64")
+    # informative scores: positives skew high
+    scores = np.clip(0.5 * labels[:, 0] + rng.rand(n) * 0.7, 0, 1) \
+        .astype("float32").reshape(n, 1)
+
+    def build():
+        p = layers.data("p", shape=[1])
+        l = layers.data("l", shape=[1], dtype="int64")
+        a, stats = layers.auc(p, l, curve=curve, num_thresholds=50)
+        return [a]
+
+    got, = _run(build, {"p": scores, "l": labels})
+    exp = _auc_np(scores[:, 0], labels[:, 0], 50, curve)
+    np.testing.assert_allclose(float(got), exp, rtol=1e-4, atol=1e-5)
+    if curve == "ROC":
+        assert float(got) > 0.7  # informative scores -> meaningful AUC
+
+
+def test_precision_recall_matches_numpy():
+    rng = np.random.RandomState(1)
+    C, n = 4, 120
+    labels = rng.randint(0, C, (n, 1)).astype("int64")
+    preds = labels.copy()
+    flip = rng.rand(n) < 0.3
+    preds[flip] = rng.randint(0, C, (flip.sum(), 1))
+
+    def build():
+        i = layers.data("i", shape=[1], dtype="int64")
+        l = layers.data("l", shape=[1], dtype="int64")
+        batch, accum, states = layers.precision_recall(i, l, class_number=C)
+        return [batch, states]
+
+    batch, states = _run(build, {"i": preds, "l": labels})
+    # numpy reference
+    exp_states = np.zeros((C, 4))
+    for c in range(C):
+        p = preds[:, 0] == c
+        t = labels[:, 0] == c
+        exp_states[c] = [(p & t).sum(), (p & ~t).sum(),
+                         (~p & ~t).sum(), (~p & t).sum()]
+    np.testing.assert_allclose(states, exp_states)
+    precs = [exp_states[c, 0] / max(exp_states[c, 0] + exp_states[c, 1], 1)
+             if exp_states[c, 0] + exp_states[c, 1] > 0 else 1.0
+             for c in range(C)]
+    recs = [exp_states[c, 0] / max(exp_states[c, 0] + exp_states[c, 3], 1)
+            if exp_states[c, 0] + exp_states[c, 3] > 0 else 1.0
+            for c in range(C)]
+    np.testing.assert_allclose(batch[0], np.mean(precs), rtol=1e-5)
+    np.testing.assert_allclose(batch[1], np.mean(recs), rtol=1e-5)
+    # micro: total TP over totals
+    tps = exp_states[:, 0].sum()
+    np.testing.assert_allclose(
+        batch[3], tps / (tps + exp_states[:, 1].sum()), rtol=1e-5)
+
+
+def test_extract_chunks_iob():
+    # types: 0, 1; IOB tags: B0=0 I0=1 B1=2 I1=3, Outside=4
+    tags = [0, 1, 1, 4, 2, 3, 0, 4]
+    got = extract_chunks(tags, "IOB", 2)
+    assert got == {(0, 2, 0), (4, 5, 1), (6, 6, 0)}
+
+
+def test_extract_chunks_iobes():
+    # IOBES: type*4 + {B:0 I:1 E:2 S:3}, Outside = 8
+    tags = [0, 1, 2, 3, 8, 4, 6]
+    got = extract_chunks(tags, "IOBES", 2)
+    assert got == {(0, 2, 0), (3, 3, 0), (5, 6, 1)}
+
+
+def test_chunk_eval_op():
+    # two sequences, IOB over 2 types
+    label_seqs = [[0, 1, 4, 2, 3], [0, 4, 2]]
+    infer_seqs = [[0, 1, 4, 2, 4], [0, 4, 0]]
+
+    def build():
+        inf = layers.data("inf", shape=[1], dtype="int64", lod_level=1)
+        lab = layers.data("lab", shape=[1], dtype="int64", lod_level=1)
+        return layers.chunk_eval(inf, lab, chunk_scheme="IOB",
+                                 num_chunk_types=2)[:3] + \
+            layers.chunk_eval(inf, lab, chunk_scheme="IOB",
+                              num_chunk_types=2)[3:]
+
+    feed = {
+        "inf": [np.array(s, "int64").reshape(-1, 1) for s in infer_seqs],
+        "lab": [np.array(s, "int64").reshape(-1, 1) for s in label_seqs],
+    }
+    p, r, f1, ni, nl, nc = _run(build, feed, mode="eager")
+    n_inf = sum(len(extract_chunks(s, "IOB", 2)) for s in infer_seqs)
+    n_lab = sum(len(extract_chunks(s, "IOB", 2)) for s in label_seqs)
+    n_cor = sum(len(extract_chunks(a, "IOB", 2)
+                    & extract_chunks(b, "IOB", 2))
+                for a, b in zip(infer_seqs, label_seqs))
+    assert int(ni[0]) == n_inf and int(nl[0]) == n_lab
+    assert int(nc[0]) == n_cor
+    np.testing.assert_allclose(p[0], n_cor / n_inf, rtol=1e-5)
+    np.testing.assert_allclose(r[0], n_cor / n_lab, rtol=1e-5)
+
+
+def test_auc_evaluator_accumulates():
+    """Stateful Auc evaluator over 4 batches equals single-shot AUC over
+    the concatenation."""
+    rng = np.random.RandomState(3)
+    n = 400
+    labels = rng.randint(0, 2, (n, 1)).astype("int64")
+    scores = np.clip(0.55 * labels[:, 0] + rng.rand(n) * 0.6, 0, 1) \
+        .astype("float32").reshape(n, 1)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = layers.data("p", shape=[1])
+        l = layers.data("l", shape=[1], dtype="int64")
+        ev = fluid.evaluator.Auc(p, l, num_thresholds=50)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    for i in range(0, n, 100):
+        fetched = exe.run(main, feed={"p": scores[i:i + 100],
+                                      "l": labels[i:i + 100]},
+                          fetch_list=ev.metrics, scope=scope)
+        ev.update(fetched)
+    exp = _auc_np(scores[:, 0], labels[:, 0], 50)
+    np.testing.assert_allclose(ev.eval(), exp, rtol=1e-4, atol=1e-5)
+
+
+def test_accuracy_evaluator():
+    rng = np.random.RandomState(4)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8])
+        l = layers.data("l", shape=[1], dtype="int64")
+        logits = layers.fc(x, size=3, act="softmax")
+        ev = fluid.evaluator.Accuracy(logits, l)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    total_correct = total = 0
+    for _ in range(3):
+        xs = rng.normal(0, 1, (32, 8)).astype("float32")
+        ls = rng.randint(0, 3, (32, 1)).astype("int64")
+        fetched = exe.run(main, feed={"x": xs, "l": ls},
+                          fetch_list=ev.metrics, scope=scope)
+        ev.update(fetched)
+        total_correct += int(np.asarray(fetched[0]))
+        total += 32
+    np.testing.assert_allclose(ev.eval(), total_correct / total)
