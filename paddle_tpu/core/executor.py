@@ -142,9 +142,52 @@ class ExecContext:
         return self.block.program.blocks[self.attr(attr_name)]
 
 
+def _check_op_outputs_finite(op, env):
+    """Eager NaN/Inf sweep after each op (reference --check_nan_inf,
+    framework/executor.cc:325-333 CheckTensorNANOrInf). Tracer leaves
+    (control-flow sub-blocks trace through lax.scan/while even in eager
+    mode) are skipped — those regions are covered by the jit-path
+    debug_nans/debug_infs instead."""
+    for name in op.output_arg_names():
+        v = env.get(name)
+        for leaf in jax.tree_util.tree_leaves(v):
+            if isinstance(leaf, jax.core.Tracer):
+                continue
+            arr = np.asarray(leaf)
+            if np.issubdtype(arr.dtype, np.floating) and \
+                    not np.isfinite(arr).all():
+                kind = "NaN" if np.isnan(arr).any() else "Inf"
+                raise FloatingPointError(
+                    f"{kind} in output {name!r} of op {op.type!r} "
+                    "(check_nan_inf flag)")
+
+
 def _run_ops(block, env, exec_state):
     """Run/trace every op of a block over ``env`` in order. This is both the
     eager interpreter and the function traced by jit."""
+    from .flags import get_flag
+    if not getattr(exec_state, "_tracing", False) and \
+            (get_flag("check_nan_inf") or get_flag("benchmark")):
+        # eager-path debug modes: per-op NaN/Inf host sweep (jit covers
+        # this via debug_nans/debug_infs around dispatch) and/or per-op
+        # wall timing (reference --benchmark, executor.cc:321-324)
+        import time as _time
+        bench = get_flag("benchmark")
+        check = get_flag("check_nan_inf")
+        for op in block.ops:
+            t0 = _time.perf_counter() if bench else 0.0
+            info = registry.get_op_info(op.type)
+            info.forward(ExecContext(op, block, env, exec_state))
+            if check:
+                _check_op_outputs_finite(op, env)
+            if bench:
+                outs = [env.get(n) for n in op.output_arg_names()]
+                jax.block_until_ready([o for o in outs
+                                       if isinstance(o, jax.Array)])
+                print(f"[benchmark] {op.type}: "
+                      f"{(_time.perf_counter() - t0) * 1e3:.3f} ms",
+                      flush=True)
+        return
     if profiler_enabled():
         # per-op host spans, the reference's RecordEvent around op->Run
         # (executor.cc:317, operator.cc:488). In eager mode these are real
@@ -257,12 +300,22 @@ class Executor:
                                    for k, v in trace_state.items()}
             # amp guard wraps dispatch because jax traces lazily (first call
             # and any shape-driven retrace happen inside fn())
+            from .flags import get_flag
             if profiler_enabled():
                 with record_event("jit_step_dispatch", kind="stage"):
                     with amp_guard(self.amp):
                         new_state, fetches = fn(trace_state, feed_vals)
                 with record_event("jit_step_device", kind="stage"):
                     jax.block_until_ready(fetches)
+            elif get_flag("check_nan_inf"):
+                # the jit analog of the eager per-op sweep: jax re-runs the
+                # computation op-by-op and points at the offending
+                # primitive (reference --check_nan_inf covers BOTH NaN and
+                # Inf, hence debug_infs too)
+                with jax.debug_nans(True), jax.debug_infs(True):
+                    with amp_guard(self.amp):
+                        new_state, fetches = fn(trace_state, feed_vals)
+                        jax.block_until_ready(fetches)
             else:
                 with amp_guard(self.amp):
                     new_state, fetches = fn(trace_state, feed_vals)
@@ -322,8 +375,15 @@ class Executor:
         fn = self._compiled_steps(program, tuple(sorted(stacked)),
                                   tuple(fetch_names), tuple(sorted(state)),
                                   K, len(prepared))
-        with amp_guard(self.amp):
-            new_state, fetches = fn(state, stacked)
+        from .flags import get_flag
+        if get_flag("check_nan_inf"):
+            with jax.debug_nans(True), jax.debug_infs(True):
+                with amp_guard(self.amp):
+                    new_state, fetches = fn(state, stacked)
+                    jax.block_until_ready(fetches)
+        else:
+            with amp_guard(self.amp):
+                new_state, fetches = fn(state, stacked)
         for n, v in new_state.items():
             scope.set(n, v)
         return [np.asarray(v) if return_numpy else v for v in fetches]
@@ -347,7 +407,11 @@ class Executor:
                 for k, v in stacked.items():
                     env[k] = jax.lax.dynamic_index_in_dim(
                         v, i, axis=0, keepdims=False)
-                _run_ops(block, env, exec_state)
+                exec_state._tracing = True
+                try:
+                    _run_ops(block, env, exec_state)
+                finally:
+                    exec_state._tracing = False
                 new_st = {n: env.get(n, st[n]) for n in carry_keys}
                 new_st[_RNG_KEY] = env[_RNG_KEY]
                 fetches = [env[n] for n in fetch_names]
@@ -373,7 +437,11 @@ class Executor:
         def step(state, feeds):
             env = dict(state)
             env.update(feeds)
-            _run_ops(block, env, self)
+            self._tracing = True
+            try:
+                _run_ops(block, env, self)
+            finally:
+                self._tracing = False
             new_state = {n: env[n] for n in state_out if n in env}
             # pass unwritten state through so that, under buffer donation,
             # the scope never retains a donated (deleted) input buffer
@@ -413,6 +481,18 @@ class Executor:
             if isinstance(value, list) and value and isinstance(
                     value[0], (np.ndarray, list)):
                 v = block.var(name) if block.has_var(name) else None
+                if (v is not None and v.lod_level >= 2
+                        and isinstance(value[0], list)):
+                    # nested python lists: outer list of inner sequences
+                    # (2-level LoD feed, reference create_lod_tensor's
+                    # recursive_seq_lens form)
+                    inner = [np.asarray(s) for group in value
+                             for s in group]
+                    arr = pack_sequences(inner)
+                    arr.outer_lens = np.asarray(
+                        [len(g) for g in value], np.int32)
+                    out[name] = place_lod(arr)
+                    continue
                 if v is not None and v.lod_level > 0:
                     out[name] = place_lod(
                         pack_sequences([np.asarray(s) for s in value]))

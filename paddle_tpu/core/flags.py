@@ -1,0 +1,83 @@
+"""Global flags registry.
+
+Reference: gflags defined beside their subsystems and re-exported to Python
+via core.init_gflags(sys.argv) (/root/reference/paddle/fluid/platform/,
+framework/init.cc:31, pybind.cc:423; the legacy ~40-flag registry
+paddle/utils/Flags.h:19-43). Here one process-wide registry: subsystems
+declare flags with DEFINE_flag, users set them via fluid.set_flags /
+init_flags(argv) / the PDTPU_FLAGS env var ("a=1,b=2" at import time).
+"""
+
+from __future__ import annotations
+
+import os
+
+_FLAGS: dict[str, dict] = {}
+
+
+def DEFINE_flag(name, default, help_str=""):
+    if name not in _FLAGS:
+        _FLAGS[name] = {"value": default, "default": default,
+                        "help": help_str, "type": type(default)}
+    return _FLAGS[name]["value"]
+
+
+def get_flag(name):
+    return _FLAGS[name]["value"]
+
+
+def set_flags(flags: dict):
+    """fluid.set_flags({'check_nan_inf': True}) — unknown flags raise, like
+    gflags' unknown-flag error."""
+    for name, value in flags.items():
+        if name not in _FLAGS:
+            raise KeyError(f"unknown flag {name!r}; known: {sorted(_FLAGS)}")
+        ty = _FLAGS[name]["type"]
+        if ty is bool and isinstance(value, str):
+            value = value.lower() in ("1", "true", "yes", "on")
+        _FLAGS[name]["value"] = ty(value)
+
+
+def flags():
+    """Snapshot of all flags (name -> value)."""
+    return {n: f["value"] for n, f in _FLAGS.items()}
+
+
+def init_flags(argv):
+    """Parse --name=value entries (the reference's core.init_gflags(argv)
+    contract); returns unconsumed argv entries."""
+    rest = []
+    for a in argv:
+        if a.startswith("--") and "=" in a:
+            name, value = a[2:].split("=", 1)
+            if name in _FLAGS:
+                set_flags({name: value})
+                continue
+        rest.append(a)
+    return rest
+
+
+# ---- core flags (reference executor.cc:26-29, platform/) ----
+DEFINE_flag("check_nan_inf", False,
+            "sweep op outputs for NaN/Inf after each op (eager) and enable "
+            "jax debug_nans under jit — reference --check_nan_inf "
+            "(framework/executor.cc:325-333)")
+DEFINE_flag("benchmark", False,
+            "log per-op timing in eager mode — reference --benchmark "
+            "(executor.cc:321-324)")
+
+# PDTPU_FLAGS=check_nan_inf=1,benchmark=0 — unknown names warn and are
+# ignored (a typo'd env var must not make the package unimportable)
+_env = os.environ.get("PDTPU_FLAGS", "")
+if _env:
+    import warnings
+
+    for _kv in _env.split(","):
+        if "=" not in _kv:
+            continue
+        _name, _value = _kv.split("=", 1)
+        try:
+            set_flags({_name: _value})
+        except KeyError:
+            warnings.warn(f"PDTPU_FLAGS: ignoring unknown flag {_name!r} "
+                          f"(known: {sorted(_FLAGS)})")

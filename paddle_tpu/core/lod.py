@@ -31,21 +31,29 @@ import jax.numpy as jnp
 
 @jax.tree_util.register_pytree_node_class
 class LoDArray:
-    """Padded device representation of a level-1 LoD tensor.
+    """Padded device representation of a LoD tensor.
 
     data: [batch, max_len, *feature] padded with zeros past each row's length
-    lens: [batch] int32 true sequence lengths
+    lens: [batch] int32 true sequence lengths (the INNERMOST LoD level)
+    outer_lens: optional [n_outer] int32 — a SECOND LoD level grouping the
+        ``batch`` rows into outer sequences (sum(outer_lens) == batch), the
+        nested-offsets capability of the reference LoD
+        (framework/lod_tensor.h:55-107): e.g. beam-search output groups
+        batch*beam sentence rows by source sentence.
     """
 
-    __slots__ = ("data", "lens")
+    __slots__ = ("data", "lens", "outer_lens")
 
-    def __init__(self, data, lens):
+    def __init__(self, data, lens, outer_lens=None):
         self.data = data
         self.lens = lens
+        self.outer_lens = outer_lens
 
     # pytree protocol: traces through jit/grad/scan transparently
     def tree_flatten(self):
-        return (self.data, self.lens), None
+        if self.outer_lens is None:
+            return (self.data, self.lens), False
+        return (self.data, self.lens, self.outer_lens), True
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -59,13 +67,26 @@ class LoDArray:
     def max_len(self):
         return self.data.shape[1]
 
+    @property
+    def lod_level(self):
+        return 2 if self.outer_lens is not None else 1
+
     def mask(self, dtype=jnp.float32):
         """[batch, max_len] 1/0 validity mask."""
         return (jnp.arange(self.data.shape[1])[None, :]
                 < self.lens[:, None]).astype(dtype)
 
+    def row_to_outer(self):
+        """[batch] int32: the outer-sequence index of each row (level-2)."""
+        starts = jnp.cumsum(self.outer_lens)
+        return jnp.searchsorted(starts, jnp.arange(self.data.shape[0]),
+                                side="right").astype(jnp.int32)
+
     def __repr__(self):
-        return f"LoDArray(data={getattr(self.data, 'shape', None)}, lens={self.lens})"
+        extra = f", outer_lens={self.outer_lens}" \
+            if self.outer_lens is not None else ""
+        return (f"LoDArray(data={getattr(self.data, 'shape', None)}, "
+                f"lens={self.lens}{extra})")
 
 
 def pack_sequences(seqs, dtype=None, max_len=None, pad_multiple=1):
@@ -101,26 +122,39 @@ def lens_from_lod(lod) -> np.ndarray:
 
 
 def flat_to_lodarray(flat, lod, pad_multiple=1):
-    """Reference feed form (concatenated [sum_len, *feat] array, offset lod) ->
-    padded LoDArray. This is the feed-boundary packer."""
-    lens = lens_from_lod(lod)
+    """Reference feed form (concatenated [sum_len, *feat] array, offset lod)
+    -> padded LoDArray. Handles level-1 ([[offsets]]) and level-2
+    ([[outer offsets over sequences], [token offsets]]) nested LoD
+    (framework/lod_tensor.h:55). This is the feed-boundary packer."""
+    lod = list(lod)
+    inner = lod[-1]
+    lens = lens_from_lod([inner])
     flat = np.asarray(flat)
     seqs, start = [], 0
     for ln in lens:
         seqs.append(flat[start:start + int(ln)])
         start += int(ln)
-    return pack_sequences(seqs, dtype=flat.dtype, pad_multiple=pad_multiple)
+    arr = pack_sequences(seqs, dtype=flat.dtype, pad_multiple=pad_multiple)
+    if len(lod) == 2:
+        arr.outer_lens = lens_from_lod([lod[0]])
+    elif len(lod) > 2:
+        raise NotImplementedError("LoD deeper than 2 levels")
+    return arr
 
 
 def lodarray_to_flat(arr: LoDArray):
     """Padded LoDArray -> (concatenated numpy array, offset lod): the fetch-
-    boundary unpacker, restoring the reference's LoDTensor wire form."""
+    boundary unpacker, restoring the reference's LoDTensor wire form (with
+    both levels for nested LoD)."""
     data = np.asarray(arr.data)
     lens = np.asarray(arr.lens)
     parts = [data[i, : int(lens[i])] for i in range(len(lens))]
     flat = np.concatenate(parts, axis=0) if parts else np.zeros((0,) + data.shape[2:],
                                                                data.dtype)
-    return flat, lod_from_lens(lens)
+    lod = lod_from_lens(lens)
+    if arr.outer_lens is not None:
+        lod = lod_from_lens(np.asarray(arr.outer_lens)) + lod
+    return flat, lod
 
 
 def sequence_mask(lens, max_len, dtype=jnp.float32):

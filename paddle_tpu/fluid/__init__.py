@@ -10,6 +10,7 @@ from .framework import (Program, Block, Operator, Variable, Parameter,
                         switch_startup_program, grad_var_name, unique_name)
 from ..core.executor import Executor, CPUPlace, TPUPlace
 from ..core.amp import amp_guard
+from ..core.flags import set_flags, get_flag, flags, init_flags
 from ..core.scope import Scope, global_scope
 from ..core.lod import LoDArray, pack_sequences, flat_to_lodarray, \
     lodarray_to_flat
@@ -39,4 +40,5 @@ __all__ = [
     "TPUPlace", "CUDAPlace", "Scope", "global_scope", "layers", "optimizer",
     "initializer", "regularizer", "backward", "io", "nets", "append_backward",
     "ParamAttr", "DataFeeder", "LoDArray", "profiler", "amp_guard", "clip",
+    "set_flags", "get_flag", "flags", "init_flags", "evaluator",
 ]

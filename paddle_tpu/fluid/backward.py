@@ -122,7 +122,10 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
         for spec in info.grad(op):
             # rename-and-sum for repeated gradients (backward.py:117);
             # overwrite_outputs specs (in-place loop state) replace instead
-            renames = {}
+            renames = []  # (canonical, tmp) pairs, possibly repeated names
+            spec_seen = set()  # duplicate grad names WITHIN one spec (the
+            # x*x pattern: X@GRAD and Y@GRAD are the same var) must also
+            # rename-and-sum, else the later slot overwrites the earlier
             for slot, names in spec.outputs.items():
                 new_names = []
                 for n in names:
@@ -131,21 +134,23 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
                         # still produce it (XLA DCEs it); cheaper than
                         # rewriting the grad op's outputs
                         pass
-                    if n in produced and slot not in spec.overwrite_slots:
+                    if ((n in produced or n in spec_seen)
+                            and slot not in spec.overwrite_slots):
                         tmp = unique_name(n + "@RENAME")
                         _create_grad_var(block, fwd, tmp)
-                        renames[n] = tmp
+                        renames.append((n, tmp))
                         new_names.append(tmp)
                     else:
                         _create_grad_var(block, fwd, n)
                         new_names.append(n)
+                    spec_seen.add(n)
                 spec.outputs[slot] = new_names
             block.append_op(spec.type, spec.inputs, spec.outputs, spec.attrs)
             for slot, names in spec.outputs.items():
                 for n in names:
                     produced.add(n)
             # accumulate renamed grads into the canonical name
-            for canonical, tmp in renames.items():
+            for canonical, tmp in renames:
                 block.append_op("sum", inputs={"X": [canonical, tmp]},
                                 outputs={"Out": [canonical]})
 

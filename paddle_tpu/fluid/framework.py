@@ -318,6 +318,38 @@ class Program:
     def global_block(self) -> Block:
         return self.blocks[0]
 
+    def to_debug_string(self, with_vars=True):
+        """Readable IR dump (reference debuger.py pprint_program_codes /
+        Program.to_string): per block, its vars (name, shape, dtype,
+        persistable) and ops (type, inputs -> outputs, attrs)."""
+        lines = []
+        for block in self.blocks:
+            parent = f" parent={block.parent_idx}" \
+                if block.parent_idx >= 0 else ""
+            lines.append(f"block {block.idx}{parent} {{")
+            if with_vars:
+                for name in sorted(block.vars):
+                    v = block.vars[name]
+                    tags = []
+                    if v.persistable:
+                        tags.append("persistable")
+                    if isinstance(v, Parameter):
+                        tags.append("param")
+                    if v.lod_level:
+                        tags.append(f"lod={v.lod_level}")
+                    tag = (" [" + ",".join(tags) + "]") if tags else ""
+                    lines.append(f"  var {name}: shape={v.shape} "
+                                 f"dtype={v.dtype}{tag}")
+            for op in block.ops:
+                ins = ", ".join(f"{k}={v}" for k, v in op.inputs.items()
+                                if v)
+                outs = ", ".join(f"{k}={v}" for k, v in op.outputs.items()
+                                 if v)
+                lines.append(f"  op {op.type}({ins}) -> ({outs})"
+                             + (f"  attrs={op.attrs}" if op.attrs else ""))
+            lines.append("}")
+        return "\n".join(lines)
+
     def current_block(self) -> Block:
         return self.blocks[self._current_block_idx]
 

@@ -466,3 +466,25 @@ class DynamicRNN(_RNNBase):
         """A non-stepped input read in full every step (reference
         DynamicRNN.static_input): nothing to do — the block closes over it."""
         return x
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug-print a variable in the running graph, pass-through value
+    (reference layers/control_flow.py Print -> print_op.cc). print_phase:
+    'forward', 'backward' (prints the gradient instead), or 'both'."""
+    helper = LayerHelper("print")
+    out = helper.create_tmp_variable(input.dtype, shape=input.shape,
+                                     lod_level=input.lod_level)
+    helper.append_op(
+        "print", inputs={"In": [input.name]}, outputs={"Out": [out.name]},
+        attrs={"first_n": first_n, "message": message or "",
+               "summarize": summarize,
+               "print_tensor_name": print_tensor_name,
+               "print_tensor_type": print_tensor_type,
+               "print_tensor_shape": print_tensor_shape,
+               "print_tensor_lod": print_tensor_lod,
+               "print_phase": print_phase})
+    return out

@@ -109,12 +109,18 @@ def sequence_softmax(input, name=None):
     return out
 
 
-def sequence_expand(x, y, name=None):
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """ref_level selects which of y's LoD levels drives the expansion
+    (reference layers/nn.py sequence_expand): -1/innermost tiles x rows
+    along y's sequences; 0 over a 2-level y repeats x's rows per inner
+    sequence."""
     helper = LayerHelper("sequence_expand", name=name)
-    out = helper.create_tmp_variable(x.dtype, lod_level=1)
+    out = helper.create_tmp_variable(x.dtype,
+                                     lod_level=0 if ref_level == 0 else 1)
     helper.append_op("sequence_expand",
                      inputs={"X": [x.name], "Y": [y.name]},
-                     outputs={"Out": [out.name]})
+                     outputs={"Out": [out.name]},
+                     attrs={"ref_level": ref_level})
     return out
 
 

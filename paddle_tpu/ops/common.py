@@ -23,9 +23,10 @@ def data_of(v):
 
 
 def like(ref, value):
-    """Re-wrap ``value`` as a LoDArray if ``ref`` carried LoD."""
+    """Re-wrap ``value`` as a LoDArray if ``ref`` carried LoD (both
+    levels)."""
     if isinstance(ref, LoDArray):
-        return LoDArray(value, ref.lens)
+        return LoDArray(value, ref.lens, ref.outer_lens)
     return value
 
 

@@ -669,5 +669,9 @@ def beam_search_decode(ctx):
     first_end = jnp.argmax(is_end, axis=1)
     total = written.sum(axis=1).astype(jnp.int32)
     lens = jnp.where(any_end, first_end + 1, total).astype(jnp.int32)
-    ctx.set_output("SentenceIds", LoDArray(seqs[..., None], lens))
+    # 2-level LoD mirroring the reference's output (beam_search_decode_op.cc
+    # emits [source][beam] nested offsets): outer level groups the beam
+    # sentence rows of each source sentence
+    outer = jnp.full((b,), beam, jnp.int32)
+    ctx.set_output("SentenceIds", LoDArray(seqs[..., None], lens, outer))
     ctx.set_output("SentenceScores", scores.reshape(b * beam))

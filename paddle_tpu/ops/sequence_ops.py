@@ -152,7 +152,10 @@ def sequence_softmax_grad(ctx):
 def _se_infer(op, block):
     x = block.var(op.input("X")[0])
     out = block.var(op.output("Out")[0])
-    out.shape, out.dtype, out.lod_level = x.shape, x.dtype, 1
+    out.shape, out.dtype = x.shape, x.dtype
+    # ref_level=0 emits dense per-inner-sequence rows (lod 0); the default
+    # innermost expansion emits one sequence per x row (lod 1)
+    out.lod_level = 0 if op.attrs.get("ref_level", -1) == 0 else 1
 
 
 @register_op("sequence_expand", infer_shape=_se_infer, grad=lambda op: [OpSpec(
@@ -161,8 +164,23 @@ def _se_infer(op, block):
      "Out@GRAD": G(op.output("Out"))},
     {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
 def sequence_expand(ctx):
+    """ref_level=-1/1 (default): tile x's i-th row along y's i-th sequence.
+    ref_level=0 over a 2-level y: repeat x's i-th row once per INNER
+    sequence of y's i-th OUTER sequence (reference sequence_expand_op.cc's
+    nested-LoD expansion) — the NMT 'broadcast encoder state over beam
+    rows' primitive."""
     xv = ctx.input("X")
     y = _seq(ctx.input("Y"))
+    ref_level = int(ctx.attr("ref_level", -1))
+    if ref_level == 0 and y.outer_lens is not None:
+        if isinstance(xv, LoDArray):
+            raise NotImplementedError(
+                "sequence_expand ref_level=0 with a LoD-carrying X (ragged "
+                "rows) is not supported; expand dense per-sequence rows")
+        x = data_of(xv)                       # [n_outer, *feat]
+        out = x[y.row_to_outer()]             # [batch_rows, *feat]
+        ctx.set_output("Out", out)
+        return
     if isinstance(xv, LoDArray):
         raise NotImplementedError(
             "sequence_expand with LoD-carrying X is served by the lod-level-2 "
@@ -176,7 +194,15 @@ def sequence_expand(ctx):
 @register_op("sequence_expand_grad")
 def sequence_expand_grad(ctx):
     y = _seq(ctx.input("Y"))
-    dy = _seq(ctx.input("Out@GRAD"))
+    dy_v = ctx.input("Out@GRAD")
+    ref_level = int(ctx.attr("ref_level", -1))
+    if ref_level == 0 and y.outer_lens is not None:
+        d = data_of(dy_v)                     # [batch_rows, *feat]
+        n_outer = y.outer_lens.shape[0]
+        ctx.set_output("X@GRAD", jax.ops.segment_sum(
+            d, y.row_to_outer(), num_segments=n_outer))
+        return
+    dy = _seq(dy_v)
     d = dy.data * _feat_mask(dy.data, y.lens)
     ctx.set_output("X@GRAD", d.sum(axis=1))
 

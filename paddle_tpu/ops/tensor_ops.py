@@ -426,3 +426,60 @@ def multiplex(ctx):
     xs = jnp.stack([data_of(v) for v in ctx.inputs("X")], axis=0)
     rows = jnp.arange(ids.shape[0])
     ctx.set_output("Out", xs[ids, rows])
+
+
+# ---------- print (debug) ----------
+
+_PRINT_COUNTS: dict = {}
+
+
+@register_op("print", infer_shape=same_shape("In", "Out"),
+             grad=lambda op: [OpSpec(
+                 "print",
+                 {"In": G(op.output("Out"))}, {"Out": G(op.input("In"))},
+                 {**dict(op.attrs),
+                  "message": (op.attr("message", "") or "") + " @GRAD",
+                  "print_phase": "forward",
+                  "is_backward_print": True})
+                 if op.attr("print_phase", "both") in ("backward", "both")
+                 else OpSpec("assign", {"X": G(op.output("Out"))},
+                             {"Out": G(op.input("In"))})])
+def print_op(ctx):
+    """Debug print (reference print_op.cc): logs message, tensor metadata
+    and a bounded data summary for the first ``first_n`` executions, then
+    passes the value through unchanged. Works under jit via debug callbacks
+    (fires per execution, like the reference's per-run kernel print)."""
+    xv = ctx.input("In")
+    x = data_of(xv)
+    first_n = int(ctx.attr("first_n", -1))
+    message = ctx.attr("message", "") or ""
+    summarize = int(ctx.attr("summarize", 20))
+    name = ctx.op.input("In")[0]
+    show_name = ctx.attr("print_tensor_name", True)
+    show_type = ctx.attr("print_tensor_type", True)
+    show_shape = ctx.attr("print_tensor_shape", True)
+    key = id(ctx.op)
+    phase = ctx.attr("print_phase", "both")
+
+    if phase in ("forward", "both") or ctx.attr("is_backward_print", False):
+        shape, dtype = x.shape, x.dtype
+
+        def emit(arr):
+            count = _PRINT_COUNTS.get(key, 0)
+            if first_n >= 0 and count >= first_n:
+                return
+            _PRINT_COUNTS[key] = count + 1
+            parts = [message] if message else []
+            if show_name:
+                parts.append(f"name={name}")
+            if show_type:
+                parts.append(f"dtype={dtype}")
+            if show_shape:
+                parts.append(f"shape={tuple(shape)}")
+            flat = np.asarray(arr).reshape(-1)
+            k = flat.size if summarize < 0 else min(summarize, flat.size)
+            parts.append(f"data={flat[:k].tolist()}")
+            print("[print op] " + "  ".join(parts), flush=True)
+
+        jax.debug.callback(emit, x)
+    ctx.set_output("Out", like(xv, x))

@@ -196,7 +196,11 @@ def shard_program_step(executor, program, feed_example, fetch_list, plan,
     def step(st, fd):
         env = dict(st)
         env.update(fd)
-        _run_ops(block, env, executor)
+        executor._tracing = True
+        try:
+            _run_ops(block, env, executor)
+        finally:
+            executor._tracing = False
         # carry exactly the input keyset so the step iterates:
         # fn(fn(state)) — read-only state (learning rate) passes through
         new_state = {n: env.get(n, st[n]) for n in st}
@@ -204,10 +208,20 @@ def shard_program_step(executor, program, feed_example, fetch_list, plan,
         return new_state, fetches
 
     # pin state shardings on both sides so the step iterates
-    fn = jax.jit(
+    jitted = jax.jit(
         step,
         in_shardings=(state_shardings, feed_shardings),
         out_shardings=(state_shardings, None),
         donate_argnums=(0,) if donate else (),
     )
+
+    def fn(st, fd):
+        from ..core.flags import get_flag
+        if get_flag("check_nan_inf"):
+            with jax.debug_nans(True), jax.debug_infs(True):
+                out = jitted(st, fd)
+                jax.block_until_ready(out)
+                return out
+        return jitted(st, fd)
+
     return fn, state, feeds

@@ -214,7 +214,10 @@ def test_machine_translation_train_and_beam_decode():
                                   fetch_list=[sent_ids, sent_scores],
                                   scope=scope)
     flat, lod = fluid.lodarray_to_flat(ids_out)
-    offs = lod[0]
+    # 2-level LoD (reference beam_search_decode form): level 0 groups beam
+    # rows per source sentence, level 1 holds per-row token offsets
+    assert len(lod) == 2
+    offs = lod[-1]
     correct = 0
     for i, (src, trg) in enumerate(pairs):
         best = i * BEAM     # beam 0 = highest score

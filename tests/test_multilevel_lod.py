@@ -1,0 +1,126 @@
+"""Nested (2-level) LoD tests.
+
+Reference: framework/lod_tensor.h:55-107 — LoD is a vector of offset
+levels; 2-level tensors group sequences into super-sequences (beam-search
+output: [source][beam][tokens]; hierarchical text: [doc][sentence][words]).
+Pinned here: feed/fetch round-trip in the reference's (flat, 2-level lod)
+wire form, nested python-list feeds, sequence_expand with ref_level=0
+(+ its gradient), and the 2-level LoD on beam_search_decode output.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.lod import (LoDArray, flat_to_lodarray,
+                                 lodarray_to_flat, pack_sequences)
+
+layers = fluid.layers
+
+
+def test_flat_roundtrip_2level():
+    # 2 outer sequences: first has 2 inner seqs (lens 2,3), second has 1 (len 2)
+    flat = np.arange(14, dtype="float32").reshape(7, 2)
+    lod = [[0, 2, 3], [0, 2, 5, 7]]
+    arr = flat_to_lodarray(flat, lod)
+    assert arr.lod_level == 2
+    np.testing.assert_array_equal(np.asarray(arr.lens), [2, 3, 2])
+    np.testing.assert_array_equal(np.asarray(arr.outer_lens), [2, 1])
+    back, lod2 = lodarray_to_flat(arr)
+    np.testing.assert_array_equal(back, flat)
+    assert lod2 == [[0, 2, 3], [0, 2, 5, 7]]
+
+
+def test_row_to_outer():
+    arr = LoDArray(jnp.zeros((5, 3)), jnp.asarray([1, 2, 3, 1, 2]),
+                   jnp.asarray([2, 1, 2]))
+    np.testing.assert_array_equal(np.asarray(arr.row_to_outer()),
+                                  [0, 0, 1, 2, 2])
+
+
+def test_feed_fetch_2level_through_executor():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[1], dtype="int64", lod_level=2)
+        out = layers.scale(x, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    # nested python-list feed: 2 docs, [2, 1] sentences
+    feed = {"x": [[np.array([[1], [2]], "int64"),
+                   np.array([[3], [4], [5]], "int64")],
+                  [np.array([[6], [7]], "int64")]]}
+    got = exe.run(main, feed=feed, fetch_list=[out])[0]
+    flat, lod = lodarray_to_flat(got)
+    np.testing.assert_array_equal(flat[:, 0], [2, 4, 6, 8, 10, 12, 14])
+    assert lod == [[0, 2, 3], [0, 2, 5, 7]]
+
+    # reference wire-form feed: (flat array, 2-level lod)
+    feed2 = {"x": (np.arange(1, 8).reshape(7, 1).astype("int64"),
+                   [[0, 2, 3], [0, 2, 5, 7]])}
+    got2 = exe.run(main, feed=feed2, fetch_list=[out])[0]
+    flat2, lod2 = lodarray_to_flat(got2)
+    np.testing.assert_array_equal(flat2, flat)
+    assert lod2 == lod
+
+
+def test_sequence_expand_ref_level0():
+    """x [n_outer, feat] expands once per inner sequence of y (reference
+    sequence_expand ref_level=0): numpy-checked, including the gradient."""
+    x_np = np.array([[1.0, 10.0], [2.0, 20.0]], "float32")
+    # y: 2 outer groups with [2, 3] inner sequences
+    y_seqs = [[np.zeros((2, 1), "float32"), np.zeros((1, 1), "float32")],
+              [np.zeros((3, 1), "float32"), np.zeros((2, 1), "float32"),
+               np.zeros((1, 1), "float32")]]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", shape=[2], stop_gradient=False) \
+            if False else layers.data("x", shape=[2])
+        xv.stop_gradient = False
+        yv = layers.data("y", shape=[1], lod_level=2)
+        out = layers.sequence_expand(xv, yv, ref_level=0)
+        loss = layers.mean(layers.elementwise_mul(out, out))
+        fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got, gx = exe.run(main, feed={"x": x_np, "y": y_seqs},
+                      fetch_list=[out, "x@GRAD"])
+    expect = x_np[[0, 0, 1, 1, 1]]
+    np.testing.assert_allclose(np.asarray(got), expect)
+    # d mean(out^2)/dx_i = sum over copies of 2*x_i / out.size
+    n = expect.size
+    exp_gx = np.stack([2 * 2 * x_np[0] / n, 3 * 2 * x_np[1] / n])
+    np.testing.assert_allclose(np.asarray(gx), exp_gx, rtol=1e-5)
+
+
+def test_beam_search_decode_emits_2level_lod():
+    from paddle_tpu.ops.control_flow_ops import TensorArrayVal
+
+    b, beam, T = 2, 3, 4
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(2, 9, (T, b, beam)).astype("int32"))
+    parents = jnp.asarray(np.zeros((T, b, beam), "int32"))
+    scores = jnp.asarray(rng.rand(b, beam).astype("float32"))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids_arr = fluid.layers.create_array("int32", cap=T)
+        par_arr = fluid.layers.create_array("int32", cap=T)
+        sc = layers.data("sc", shape=[beam])
+        sent_ids, sent_scores = fluid.layers.beam_search_decode(
+            ids_arr, par_arr, sc, end_id=1)
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    scope.set(ids_arr.name, TensorArrayVal(ids, jnp.asarray(T, jnp.int32)))
+    scope.set(par_arr.name,
+              TensorArrayVal(parents, jnp.asarray(T, jnp.int32)))
+    out = exe.run(main, feed={"sc": np.asarray(scores)},
+                  fetch_list=[sent_ids], scope=scope)[0]
+    assert out.lod_level == 2
+    np.testing.assert_array_equal(np.asarray(out.outer_lens), [beam, beam])
+    flat, lod = lodarray_to_flat(out)
+    assert len(lod) == 2
+    assert lod[0] == [0, beam, 2 * beam]

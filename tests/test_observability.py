@@ -1,0 +1,120 @@
+"""Observability floor: flags registry, NaN/Inf check, Print op, debug dump.
+
+Reference: gflags registry (paddle/utils/Flags.h:19-43, pybind.cc:423
+init_gflags), --check_nan_inf sweep (framework/executor.cc:27,325-333),
+print op (operators/print_op.cc), program debug strings
+(python/paddle/fluid/debuger.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+layers = fluid.layers
+
+
+@pytest.fixture(autouse=True)
+def _reset_flags():
+    yield
+    fluid.set_flags({"check_nan_inf": False, "benchmark": False})
+
+
+def test_flags_registry():
+    assert fluid.get_flag("check_nan_inf") is False
+    fluid.set_flags({"check_nan_inf": True})
+    assert fluid.get_flag("check_nan_inf") is True
+    with pytest.raises(KeyError, match="unknown flag"):
+        fluid.set_flags({"definitely_not_a_flag": 1})
+    assert "benchmark" in fluid.flags()
+    # argv-style init (the reference core.init_gflags contract)
+    rest = fluid.init_flags(["prog", "--check_nan_inf=0", "--other=x"])
+    assert rest == ["prog", "--other=x"]
+    assert fluid.get_flag("check_nan_inf") is False
+
+
+def _nan_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.log(x)          # log of a negative -> NaN
+        out = layers.mean(y)
+    return main, startup, out
+
+
+@pytest.mark.parametrize("mode", ["eager", "jit"])
+def test_check_nan_inf_raises(mode):
+    main, startup, out = _nan_program()
+    exe = fluid.Executor(fluid.CPUPlace(), mode=mode)
+    exe.run(startup)
+    bad = np.array([[1.0, 2.0, -3.0, 4.0]], "float32")
+    fluid.set_flags({"check_nan_inf": True})
+    with pytest.raises(FloatingPointError):
+        exe.run(main, feed={"x": bad}, fetch_list=[out])
+    # clean input passes
+    ok = np.array([[1.0, 2.0, 3.0, 4.0]], "float32")
+    v = exe.run(main, feed={"x": ok}, fetch_list=[out])[0]
+    assert np.isfinite(v)
+
+
+def test_check_nan_inf_off_by_default():
+    main, startup, out = _nan_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    bad = np.array([[-1.0, 2.0, 3.0, 4.0]], "float32")
+    v = exe.run(main, feed={"x": bad}, fetch_list=[out])[0]
+    assert np.isnan(v)  # silently propagates, like the reference default
+
+
+def test_print_op_first_n_and_passthrough(capsys):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3])
+        p = layers.Print(x, first_n=2, message="dbg", summarize=3,
+                         print_phase="forward")
+        out = layers.scale(p, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    exe.run(startup)
+    feed = {"x": np.array([[1.0, 2.0, 3.0]], "float32")}
+    for _ in range(4):
+        v = exe.run(main, feed=feed, fetch_list=[out])[0]
+    np.testing.assert_allclose(v, [[2.0, 4.0, 6.0]])  # pass-through intact
+    cap = capsys.readouterr().out
+    assert cap.count("[print op]") == 2      # first_n honored
+    assert "dbg" in cap and "shape=(1, 3)" in cap
+    assert "data=[1.0, 2.0, 3.0]" in cap
+
+
+def test_print_backward_phase_prints_gradient(capsys):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2])
+        x.stop_gradient = False
+        p = layers.Print(x, message="gradcheck", print_phase="backward")
+        loss = layers.mean(layers.scale(p, scale=3.0))
+        fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    exe.run(startup)
+    v = exe.run(main, feed={"x": np.ones((1, 2), "float32")},
+                fetch_list=["x@GRAD"])[0]
+    np.testing.assert_allclose(v, 1.5 * np.ones((1, 2)))
+    cap = capsys.readouterr().out
+    assert "gradcheck @GRAD" in cap
+    assert "data=[1.5, 1.5]" in cap
+
+
+def test_program_to_debug_string():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(x, size=3, act="softmax")
+        loss = layers.mean(layers.cross_entropy(h, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss, startup)
+    s = main.to_debug_string()
+    assert "block 0 {" in s
+    assert "op mul(" in s and "op sgd(" in s
+    assert "dtype=int64" in s
+    assert "[persistable,param]" in s
+    # sub-block-free programs print one block; control flow adds more
+    assert s.count("block ") == 1
