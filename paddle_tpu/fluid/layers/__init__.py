@@ -20,7 +20,7 @@ from .learning_rate_scheduler import (noam_decay, exponential_decay,  # noqa: F4
 from .control_flow import (While, Switch, StaticRNN, DynamicRNN,  # noqa: F401
                            increment, less_than, create_array, array_write,
                            array_read, array_length, beam_search,
-                           beam_search_decode, batch_gather, Print)
+                           beam_search_decode, batch_gather, Print, IfElse)
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .io import data  # noqa: F401

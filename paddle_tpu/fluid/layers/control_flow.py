@@ -488,3 +488,72 @@ def Print(input, first_n=-1, message=None, summarize=20,
                "print_tensor_lod": print_tensor_lod,
                "print_phase": print_phase})
     return out
+
+
+class IfElse:
+    """Per-row conditional (reference control_flow.py IfElse): the reference
+    splits rows by a [N, 1] bool condition (split_lod_tensor), runs each
+    branch on its subset and merges (merge_lod_tensor). TPU-native select
+    semantics: both branches compute over the FULL batch and ``()`` merges
+    row-wise with where(cond) — identical results, no dynamic shapes
+    (the conditional_block/Switch cost model, ops/control_flow_ops.py).
+
+        ie = layers.IfElse(cond)          # cond: [N, 1] bool
+        with ie.true_block():
+            ie.output(true_expr)
+        with ie.false_block():
+            ie.output(false_expr)
+        merged, = ie()
+    """
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self._true_outs = None
+        self._false_outs = None
+        self._current = None
+
+    def input(self, x):
+        """Reference API compatibility: the branch sees the full rows (the
+        reference would slice to the branch's subset; select semantics make
+        that a no-op here)."""
+        return x
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self._current = []
+        yield
+        self._true_outs = self._current
+        self._current = None
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self._current = []
+        yield
+        self._false_outs = self._current
+        self._current = None
+
+    def output(self, *outs):
+        assert self._current is not None, "output() outside a block"
+        self._current.extend(outs)
+
+    def __call__(self):
+        if self._true_outs is None or self._false_outs is None:
+            raise ValueError("IfElse needs both true_block and false_block")
+        if len(self._true_outs) != len(self._false_outs):
+            raise ValueError("branches must produce the same output count")
+        helper = self.helper
+        from .tensor import cast
+        cond_f = cast(self.cond, "float32")
+        merged = []
+        for t, f in zip(self._true_outs, self._false_outs):
+            out = helper.create_tmp_variable(t.dtype, shape=t.shape,
+                                             lod_level=t.lod_level)
+            # where(cond, t, f) = cond*t + (1-cond)*f, broadcasting the
+            # [N, 1] condition across feature dims
+            helper.append_op("ifelse_merge",
+                             inputs={"Cond": [cond_f.name], "TrueVal": [t.name],
+                                     "FalseVal": [f.name]},
+                             outputs={"Out": [out.name]})
+            merged.append(out)
+        return merged

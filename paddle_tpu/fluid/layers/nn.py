@@ -553,3 +553,228 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
                      attrs={"transpose_X": transpose_x,
                             "transpose_Y": transpose_y})
     return out
+
+
+# ---------------------------------------------------------------------------
+# op-breadth layers (reference layers/nn.py + layers/ops.py wrappers)
+# ---------------------------------------------------------------------------
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False, name=None):
+    helper = LayerHelper("cumsum", name=name)
+    out = helper.create_tmp_variable(x.dtype, shape=x.shape,
+                                     lod_level=x.lod_level)
+    helper.append_op("cumsum", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"axis": axis, "exclusive": exclusive,
+                            "reverse": reverse})
+    return out
+
+
+def prelu(x, param_attr=None, name=None):
+    """Scalar-alpha PReLU (reference prelu_op.cc requires numel(Alpha)==1)."""
+    helper = LayerHelper("prelu", name=name)
+    alpha = helper.create_parameter(ParamAttr.to_attr(param_attr),
+                                    shape=(1,), dtype=x.dtype,
+                                    default_initializer=Constant(0.25))
+    out = helper.create_tmp_variable(x.dtype, shape=x.shape)
+    helper.append_op("prelu", inputs={"X": [x.name], "Alpha": [alpha.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def maxout(x, groups, name=None):
+    helper = LayerHelper("maxout", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("maxout", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"groups": groups})
+    return out
+
+
+def spp(input, pyramid_height, pool_type="max", name=None):
+    helper = LayerHelper("spp", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("spp", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"pyramid_height": pyramid_height,
+                            "pooling_type": pool_type})
+    return out
+
+
+def max_pool2d_with_index(input, pool_size, pool_stride=None, name=None):
+    helper = LayerHelper("max_pool2d_with_index", name=name)
+    ks = [pool_size, pool_size] if isinstance(pool_size, int) else pool_size
+    st = pool_stride or ks
+    st = [st, st] if isinstance(st, int) else st
+    out = helper.create_tmp_variable(input.dtype)
+    mask = helper.create_tmp_variable("int32", stop_gradient=True)
+    helper.append_op("max_pool2d_with_index", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name], "Mask": [mask.name]},
+                     attrs={"ksize": list(ks), "strides": list(st)})
+    return out, mask
+
+
+def unpool(input, indices, unpooled_size, name=None):
+    helper = LayerHelper("unpool", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("unpool",
+                     inputs={"X": [input.name], "Indices": [indices.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"unpooled_size": list(unpooled_size)})
+    return out
+
+
+def norm(input, param_attr=None, epsilon=1e-10, name=None):
+    """Cross-channel L2 normalization with a learned per-channel scale
+    (reference norm_op.h, the SSD conv4_3 normalize layer)."""
+    helper = LayerHelper("norm", name=name)
+    channels = input.shape[1]
+    scale = helper.create_parameter(ParamAttr.to_attr(param_attr),
+                                    shape=(channels,), dtype=input.dtype,
+                                    default_initializer=Constant(1.0))
+    out = helper.create_tmp_variable(input.dtype, shape=input.shape)
+    helper.append_op("norm",
+                     inputs={"X": [input.name], "Scale": [scale.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"epsilon": epsilon})
+    return out
+
+
+def im2sequence(input, filter_size, stride=1, padding=0, name=None):
+    helper = LayerHelper("im2sequence", name=name)
+    ks = [filter_size, filter_size] if isinstance(filter_size, int) \
+        else list(filter_size)
+    st = [stride, stride] if isinstance(stride, int) else list(stride)
+    pd = [padding] * 4 if isinstance(padding, int) else list(padding)
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    # flat-rows LoD shape [-1, c*kh*kw] so downstream fc sees the feature dim
+    shape = None
+    if input.shape is not None:
+        shape = (-1, input.shape[1] * ks[0] * ks[1])
+    out = helper.create_tmp_variable(input.dtype, shape=shape, lod_level=1)
+    helper.append_op("im2sequence", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"kernels": ks, "strides": st, "paddings": pd})
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    helper = LayerHelper("rank_loss", name=name)
+    out = helper.create_tmp_variable(left.dtype, shape=left.shape)
+    helper.append_op("rank_loss",
+                     inputs={"Label": [label.name], "Left": [left.name],
+                             "Right": [right.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper("margin_rank_loss", name=name)
+    out = helper.create_tmp_variable(left.dtype, shape=left.shape)
+    helper.append_op("margin_rank_loss",
+                     inputs={"Label": [label.name], "X1": [left.name],
+                             "X2": [right.name]},
+                     outputs={"Out": [out.name]}, attrs={"margin": margin})
+    return out
+
+
+def bilinear_tensor_product(x, y, size, param_attr=None, bias_attr=None,
+                            name=None):
+    helper = LayerHelper("bilinear_tensor_product", name=name,
+                         bias_attr=bias_attr)
+    w = helper.create_parameter(
+        ParamAttr.to_attr(param_attr),
+        shape=(size, x.shape[-1], y.shape[-1]), dtype=x.dtype,
+        default_initializer=Xavier())
+    out = helper.create_tmp_variable(x.dtype, shape=(x.shape[0], size))
+    inputs = {"X": [x.name], "Y": [y.name], "Weight": [w.name]}
+    if bias_attr is not False:
+        b = helper.create_parameter(ParamAttr.to_attr(bias_attr),
+                                    shape=(size,), dtype=x.dtype,
+                                    default_initializer=Constant(0.0))
+        inputs["Bias"] = [b.name]
+    helper.append_op("bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def is_empty(x, name=None):
+    helper = LayerHelper("is_empty", name=name)
+    out = helper.create_tmp_variable("bool", shape=(1,), stop_gradient=True)
+    helper.append_op("is_empty", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def nce(input, label, num_total_classes, num_neg_samples=10,
+        sample_weight=None, param_attr=None, bias_attr=None,
+        custom_neg_classes=None, name=None):
+    """Noise-contrastive estimation loss (reference layers/nn.py nce ->
+    nce_op.h): per-sample cost over [true | sampled negative] classes."""
+    helper = LayerHelper("nce", name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(ParamAttr.to_attr(param_attr),
+                                shape=(num_total_classes, dim),
+                                dtype=input.dtype,
+                                default_initializer=Xavier())
+    b = helper.create_parameter(ParamAttr.to_attr(bias_attr),
+                                shape=(num_total_classes,),
+                                dtype=input.dtype,
+                                default_initializer=Constant(0.0))
+    cost = helper.create_tmp_variable(input.dtype)
+    sample_labels = helper.create_tmp_variable("int32", stop_gradient=True)
+    inputs = {"Input": [input.name], "Label": [label.name],
+              "Weight": [w.name], "Bias": [b.name]}
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight.name]
+    helper.append_op(
+        "nce", inputs=inputs,
+        outputs={"Cost": [cost.name],
+                 "SampleLabels": [sample_labels.name]},
+        attrs={"num_total_classes": num_total_classes,
+               "num_neg_samples": num_neg_samples,
+               "custom_neg_classes": list(custom_neg_classes or [])})
+    return cost
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv3d", name=name, act=act, bias_attr=bias_attr)
+    ks = [filter_size] * 3 if isinstance(filter_size, int) \
+        else list(filter_size)
+    c_in = input.shape[1]
+    w = helper.create_parameter(
+        ParamAttr.to_attr(param_attr),
+        shape=(num_filters, c_in // groups, ks[0], ks[1], ks[2]),
+        dtype=input.dtype, default_initializer=Xavier())
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(
+        "conv3d", inputs={"Input": [input.name], "Filter": [w.name]},
+        outputs={"Output": [out.name]},
+        attrs={"strides": [stride] * 3 if isinstance(stride, int)
+               else list(stride),
+               "paddings": [padding] * 3 if isinstance(padding, int)
+               else list(padding),
+               "dilations": [dilation] * 3 if isinstance(dilation, int)
+               else list(dilation),
+               "groups": groups})
+    out = _append_channel_bias(helper, out, num_filters, bias_attr)
+    return helper.append_activation(out)
+
+
+def pool3d(input, pool_size=2, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, name=None):
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(
+        "pool3d", inputs={"X": [input.name]},
+        outputs={"Out": [out.name]},
+        attrs={"ksize": [pool_size] * 3 if isinstance(pool_size, int)
+               else list(pool_size),
+               "strides": [pool_stride] * 3 if isinstance(pool_stride, int)
+               else list(pool_stride),
+               "paddings": [pool_padding] * 3
+               if isinstance(pool_padding, int) else list(pool_padding),
+               "pooling_type": pool_type,
+               "global_pooling": global_pooling})
+    return out

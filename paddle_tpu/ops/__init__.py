@@ -24,4 +24,5 @@ from . import (  # noqa: F401
     optimizer_ops,
     metrics,
     detection_ops,
+    misc_ops,
 )

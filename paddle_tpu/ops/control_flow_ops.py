@@ -675,3 +675,28 @@ def beam_search_decode(ctx):
     outer = jnp.full((b,), beam, jnp.int32)
     ctx.set_output("SentenceIds", LoDArray(seqs[..., None], lens, outer))
     ctx.set_output("SentenceScores", scores.reshape(b * beam))
+
+
+@register_op("ifelse_merge", grad=lambda op: [OpSpec(
+    "ifelse_merge_grad",
+    {"Cond": op.input("Cond"), "Out@GRAD": G(op.output("Out"))},
+    {"TrueVal@GRAD": G(op.input("TrueVal")),
+     "FalseVal@GRAD": G(op.input("FalseVal"))})])
+def ifelse_merge(ctx):
+    """Row-wise select merging IfElse branches (the merge_lod_tensor
+    equivalent, reference merge_lod_tensor_op.cc, under select semantics)."""
+    cond = data_of(ctx.input("Cond"))
+    t = data_of(ctx.input("TrueVal"))
+    f = data_of(ctx.input("FalseVal"))
+    c = cond.reshape((cond.shape[0],) + (1,) * (t.ndim - 1)) > 0.5
+    ctx.set_output("Out", jnp.where(c, t, f))
+
+
+@register_op("ifelse_merge_grad")
+def ifelse_merge_grad(ctx):
+    cond = data_of(ctx.input("Cond"))
+    d = data_of(ctx.input("Out@GRAD"))
+    c = cond.reshape((cond.shape[0],) + (1,) * (d.ndim - 1)) > 0.5
+    zero = jnp.zeros_like(d)
+    ctx.set_output("TrueVal@GRAD", jnp.where(c, d, zero))
+    ctx.set_output("FalseVal@GRAD", jnp.where(c, zero, d))

@@ -1,0 +1,606 @@
+"""Remaining op breadth: cumsum, prelu, maxout, spp, unpool, norm,
+im2sequence, rank_loss, margin_rank_loss, bilinear_tensor_product, is_empty,
+nce, conv3d, pool3d.
+
+Reference: /root/reference/paddle/fluid/operators/{cum_op.h (cumsum),
+prelu_op.cc (scalar alpha), maxout_op.cc + math/maxouting.cc, spp_op.h
+(pyramid of pools + concat), unpool_op.cc + math/unpooling.cc (max-indices
+scatter), norm_op.h (cross-channel L2 normalize, per-channel scale),
+im2sequence_op.h (im2col patches as sequences), rank_loss_op.h
+(log(1+e^{l-r}) - label (l-r)), margin_rank_loss_op.h, bilinear_tensor_
+product_op.h (x W_k yᵀ per output k), is_empty_op.cc, nce_op.h (sampled
+sigmoid logits, -log(o/(o+b)) / -log(b/(o+b)) with b = S/C), conv_op.cc +
+pool_op.cc 3-D variants}.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op, OpSpec, same_shape, infer_output
+from .common import G, data_of, like
+
+
+# ---------------------------------------------------------------------------
+# cumsum
+# ---------------------------------------------------------------------------
+
+@register_op("cumsum", infer_shape=same_shape("X", "Out"), grad=lambda op: [
+    OpSpec("cumsum", {"X": G(op.output("Out"))}, {"Out": G(op.input("X"))},
+           {**dict(op.attrs), "reverse": not op.attr("reverse", False)})])
+def cumsum(ctx):
+    """cum_op.h: running sum along ``axis`` with exclusive/reverse modes;
+    the gradient is cumsum with reverse flipped."""
+    x = data_of(ctx.input("X"))
+    axis = int(ctx.attr("axis", -1))
+    exclusive = bool(ctx.attr("exclusive", False))
+    reverse = bool(ctx.attr("reverse", False))
+    v = jnp.flip(x, axis) if reverse else x
+    out = jnp.cumsum(v, axis=axis)
+    if exclusive:
+        out = out - v
+    if reverse:
+        out = jnp.flip(out, axis)
+    ctx.set_output("Out", like(ctx.input("X"), out))
+
+
+# ---------------------------------------------------------------------------
+# prelu (scalar alpha, the reference's product(alpha)==1 contract)
+# ---------------------------------------------------------------------------
+
+@register_op("prelu", infer_shape=same_shape("X", "Out"), grad=lambda op: [
+    OpSpec("prelu_grad",
+           {"X": op.input("X"), "Alpha": op.input("Alpha"),
+            "Out@GRAD": G(op.output("Out"))},
+           {"X@GRAD": G(op.input("X")), "Alpha@GRAD": G(op.input("Alpha"))})])
+def prelu(ctx):
+    x = data_of(ctx.input("X"))
+    alpha = data_of(ctx.input("Alpha")).reshape(())
+    ctx.set_output("Out", like(ctx.input("X"),
+                               jnp.where(x > 0, x, alpha * x)))
+
+
+@register_op("prelu_grad")
+def prelu_grad(ctx):
+    x = data_of(ctx.input("X"))
+    alpha = data_of(ctx.input("Alpha")).reshape(())
+    d = data_of(ctx.input("Out@GRAD"))
+    ctx.set_output("X@GRAD", jnp.where(x > 0, d, alpha * d))
+    ctx.set_output("Alpha@GRAD",
+                   jnp.sum(jnp.where(x > 0, 0.0, d * x)).reshape(
+                       data_of(ctx.input("Alpha")).shape))
+
+
+# ---------------------------------------------------------------------------
+# maxout
+# ---------------------------------------------------------------------------
+
+def _maxout_infer(op, block):
+    x = block.var(op.input("X")[0])
+    if x.shape is None:
+        return
+    g = int(op.attrs["groups"])
+    n, c, h, w = x.shape
+    infer_output(op, block, "Out", (n, c // g, h, w), dtype=x.dtype)
+
+
+def _maxout_compute(x, groups):
+    n, c, h, w = x.shape
+    return x.reshape(n, c // groups, groups, h, w).max(axis=2)
+
+
+@register_op("maxout", infer_shape=_maxout_infer, grad=lambda op: [OpSpec(
+    "maxout_grad",
+    {"X": op.input("X"), "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
+def maxout(ctx):
+    """maxout_op.cc: channels split into groups of ``groups``, max over the
+    group (NCHW)."""
+    x = data_of(ctx.input("X"))
+    ctx.set_output("Out", _maxout_compute(x, int(ctx.attr("groups"))))
+
+
+@register_op("maxout_grad")
+def maxout_grad(ctx):
+    x = data_of(ctx.input("X"))
+    dy = data_of(ctx.input("Out@GRAD"))
+    g = int(ctx.attr("groups"))
+    _, vjp = jax.vjp(lambda a: _maxout_compute(a, g), x)
+    ctx.set_output("X@GRAD", vjp(dy.astype(x.dtype))[0])
+
+
+# ---------------------------------------------------------------------------
+# spp (spatial pyramid pooling)
+# ---------------------------------------------------------------------------
+
+@register_op("spp", grad=lambda op: [OpSpec(
+    "spp_grad",
+    {"X": op.input("X"), "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
+def spp(ctx):
+    """spp_op.h: for level l in [0, pyramid_height): pool into 2^l x 2^l
+    bins (max or avg), flatten, concat -> [N, C * Σ 4^l]."""
+    x = data_of(ctx.input("X"))
+    ctx.set_output("Out", _spp_compute(
+        x, int(ctx.attr("pyramid_height")),
+        ctx.attr("pooling_type", "max")))
+
+
+def _spp_compute(x, height, ptype):
+    """Reference spp_op.h geometry: kernel = ceil(size/bins), stride =
+    kernel, padding = (kernel*bins - size + 1) / 2 — EXACTLY bins x bins
+    outputs per level regardless of divisibility."""
+    from .conv_ops import _pool2d_compute
+    n, c, h, w = x.shape
+    outs = []
+    for lvl in range(height):
+        bins = 2 ** lvl
+        kh, kw = -(-h // bins), -(-w // bins)
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        pooled = _pool2d_compute(x, (kh, kw), (kh, kw), (ph, pw), ptype,
+                                 False, False)
+        assert pooled.shape[2] == bins and pooled.shape[3] == bins, \
+            (pooled.shape, bins)
+        outs.append(pooled.reshape(n, -1))
+    return jnp.concatenate(outs, axis=1)
+
+
+@register_op("spp_grad")
+def spp_grad(ctx):
+    x = data_of(ctx.input("X"))
+    dy = data_of(ctx.input("Out@GRAD"))
+    h = int(ctx.attr("pyramid_height"))
+    ptype = ctx.attr("pooling_type", "max")
+    _, vjp = jax.vjp(lambda a: _spp_compute(a, h, ptype), x)
+    ctx.set_output("X@GRAD", vjp(dy.astype(x.dtype))[0])
+
+
+# ---------------------------------------------------------------------------
+# max_pool2d_with_index + unpool
+# ---------------------------------------------------------------------------
+
+@register_op("max_pool2d_with_index")
+def max_pool2d_with_index(ctx):
+    """Pooling that also emits flat argmax indices within each image
+    (math/pooling.cc MaxPool2dWithIndexFunctor) — the producer unpool
+    consumes."""
+    x = data_of(ctx.input("X"))
+    ks = tuple(ctx.attr("ksize"))
+    st = tuple(ctx.attr("strides", ks))
+    n, c, h, w = x.shape
+    oh, ow = (h - ks[0]) // st[0] + 1, (w - ks[1]) // st[1] + 1
+    # windows -> [N, C, oh, ow, kh*kw]
+    patches = jnp.stack([
+        x[:, :, i:i + st[0] * oh:st[0], j:j + st[1] * ow:st[1]]
+        for i in range(ks[0]) for j in range(ks[1])], axis=-1)
+    arg = jnp.argmax(patches, axis=-1)
+    out = jnp.max(patches, axis=-1)
+    # flat index within the [h, w] plane (reference index convention)
+    ki, kj = arg // ks[1], arg % ks[1]
+    rows = jnp.arange(oh)[None, None, :, None] * st[0] + ki
+    cols = jnp.arange(ow)[None, None, None, :] * st[1] + kj
+    ctx.set_output("Out", out)
+    ctx.set_output("Mask", (rows * w + cols).astype(jnp.int32))
+
+
+@register_op("unpool", grad=lambda op: [OpSpec(
+    "unpool_grad",
+    {"Indices": op.input("Indices"), "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
+def unpool(ctx):
+    """unpool_op.cc (max unpooling): scatter each pooled value back to its
+    argmax position in the [unpooled_h, unpooled_w] plane."""
+    x = data_of(ctx.input("X"))
+    idx = data_of(ctx.input("Indices")).astype(jnp.int32)
+    uh, uw = tuple(ctx.attr("unpooled_size"))
+    n, c, oh, ow = x.shape
+    flat = jnp.zeros((n, c, uh * uw), x.dtype)
+    out = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, -1)].add(x.reshape(n, c, -1))
+    ctx.set_output("Out", out.reshape(n, c, uh, uw))
+
+
+@register_op("unpool_grad")
+def unpool_grad(ctx):
+    dy = data_of(ctx.input("Out@GRAD"))
+    idx = data_of(ctx.input("Indices")).astype(jnp.int32)
+    n, c = idx.shape[:2]
+    flat = dy.reshape(n, c, -1)
+    ctx.set_output("X@GRAD", jnp.take_along_axis(
+        flat, idx.reshape(n, c, -1), axis=2).reshape(idx.shape))
+
+
+# ---------------------------------------------------------------------------
+# norm (cross-channel L2 normalization with per-channel scale)
+# ---------------------------------------------------------------------------
+
+def _norm_compute(x, scale, eps):
+    denom = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True) + eps)
+    return x / denom * scale.reshape(1, -1, 1, 1)
+
+
+@register_op("norm", infer_shape=same_shape("X", "Out"), grad=lambda op: [
+    OpSpec("norm_grad",
+           {"X": op.input("X"), "Scale": op.input("Scale"),
+            "Out@GRAD": G(op.output("Out"))},
+           {"X@GRAD": G(op.input("X")), "Scale@GRAD": G(op.input("Scale"))},
+           dict(op.attrs))])
+def norm(ctx):
+    """norm_op.h: out[n,c,h,w] = scale[c] * x / ||x[n,:,h,w]||₂ (+eps)."""
+    x = data_of(ctx.input("X"))
+    scale = data_of(ctx.input("Scale")).reshape(-1)
+    ctx.set_output("Out", _norm_compute(x, scale,
+                                        float(ctx.attr("epsilon", 1e-10))))
+
+
+@register_op("norm_grad")
+def norm_grad(ctx):
+    x = data_of(ctx.input("X"))
+    scale = data_of(ctx.input("Scale")).reshape(-1)
+    dy = data_of(ctx.input("Out@GRAD"))
+    eps = float(ctx.attr("epsilon", 1e-10))
+    _, vjp = jax.vjp(lambda a, s: _norm_compute(a, s, eps), x, scale)
+    dx, ds = vjp(dy.astype(x.dtype))
+    ctx.set_output("X@GRAD", dx)
+    ctx.set_output("Scale@GRAD", ds.reshape(
+        data_of(ctx.input("Scale")).shape))
+
+
+# ---------------------------------------------------------------------------
+# im2sequence — im2col patches as an LoD sequence per image
+# ---------------------------------------------------------------------------
+
+def _im2seq_compute(x, kernels, strides, paddings):
+    n, c, h, w = x.shape
+    kh, kw = kernels
+    sh, sw = strides
+    pu, pl, pd, pr = paddings          # up, left, down, right
+    oh = (h + pu + pd - kh) // sh + 1
+    ow = (w + pl + pr - kw) // sw + 1
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pu, pd), (pl, pr)))
+    patches = jnp.stack([
+        xp[:, :, i:i + sh * oh:sh, j:j + sw * ow:sw]
+        for i in range(kh) for j in range(kw)], axis=2)  # [N,C,kh*kw,oh,ow]
+    # reference layout per step: [c, kh, kw] flattened; steps row-major
+    seq = jnp.transpose(patches.reshape(n, c, kh, kw, oh, ow),
+                        (0, 4, 5, 1, 2, 3))
+    return seq.reshape(n, oh * ow, c * kh * kw), oh * ow
+
+
+@register_op("im2sequence", grad=lambda op: [OpSpec(
+    "im2sequence_grad",
+    {"X": op.input("X"), "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
+def im2sequence(ctx):
+    """im2sequence_op.h: each image becomes one sequence of oh*ow steps,
+    each step a flattened [c, kh, kw] patch (the OCR-CTC front end)."""
+    x = data_of(ctx.input("X"))
+    seq, steps = _im2seq_compute(
+        x, tuple(ctx.attr("kernels")), tuple(ctx.attr("strides", [1, 1])),
+        tuple(ctx.attr("paddings", [0, 0, 0, 0])))
+    lens = jnp.full((x.shape[0],), steps, jnp.int32)
+    ctx.set_output("Out", LoDArray(seq, lens))
+
+
+@register_op("im2sequence_grad")
+def im2sequence_grad(ctx):
+    x = data_of(ctx.input("X"))
+    dy = data_of(ctx.input("Out@GRAD"))
+    args = (tuple(ctx.attr("kernels")), tuple(ctx.attr("strides", [1, 1])),
+            tuple(ctx.attr("paddings", [0, 0, 0, 0])))
+    _, vjp = jax.vjp(lambda a: _im2seq_compute(a, *args)[0], x)
+    ctx.set_output("X@GRAD", vjp(dy.astype(x.dtype))[0])
+
+
+# ---------------------------------------------------------------------------
+# rank_loss / margin_rank_loss
+# ---------------------------------------------------------------------------
+
+def _rank_loss_compute(label, left, right):
+    return jnp.log1p(jnp.exp(left - right)) - label * (left - right)
+
+
+@register_op("rank_loss", infer_shape=same_shape("Left", "Out"),
+             grad=lambda op: [OpSpec(
+                 "rank_loss_grad",
+                 {"Label": op.input("Label"), "Left": op.input("Left"),
+                  "Right": op.input("Right"),
+                  "Out@GRAD": G(op.output("Out"))},
+                 {"Left@GRAD": G(op.input("Left")),
+                  "Right@GRAD": G(op.input("Right"))})])
+def rank_loss(ctx):
+    """rank_loss_op.h: RankNet pairwise loss
+    log(1 + e^{l-r}) - label·(l-r)."""
+    ctx.set_output("Out", _rank_loss_compute(
+        data_of(ctx.input("Label")), data_of(ctx.input("Left")),
+        data_of(ctx.input("Right"))))
+
+
+@register_op("rank_loss_grad")
+def rank_loss_grad(ctx):
+    label = data_of(ctx.input("Label"))
+    left = data_of(ctx.input("Left"))
+    right = data_of(ctx.input("Right"))
+    d = data_of(ctx.input("Out@GRAD"))
+    sig = jax.nn.sigmoid(left - right)
+    ctx.set_output("Left@GRAD", d * (sig - label))
+    ctx.set_output("Right@GRAD", d * (label - sig))
+
+
+@register_op("margin_rank_loss", infer_shape=same_shape("X1", "Out"),
+             grad=lambda op: [OpSpec(
+                 "margin_rank_loss_grad",
+                 {"Label": op.input("Label"), "X1": op.input("X1"),
+                  "X2": op.input("X2"), "Out@GRAD": G(op.output("Out"))},
+                 {"X1@GRAD": G(op.input("X1")),
+                  "X2@GRAD": G(op.input("X2"))}, dict(op.attrs))])
+def margin_rank_loss(ctx):
+    """margin_rank_loss_op.h: max(0, -label·(x1-x2) + margin)."""
+    label = data_of(ctx.input("Label"))
+    x1 = data_of(ctx.input("X1"))
+    x2 = data_of(ctx.input("X2"))
+    margin = float(ctx.attr("margin", 0.0))
+    ctx.set_output("Out", jnp.maximum(0.0, -label * (x1 - x2) + margin))
+
+
+@register_op("margin_rank_loss_grad")
+def margin_rank_loss_grad(ctx):
+    label = data_of(ctx.input("Label"))
+    x1 = data_of(ctx.input("X1"))
+    x2 = data_of(ctx.input("X2"))
+    margin = float(ctx.attr("margin", 0.0))
+    d = data_of(ctx.input("Out@GRAD"))
+    act = (-label * (x1 - x2) + margin) > 0
+    ctx.set_output("X1@GRAD", jnp.where(act, -label * d, 0.0))
+    ctx.set_output("X2@GRAD", jnp.where(act, label * d, 0.0))
+
+
+# ---------------------------------------------------------------------------
+# bilinear_tensor_product
+# ---------------------------------------------------------------------------
+
+def _btp_compute(x, y, w, bias):
+    # out[b, k] = x[b] @ W[k] @ y[b] (+ bias[k])
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    return out
+
+
+@register_op("bilinear_tensor_product", grad=lambda op: [OpSpec(
+    "bilinear_tensor_product_grad",
+    {"X": op.input("X"), "Y": op.input("Y"), "Weight": op.input("Weight"),
+     **({"Bias": op.input("Bias")} if op.input("Bias") else {}),
+     "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X")), "Y@GRAD": G(op.input("Y")),
+     "Weight@GRAD": G(op.input("Weight")),
+     **({"Bias@GRAD": G(op.input("Bias"))} if op.input("Bias") else {})})])
+def bilinear_tensor_product(ctx):
+    """bilinear_tensor_product_op.h: out_k = x W_k yᵀ + b_k."""
+    x = data_of(ctx.input("X"))
+    y = data_of(ctx.input("Y"))
+    w = data_of(ctx.input("Weight"))
+    bias = data_of(ctx.input("Bias")) if ctx.has_input("Bias") else None
+    ctx.set_output("Out", _btp_compute(x, y, w, bias))
+
+
+@register_op("bilinear_tensor_product_grad")
+def bilinear_tensor_product_grad(ctx):
+    x = data_of(ctx.input("X"))
+    y = data_of(ctx.input("Y"))
+    w = data_of(ctx.input("Weight"))
+    has_bias = ctx.has_input("Bias")
+    bias = data_of(ctx.input("Bias")) if has_bias else None
+    d = data_of(ctx.input("Out@GRAD"))
+    args = (x, y, w) + ((bias,) if has_bias else ())
+
+    def f(*a):
+        return _btp_compute(a[0], a[1], a[2], a[3] if has_bias else None)
+
+    _, vjp = jax.vjp(f, *args)
+    grads = vjp(d.astype(x.dtype))
+    ctx.set_output("X@GRAD", grads[0])
+    ctx.set_output("Y@GRAD", grads[1])
+    ctx.set_output("Weight@GRAD", grads[2])
+    if has_bias:
+        ctx.set_output("Bias@GRAD", grads[3])
+
+
+# ---------------------------------------------------------------------------
+# is_empty
+# ---------------------------------------------------------------------------
+
+@register_op("is_empty")
+def is_empty(ctx):
+    """is_empty_op.cc: scalar bool, true iff X has zero elements (a static
+    property under XLA, computed at trace time)."""
+    x = data_of(ctx.input("X"))
+    ctx.set_output("Out", jnp.asarray([x.size == 0]))
+
+
+# ---------------------------------------------------------------------------
+# nce (noise-contrastive estimation)
+# ---------------------------------------------------------------------------
+
+@register_op("nce", grad=lambda op: [OpSpec(
+    "nce_grad",
+    {"Input": op.input("Input"), "Weight": op.input("Weight"),
+     "SampleLabels": op.output("SampleLabels"),
+     **({"Bias": op.input("Bias")} if op.input("Bias") else {}),
+     **({"SampleWeight": op.input("SampleWeight")}
+        if op.input("SampleWeight") else {}),
+     "Cost@GRAD": G(op.output("Cost"))},
+    {"Input@GRAD": G(op.input("Input")),
+     "Weight@GRAD": G(op.input("Weight")),
+     **({"Bias@GRAD": G(op.input("Bias"))} if op.input("Bias") else {})},
+    dict(op.attrs))])
+def nce(ctx):
+    """nce_op.h: per sample, logits σ(x·w_c + b_c) over [true classes |
+    sampled negatives]; cost = Σ_true -log(o/(o+b)) + Σ_neg -log(b/(o+b)),
+    b = num_neg/num_classes, each row scaled by SampleWeight when given.
+    Negatives: custom_neg_classes when given (the reference's unit-test
+    hook) else uniform draws from the executor PRNG. The drawn samples are
+    EMITTED as SampleLabels (the reference op's output) and consumed by
+    nce_grad, so forward cost and gradient always describe the same
+    sampled loss."""
+    label = data_of(ctx.input("Label"))
+    if label.ndim == 1:
+        label = label[:, None]
+    num_neg = int(ctx.attr("num_neg_samples"))
+    num_classes = int(ctx.attr("num_total_classes"))
+    custom = ctx.attr("custom_neg_classes", []) or []
+    b = label.shape[0]
+    if custom:
+        neg = jnp.broadcast_to(jnp.asarray(custom, jnp.int32)[None, :],
+                               (b, len(custom)))
+    else:
+        neg = jax.random.randint(ctx.next_rng(), (b, num_neg), 0,
+                                 num_classes).astype(jnp.int32)
+    samples = jnp.concatenate([label.astype(jnp.int32), neg], axis=1)
+    ctx.set_output("SampleLabels", samples)
+    ctx.set_output("Cost", _nce_cost(ctx, samples, label.shape[1]))
+
+
+def _nce_cost(ctx, samples, num_true, x=None, w=None, bias=None):
+    x = data_of(ctx.input("Input")) if x is None else x
+    w = data_of(ctx.input("Weight")) if w is None else w
+    if bias is None and ctx.has_input("Bias"):
+        bias = data_of(ctx.input("Bias"))
+    num_neg = int(ctx.attr("num_neg_samples"))
+    num_classes = int(ctx.attr("num_total_classes"))
+    logits = jnp.einsum("bd,bsd->bs", x, w[samples])
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[samples]
+    o = jax.nn.sigmoid(logits)
+    bconst = num_neg / num_classes
+    true_cost = -jnp.log(o[:, :num_true] / (o[:, :num_true] + bconst))
+    neg_cost = -jnp.log(bconst / (o[:, num_true:] + bconst))
+    cost = true_cost.sum(axis=1) + neg_cost.sum(axis=1)
+    if ctx.has_input("SampleWeight"):
+        cost = cost * data_of(ctx.input("SampleWeight")).reshape(-1)
+    return cost.reshape(-1, 1)
+
+
+@register_op("nce_grad")
+def nce_grad(ctx):
+    """jax.vjp over _nce_cost with the forward's OWN SampleLabels."""
+    x = data_of(ctx.input("Input"))
+    w = data_of(ctx.input("Weight"))
+    has_bias = ctx.has_input("Bias")
+    bias = data_of(ctx.input("Bias")) if has_bias else None
+    samples = data_of(ctx.input("SampleLabels")).astype(jnp.int32)
+    num_neg = int(ctx.attr("num_neg_samples"))
+    custom = ctx.attr("custom_neg_classes", []) or []
+    num_true = samples.shape[1] - (len(custom) or num_neg)
+    d = data_of(ctx.input("Cost@GRAD")).reshape(-1, 1)
+
+    args = (x, w) + ((bias,) if has_bias else ())
+
+    def f(*a):
+        return _nce_cost(ctx, samples, num_true, a[0], a[1],
+                         a[2] if has_bias else None)
+
+    _, vjp = jax.vjp(f, *args)
+    grads = vjp(d.astype(x.dtype))
+    ctx.set_output("Input@GRAD", grads[0])
+    ctx.set_output("Weight@GRAD", grads[1])
+    if has_bias:
+        ctx.set_output("Bias@GRAD", grads[2])
+
+
+# ---------------------------------------------------------------------------
+# conv3d / pool3d
+# ---------------------------------------------------------------------------
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(a) for a in (list(v) + [v[-1]] * 3)[:3])
+    return (int(v),) * 3
+
+
+@register_op("conv3d", grad=lambda op: [OpSpec(
+    "conv3d_grad",
+    {"Input": op.input("Input"), "Filter": op.input("Filter"),
+     "Output@GRAD": G(op.output("Output"))},
+    {"Input@GRAD": G(op.input("Input")),
+     "Filter@GRAD": G(op.input("Filter"))}, dict(op.attrs))])
+def conv3d(ctx):
+    """conv_op.cc 3-D registration: NCDHW input, OIDHW filter — one
+    lax.conv_general_dilated on the MXU, like conv2d."""
+    ctx.set_output("Output", _conv3d_compute(ctx))
+
+
+def _conv3d_compute(ctx, x=None, w=None):
+    from ..core.amp import cast_compute
+    x = data_of(ctx.input("Input")) if x is None else x
+    w = data_of(ctx.input("Filter")) if w is None else w
+    s = _triple(ctx.attr("strides", [1, 1, 1]))
+    p = _triple(ctx.attr("paddings", [0, 0, 0]))
+    d = _triple(ctx.attr("dilations", [1, 1, 1]))
+    g = int(ctx.attr("groups", 1) or 1)
+    x, w = cast_compute(x, w)
+    return lax.conv_general_dilated(
+        x, w, window_strides=s, padding=[(pp, pp) for pp in p],
+        rhs_dilation=d, feature_group_count=g,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+
+
+@register_op("conv3d_grad")
+def conv3d_grad(ctx):
+    x = data_of(ctx.input("Input"))
+    w = data_of(ctx.input("Filter"))
+    dy = data_of(ctx.input("Output@GRAD"))
+    out, vjp = jax.vjp(lambda a, b: _conv3d_compute(ctx, a, b), x, w)
+    dx, dw = vjp(dy.astype(out.dtype))
+    ctx.set_output("Input@GRAD", dx)
+    ctx.set_output("Filter@GRAD", dw)
+
+
+def _pool3d_compute(x, ksize, strides, paddings, ptype, global_pooling):
+    n, c, d, h, w = x.shape
+    if global_pooling:
+        ksize = (d, h, w)
+        paddings = (0, 0, 0)
+    kd, kh, kw = ksize
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    dims = (1, 1, kd, kh, kw)
+    strides5 = (1, 1) + tuple(strides)
+    if ptype == "max":
+        return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides5, pads)
+    sums = lax.reduce_window(x, 0.0, lax.add, dims, strides5, pads)
+    if any(paddings):
+        ones = jnp.ones((1, 1, d, h, w), x.dtype)
+        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides5, pads)
+        return sums / counts
+    return sums / (kd * kh * kw)
+
+
+def _pool3d_args(attr):
+    return (_triple(attr("ksize", [2, 2, 2])),
+            _triple(attr("strides", [1, 1, 1])),
+            _triple(attr("paddings", [0, 0, 0])),
+            attr("pooling_type", "max"),
+            bool(attr("global_pooling", False)))
+
+
+@register_op("pool3d", grad=lambda op: [OpSpec(
+    "pool3d_grad",
+    {"X": op.input("X"), "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
+def pool3d(ctx):
+    x = data_of(ctx.input("X"))
+    ctx.set_output("Out", _pool3d_compute(x, *_pool3d_args(ctx.attr)))
+
+
+@register_op("pool3d_grad")
+def pool3d_grad(ctx):
+    x = data_of(ctx.input("X"))
+    dy = data_of(ctx.input("Out@GRAD"))
+    args = _pool3d_args(ctx.attr)
+    out, vjp = jax.vjp(lambda a: _pool3d_compute(a, *args), x)
+    ctx.set_output("X@GRAD", vjp(dy.astype(out.dtype))[0])

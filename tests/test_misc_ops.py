@@ -1,0 +1,375 @@
+"""Op-breadth numeric tests vs numpy references.
+
+Reference OpTests: test_cumsum_op.py (cum_op.h), test_prelu_op.py,
+test_maxout_op.py, test_spp_op.py, test_unpool_op.py, test_norm_op.py,
+test_im2sequence_op.py, test_rank_loss_op.py, test_margin_rank_loss_op.py,
+test_bilinear_tensor_product_op.py, test_is_empty_op.py, test_nce.py,
+test_conv3d_op.py, test_pool3d_op.py (python/paddle/fluid/tests/unittests/).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+layers = fluid.layers
+
+
+def _run(builder, feed, mode="jit"):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        fetch = builder()
+    exe = fluid.Executor(fluid.CPUPlace(), mode=mode)
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=list(fetch))
+
+
+@pytest.mark.parametrize("exclusive,reverse", [(False, False), (True, False),
+                                               (False, True), (True, True)])
+def test_cumsum(exclusive, reverse):
+    rng = np.random.RandomState(0)
+    x = rng.rand(3, 5).astype("float32")
+
+    def build():
+        xv = layers.data("x", shape=[5])
+        return [layers.cumsum(xv, axis=1, exclusive=exclusive,
+                              reverse=reverse)]
+
+    got, = _run(build, {"x": x})
+    v = x[:, ::-1] if reverse else x
+    exp = np.cumsum(v, axis=1)
+    if exclusive:
+        exp = exp - v
+    if reverse:
+        exp = exp[:, ::-1]
+    np.testing.assert_allclose(got, exp, rtol=1e-6)
+
+
+def test_prelu_trains_alpha():
+    rng = np.random.RandomState(1)
+    x = rng.normal(0, 1, (8, 4)).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", shape=[4])
+        out = layers.prelu(xv, param_attr=fluid.ParamAttr(name="alpha"))
+        loss = layers.mean(out)
+        fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got, galpha = exe.run(main, feed={"x": x},
+                          fetch_list=[out, "alpha@GRAD"])
+    np.testing.assert_allclose(got, np.where(x > 0, x, 0.25 * x), rtol=1e-6)
+    exp_g = np.where(x > 0, 0, x).sum() / x.size
+    np.testing.assert_allclose(np.asarray(galpha).ravel()[0], exp_g,
+                               rtol=1e-5)
+
+
+def test_maxout():
+    rng = np.random.RandomState(2)
+    x = rng.rand(2, 6, 3, 3).astype("float32")
+
+    def build():
+        xv = layers.data("x", shape=[6, 3, 3])
+        return [layers.maxout(xv, groups=3)]
+
+    got, = _run(build, {"x": x})
+    exp = x.reshape(2, 2, 3, 3, 3).max(axis=2)
+    np.testing.assert_allclose(got, exp)
+
+
+def test_spp_non_divisible_feature_map():
+    """7x7 input, pyramid_height=3: output must be exactly C*(1+4+16)
+    (reference kernel=ceil/stride=kernel/pad geometry)."""
+    rng = np.random.RandomState(30)
+    x = rng.rand(2, 2, 7, 7).astype("float32")
+
+    def build():
+        xv = layers.data("x", shape=[2, 7, 7])
+        return [layers.spp(xv, pyramid_height=3, pool_type="max")]
+
+    got, = _run(build, {"x": x})
+    assert got.shape == (2, 2 * (1 + 4 + 16))
+    np.testing.assert_allclose(got[:, :2], x.max(axis=(2, 3)), rtol=1e-6)
+
+
+def test_spp_output():
+    rng = np.random.RandomState(3)
+    x = rng.rand(2, 3, 8, 8).astype("float32")
+
+    def build():
+        xv = layers.data("x", shape=[3, 8, 8])
+        return [layers.spp(xv, pyramid_height=2, pool_type="max")]
+
+    got, = _run(build, {"x": x})
+    assert got.shape == (2, 3 * (1 + 4))
+    np.testing.assert_allclose(got[:, :3], x.max(axis=(2, 3)), rtol=1e-6)
+    # level 1, bin (0,0) = max of the top-left 4x4 quadrant
+    np.testing.assert_allclose(got[:, 3], x[:, 0, :4, :4].max(axis=(1, 2)),
+                               rtol=1e-6)
+
+
+def test_max_pool_with_index_and_unpool_roundtrip():
+    rng = np.random.RandomState(4)
+    x = rng.rand(2, 2, 4, 4).astype("float32")
+
+    def build():
+        xv = layers.data("x", shape=[2, 4, 4])
+        pooled, mask = layers.max_pool2d_with_index(xv, pool_size=2,
+                                                    pool_stride=2)
+        up = layers.unpool(pooled, mask, unpooled_size=[4, 4])
+        return [pooled, mask, up]
+
+    pooled, mask, up = _run(build, {"x": x})
+    exp_pool = x.reshape(2, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(pooled, exp_pool)
+    # unpool scatters each max back to its original position
+    for n in range(2):
+        for c in range(2):
+            nz = up[n, c][up[n, c] != 0]
+            np.testing.assert_allclose(np.sort(nz),
+                                       np.sort(pooled[n, c].ravel()))
+
+
+def test_norm_cross_channel():
+    rng = np.random.RandomState(5)
+    x = rng.rand(2, 4, 3, 3).astype("float32") + 0.1
+
+    def build():
+        xv = layers.data("x", shape=[4, 3, 3])
+        return [layers.norm(xv, param_attr=fluid.ParamAttr(name="nsc"))]
+
+    got, = _run(build, {"x": x})
+    denom = np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+    np.testing.assert_allclose(got, x / denom, rtol=1e-5)
+
+
+def test_im2sequence():
+    rng = np.random.RandomState(6)
+    x = rng.rand(2, 2, 4, 4).astype("float32")
+
+    def build():
+        xv = layers.data("x", shape=[2, 4, 4])
+        return [layers.im2sequence(xv, filter_size=2, stride=2)]
+
+    out, = _run(build, {"x": x})
+    data = np.asarray(out.data)
+    lens = np.asarray(out.lens)
+    assert data.shape == (2, 4, 2 * 2 * 2) and (lens == 4).all()
+    # step 0 = top-left 2x2 patch of each channel, [c, kh, kw] flattened
+    exp0 = x[:, :, :2, :2].reshape(2, -1)
+    np.testing.assert_allclose(data[:, 0], exp0)
+
+
+def test_rank_loss_and_grad():
+    rng = np.random.RandomState(7)
+    label = (rng.rand(6, 1) > 0.5).astype("float32")
+    left = rng.normal(0, 1, (6, 1)).astype("float32")
+    right = rng.normal(0, 1, (6, 1)).astype("float32")
+
+    def build():
+        l = layers.data("label", shape=[1])
+        a = layers.data("left", shape=[1])
+        b = layers.data("right", shape=[1])
+        out = layers.rank_loss(l, a, b)
+        loss = layers.mean(out)
+        fluid.append_backward(loss)
+        return [out, "left@GRAD"]
+
+    out, gleft = _run(build, {"label": label, "left": left, "right": right})
+    exp = np.log1p(np.exp(left - right)) - label * (left - right)
+    np.testing.assert_allclose(out, exp, rtol=1e-5)
+    sig = 1 / (1 + np.exp(right - left))
+    np.testing.assert_allclose(gleft, (sig - label) / 6.0, rtol=1e-5)
+
+
+def test_margin_rank_loss():
+    label = np.array([[1.0], [-1.0], [1.0]], "float32")
+    x1 = np.array([[0.5], [0.5], [0.1]], "float32")
+    x2 = np.array([[0.3], [0.3], [0.4]], "float32")
+
+    def build():
+        l = layers.data("label", shape=[1])
+        a = layers.data("x1", shape=[1])
+        b = layers.data("x2", shape=[1])
+        return [layers.margin_rank_loss(l, a, b, margin=0.1)]
+
+    out, = _run(build, {"label": label, "x1": x1, "x2": x2})
+    exp = np.maximum(0.0, -label * (x1 - x2) + 0.1)
+    np.testing.assert_allclose(out, exp, rtol=1e-6)
+
+
+def test_bilinear_tensor_product():
+    rng = np.random.RandomState(8)
+    x = rng.rand(3, 4).astype("float32")
+    y = rng.rand(3, 5).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", shape=[4])
+        yv = layers.data("y", shape=[5])
+        out = layers.bilinear_tensor_product(
+            xv, yv, size=2, param_attr=fluid.ParamAttr(name="btp_w"),
+            bias_attr=fluid.ParamAttr(name="btp_b"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    got = exe.run(main, feed={"x": x, "y": y}, fetch_list=[out],
+                  scope=scope)[0]
+    w = np.asarray(scope.find_var("btp_w"))
+    b = np.asarray(scope.find_var("btp_b"))
+    exp = np.stack([np.sum(x @ w[k] * y, axis=1) for k in range(2)],
+                   axis=1) + b
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_is_empty():
+    def build():
+        xv = layers.data("x", shape=[3])
+        return [layers.is_empty(xv)]
+
+    got, = _run(build, {"x": np.zeros((2, 3), "float32")}, mode="eager")
+    assert bool(np.asarray(got)[0]) is False
+    got2, = _run(build, {"x": np.zeros((0, 3), "float32")}, mode="eager")
+    assert bool(np.asarray(got2)[0]) is True
+
+
+def test_nce_matches_numpy_with_custom_negatives():
+    """custom_neg_classes pins the sample set (the reference's own unit-test
+    hook), making the cost deterministic and numpy-checkable."""
+    rng = np.random.RandomState(9)
+    b, d, C = 4, 6, 8
+    x = rng.normal(0, 1, (b, d)).astype("float32")
+    label = rng.randint(0, C, (b, 1)).astype("int64")
+    negs = [5, 6]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", shape=[d])
+        lv = layers.data("label", shape=[1], dtype="int64")
+        cost = layers.nce(xv, lv, num_total_classes=C,
+                          num_neg_samples=len(negs),
+                          custom_neg_classes=negs,
+                          param_attr=fluid.ParamAttr(name="nce_w"),
+                          bias_attr=fluid.ParamAttr(name="nce_b"))
+        loss = layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    w = np.asarray(scope.find_var("nce_w")).copy()
+    bb = np.asarray(scope.find_var("nce_b")).copy()
+    got, = exe.run(main, feed={"x": x, "label": label}, fetch_list=[cost],
+                   scope=scope)
+
+    bconst = len(negs) / C
+    exp = np.zeros((b, 1), "float32")
+    for i in range(b):
+        samples = [int(label[i, 0])] + negs
+        for j, c in enumerate(samples):
+            o = 1 / (1 + np.exp(-(x[i] @ w[c] + bb[c])))
+            exp[i, 0] += -np.log(o / (o + bconst)) if j == 0 \
+                else -np.log(bconst / (o + bconst))
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+    # and it trains: repeated steps reduce the loss
+    losses = [float(exe.run(main, feed={"x": x, "label": label},
+                            fetch_list=[loss], scope=scope)[0])
+              for _ in range(20)]
+    assert losses[-1] < 0.6 * losses[0]
+
+
+def test_conv3d_matches_numpy():
+    rng = np.random.RandomState(10)
+    x = rng.rand(1, 2, 4, 4, 4).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", shape=[2, 4, 4, 4])
+        out = layers.conv3d(xv, num_filters=3, filter_size=2,
+                            bias_attr=False,
+                            param_attr=fluid.ParamAttr(name="c3w"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    got = exe.run(main, feed={"x": x}, fetch_list=[out], scope=scope)[0]
+    w = np.asarray(scope.find_var("c3w"))
+    exp = np.zeros((1, 3, 3, 3, 3), "float32")
+    for o in range(3):
+        for dz in range(3):
+            for dy in range(3):
+                for dx in range(3):
+                    exp[0, o, dz, dy, dx] = np.sum(
+                        x[0, :, dz:dz + 2, dy:dy + 2, dx:dx + 2] * w[o])
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_pool3d(ptype):
+    rng = np.random.RandomState(11)
+    x = rng.rand(1, 2, 4, 4, 4).astype("float32")
+
+    def build():
+        xv = layers.data("x", shape=[2, 4, 4, 4])
+        return [layers.pool3d(xv, pool_size=2, pool_type=ptype,
+                              pool_stride=2)]
+
+    got, = _run(build, {"x": x})
+    blocks = x.reshape(1, 2, 2, 2, 2, 2, 2, 2)
+    r = blocks.transpose(0, 1, 2, 4, 6, 3, 5, 7).reshape(1, 2, 2, 2, 2, -1)
+    exp = r.max(-1) if ptype == "max" else r.mean(-1)
+    np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+
+def test_ifelse_select_semantics_and_grad():
+    rng = np.random.RandomState(12)
+    x = rng.normal(0, 1, (6, 3)).astype("float32")
+    cond_np = (rng.rand(6, 1) > 0.5).astype("bool")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", shape=[3])
+        xv.stop_gradient = False
+        cv = layers.data("c", shape=[1], dtype="bool")
+        ie = layers.IfElse(cv)
+        with ie.true_block():
+            ie.output(layers.scale(ie.input(xv), scale=2.0))
+        with ie.false_block():
+            ie.output(layers.scale(ie.input(xv), scale=-1.0))
+        merged, = ie()
+        loss = layers.mean(merged)
+        fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got, gx = exe.run(main, feed={"x": x, "c": cond_np},
+                      fetch_list=[merged, "x@GRAD"])
+    exp = np.where(cond_np, 2.0 * x, -1.0 * x)
+    np.testing.assert_allclose(got, exp, rtol=1e-6)
+    exp_g = np.where(cond_np, 2.0, -1.0) / x.size * np.ones_like(x)
+    np.testing.assert_allclose(gx, exp_g, rtol=1e-5)
+
+
+def test_checkpoint_manifest_and_torn_save_detection(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        layers.fc(x, size=2, param_attr=fluid.ParamAttr(name="ckw"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    d = str(tmp_path / "ckpt")
+    fluid.io.save_params(exe, d, main)
+    import json
+    import os
+    manifest = json.load(open(os.path.join(d, "MANIFEST.json")))
+    assert "ckw" in manifest and manifest["ckw"]["shape"] == [4, 2]
+    # torn checkpoint: delete a var file the manifest lists
+    os.remove(os.path.join(d, "ckw.npy"))
+    from paddle_tpu.core.scope import reset_global_scope
+    reset_global_scope()
+    with pytest.raises(RuntimeError, match="torn"):
+        fluid.io.load_params(exe, d, main)
+    # saving vars absent from the scope is an error, not a silent skip
+    reset_global_scope()
+    with pytest.raises(RuntimeError, match="absent from the scope"):
+        fluid.io.save_params(exe, str(tmp_path / "c2"), main)
