@@ -37,12 +37,14 @@ def _tar_reader(path, member_match):
 
 
 def _synthetic(n, classes, seed):
-    rng = np.random.RandomState(seed)
-    templates = rng.rand(classes, 3072).astype(np.float32)
+    # fixed per-class templates across splits (see mnist._synthetic)
+    trng = np.random.RandomState(4321 + classes)
+    templates = trng.rand(classes, 3072).astype(np.float32)
     t = templates.reshape(classes, 3, 32, 32)
     for _ in range(2):
         t = (t + np.roll(t, 1, 2) + np.roll(t, 1, 3)) / 3.0
     templates = t.reshape(classes, 3072)
+    rng = np.random.RandomState(seed)
     labels = rng.randint(0, classes, n)
     imgs = np.clip(templates[labels]
                    + 0.2 * rng.rand(n, 3072).astype(np.float32), 0, 1)

@@ -14,7 +14,8 @@ import numpy as np
 
 from . import layers
 
-__all__ = ["Evaluator", "Accuracy", "ChunkEvaluator", "Auc"]
+__all__ = ["Evaluator", "Accuracy", "ChunkEvaluator", "Auc",
+           "DetectionMAP"]
 
 
 class Evaluator:
@@ -125,3 +126,86 @@ class Auc(Evaluator):
 
         tp, fn, tn, fp = (jnp.asarray(s, jnp.float32) for s in self._stats)
         return float(auc_from_stats(tp, fn, tn, fp, self._curve))
+
+class DetectionMAP(Evaluator):
+    """Mean average precision over accumulated detections (the capability of
+    the reference detection_map op, operators/detection_map_op.cc, exposed
+    as the stateful evaluator the reference pairs it with,
+    evaluator.py DetectionMAP). Host-side accumulation: call
+    ``update(detections, gt_boxes)`` per batch with the multiclass_nms
+    output LoDArray and per-image ground truth [[(label, x1, y1, x2, y2)]];
+    ``eval()`` integrates 11-point interpolated AP per class."""
+
+    def __init__(self, overlap_threshold=0.5, name=None):
+        super().__init__(name)
+        self._thresh = overlap_threshold
+        self._metrics = []
+        self.reset()
+
+    def reset(self):
+        self._dets = {}     # class -> list of (score, is_tp)
+        self._n_gt = {}     # class -> count
+
+    @staticmethod
+    def _iou(a, b):
+        ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+        ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+        iw, ih = max(ix2 - ix1, 0.0), max(iy2 - iy1, 0.0)
+        inter = iw * ih
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def update(self, detections, gt_boxes):
+        """detections: LoDArray [b, K, 6] rows (label, score, box) with lens;
+        gt_boxes: list (per image) of (label, x1, y1, x2, y2) tuples."""
+        rows = np.asarray(detections.data)
+        lens = np.asarray(detections.lens)
+        for img, gts in enumerate(gt_boxes):
+            for lbl, *_ in gts:
+                self._n_gt[int(lbl)] = self._n_gt.get(int(lbl), 0) + 1
+            matched = set()
+            dets = sorted((rows[img][k] for k in range(int(lens[img]))),
+                          key=lambda r: -r[1])
+            for r in dets:
+                lbl, score, box = int(r[0]), float(r[1]), r[2:6]
+                # VOC semantics (reference detection_map_op.cc): match the
+                # single max-overlap gt of the class; a duplicate detection
+                # of an already-matched gt is an FP (it does NOT fall back
+                # to the next-best gt)
+                best, best_j = 0.0, -1
+                for j, (glbl, *gbox) in enumerate(gts):
+                    if int(glbl) != lbl:
+                        continue
+                    ov = self._iou(box, gbox)
+                    if ov > best:
+                        best, best_j = ov, j
+                tp = (best > self._thresh and best_j >= 0
+                      and best_j not in matched)
+                if tp:
+                    matched.add(best_j)
+                self._dets.setdefault(lbl, []).append((score, tp))
+
+    def eval(self):
+        """11-point interpolated mAP (the reference's default ap_type)."""
+        aps = []
+        # iterate classes WITH ground truth: a class the detector never
+        # predicted contributes AP=0, not silence (the reference averages
+        # over all gt classes)
+        for lbl, n_gt in self._n_gt.items():
+            dets = self._dets.get(lbl, [])
+            if not dets:
+                aps.append(0.0)
+                continue
+            dets = sorted(dets, key=lambda d: -d[0])
+            tps = np.cumsum([1 if tp else 0 for _, tp in dets])
+            fps = np.cumsum([0 if tp else 1 for _, tp in dets])
+            recall = tps / n_gt
+            precision = tps / np.maximum(tps + fps, 1)
+            ap = 0.0
+            for t in np.linspace(0, 1, 11):
+                p = precision[recall >= t].max() if (recall >= t).any() \
+                    else 0.0
+                ap += p / 11.0
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0

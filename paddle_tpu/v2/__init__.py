@@ -1,0 +1,17 @@
+"""v2-style trainer API: the event-driven training loop of the legacy
+generation (reference python/paddle/v2/trainer.py SGD.train + event.py),
+provided over fluid Programs.
+
+The reference v2 stack wraps a C++ GradientMachine built from the layer-DSL
+config compiler (trainer_config_helpers + config_parser.py, ~16k LoC of
+legacy front end); the fluid Program IS this framework's topology format, so
+the v2 capability that carries forward is the TRAINER CONTRACT: reader in,
+BeginPass/BeginIteration/EndIteration/EndPass events out, feeding maps, and
+test() over a held-out reader — used exactly like
+``paddle.v2.trainer.SGD(cost, parameters, optimizer).train(...)``.
+"""
+
+from . import event
+from .trainer import SGD
+
+__all__ = ["event", "SGD"]

@@ -1,0 +1,137 @@
+"""The v2 SGD trainer event loop over a fluid Program.
+
+Reference: python/paddle/v2/trainer.py:137-215 — per pass: BeginPass; per
+batch: BeginIteration -> feed -> forwardBackward+update -> EndIteration
+(with cost and batch metrics); EndPass (with pass-accumulated metrics);
+plus ``test(reader)`` -> TestResult. The gradient machine + parameter
+updater become one jitted fluid step; metrics are (name, Variable) pairs
+fetched per batch, averaged per pass.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import event as v2_event
+
+
+def default_event_handler(evt):
+    pass
+
+
+class SGD:
+    """v2-compatible trainer (reference v2/trainer.py SGD):
+
+        trainer = paddle_tpu.v2.SGD(cost=avg_cost,
+                                    optimizer=fluid.optimizer.Adam(1e-3),
+                                    feed_order=["img", "label"],
+                                    metrics={"acc": acc_var})
+        trainer.train(reader=paddle_batch_reader, num_passes=2,
+                      event_handler=handler)
+
+    ``cost`` lives in the current default main/startup programs (built with
+    fluid.layers under program_guard, the fluid topology replacing the v2
+    layer DSL); ``feed_order`` maps reader tuple positions to data-var
+    names (the reference's ``feeding``).
+    """
+
+    def __init__(self, cost, optimizer, feed_order, metrics=None,
+                 place=None, main_program=None, startup_program=None):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid.framework import (default_main_program,
+                                                default_startup_program)
+
+        self._cost = cost
+        self._main = main_program or default_main_program()
+        self._startup = startup_program or default_startup_program()
+        self._feed_order = list(feed_order)
+        self._metrics = dict(metrics or {})
+        self._exe = fluid.Executor(place)
+        self._scope = fluid.Scope()
+        optimizer.minimize(cost, self._startup)
+        # test program: forward-only clone (reference creates a separate
+        # test evaluator over the same machine)
+        self._test_program = self._main.clone(for_test=True)
+        self._exe.run(self._startup, scope=self._scope)
+
+    @property
+    def scope(self):
+        return self._scope
+
+    def _feed(self, data_batch):
+        feed = {}
+        for idx, name in enumerate(self._feed_order):
+            vals = [row[idx] for row in data_batch]
+            v = self._main.global_block().var(name)
+            if v.lod_level > 0:
+                feed[name] = [np.asarray(s) for s in vals]
+            else:
+                feed[name] = np.stack([np.asarray(s) for s in vals])
+        return feed
+
+    def _run(self, program, data_batch):
+        fetch = [self._cost] + list(self._metrics.values())
+        vals = self._exe.run(program, feed=self._feed(data_batch),
+                             fetch_list=fetch, scope=self._scope)
+        cost = float(np.asarray(vals[0]))
+        metrics = {n: np.asarray(v)
+                   for n, v in zip(self._metrics, vals[1:])}
+        return cost, metrics
+
+    def train(self, reader, num_passes=1, event_handler=None):
+        event_handler = event_handler or default_event_handler
+        for pass_id in range(num_passes):
+            event_handler(v2_event.BeginPass(pass_id))
+            pass_costs, pass_metrics = [], []
+            for batch_id, data_batch in enumerate(reader()):
+                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                cost, metrics = self._run(self._main, data_batch)
+                pass_costs.append(cost)
+                pass_metrics.append(metrics)
+                event_handler(v2_event.EndIteration(pass_id, batch_id, cost,
+                                                    metrics))
+            avg_metrics = {
+                n: np.mean([m[n] for m in pass_metrics], axis=0)
+                for n in self._metrics
+            } if pass_metrics else {}
+            avg_metrics["cost"] = float(np.mean(pass_costs)) \
+                if pass_costs else float("nan")
+            event_handler(v2_event.EndPass(pass_id, avg_metrics))
+
+    def test(self, reader):
+        """Forward-only evaluation over a reader (reference SGD.test)."""
+        costs, metrics_list, sizes = [], [], []
+        for data_batch in reader():
+            cost, metrics = self._run(self._test_program, data_batch)
+            costs.append(cost)
+            metrics_list.append(metrics)
+            sizes.append(len(data_batch))
+        total = max(sum(sizes), 1)
+        cost = float(np.sum([c * s for c, s in zip(costs, sizes)]) / total)
+        avg_metrics = {
+            n: np.sum([m[n] * s for m, s in zip(metrics_list, sizes)],
+                      axis=0) / total
+            for n in self._metrics
+        } if metrics_list else {}
+        return v2_event.TestResult(cost, avg_metrics)
+
+    def save_parameter_to_tar(self, f):
+        """v2 parameters.to_tar capability: persist trained params
+        (reference v2/parameters.py) — here via the fluid checkpoint."""
+        import paddle_tpu.fluid as fluid
+        import tarfile
+        import tempfile
+        import os
+
+        d = tempfile.mkdtemp()
+        from paddle_tpu.core import scope as scope_mod
+        prev = scope_mod._global_scope
+        scope_mod._global_scope = self._scope
+        try:
+            fluid.io.save_params(self._exe, d, self._main)
+        finally:
+            scope_mod._global_scope = prev
+        tf = tarfile.open(fileobj=f, mode="w")
+        for name in sorted(os.listdir(d)):
+            tf.add(os.path.join(d, name), arcname=name)
+        tf.close()
