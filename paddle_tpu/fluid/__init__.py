@@ -26,6 +26,9 @@ from . import clip
 from . import backward
 from . import io
 from . import evaluator
+from . import concurrency
+from .concurrency import (Go, make_channel, channel_send, channel_recv,
+                          channel_close)
 from .backward import append_backward
 from .param_attr import ParamAttr
 from .data_feeder import DataFeeder
@@ -41,4 +44,6 @@ __all__ = [
     "initializer", "regularizer", "backward", "io", "nets", "append_backward",
     "ParamAttr", "DataFeeder", "LoDArray", "profiler", "amp_guard", "clip",
     "set_flags", "get_flag", "flags", "init_flags", "evaluator",
+    "concurrency", "Go", "make_channel", "channel_send", "channel_recv",
+    "channel_close",
 ]

@@ -1,0 +1,135 @@
+"""CSP concurrency: Go blocks + typed channels.
+
+Reference: /root/reference/python/paddle/fluid/concurrency.py (Go, Select,
+make_channel/channel_send/channel_recv/channel_close appending channel ops)
+over the C++ buffered/unbuffered channel (framework/channel.h:35-79,
+channel_impl.h) and go_op (operators/go_op.cc spawning the sub-block on the
+ThreadPool).
+
+TPU-native design: channels coordinate HOST-side concurrency (the
+reference's use cases are pipelines feeding/draining graph executions — the
+double-buffer reader is its flagship user, reader/prefetch.py here). So a
+channel is a host object (bounded queue with close semantics matching
+channel_impl.h: send on closed raises, recv on closed-and-empty returns
+not-ok), and a Go block runs its captured sub-block eagerly on a daemon
+thread against the shared scope — the go_op thread-pool contract. Device
+programs stay pure; anything crossing into a compiled step goes through
+feeds, exactly like the reference's recommended reader/channel usage.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+__all__ = ["Channel", "make_channel", "channel_send", "channel_recv",
+           "channel_close", "Go"]
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class Channel:
+    """Bounded typed channel (framework/channel_impl.h semantics):
+    capacity=0 means rendezvous (unbuffered) — a send blocks until a
+    receiver takes the value."""
+
+    def __init__(self, dtype="float32", capacity=0):
+        self.dtype = dtype
+        self.capacity = capacity
+        # queue.Queue(0) is UNBOUNDED; emulate rendezvous with maxsize 1 +
+        # a handshake event per item
+        self._q = queue.Queue(maxsize=capacity if capacity > 0 else 1)
+        self._unbuffered = capacity == 0
+        self._closed = threading.Event()
+        self._taken = threading.Condition()
+        self._outstanding = 0
+
+    def send(self, value, timeout=None):
+        """True on success; raises ChannelClosed if the channel is closed
+        (channel_impl.h Send PADDLE_ENFORCE on closed)."""
+        if self._closed.is_set():
+            raise ChannelClosed("send on closed channel")
+        self._q.put(value, timeout=timeout)
+        if self._unbuffered:
+            with self._taken:
+                self._outstanding += 1
+                while self._outstanding > 0 and not self._closed.is_set():
+                    if not self._taken.wait(timeout=timeout or 30.0):
+                        raise TimeoutError("unbuffered send never received")
+        return True
+
+    def recv(self, timeout=None):
+        """(value, ok): ok False iff closed and drained
+        (channel_impl.h Receive)."""
+        while True:
+            try:
+                v = self._q.get(timeout=0.05)
+                if self._unbuffered:
+                    with self._taken:
+                        self._outstanding -= 1
+                        self._taken.notify_all()
+                return v, True
+            except queue.Empty:
+                if self._closed.is_set() and self._q.empty():
+                    return None, False
+                if timeout is not None:
+                    timeout -= 0.05
+                    if timeout <= 0:
+                        raise TimeoutError("channel recv timed out")
+
+    def close(self):
+        self._closed.set()
+        with self._taken:
+            self._taken.notify_all()
+
+
+def make_channel(dtype, capacity=0):
+    return Channel(dtype, capacity)
+
+
+def channel_send(channel, value, timeout=None):
+    return channel.send(value, timeout=timeout)
+
+
+def channel_recv(channel, timeout=None):
+    return channel.recv(timeout=timeout)
+
+
+def channel_close(channel):
+    channel.close()
+
+
+class Go:
+    """Run a python block concurrently (the go_op thread-pool contract,
+    operators/go_op.cc / reference concurrency.py Go). Usage:
+
+        with fluid.Go() as g:
+            @g.run
+            def producer():
+                for x in data:
+                    fluid.channel_send(ch, x)
+                fluid.channel_close(ch)
+
+    Threads are daemons; ``g.join()`` waits for completion (the reference's
+    go_op detaches the same way — joins only at scope teardown)."""
+
+    def __init__(self, name=None):
+        self._threads = []
+
+    def __enter__(self):
+        return self
+
+    def run(self, fn):
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return fn
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        return False
+
+    def join(self, timeout=None):
+        for t in self._threads:
+            t.join(timeout)

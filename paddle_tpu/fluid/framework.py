@@ -318,6 +318,36 @@ class Program:
     def global_block(self) -> Block:
         return self.blocks[0]
 
+    def to_graphviz(self, block_idx=0):
+        """DOT-language dataflow graph of one block (reference debuger.py /
+        graphviz.py draw_block_graphviz): op nodes (boxes) wired through
+        var nodes (ellipses; parameters double-ringed)."""
+        block = self.blocks[block_idx]
+        lines = ["digraph G {", "  rankdir=TB;"]
+        var_nodes = set()
+
+        def vnode(name):
+            if name in var_nodes:
+                return
+            var_nodes.add(name)
+            shape = "doublecircle" if (
+                block.has_var(name)
+                and isinstance(block.var(name), Parameter)) else "ellipse"
+            lines.append(f'  "{name}" [shape={shape}];')
+
+        for i, op in enumerate(block.ops):
+            op_id = f"op_{i}_{op.type}"
+            lines.append(f'  "{op_id}" [shape=box, style=rounded, '
+                         f'label="{op.type}"];')
+            for n in op.input_arg_names():
+                vnode(n)
+                lines.append(f'  "{n}" -> "{op_id}";')
+            for n in op.output_arg_names():
+                vnode(n)
+                lines.append(f'  "{op_id}" -> "{n}";')
+        lines.append("}")
+        return "\n".join(lines)
+
     def to_debug_string(self, with_vars=True):
         """Readable IR dump (reference debuger.py pprint_program_codes /
         Program.to_string): per block, its vars (name, shape, dtype,

@@ -1,0 +1,90 @@
+"""CSP channels + Go blocks.
+
+Reference: framework/channel_impl.h (buffered/unbuffered send/recv/close
+semantics, framework/channel_test.cc pins them), operators/go_op.cc,
+python/paddle/fluid/concurrency.py — the canonical use is a producer Go
+block feeding training through a channel (concurrency_test.cc).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def test_buffered_channel_fifo_and_close_semantics():
+    ch = fluid.make_channel("int32", capacity=4)
+    for i in range(4):
+        fluid.channel_send(ch, i)
+    fluid.channel_close(ch)
+    # drains in order after close, then reports not-ok
+    got = []
+    while True:
+        v, ok = fluid.channel_recv(ch)
+        if not ok:
+            break
+        got.append(v)
+    assert got == [0, 1, 2, 3]
+    with pytest.raises(fluid.concurrency.ChannelClosed):
+        fluid.channel_send(ch, 99)
+
+
+def test_unbuffered_channel_rendezvous():
+    ch = fluid.make_channel("float32", capacity=0)
+    order = []
+
+    with fluid.Go() as g:
+        @g.run
+        def producer():
+            order.append("send-start")
+            fluid.channel_send(ch, 1.0)
+            order.append("send-done")
+
+        import time
+        time.sleep(0.2)
+        # unbuffered: the send cannot complete before this recv
+        assert "send-done" not in order
+        v, ok = fluid.channel_recv(ch)
+        assert ok and v == 1.0
+        g.join(5.0)
+    assert order == ["send-start", "send-done"]
+
+
+def test_go_producer_feeds_training_through_channel():
+    """The reference concurrency_test.cc pattern: a Go producer streams
+    batches through a channel while the main thread trains."""
+    layers = fluid.layers
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[6])
+        y = layers.data("y", shape=[1])
+        pred = layers.fc(x, size=1, act=None)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    w_true = rng.normal(0, 1, (6, 1)).astype("float32")
+    ch = fluid.make_channel("float32", capacity=2)
+
+    with fluid.Go() as g:
+        @g.run
+        def producer():
+            r = np.random.RandomState(1)
+            for _ in range(30):
+                X = r.normal(0, 1, (32, 6)).astype("float32")
+                fluid.channel_send(ch, (X, X @ w_true))
+            fluid.channel_close(ch)
+
+        losses = []
+        while True:
+            batch, ok = fluid.channel_recv(ch)
+            if not ok:
+                break
+            X, Y = batch
+            losses.append(float(exe.run(main, feed={"x": X, "y": Y},
+                                        fetch_list=[loss])[0]))
+        g.join(5.0)
+    assert len(losses) == 30
+    assert losses[-1] < 0.05 * losses[0]

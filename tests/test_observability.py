@@ -118,3 +118,13 @@ def test_program_to_debug_string():
     assert "[persistable,param]" in s
     # sub-block-free programs print one block; control flow adds more
     assert s.count("block ") == 1
+
+def test_program_to_graphviz():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        h = layers.fc(x, size=2, param_attr=fluid.ParamAttr(name="gv_w"))
+    dot = main.to_graphviz()
+    assert dot.startswith("digraph G {") and dot.endswith("}")
+    assert '"gv_w" [shape=doublecircle];' in dot   # parameter styling
+    assert '"x" -> "op_0_mul";' in dot or '"gv_w" -> "op_0_mul";' in dot
