@@ -219,6 +219,24 @@ def _written_names(program, block_idx):
     return written_names(program, block_idx)
 
 
+def _compiler_options():
+    """Backend compiler options from the flags registry (the env-route
+    XLA_FLAGS parser rejects TPU-only flag names client-side; the
+    compiler_options channel reaches the backend compiler)."""
+    from .flags import get_flag
+    s = get_flag("xla_compiler_options")
+    if not s:
+        return None
+    return dict(kv.split("=", 1) for kv in s.split(",") if "=" in kv)
+
+
+def tpu_jit(fn, **jit_kwargs):
+    """jax.jit with the flag-registry compiler options applied — the ONE
+    jit wrapper every compiled path (Executor, run_steps, sharded step)
+    goes through, so the xla_compiler_options flag reaches them all."""
+    return jax.jit(fn, compiler_options=_compiler_options(), **jit_kwargs)
+
+
 def _is_traceable(v):
     from .sparse import SparseRows
     return isinstance(v, (jax.Array, np.ndarray, LoDArray, SparseRows, int,
@@ -390,8 +408,10 @@ class Executor:
 
     def _compiled_steps(self, program, feed_names, fetch_names, carry_keys,
                         K, B):
+        from .flags import get_flag
         key = ("multi", id(program), program._version, feed_names,
-               fetch_names, carry_keys, K, B, self.donate, self.amp)
+               fetch_names, carry_keys, K, B, self.donate, self.amp,
+               get_flag("xla_compiler_options"))
         fn = self._cache.get(key)
         if fn is not None:
             return fn
@@ -420,14 +440,16 @@ class Executor:
             return jax.lax.scan(body, state, idx)
 
         donate = (0,) if self.donate else ()
-        fn = jax.jit(multi, donate_argnums=donate)
+        fn = tpu_jit(multi, donate_argnums=donate)
         self._cache[key] = fn
         return fn
 
     # ------------------------------------------------------------------
     def _compiled(self, program, feed_names, fetch_names, state_in, state_out):
+        from .flags import get_flag
         key = (id(program), program._version, feed_names, fetch_names,
-               state_in, state_out, self.donate, self.amp)
+               state_in, state_out, self.donate, self.amp,
+               get_flag("xla_compiler_options"))
         fn = self._cache.get(key)
         if fn is not None:
             return fn
@@ -453,7 +475,7 @@ class Executor:
             return new_state, fetches
 
         donate = (0,) if self.donate else ()
-        fn = jax.jit(step, donate_argnums=donate)
+        fn = tpu_jit(step, donate_argnums=donate)
         self._cache[key] = fn
         return fn
 

@@ -65,6 +65,11 @@ DEFINE_flag("check_nan_inf", False,
 DEFINE_flag("benchmark", False,
             "log per-op timing in eager mode — reference --benchmark "
             "(executor.cc:321-324)")
+DEFINE_flag("xla_compiler_options", "",
+            "comma-separated k=v TPU compiler options forwarded to "
+            "jit(compiler_options=...), e.g. "
+            "xla_tpu_scoped_vmem_limit_kib=114688 — the analog of the "
+            "reference's backend gflags (platform/gpu_info.cc)")
 
 # PDTPU_FLAGS=check_nan_inf=1,benchmark=0 — unknown names warn and are
 # ignored (a typo'd env var must not make the package unimportable)

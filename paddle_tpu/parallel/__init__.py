@@ -12,6 +12,7 @@ with jax.sharding shardings over a Mesh and lets GSPMD insert ICI collectives.
 from .sharding import (ShardingPlan, make_mesh, shard_program_step,
                        place_feed)
 from .ring_attention import ring_attention
+from .multihost import init_multihost, global_mesh
 
 __all__ = ["ShardingPlan", "make_mesh", "shard_program_step", "place_feed",
-           "ring_attention"]
+           "ring_attention", "init_multihost", "global_mesh"]

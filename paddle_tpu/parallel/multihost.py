@@ -1,0 +1,69 @@
+"""Multi-host (DCN) initialization and global meshes.
+
+Reference capability: multi-node data parallelism via
+trainer+pserver programs over gRPC (distribute_transpiler.py:134) or the
+legacy/Go pservers. TPU-native: every host runs the SAME SPMD program;
+jax.distributed wires the hosts into one runtime, ``global_mesh`` lays the
+axes out so that the FASTEST-varying axes map to intra-host ICI and the
+slowest to cross-host DCN (data parallelism tolerates DCN latency; tensor/
+sequence parallel axes must stay on ICI — the scaling-book layout rule).
+The driver's multichip dryrun + tests/test_parallel.py validate the
+single-host SPMD path; this module is the multi-host entry the same
+programs run under unchanged (ShardingPlan and shard_program_step are
+process-count agnostic: jax arrays are globally addressed).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .sharding import make_mesh
+
+
+def init_multihost(coordinator_address=None, num_processes=None,
+                   process_id=None, local_device_ids=None):
+    """Join this process into a multi-host JAX runtime (DCN). On TPU pods
+    the three None defaults auto-discover from the TPU environment; on
+    CPU/GPU clusters pass them explicitly (the reference's trainer_id /
+    pserver endpoint flags, distribute_transpiler.py transpile args)."""
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id,
+                               local_device_ids=local_device_ids)
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+def global_mesh(axes=("dp", "tp"), dcn_axis="dp"):
+    """Mesh over ALL hosts' devices: ``dcn_axis`` spans processes (cross-
+    host traffic rides DCN), remaining axes stay within a host (ICI). With
+    one process this degrades to the single-host mesh."""
+    n_proc = jax.process_count()
+    devs = jax.devices()
+    if n_proc == 1 or len(axes) == 1:
+        return make_mesh(len(devs), axes=axes)
+    if dcn_axis != axes[0]:
+        raise ValueError("dcn_axis must be the first (slowest-varying) "
+                         "mesh axis so cross-host traffic stays on the "
+                         "data-parallel dimension")
+    if len(axes) != 2:
+        raise ValueError("provide a custom mesh for >2 axes across hosts")
+    # group rows by OWNING PROCESS, not by device-id order (jax.devices()
+    # ordering carries no per-process contiguity guarantee): row i must be
+    # exactly host i's devices so the fast axis stays on intra-host ICI
+    import numpy as np
+
+    by_proc: dict = {}
+    for d in devs:
+        by_proc.setdefault(d.process_index, []).append(d)
+    rows = [by_proc[p] for p in sorted(by_proc)]
+    width = len(rows[0])
+    if any(len(r) != width for r in rows):
+        raise ValueError("uneven device counts across hosts; build a "
+                         "custom Mesh")
+    from jax.sharding import Mesh
+    return Mesh(np.array(rows), (dcn_axis, axes[1]))

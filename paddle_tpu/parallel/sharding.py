@@ -207,8 +207,10 @@ def shard_program_step(executor, program, feed_example, fetch_list, plan,
         fetches = [env[n] for n in fetch_names]
         return new_state, fetches
 
-    # pin state shardings on both sides so the step iterates
-    jitted = jax.jit(
+    # pin state shardings on both sides so the step iterates; tpu_jit
+    # forwards the xla_compiler_options flag to the backend compiler
+    from ..core.executor import tpu_jit
+    jitted = tpu_jit(
         step,
         in_shardings=(state_shardings, feed_shardings),
         out_shardings=(state_shardings, None),
