@@ -411,7 +411,8 @@ class Executor:
         from .flags import get_flag
         key = ("multi", id(program), program._version, feed_names,
                fetch_names, carry_keys, K, B, self.donate, self.amp,
-               get_flag("xla_compiler_options"))
+               get_flag("xla_compiler_options"),
+               get_flag("use_pallas_rnn"))
         fn = self._cache.get(key)
         if fn is not None:
             return fn
@@ -449,7 +450,8 @@ class Executor:
         from .flags import get_flag
         key = (id(program), program._version, feed_names, fetch_names,
                state_in, state_out, self.donate, self.amp,
-               get_flag("xla_compiler_options"))
+               get_flag("xla_compiler_options"),
+               get_flag("use_pallas_rnn"))
         fn = self._cache.get(key)
         if fn is not None:
             return fn

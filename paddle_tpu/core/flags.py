@@ -65,6 +65,11 @@ DEFINE_flag("check_nan_inf", False,
 DEFINE_flag("benchmark", False,
             "log per-op timing in eager mode — reference --benchmark "
             "(executor.cc:321-324)")
+DEFINE_flag("use_pallas_rnn", False,
+            "use the Pallas fused LSTM/GRU cell kernels (the hand-scheduled "
+            "hl_cuda_lstm.cu analogs) inside recurrent scans; default off — "
+            "XLA's fusion handles the elementwise chain well, so this is a "
+            "tuning/demonstration surface with pinned numeric parity")
 DEFINE_flag("xla_compiler_options", "",
             "comma-separated k=v TPU compiler options forwarded to "
             "jit(compiler_options=...), e.g. "

@@ -60,23 +60,34 @@ def _reverse_padded(data, lens):
 def _lstm_scan(x, lens, w, h0, c0, gate_act, cell_act, cand_act):
     """x: [b, L, 4H] projected inputs (+bias already added); w: [H, 4H].
     Returns hidden [b, L, H], cell [b, L, H]."""
+    from ..core.flags import get_flag
+
     b, L, H4 = x.shape
     H = H4 // 4
     ga, ca, cda = _act(gate_act), _act(cell_act), _act(cand_act)
+    # the Pallas fused cell implements the standard activation set (the
+    # reference's hand-scheduled hl_cuda_lstm.cu does the same)
+    use_pallas = (get_flag("use_pallas_rnn")
+                  and (gate_act, cell_act, cand_act)
+                  == ("sigmoid", "tanh", "tanh"))
 
     def step(carry, inp):
         h_prev, c_prev, t = carry
         xt = inp                                     # [b, 4H]
-        gates = xt + h_prev @ w
-        i = ga(gates[:, :H])
-        f = ga(gates[:, H:2 * H])
-        cand = cda(gates[:, 2 * H:3 * H])
-        o = ga(gates[:, 3 * H:])
-        c = f * c_prev + i * cand
-        h = o * ca(c)
+        gates = xt + h_prev @ w                      # MXU matmul
         alive = (t < lens)[:, None].astype(x.dtype)
-        h = alive * h + (1 - alive) * h_prev
-        c = alive * c + (1 - alive) * c_prev
+        if use_pallas:
+            from .pallas_kernels import fused_lstm_cell
+            h, c = fused_lstm_cell(gates, c_prev, h_prev, alive)
+        else:
+            i = ga(gates[:, :H])
+            f = ga(gates[:, H:2 * H])
+            cand = cda(gates[:, 2 * H:3 * H])
+            o = ga(gates[:, 3 * H:])
+            c = f * c_prev + i * cand
+            h = o * ca(c)
+            h = alive * h + (1 - alive) * h_prev
+            c = alive * c + (1 - alive) * c_prev
         return (h, c, t + 1), (h * alive, c * alive)
 
     xt = jnp.swapaxes(x, 0, 1)                       # [L, b, 4H]
@@ -219,15 +230,26 @@ def _gru_compute(x, lens, w, bias, h0, attrs):
     if rev:
         x = _reverse_padded(x, lens)
 
+    from ..core.flags import get_flag
+    use_pallas = (get_flag("use_pallas_rnn")
+                  and attrs.get("gate_activation", "sigmoid") == "sigmoid"
+                  and attrs.get("activation", "tanh") == "tanh")
+
     def step(carry, inp):
         h_prev, t = carry
         xt = inp
-        u = ga(xt[:, :H] + h_prev @ wu)
-        r = ga(xt[:, H:2 * H] + h_prev @ wr)
-        c = ca(xt[:, 2 * H:] + (r * h_prev) @ wc)
-        h = u * c + (1.0 - u) * h_prev
         alive = (t < lens)[:, None].astype(x.dtype)
-        h = alive * h + (1 - alive) * h_prev
+        r = ga(xt[:, H:2 * H] + h_prev @ wr)
+        rc = (r * h_prev) @ wc                       # MXU matmul
+        if use_pallas:
+            from .pallas_kernels import fused_gru_cell
+            h = fused_gru_cell(xt[:, :H] + h_prev @ wu, xt[:, 2 * H:],
+                               h_prev, rc, alive)
+        else:
+            u = ga(xt[:, :H] + h_prev @ wu)
+            c = ca(xt[:, 2 * H:] + rc)
+            h = u * c + (1.0 - u) * h_prev
+            h = alive * h + (1 - alive) * h_prev
         return (h, t + 1), h * alive
 
     xt = jnp.swapaxes(x, 0, 1)
