@@ -228,3 +228,44 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
         attrs={"activation": activation,
                "gate_activation": gate_activation})
     return updated_hidden, reset_hidden_pre, gate
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  proj_activation="tanh", gate_activation="sigmoid",
+                  cell_activation="tanh", candidate_activation="tanh",
+                  is_reverse=False, name=None):
+    """LSTM with recurrent projection (reference layers/nn.py dynamic_lstmp
+    -> lstmp_op): the recurrence runs over the proj_size-dim projected
+    state. ``input`` carries the [*, 4*H] projected inputs (H = size//4);
+    returns (projection LoD var [*, proj_size], cell LoD var [*, H])."""
+    helper = LayerHelper("lstmp", name=name)
+    H = size // 4
+    w = helper.create_parameter(ParamAttr.to_attr(param_attr),
+                                shape=(proj_size, size),
+                                dtype=input.dtype)
+    # the projection weight follows param_attr (initializer/regularizer)
+    # but needs its own name — an explicit param_attr name would otherwise
+    # alias the recurrent weight (the reference's helper suffixes names)
+    proj_attr = ParamAttr.to_attr(param_attr)
+    if proj_attr.name is not None:
+        import copy
+        proj_attr = copy.copy(proj_attr)
+        proj_attr.name = proj_attr.name + "_proj"
+    proj_w = helper.create_parameter(proj_attr, shape=(H, proj_size),
+                                     dtype=input.dtype)
+    bias = helper.create_parameter(ParamAttr.to_attr(bias_attr),
+                                   shape=(1, size), dtype=input.dtype,
+                                   is_bias=True)
+    proj = helper.create_tmp_variable(input.dtype, lod_level=1)
+    cell = helper.create_tmp_variable(input.dtype, lod_level=1)
+    helper.append_op(
+        "lstmp",
+        inputs={"Input": [input.name], "Weight": [w.name],
+                "ProjWeight": [proj_w.name], "Bias": [bias.name]},
+        outputs={"Projection": [proj.name], "Cell": [cell.name]},
+        attrs={"gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation,
+               "proj_activation": proj_activation,
+               "is_reverse": is_reverse})
+    return proj, cell

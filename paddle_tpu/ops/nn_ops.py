@@ -90,3 +90,48 @@ def lookup_table_grad(ctx):
         return
     dw = jnp.zeros_like(w).at[flat_ids].add(flat_d)
     ctx.set_output("W@GRAD", dw)
+
+
+@register_op("split_ids")
+def split_ids(ctx):
+    """Route ids to N shard outputs by id % N (reference split_ids_op.cc —
+    the trainer-side prep for a sharded lookup table). Output sizes are
+    data-dependent, so this is a HOST-side op (eager mode; the reference's
+    kernel is CPU-only for the same reason): the jit-compatible sharded-
+    table path is the GSPMD-sharded embedding (tests/test_sparse.py)."""
+    import numpy as np
+
+    ids_v = ctx.input("Ids")
+    import jax as _jax
+    if isinstance(data_of(ids_v), _jax.core.Tracer):
+        raise RuntimeError(
+            "split_ids produces data-dependent output sizes and only runs "
+            "host-side: use Executor(mode='eager') for this program, or "
+            "the GSPMD-sharded embedding path for in-graph sharded tables")
+    ids = np.asarray(data_of(ids_v))
+    outs = ctx.op.output("Out")
+    n = len(outs)
+    flat = ids.reshape(-1)
+    pieces = [ids.reshape(-1, 1)[flat % n == i] for i in range(n)]
+    ctx.set_outputs("Out", [jnp.asarray(p) for p in pieces])
+
+
+@register_op("split_selected_rows")
+def split_selected_rows(ctx):
+    """Split a SparseRows by row ranges (reference split_selected_rows_op.cc
+    height_sections: rows [0,h0) to shard 0 as-is, [h0,h0+h1) to shard 1
+    rebased, ...). Static shapes: every output keeps the input's entry
+    count; out-of-range entries become sentinels that scatters drop."""
+    from ..core.sparse import SparseRows
+
+    x = ctx.input("X")
+    sections = [int(s) for s in ctx.attr("height_sections")]
+    outs = []
+    start = 0
+    for h in sections:
+        in_range = (x.rows >= start) & (x.rows < start + h)
+        rows = jnp.where(in_range, x.rows - start, h)   # h = sentinel
+        vals = jnp.where(in_range[:, None], x.values, 0)
+        outs.append(SparseRows(rows.astype(jnp.int32), vals, h))
+        start += h
+    ctx.set_outputs("Out", outs)

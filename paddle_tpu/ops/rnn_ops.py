@@ -445,3 +445,140 @@ def gru_unit_grad(ctx):
     ctx.set_output("Input@GRAD", dx)
     ctx.set_output("HiddenPrev@GRAD", dhp)
     ctx.set_output("Weight@GRAD", dw)
+
+
+# ---------------------------------------------------------------------------
+# lstmp — LSTM with recurrent projection (reference lstmp_op.{cc,h}:
+# r_t = proj_act(P^T h_t); the recurrence runs over the PROJECTED state,
+# Weight [P, 4H], ProjWeight [H, P]; outputs Projection + Cell)
+# ---------------------------------------------------------------------------
+
+def _lstmp_compute(x, lens, w, proj_w, bias, h0, c0, attrs):
+    b, L, H4 = x.shape
+    H = H4 // 4
+    P = proj_w.shape[1]
+    if bias is not None:
+        x = x + bias[None, None, :H4]
+    ga = _act(attrs.get("gate_activation", "sigmoid"))
+    ca = _act(attrs.get("cell_activation", "tanh"))
+    cda = _act(attrs.get("candidate_activation", "tanh"))
+    pa = _act(attrs.get("proj_activation", "tanh"))
+    r0 = jnp.zeros((b, P), x.dtype) if h0 is None else h0 @ proj_w
+    c0 = jnp.zeros((b, H), x.dtype) if c0 is None else c0
+    rev = attrs.get("is_reverse", False)
+    if rev:
+        x = _reverse_padded(x, lens)
+
+    def step(carry, inp):
+        r_prev, c_prev, t = carry
+        gates = inp + r_prev @ w                    # w: [P, 4H]
+        i = ga(gates[:, :H])
+        f = ga(gates[:, H:2 * H])
+        cand = cda(gates[:, 2 * H:3 * H])
+        o = ga(gates[:, 3 * H:])
+        c = f * c_prev + i * cand
+        h = o * ca(c)
+        r = pa(h @ proj_w)                          # [b, P]
+        alive = (t < lens)[:, None].astype(x.dtype)
+        r = alive * r + (1 - alive) * r_prev
+        c = alive * c + (1 - alive) * c_prev
+        return (r, c, t + 1), (r * alive, c * alive)
+
+    xt = jnp.swapaxes(x, 0, 1)
+    _, (rs, cs) = jax.lax.scan(step, (r0, c0, jnp.zeros((), jnp.int32)), xt)
+    rs = jnp.swapaxes(rs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    if rev:
+        rs = _reverse_padded(rs, lens)
+        cs = _reverse_padded(cs, lens)
+    return rs, cs
+
+
+def _lstmp_grad_maker(op):
+    inputs = {"Input": op.input("Input"), "Weight": op.input("Weight"),
+              "ProjWeight": op.input("ProjWeight"),
+              "Projection@GRAD": G(op.output("Projection")),
+              "Cell@GRAD": G(op.output("Cell"))}
+    outputs = {"Input@GRAD": G(op.input("Input")),
+               "Weight@GRAD": G(op.input("Weight")),
+               "ProjWeight@GRAD": G(op.input("ProjWeight"))}
+    for slot in ("Bias", "H0", "C0"):
+        if op.input(slot):
+            inputs[slot] = op.input(slot)
+            outputs[slot + "@GRAD"] = G(op.input(slot))
+    return [OpSpec("lstmp_grad", inputs, outputs, dict(op.attrs))]
+
+
+def _lstmp_infer(op, block):
+    x = block.var(op.input("Input")[0])
+    w = block.var(op.input("Weight")[0])
+    pw = block.var(op.input("ProjWeight")[0])
+    if x.shape is None or w.shape is None or pw.shape is None:
+        return
+    H, P = pw.shape
+    for slot, width in (("Projection", P), ("Cell", H)):
+        for name in op.output(slot):
+            v = block.var(name)
+            v.shape = tuple(x.shape[:-1]) + (width,)
+            v.dtype = x.dtype
+            v.lod_level = x.lod_level
+
+
+@register_op("lstmp", infer_shape=_lstmp_infer, grad=_lstmp_grad_maker)
+def lstmp(ctx):
+    xv = ctx.input("Input")
+    x = xv.data if isinstance(xv, LoDArray) else data_of(xv)
+    lens = xv.lens if isinstance(xv, LoDArray) else \
+        jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    w = data_of(ctx.input("Weight"))
+    proj_w = data_of(ctx.input("ProjWeight"))
+    bias = data_of(ctx.input("Bias")).reshape(-1) \
+        if ctx.has_input("Bias") else None
+    h0 = data_of(ctx.input("H0")) if ctx.has_input("H0") else None
+    c0 = data_of(ctx.input("C0")) if ctx.has_input("C0") else None
+    rs, cs = _lstmp_compute(x, lens, w, proj_w, bias, h0, c0, ctx.op.attrs)
+    ctx.set_output("Projection", LoDArray(rs, lens))
+    ctx.set_output("Cell", LoDArray(cs, lens))
+
+
+@register_op("lstmp_grad")
+def lstmp_grad(ctx):
+    xv = ctx.input("Input")
+    x = xv.data if isinstance(xv, LoDArray) else data_of(xv)
+    lens = xv.lens if isinstance(xv, LoDArray) else \
+        jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    attrs = dict(ctx.op.attrs)
+    operands = {"Input": x, "Weight": data_of(ctx.input("Weight")),
+                "ProjWeight": data_of(ctx.input("ProjWeight"))}
+    if ctx.has_input("Bias"):
+        operands["Bias"] = data_of(ctx.input("Bias")).reshape(-1)
+    if ctx.has_input("H0"):
+        operands["H0"] = data_of(ctx.input("H0"))
+    if ctx.has_input("C0"):
+        operands["C0"] = data_of(ctx.input("C0"))
+    names = list(operands)
+
+    def f(*args):
+        kw = dict(zip(names, args))
+        return _lstmp_compute(kw["Input"], lens, kw["Weight"],
+                              kw["ProjWeight"], kw.get("Bias"),
+                              kw.get("H0"), kw.get("C0"), attrs)
+
+    def gd(slot):
+        v = ctx.input(slot)
+        return v.data if isinstance(v, LoDArray) else data_of(v)
+
+    outs, vjp = jax.vjp(f, *[operands[n] for n in names])
+    d_rs = gd("Projection@GRAD").astype(outs[0].dtype)
+    d_cs = gd("Cell@GRAD").astype(outs[1].dtype)
+    grads = vjp((d_rs.reshape(outs[0].shape), d_cs.reshape(outs[1].shape)))
+    for n, g in zip(names, grads):
+        if n == "Input":
+            ctx.set_output("Input@GRAD",
+                           LoDArray(g, lens) if isinstance(xv, LoDArray)
+                           else g)
+        elif n == "Bias":
+            # restore the (1, 4H) parameter shape (lstm_grad does the same)
+            ctx.set_output("Bias@GRAD", g.reshape(1, -1))
+        else:
+            ctx.set_output(n + "@GRAD", g)

@@ -179,3 +179,38 @@ class TestGruUnit(OpTest):
     def test_grad(self):
         self.check_grad(["Input", "HiddenPrev", "Weight"], "Hidden",
                         max_relative_error=0.06)
+
+
+def test_dynamic_lstmp_trains_and_projects():
+    """LSTM with recurrent projection (reference lstmp_op): the projection
+    output has proj_size features, the recurrence runs over it, and the
+    model trains end to end."""
+    import paddle_tpu.fluid as fluid
+    layers = fluid.layers
+    H, P = 12, 5
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 6
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[1], dtype="int64", lod_level=1)
+        e = layers.embedding(x, size=[10, 8])
+        proj_in = layers.fc(e, size=4 * H)
+        proj, cell = layers.dynamic_lstmp(proj_in, size=4 * H, proj_size=P)
+        last = layers.sequence_last_step(proj)
+        pred = layers.fc(last, size=1)
+        label = layers.data("y", shape=[1])
+        loss = layers.mean(layers.square(
+            layers.elementwise_sub(pred, label)))
+        fluid.optimizer.Adam(learning_rate=0.03).minimize(loss, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(1)
+    seqs = [rng.randint(0, 10, (int(rng.randint(2, 6)), 1)).astype("int64")
+            for _ in range(6)]
+    feed = {"x": seqs, "y": rng.normal(0, 1, (6, 1)).astype("float32")}
+    out = exe.run(main, feed=feed, fetch_list=[proj, cell], scope=scope)
+    assert out[0].data.shape[-1] == P       # projected width
+    assert out[1].data.shape[-1] == H       # cell width
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss],
+                            scope=scope)[0]) for _ in range(40)]
+    assert losses[-1] < 0.2 * losses[0], losses[::10]

@@ -257,3 +257,52 @@ def test_sparse_grad_through_double_use():
     assert l1 < l0
     np.testing.assert_allclose(w1[3:], w0[3:])  # rows 3+ untouched
     assert np.abs(w1[:3] - w0[:3]).max() > 1e-6
+
+def test_split_ids_routes_by_modulo():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        outs = [main.global_block().create_var(
+            name=f"shard{i}", dtype="int64") for i in range(3)]
+        main.global_block().append_op(
+            "split_ids", inputs={"Ids": [ids.name]},
+            outputs={"Out": [o.name for o in outs]})
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    exe.run(startup)
+    got = exe.run(main, feed={"ids": np.array(
+        [[0], [1], [2], [3], [4], [7]], "int64")},
+        fetch_list=[o.name for o in outs])
+    np.testing.assert_array_equal(np.asarray(got[0]).ravel(), [0, 3])
+    np.testing.assert_array_equal(np.asarray(got[1]).ravel(), [1, 4, 7])
+    np.testing.assert_array_equal(np.asarray(got[2]).ravel(), [2])
+
+
+def test_split_selected_rows_by_height_sections():
+    from paddle_tpu.core.sparse import SparseRows
+    import jax.numpy as jnp
+
+    sr = SparseRows(jnp.asarray([0, 4, 7, 9], jnp.int32),
+                    jnp.arange(8, dtype=jnp.float32).reshape(4, 2),
+                    nrows=10)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        x = block.create_var(name="sr")
+        outs = [block.create_var(name=f"part{i}") for i in range(2)]
+        block.append_op("split_selected_rows", inputs={"X": [x.name]},
+                        outputs={"Out": [o.name for o in outs]},
+                        attrs={"height_sections": [5, 5]})
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    scope.set("sr", sr)
+    p0, p1 = exe.run(main, feed={}, fetch_list=["part0", "part1"],
+                     scope=scope)
+    d0 = np.asarray(p0.to_dense())
+    d1 = np.asarray(p1.to_dense())
+    # rows 0, 4 land in part 0; rows 7, 9 rebased to 2, 4 in part 1
+    np.testing.assert_allclose(d0[0], [0, 1])
+    np.testing.assert_allclose(d0[4], [2, 3])
+    np.testing.assert_allclose(d1[2], [4, 5])
+    np.testing.assert_allclose(d1[4], [6, 7])
+    assert d0[[1, 2, 3]].sum() == 0 and d1[[0, 1, 3]].sum() == 0
