@@ -19,14 +19,15 @@ def dynamic_lstm(input, size, param_attr=None, bias_attr=None,
                  candidate_activation="tanh", dtype="float32", name=None):
     """``input`` is the projected gate pre-activation [*, 4*hidden] (apply an
     fc of width 4*hidden first, like the reference); ``size`` = 4*hidden."""
-    if use_peepholes:
-        raise NotImplementedError("use_peepholes=True is not lowered yet")
     helper = LayerHelper("lstm", name=name)
     hidden = size // 4
     weight = helper.create_parameter(param_attr, shape=(hidden, 4 * hidden),
                                      dtype=dtype)
+    # with peepholes the bias carries the diagonal cell->gate weights too:
+    # [4H gate bias | W_ic | W_fc | W_oc] (reference lstm_op.cc:74)
+    bias_width = 7 * hidden if use_peepholes else 4 * hidden
     bias = helper.create_parameter(ParamAttr.to_attr(bias_attr),
-                                   shape=(1, 4 * hidden), dtype=dtype,
+                                   shape=(1, bias_width), dtype=dtype,
                                    is_bias=True)
     hidden_out = helper.create_tmp_variable(dtype, lod_level=input.lod_level)
     cell_out = helper.create_tmp_variable(dtype, lod_level=input.lod_level)

@@ -356,24 +356,70 @@ def while_grad(ctx):
 
 @register_op("conditional_block", is_control_flow=True)
 def conditional_block(ctx):
-    """Select-semantics conditional (scalar guard): run the block, keep its
-    writes where cond else the previous binding (zeros when unbound). XLA
-    evaluates both sides; cond picks — the jit-compatible lowering of
-    conditional_block_op.cc for scalar conditions (Switch/LR schedules)."""
+    """Scalar-guarded conditional lowered to ``lax.cond``: the block's ops
+    are TRACED unconditionally (XLA needs both branch computations), but at
+    RUNTIME only the taken branch executes — the lazy cost model of the
+    reference's conditional_block_op.cc, unlike a both-sides select. The
+    false branch keeps the previous bindings (zeros when unbound, with
+    shapes discovered via jax.eval_shape of the block)."""
     sub = ctx.sub_block("sub_block")
     cond = data_of(ctx.inputs("Cond")[0]).reshape(()).astype(jnp.bool_)
     env = ctx.env
+    exec_state = ctx._exec
     from ..core.executor import _run_ops
 
-    local = dict(env)
-    _run_ops(sub, local, ctx._exec)
-    for n in _block_written(sub):
-        new = local[n]
-        old = env.get(n)
-        if old is None:
-            old = jax.tree_util.tree_map(jnp.zeros_like, new)
-        env[n] = jax.tree_util.tree_map(
-            lambda a, b: jnp.where(cond, a, b), new, old)
+    written = _block_written(sub)
+
+    def then_fn(_):
+        local = dict(env)
+        _run_ops(sub, local, exec_state)
+        return tuple(local[n] for n in written)
+
+    prev_tracing = getattr(exec_state, "_tracing", False)
+    if exec_state is not None:
+        exec_state._tracing = True  # branches (and eval_shape) only trace
+    try:
+        if all(n in env for n in written):
+            shapes = None  # every write pre-bound: no extra trace needed
+        else:
+            # shapes of the block's writes to synthesize zero defaults for
+            # names unbound before the block
+            shapes = jax.eval_shape(then_fn, 0)
+
+        def else_fn(_):
+            out = []
+            for i, n in enumerate(written):
+                old = env.get(n)
+                if old is None:
+                    old = jax.tree_util.tree_map(
+                        lambda l: jnp.zeros(l.shape, l.dtype), shapes[i])
+                out.append(old)
+            return tuple(out)
+
+        results = jax.lax.cond(cond, then_fn, else_fn, 0)
+    finally:
+        if exec_state is not None:
+            exec_state._tracing = prev_tracing
+    for n, v in zip(written, results):
+        env[n] = v
+    from ..core.flags import get_flag
+    if get_flag("check_nan_inf") and not prev_tracing:
+        # eager mode: the block's ops only traced (lax.cond), so the per-op
+        # sweep couldn't see them — check the block's OUTPUTS here (block-
+        # level attribution instead of op-level; jit mode gets op-level via
+        # debug_nans)
+        import numpy as _np
+        for n, v in zip(written, results):
+            for leaf in jax.tree_util.tree_leaves(v):
+                if isinstance(leaf, jax.core.Tracer):
+                    continue
+                arr = _np.asarray(leaf)
+                if _np.issubdtype(arr.dtype, _np.floating) and \
+                        not _np.isfinite(arr).all():
+                    raise FloatingPointError(
+                        f"NaN/Inf in conditional_block output {n!r} "
+                        "(check_nan_inf flag; rerun under jit with the "
+                        "flag for per-op attribution)")
 
 
 # ---------------------------------------------------------------------------

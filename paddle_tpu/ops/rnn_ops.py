@@ -57,9 +57,13 @@ def _reverse_padded(data, lens):
     return jnp.take_along_axis(data, idx, axis=1)
 
 
-def _lstm_scan(x, lens, w, h0, c0, gate_act, cell_act, cand_act):
+def _lstm_scan(x, lens, w, h0, c0, gate_act, cell_act, cand_act,
+               peepholes=None):
     """x: [b, L, 4H] projected inputs (+bias already added); w: [H, 4H].
-    Returns hidden [b, L, H], cell [b, L, H]."""
+    ``peepholes``: optional (w_ic, w_fc, w_oc) each [H] — the reference's
+    diagonal cell->gate connections (math/detail/lstm_kernel.h:37-40:
+    i/f see the PREVIOUS cell state, o sees the NEW one). Returns
+    hidden [b, L, H], cell [b, L, H]."""
     from ..core.flags import get_flag
 
     b, L, H4 = x.shape
@@ -67,7 +71,7 @@ def _lstm_scan(x, lens, w, h0, c0, gate_act, cell_act, cand_act):
     ga, ca, cda = _act(gate_act), _act(cell_act), _act(cand_act)
     # the Pallas fused cell implements the standard activation set (the
     # reference's hand-scheduled hl_cuda_lstm.cu does the same)
-    use_pallas = (get_flag("use_pallas_rnn")
+    use_pallas = (get_flag("use_pallas_rnn") and peepholes is None
                   and (gate_act, cell_act, cand_act)
                   == ("sigmoid", "tanh", "tanh"))
 
@@ -80,11 +84,20 @@ def _lstm_scan(x, lens, w, h0, c0, gate_act, cell_act, cand_act):
             from .pallas_kernels import fused_lstm_cell
             h, c = fused_lstm_cell(gates, c_prev, h_prev, alive)
         else:
-            i = ga(gates[:, :H])
-            f = ga(gates[:, H:2 * H])
+            gi = gates[:, :H]
+            gf = gates[:, H:2 * H]
+            go = gates[:, 3 * H:]
+            if peepholes is not None:
+                w_ic, w_fc, w_oc = peepholes
+                gi = gi + c_prev * w_ic[None, :]
+                gf = gf + c_prev * w_fc[None, :]
+            i = ga(gi)
+            f = ga(gf)
             cand = cda(gates[:, 2 * H:3 * H])
-            o = ga(gates[:, 3 * H:])
             c = f * c_prev + i * cand
+            if peepholes is not None:
+                go = go + c * w_oc[None, :]
+            o = ga(go)
             h = o * ca(c)
             h = alive * h + (1 - alive) * h_prev
             c = alive * c + (1 - alive) * c_prev
@@ -99,12 +112,14 @@ def _lstm_scan(x, lens, w, h0, c0, gate_act, cell_act, cand_act):
 def _lstm_compute(x, lens, w, bias, h0, c0, attrs):
     b, L, H4 = x.shape
     H = H4 // 4
+    peepholes = None
     if bias is not None:
         x = x + bias[None, None, :H4]
         if bias.shape[-1] == 7 * H:
-            raise NotImplementedError(
-                "peephole connections (use_peepholes=True) are not lowered "
-                "yet; pass use_peepholes=False")
+            # reference bias layout with use_peepholes (lstm_op.cc:74):
+            # [4H gate bias | W_ic | W_fc | W_oc]
+            peepholes = (bias[4 * H:5 * H], bias[5 * H:6 * H],
+                         bias[6 * H:7 * H])
     if h0 is None:
         h0 = jnp.zeros((b, H), x.dtype)
     if c0 is None:
@@ -116,7 +131,8 @@ def _lstm_compute(x, lens, w, bias, h0, c0, attrs):
                         h0, c0,
                         attrs.get("gate_activation", "sigmoid"),
                         attrs.get("cell_activation", "tanh"),
-                        attrs.get("candidate_activation", "tanh"))
+                        attrs.get("candidate_activation", "tanh"),
+                        peepholes=peepholes)
     if rev:
         hs = _reverse_padded(hs, lens)
         cs = _reverse_padded(cs, lens)

@@ -128,3 +128,32 @@ def test_program_to_graphviz():
     assert dot.startswith("digraph G {") and dot.endswith("}")
     assert '"gv_w" [shape=doublecircle];' in dot   # parameter styling
     assert '"x" -> "op_0_mul";' in dot or '"gv_w" -> "op_0_mul";' in dot
+
+
+def test_conditional_block_is_lazy_at_runtime(capsys):
+    """conditional_block lowers to lax.cond: the untaken branch's ops do
+    NOT execute at runtime (the reference's conditional cost model) —
+    observable because the Print op's debug callback only fires when its
+    branch is taken."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2])
+        flag = layers.data("flag", shape=[1], dtype="bool")
+        out = layers.fill_constant(shape=[1, 2], dtype="float32", value=0.0)
+        sw = fluid.layers.Switch()
+        with sw.case(flag):
+            p = layers.Print(x, message="taken-branch",
+                             print_phase="forward")
+            layers.assign(layers.scale(p, scale=2.0), output=out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed_f = {"x": np.ones((1, 2), "float32"),
+              "flag": np.array([[False]])}
+    v = exe.run(main, feed=feed_f, fetch_list=[out])[0]
+    np.testing.assert_allclose(v, 0.0)
+    assert "taken-branch" not in capsys.readouterr().out  # branch skipped
+
+    feed_t = {"x": np.ones((1, 2), "float32"), "flag": np.array([[True]])}
+    v = exe.run(main, feed=feed_t, fetch_list=[out])[0]
+    np.testing.assert_allclose(v, 2.0)
+    assert "taken-branch" in capsys.readouterr().out      # branch executed

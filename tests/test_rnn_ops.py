@@ -214,3 +214,56 @@ def test_dynamic_lstmp_trains_and_projects():
     losses = [float(exe.run(main, feed=feed, fetch_list=[loss],
                             scope=scope)[0]) for _ in range(40)]
     assert losses[-1] < 0.2 * losses[0], losses[::10]
+
+
+def test_lstm_peepholes_train_and_differ_from_plain():
+    """use_peepholes=True (the reference DEFAULT): i/f gates see the
+    previous cell state, o sees the new one, weights live in the 7H bias
+    (lstm_op.cc:74, math/detail/lstm_kernel.h:37-40). The model must train
+    AND produce different outputs from the plain LSTM once the peephole
+    weights move off zero."""
+    import paddle_tpu.fluid as fluid
+    layers = fluid.layers
+
+    def build(peep):
+        from paddle_tpu.fluid import framework
+        framework.reset_unique_name()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 4
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[1], dtype="int64", lod_level=1)
+            e = layers.embedding(x, size=[10, 8])
+            h, c = layers.dynamic_lstm(layers.fc(e, size=32), size=32,
+                                       use_peepholes=peep)
+            pred = layers.fc(layers.sequence_last_step(h), size=1)
+            y = layers.data("y", shape=[1])
+            loss = layers.mean(layers.square(
+                layers.elementwise_sub(pred, y)))
+            fluid.optimizer.Adam(learning_rate=0.05).minimize(loss, startup)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    seqs = [rng.randint(0, 10, (int(rng.randint(2, 6)), 1)).astype("int64")
+            for _ in range(6)]
+    feed = {"x": seqs, "y": rng.normal(0, 1, (6, 1)).astype("float32")}
+
+    def train(peep):
+        main, startup, loss = build(peep)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        losses = [float(exe.run(main, feed=feed, fetch_list=[loss],
+                                scope=scope)[0]) for _ in range(40)]
+        return main, scope, losses
+
+    main, scope, losses = train(True)
+    assert losses[-1] < 0.25 * losses[0], losses[::10]
+    # the peephole bias is 7H wide and its diagonal weights trained away
+    # from zero
+    bias_name = [p.name for p in main.all_parameters()
+                 if p.shape and p.shape[-1] == 7 * 8][0]
+    b = np.asarray(scope.find_var(bias_name))
+    assert np.abs(b[0, 4 * 8:]).max() > 1e-4
+    # and the trajectory DIFFERS from the plain LSTM once peepholes move
+    _, _, plain_losses = train(False)
+    assert not np.allclose(losses[5:], plain_losses[5:], rtol=1e-4)
