@@ -65,3 +65,95 @@ def test_recognize_digits_mlp_converges(tmp_path):
     pred, = exe.run(infer_prog, feed={"img": xs[:32]}, fetch_list=fetch_vars)
     top1 = pred.argmax(axis=1)
     assert (top1 == ys[:32].flatten()).mean() > 0.8
+
+
+def test_recognize_digits_parallel_matches_reference_variant():
+    """The reference book test's parallel=True axis
+    (test_recognize_digits.py:77-86: parallel_do over places): here the
+    same MLP trains SPMD over the 8-device mesh via shard_program_step and
+    must reach the same accuracy contract."""
+    from paddle_tpu.parallel import (make_mesh, ShardingPlan,
+                                     shard_program_step, place_feed)
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 2
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[64])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        prediction, avg_loss, acc = mlp(img, label)
+        fluid.optimizer.Adam(learning_rate=0.002).minimize(avg_loss,
+                                                           startup)
+    scope = fluid.Scope()
+    exe = fluid.Executor(mode="jit")
+    exe.run(startup, scope=scope)
+    mesh = make_mesh(8, axes=("dp",))
+    plan = ShardingPlan(mesh)
+
+    xs, ys = _synthetic_digits()
+    batch = 128
+    block = main.global_block()
+    feed0 = {"img": xs[:batch], "label": ys[:batch]}
+    fn, state, _ = shard_program_step(exe, main, feed0, [avg_loss, acc],
+                                      plan, scope=scope)
+    acc_val = 0.0
+    with mesh:
+        for epoch in range(10):
+            accs = []
+            for i in range(0, len(xs) - batch + 1, batch):
+                fd = exe._prepare_feed(block, {"img": xs[i:i + batch],
+                                               "label": ys[i:i + batch]})
+                fd = {n: place_feed(v, plan, n) for n, v in fd.items()}
+                state, fetches = fn(state, fd)
+                accs.append(float(np.asarray(fetches[1])))
+            acc_val = float(np.mean(accs))
+            if acc_val > 0.95:
+                break
+    assert acc_val > 0.9, f"parallel MLP failed to converge, acc={acc_val}"
+
+
+def test_recognize_digits_pserver_variant():
+    """The reference book test's is_local=False axis
+    (test_recognize_digits.py:151-179: transpiled trainer + pserver): the
+    trainer program is forward+backward only, the optimizer runs on the
+    parameter server, and the same accuracy contract holds."""
+    from paddle_tpu.distributed import serve, ParamClient
+
+    ps, rpc = serve(optimizer="adam", opt_kwargs={"lr": 0.002},
+                    mode="async")
+    rpc.serve_in_thread()
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[64])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        prediction, avg_loss, acc = mlp(img, label)
+        params_grads = fluid.append_backward(avg_loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    pnames = [p.name for p, _ in params_grads]
+    client = ParamClient([rpc.address])
+    client.init_params({n: np.asarray(scope.find_var(n)) for n in pnames})
+
+    xs, ys = _synthetic_digits()
+    batch = 128
+    grad_names = [g.name for _, g in params_grads]
+    acc_val = 0.0
+    for epoch in range(10):
+        accs = []
+        for i in range(0, len(xs) - batch + 1, batch):
+            for n, v in client.pull().items():     # recv params
+                scope.set(n, v)
+            vals = exe.run(main, feed={"img": xs[i:i + batch],
+                                       "label": ys[i:i + batch]},
+                           fetch_list=[acc] + grad_names, scope=scope)
+            accs.append(float(vals[0]))
+            client.push({p: np.asarray(g)          # send grads
+                         for p, g in zip(pnames, vals[1:])})
+        acc_val = float(np.mean(accs))
+        if acc_val > 0.95:
+            break
+    rpc.shutdown()
+    assert acc_val > 0.9, f"pserver MLP failed to converge, acc={acc_val}"
