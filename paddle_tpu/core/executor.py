@@ -230,10 +230,26 @@ def _compiler_options():
     return dict(kv.split("=", 1) for kv in s.split(",") if "=" in kv)
 
 
-def tpu_jit(fn, **jit_kwargs):
+def tpu_jit(fn, auto_state_layout=False, **jit_kwargs):
     """jax.jit with the flag-registry compiler options applied — the ONE
     jit wrapper every compiled path (Executor, run_steps, sharded step)
-    goes through, so the xla_compiler_options flag reaches them all."""
+    goes through, so the xla_compiler_options flag reaches them all.
+
+    auto_state_layout lets XLA pick the entry layout of the first argument
+    (the persistent state dict) instead of forcing row-major at the jit
+    boundary. Parameters then live in the scope in their compute-preferred
+    layout (e.g. conv filters pre-transposed for the MXU), which removes the
+    per-step relayout copies the default boundary forces (~8 GB/step of
+    weight copies on the ResNet-50 flagship, measured via tools/
+    hlo_report.py). Feeds keep the default layout so pre-staged input
+    buffers never relayout. First call with row-major state pays a one-time
+    transpose; every subsequent step reuses the returned arrays unchanged
+    (donation aliases input/output so the layouts agree)."""
+    if auto_state_layout:
+        from jax.experimental.layout import Format, Layout
+        auto = Format(Layout.AUTO)
+        jit_kwargs.setdefault("in_shardings", (auto, None))
+        jit_kwargs.setdefault("out_shardings", (auto, None))
     return jax.jit(fn, compiler_options=_compiler_options(), **jit_kwargs)
 
 
@@ -250,7 +266,8 @@ class Executor:
     mode="eager" : op-at-a-time interpreter (debug / OpTest path)
     """
 
-    def __init__(self, place=None, mode="jit", donate=False, amp=False):
+    def __init__(self, place=None, mode="jit", donate=False, amp=False,
+                 auto_layout=False):
         self.place = place
         self.device = _resolve_device(place)
         self.mode = mode
@@ -258,6 +275,9 @@ class Executor:
         # AMP: bf16 compute with fp32 master weights (core/amp.py). The flag
         # is applied around tracing/execution so op lowerings autocast.
         self.amp = amp
+        # auto_layout: XLA picks the persistent-state entry layout (see
+        # tpu_jit). Scope arrays then carry compute-preferred layouts.
+        self.auto_layout = auto_layout
         self._cache = {}
 
     # ------------------------------------------------------------------
@@ -449,7 +469,7 @@ class Executor:
     def _compiled(self, program, feed_names, fetch_names, state_in, state_out):
         from .flags import get_flag
         key = (id(program), program._version, feed_names, fetch_names,
-               state_in, state_out, self.donate, self.amp,
+               state_in, state_out, self.donate, self.amp, self.auto_layout,
                get_flag("xla_compiler_options"),
                get_flag("use_pallas_rnn"))
         fn = self._cache.get(key)
@@ -477,7 +497,8 @@ class Executor:
             return new_state, fetches
 
         donate = (0,) if self.donate else ()
-        fn = tpu_jit(step, donate_argnums=donate)
+        fn = tpu_jit(step, auto_state_layout=self.auto_layout,
+                     donate_argnums=donate)
         self._cache[key] = fn
         return fn
 
@@ -507,14 +528,22 @@ class Executor:
                 v = block.var(name) if block.has_var(name) else None
                 if (v is not None and v.lod_level >= 2
                         and isinstance(value[0], list)):
-                    # nested python lists: outer list of inner sequences
-                    # (2-level LoD feed, reference create_lod_tensor's
-                    # recursive_seq_lens form)
-                    inner = [np.asarray(s) for group in value
-                             for s in group]
-                    arr = pack_sequences(inner)
-                    arr.outer_lens = np.asarray(
-                        [len(g) for g in value], np.int32)
+                    # nested python lists to arbitrary depth (reference
+                    # create_lod_tensor's recursive_seq_lens form,
+                    # lod_tensor.h:55 N-level LoD): peel exactly the declared
+                    # outer levels (lod_level - 1), so empty outer groups
+                    # pack as zero-length entries instead of stopping the
+                    # peel
+                    levels, cur = [], value
+                    for _ in range(v.lod_level - 1):
+                        if not all(isinstance(g, list) for g in cur):
+                            break
+                        levels.append(np.asarray([len(g) for g in cur],
+                                                 np.int32))
+                        cur = [s for g in cur for s in g]
+                    arr = pack_sequences([np.asarray(s) for s in cur])
+                    if levels:
+                        arr.outer_lens = tuple(levels)
                     out[name] = place_lod(arr)
                     continue
                 if v is not None and v.lod_level > 0:

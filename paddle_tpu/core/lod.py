@@ -35,29 +35,61 @@ class LoDArray:
 
     data: [batch, max_len, *feature] padded with zeros past each row's length
     lens: [batch] int32 true sequence lengths (the INNERMOST LoD level)
-    outer_lens: optional [n_outer] int32 — a SECOND LoD level grouping the
-        ``batch`` rows into outer sequences (sum(outer_lens) == batch), the
+    outer_lens: optional outer LoD levels grouping the ``batch`` rows — the
         nested-offsets capability of the reference LoD
-        (framework/lod_tensor.h:55-107): e.g. beam-search output groups
-        batch*beam sentence rows by source sentence.
+        (framework/lod_tensor.h:55, arbitrarily nested ``LoD =
+        vector<Vector<size_t>>``). Either
+
+        * a single [n_outer] int32 array — one extra level
+          (sum(outer_lens) == batch), e.g. beam-search output grouping
+          batch*beam sentence rows by source sentence; or
+        * a tuple of arrays OUTERMOST FIRST for deeper nesting: each level's
+          lens sum to the number of entries of the level below it, and the
+          innermost tuple entry sums to ``batch``.
     """
 
-    __slots__ = ("data", "lens", "outer_lens")
+    __slots__ = ("data", "lens", "_outer")
 
     def __init__(self, data, lens, outer_lens=None):
         self.data = data
         self.lens = lens
         self.outer_lens = outer_lens
 
-    # pytree protocol: traces through jit/grad/scan transparently
+    @property
+    def outer_lens(self):
+        """None (level-1), the single outer array (level-2, the dominant
+        case — callers index it directly), or the outermost-first tuple of
+        arrays (level-3+)."""
+        if not self._outer:
+            return None
+        if len(self._outer) == 1:
+            return self._outer[0]
+        return self._outer
+
+    @outer_lens.setter
+    def outer_lens(self, value):
+        if value is None:
+            self._outer = ()
+        elif isinstance(value, (tuple, list)):
+            self._outer = tuple(value)
+        else:
+            self._outer = (value,)
+
+    @property
+    def outer_levels(self):
+        """All outer levels as a tuple, outermost first (empty for level-1)."""
+        return self._outer
+
+    # pytree protocol: traces through jit/grad/scan transparently; aux is the
+    # outer-level count (bool back-compat: False==0 / True==1 pickles match)
     def tree_flatten(self):
-        if self.outer_lens is None:
-            return (self.data, self.lens), False
-        return (self.data, self.lens, self.outer_lens), True
+        return (self.data, self.lens) + self._outer, len(self._outer)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children)
+        data, lens = children[0], children[1]
+        n = int(aux)
+        return cls(data, lens, tuple(children[2:2 + n]) if n else None)
 
     @property
     def batch(self):
@@ -69,22 +101,26 @@ class LoDArray:
 
     @property
     def lod_level(self):
-        return 2 if self.outer_lens is not None else 1
+        return 1 + len(self._outer)
 
     def mask(self, dtype=jnp.float32):
         """[batch, max_len] 1/0 validity mask."""
         return (jnp.arange(self.data.shape[1])[None, :]
                 < self.lens[:, None]).astype(dtype)
 
-    def row_to_outer(self):
-        """[batch] int32: the outer-sequence index of each row (level-2)."""
-        starts = jnp.cumsum(self.outer_lens)
-        return jnp.searchsorted(starts, jnp.arange(self.data.shape[0]),
+    def row_to_outer(self, level=-1):
+        """[n_below] int32: for each entry of the level below, the index of
+        its parent group in outer level ``level`` (default: the innermost
+        outer level, mapping data rows to their group)."""
+        lens = self._outer[level]
+        starts = jnp.cumsum(lens)
+        n_below = self.data.shape[0] if level in (-1, len(self._outer) - 1) \
+            else self._outer[level + 1].shape[0]
+        return jnp.searchsorted(starts, jnp.arange(n_below),
                                 side="right").astype(jnp.int32)
 
     def __repr__(self):
-        extra = f", outer_lens={self.outer_lens}" \
-            if self.outer_lens is not None else ""
+        extra = f", outer_lens={self.outer_lens}" if self._outer else ""
         return (f"LoDArray(data={getattr(self.data, 'shape', None)}, "
                 f"lens={self.lens}{extra})")
 
@@ -123,9 +159,10 @@ def lens_from_lod(lod) -> np.ndarray:
 
 def flat_to_lodarray(flat, lod, pad_multiple=1):
     """Reference feed form (concatenated [sum_len, *feat] array, offset lod)
-    -> padded LoDArray. Handles level-1 ([[offsets]]) and level-2
-    ([[outer offsets over sequences], [token offsets]]) nested LoD
-    (framework/lod_tensor.h:55). This is the feed-boundary packer."""
+    -> padded LoDArray. Handles arbitrarily nested LoD — level-1
+    ([[offsets]]), level-2 ([[outer offsets], [token offsets]]), level-N
+    (framework/lod_tensor.h:55 ``LoD = vector<Vector<size_t>>``, outermost
+    first). This is the feed-boundary packer."""
     lod = list(lod)
     inner = lod[-1]
     lens = lens_from_lod([inner])
@@ -135,25 +172,23 @@ def flat_to_lodarray(flat, lod, pad_multiple=1):
         seqs.append(flat[start:start + int(ln)])
         start += int(ln)
     arr = pack_sequences(seqs, dtype=flat.dtype, pad_multiple=pad_multiple)
-    if len(lod) == 2:
-        arr.outer_lens = lens_from_lod([lod[0]])
-    elif len(lod) > 2:
-        raise NotImplementedError("LoD deeper than 2 levels")
+    if len(lod) > 1:
+        arr.outer_lens = tuple(lens_from_lod([lvl]) for lvl in lod[:-1])
     return arr
 
 
 def lodarray_to_flat(arr: LoDArray):
     """Padded LoDArray -> (concatenated numpy array, offset lod): the fetch-
     boundary unpacker, restoring the reference's LoDTensor wire form (with
-    both levels for nested LoD)."""
+    every nesting level for multi-level LoD)."""
     data = np.asarray(arr.data)
     lens = np.asarray(arr.lens)
     parts = [data[i, : int(lens[i])] for i in range(len(lens))]
     flat = np.concatenate(parts, axis=0) if parts else np.zeros((0,) + data.shape[2:],
                                                                data.dtype)
     lod = lod_from_lens(lens)
-    if arr.outer_lens is not None:
-        lod = lod_from_lens(np.asarray(arr.outer_lens)) + lod
+    for lvl in reversed(arr.outer_levels):
+        lod = lod_from_lens(np.asarray(lvl)) + lod
     return flat, lod
 
 

@@ -27,8 +27,8 @@ from . import backward
 from . import io
 from . import evaluator
 from . import concurrency
-from .concurrency import (Go, make_channel, channel_send, channel_recv,
-                          channel_close)
+from .concurrency import (Go, Select, make_channel, channel_send,
+                          channel_recv, channel_close)
 from .backward import append_backward
 from .param_attr import ParamAttr
 from .data_feeder import DataFeeder
@@ -44,6 +44,6 @@ __all__ = [
     "initializer", "regularizer", "backward", "io", "nets", "append_backward",
     "ParamAttr", "DataFeeder", "LoDArray", "profiler", "amp_guard", "clip",
     "set_flags", "get_flag", "flags", "init_flags", "evaluator",
-    "concurrency", "Go", "make_channel", "channel_send", "channel_recv",
-    "channel_close",
+    "concurrency", "Go", "Select", "make_channel", "channel_send",
+    "channel_recv", "channel_close",
 ]

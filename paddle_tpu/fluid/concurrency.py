@@ -23,7 +23,7 @@ import queue
 import threading
 
 __all__ = ["Channel", "make_channel", "channel_send", "channel_recv",
-           "channel_close", "Go"]
+           "channel_close", "Go", "Select"]
 
 
 class ChannelClosed(Exception):
@@ -84,6 +84,40 @@ class Channel:
         with self._taken:
             self._taken.notify_all()
 
+    # ---- non-blocking probes (the Select building blocks) ----
+    def try_recv(self):
+        """(value, ok, ready): ready False when nothing is available yet;
+        (None, False, True) once closed-and-drained — mirroring the ready
+        states select_op.cc polls for (operators/select_op.cc
+        QueueListenerThread readiness checks)."""
+        try:
+            v = self._q.get_nowait()
+        except queue.Empty:
+            if self._closed.is_set():
+                return None, False, True
+            return None, False, False
+        if self._unbuffered:
+            with self._taken:
+                self._outstanding -= 1
+                self._taken.notify_all()
+        return v, True, True
+
+    def try_send(self, value):
+        """True if the value was accepted without blocking. On an unbuffered
+        channel the value is parked in the rendezvous slot (the host-side
+        approximation of "a receiver is ready"); a closed channel raises,
+        like send."""
+        if self._closed.is_set():
+            raise ChannelClosed("send on closed channel")
+        try:
+            self._q.put_nowait(value)
+        except queue.Full:
+            return False
+        if self._unbuffered:
+            with self._taken:
+                self._outstanding += 1
+        return True
+
 
 def make_channel(dtype, capacity=0):
     return Channel(dtype, capacity)
@@ -133,3 +167,89 @@ class Go:
     def join(self, timeout=None):
         for t in self._threads:
             t.join(timeout)
+
+
+class Select:
+    """CSP select over host channels (reference fluid/concurrency.py:193
+    Select + operators/select_op.cc): register send/recv cases and an
+    optional default, then ``run()`` fires the FIRST READY case exactly once.
+    With no ready case, ``run`` blocks polling until one becomes ready —
+    unless a default case exists, which then fires immediately
+    (select_op.cc's default-case fallthrough).
+
+    The reference builds conditional_block sub-graphs gated by a
+    case_to_execute variable; here (channels being host objects, see module
+    docstring) cases are Python callables:
+
+        sel = fluid.Select()
+
+        @sel.case(fluid.channel_recv, ch1)
+        def on_recv(value, ok):
+            ...
+
+        @sel.case(fluid.channel_send, ch2, x)
+        def on_send():
+            ...
+
+        @sel.default
+        def on_default():
+            ...
+
+        fired = sel.run()     # index of the case that executed
+    """
+
+    _POLL = 0.002
+
+    def __init__(self, name=None):
+        self._cases = []          # (kind, channel, value, body)
+        self._default = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        return False
+
+    def case(self, channel_action_fn, channel, value=None, is_copy=False):
+        """Register a case; channel_action_fn is fluid.channel_send or
+        fluid.channel_recv (the reference's calling convention)."""
+        kind = "send" if channel_action_fn is channel_send else "recv"
+        if channel_action_fn not in (channel_send, channel_recv):
+            raise ValueError("case action must be channel_send/channel_recv")
+        if kind == "send" and is_copy:
+            import copy as _copy
+            value = _copy.deepcopy(value)
+
+        def deco(body):
+            self._cases.append((kind, channel, value, body))
+            return body
+        return deco
+
+    def default(self, body):
+        if self._default is not None:
+            raise ValueError("select already has a default case")
+        self._default = body
+        return body
+
+    def run(self, timeout=None):
+        """Execute exactly one case; returns its registration index
+        (len(cases) for the default case)."""
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            for idx, (kind, ch, value, body) in enumerate(self._cases):
+                if kind == "recv":
+                    v, ok, ready = ch.try_recv()
+                    if ready:
+                        body(v, ok)
+                        return idx
+                else:
+                    if ch.try_send(value):
+                        body()
+                        return idx
+            if self._default is not None:
+                self._default()
+                return len(self._cases)
+            if deadline is not None and _time.monotonic() > deadline:
+                raise TimeoutError("select: no case became ready")
+            _time.sleep(self._POLL)

@@ -778,3 +778,188 @@ def pool3d(input, pool_size=2, pool_type="max", pool_stride=1,
                "pooling_type": pool_type,
                "global_pooling": global_pooling})
     return out
+
+
+# ---------------------------------------------------------------------------
+# round-4 breadth: the remaining reference nn.py surface
+# ---------------------------------------------------------------------------
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    """x / sqrt(max(sum(x**2, axis), epsilon)) (reference nn.py l2_normalize;
+    the reference's op chain drops the sqrt — an acknowledged bug in its
+    TODO — so this follows the documented L2 semantics)."""
+    helper = LayerHelper("l2_normalize", name=name)
+    if len(x.shape) == 1:
+        axis = 0
+    square = helper.create_tmp_variable(x.dtype, shape=x.shape)
+    helper.append_op("square", inputs={"X": [x.name]},
+                     outputs={"Out": [square.name]})
+    rshape = tuple(1 if i == (axis % len(x.shape)) else s
+                   for i, s in enumerate(x.shape))
+    reduced = helper.create_tmp_variable(x.dtype, shape=rshape)
+    helper.append_op("reduce_sum", inputs={"X": [square.name]},
+                     outputs={"Out": [reduced.name]},
+                     attrs={"dim": axis, "keep_dim": True,
+                            "reduce_all": False})
+    clipped = helper.create_tmp_variable(x.dtype, shape=rshape)
+    helper.append_op("clip", inputs={"X": [reduced.name]},
+                     outputs={"Out": [clipped.name]},
+                     attrs={"min": float(epsilon), "max": 3.4e38})
+    root = helper.create_tmp_variable(x.dtype, shape=rshape)
+    helper.append_op("sqrt", inputs={"X": [clipped.name]},
+                     outputs={"Out": [root.name]})
+    rsq = helper.create_tmp_variable(x.dtype, shape=rshape)
+    helper.append_op("reciprocal", inputs={"X": [root.name]},
+                     outputs={"Out": [rsq.name]})
+    out = helper.create_tmp_variable(x.dtype, shape=x.shape)
+    helper.append_op("elementwise_mul",
+                     inputs={"X": [x.name], "Y": [rsq.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": -1})
+    return out
+
+
+def multiplex(inputs, index):
+    """Row-wise select among candidate tensors by index column
+    (reference nn.py multiplex -> multiplex_op.cc)."""
+    helper = LayerHelper("multiplex")
+    if not isinstance(inputs, (list, tuple)) or len(inputs) < 2:
+        raise ValueError("multiplex needs at least 2 input tensors")
+    out = helper.create_tmp_variable(inputs[0].dtype, shape=inputs[0].shape)
+    helper.append_op("multiplex",
+                     inputs={"X": [i.name for i in inputs],
+                             "Ids": [index.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def one_hot(input, depth):
+    """Int ids -> one-hot float rows (reference nn.py one_hot)."""
+    helper = LayerHelper("one_hot")
+    shape = tuple(input.shape[:-1]) + (depth,) if input.shape else None
+    out = helper.create_tmp_variable("float32", shape=shape)
+    helper.append_op("one_hot", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]}, attrs={"depth": depth})
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    """Smooth-L1 (Huber) loss rows (reference nn.py smooth_l1 ->
+    smooth_l1_loss_op.cc); weights gate the diff inside / the loss outside."""
+    helper = LayerHelper("smooth_l1_loss")
+    diff = helper.create_tmp_variable(x.dtype, shape=x.shape)
+    loss = helper.create_tmp_variable(x.dtype, shape=(x.shape[0], 1))
+    inputs = {"X": [x.name], "Y": [y.name]}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = [inside_weight.name]
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = [outside_weight.name]
+    helper.append_op("smooth_l1_loss", inputs=inputs,
+                     outputs={"Diff": [diff.name], "Out": [loss.name]},
+                     attrs={"sigma": 1.0 if sigma is None else float(sigma)})
+    return loss
+
+
+def expand(x, expand_times, name=None):
+    """Tile x by expand_times per dim (reference nn.py expand op chain)."""
+    helper = LayerHelper("expand", name=name)
+    shape = tuple(int(s * t) for s, t in zip(x.shape, expand_times)) \
+        if x.shape else None
+    out = helper.create_tmp_variable(x.dtype, shape=shape)
+    helper.append_op("expand", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"expand_times": list(expand_times)})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    """Zero-extend each dim by (before, after) pairs (reference layers pad ->
+    pad_op.cc)."""
+    helper = LayerHelper("pad", name=name)
+    shape = tuple(int(s + paddings[2 * i] + paddings[2 * i + 1])
+                  for i, s in enumerate(x.shape)) if x.shape else None
+    out = helper.create_tmp_variable(x.dtype, shape=shape)
+    helper.append_op("pad", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"paddings": list(paddings),
+                            "pad_value": float(pad_value)})
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Slice a static-shape window out of x (reference crop_op.cc; shape may
+    come from a reference Variable)."""
+    helper = LayerHelper("crop", name=name)
+    inputs = {"X": [x.name]}
+    attrs = {}
+    if isinstance(shape, Variable):
+        inputs["Y"] = [shape.name]
+        out_shape = shape.shape
+    else:
+        attrs["shape"] = list(shape)
+        out_shape = tuple(shape)
+    attrs["offsets"] = list(offsets) if offsets is not None \
+        else [0] * len(x.shape)
+    out = helper.create_tmp_variable(x.dtype, shape=out_shape)
+    helper.append_op("crop", inputs=inputs, outputs={"Out": [out.name]},
+                     attrs=attrs)
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    """(1-eps)*label + eps*prior (reference label_smooth_op.h)."""
+    helper = LayerHelper("label_smooth", name=name)
+    inputs = {"X": [label.name]}
+    if prior_dist is not None:
+        inputs["PriorDist"] = [prior_dist.name]
+    out = helper.create_tmp_variable(label.dtype, shape=label.shape)
+    helper.append_op("label_smooth", inputs=inputs,
+                     outputs={"Out": [out.name]},
+                     attrs={"epsilon": float(epsilon)})
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, param_attr=None,
+                     bias_attr=None, act=None, name=None):
+    """3-D transposed convolution (reference conv_transpose_op.cc 3-D maker,
+    filter layout [C_in, C_out, kd, kh, kw])."""
+    helper = LayerHelper("conv3d_transpose", name=name, act=act)
+    c_in = input.shape[1]
+    st = [stride] * 3 if isinstance(stride, int) else list(stride)
+    pd = [padding] * 3 if isinstance(padding, int) else list(padding)
+    dl = [dilation] * 3 if isinstance(dilation, int) else list(dilation)
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("output_size must be set when filter_size is None")
+        osize = [output_size] * 3 if isinstance(output_size, int) \
+            else list(output_size)
+        ks = [osize[i] - (input.shape[2 + i] - 1) * st[i] + 2 * pd[i]
+              for i in range(3)]
+    else:
+        ks = [filter_size] * 3 if isinstance(filter_size, int) \
+            else list(filter_size)
+    w = helper.create_parameter(
+        ParamAttr.to_attr(param_attr),
+        shape=(c_in, num_filters, ks[0], ks[1], ks[2]), dtype=input.dtype,
+        default_initializer=Xavier())
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(
+        "conv3d_transpose",
+        inputs={"Input": [input.name], "Filter": [w.name]},
+        outputs={"Output": [out.name]},
+        attrs={"strides": st, "paddings": pd, "dilations": dl})
+    out = _append_channel_bias(helper, out, num_filters, bias_attr)
+    return helper.append_activation(out)
+
+
+def max_pool3d_with_index(input, pool_size, pool_stride=None, name=None):
+    helper = LayerHelper("max_pool3d_with_index", name=name)
+    ks = [pool_size] * 3 if isinstance(pool_size, int) else list(pool_size)
+    st = pool_stride or ks
+    st = [st] * 3 if isinstance(st, int) else list(st)
+    out = helper.create_tmp_variable(input.dtype)
+    mask = helper.create_tmp_variable("int32", stop_gradient=True)
+    helper.append_op("max_pool3d_with_index", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name], "Mask": [mask.name]},
+                     attrs={"ksize": ks, "strides": st})
+    return out, mask

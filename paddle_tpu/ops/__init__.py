@@ -25,4 +25,5 @@ from . import (  # noqa: F401
     metrics,
     detection_ops,
     misc_ops,
+    breadth_ops,
 )

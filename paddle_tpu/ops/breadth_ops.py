@@ -1,0 +1,694 @@
+"""Op-inventory breadth: expand, pad, crop, label_smooth, minus, l1_norm,
+conv_shift, modified_huber_loss, *_random_batch_size_like, conv3d_transpose,
+max_pool3d_with_index, positive_negative_pair, average_accumulates,
+detection_map.
+
+Reference semantics: /root/reference/paddle/fluid/operators/{expand_op.cc
+(tile by expand_times, grad sums over tiles), pad_op.cc (paddings =
+[before0, after0, ...] + pad_value, grad slices), crop_op.h (offset slice via
+StridedMemcpy, shape from attr or the Y reference input), label_smooth_op.h
+(out = (1-eps)·x + eps·prior-or-uniform), minus_op.cc, l1_norm_op.cc,
+conv_shift_op.cu (per-row circular correlation), modified_huber_loss_op.h,
+batch_size_like.h + {uniform,gaussian}_random_batch_size_like_op.cc,
+conv_transpose_op.cc (3-D variant), pool_with_index_op.cc (3-D variant),
+positive_negative_pair_op.h (per-query concordant/discordant pair counts),
+average_accumulates_op.h (Polyak-style parameter-average windows),
+detection_map_op.cc}.
+
+TPU-native notes: every lowering here is a handful of jnp/lax calls that XLA
+fuses; the reference's hand-written CUDA kernels (e.g. conv_shift_op.cu's
+shared-memory circular loads) become gather/one-hot matmul forms. The
+stateful metric ops (positive_negative_pair, average_accumulates,
+detection_map) keep the reference's accumulate-through-inputs contract so
+they thread through scopes exactly like the originals.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op, OpSpec, same_shape, infer_output
+from ..core.types import np_dtype
+from .common import G, data_of, like
+
+
+# ---------------------------------------------------------------------------
+# expand
+# ---------------------------------------------------------------------------
+
+def _expand_infer(op, block):
+    x = block.var(op.input("X")[0])
+    if x.shape is None:
+        return
+    times = op.attrs.get("expand_times", [1] * len(x.shape))
+    infer_output(op, block, "Out",
+                 tuple(int(s * t) for s, t in zip(x.shape, times)),
+                 dtype=x.dtype)
+
+
+@register_op("expand", infer_shape=_expand_infer, grad=lambda op: [OpSpec(
+    "expand_grad", {"X": op.input("X"), "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
+def expand(ctx):
+    """expand_op.h: Eigen broadcast by expand_times per dimension."""
+    x = data_of(ctx.input("X"))
+    times = tuple(int(t) for t in ctx.attr("expand_times"))
+    ctx.set_output("Out", jnp.tile(x, times))
+
+
+@register_op("expand_grad")
+def expand_grad(ctx):
+    x = data_of(ctx.input("X"))
+    dy = data_of(ctx.input("Out@GRAD"))
+    times = tuple(int(t) for t in ctx.attr("expand_times"))
+    # fold each tiled axis into (times, size) and sum the tile axis
+    split = []
+    for t, s in zip(times, x.shape):
+        split += [t, s]
+    dx = dy.reshape(split).sum(axis=tuple(range(0, 2 * len(times), 2)))
+    ctx.set_output("X@GRAD", dx)
+
+
+# ---------------------------------------------------------------------------
+# pad
+# ---------------------------------------------------------------------------
+
+def _pad_pairs(ctx_attr, ndim):
+    flat = [int(p) for p in ctx_attr("paddings")]
+    return [(flat[2 * i], flat[2 * i + 1]) for i in range(ndim)]
+
+
+def _pad_infer(op, block):
+    x = block.var(op.input("X")[0])
+    if x.shape is None:
+        return
+    flat = op.attrs.get("paddings", [])
+    shape = tuple(int(s + flat[2 * i] + flat[2 * i + 1])
+                  for i, s in enumerate(x.shape))
+    infer_output(op, block, "Out", shape, dtype=x.dtype)
+
+
+@register_op("pad", infer_shape=_pad_infer, grad=lambda op: [OpSpec(
+    "pad_grad", {"X": op.input("X"), "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
+def pad(ctx):
+    x = data_of(ctx.input("X"))
+    pairs = _pad_pairs(ctx.attr, x.ndim)
+    ctx.set_output("Out", jnp.pad(x, pairs, constant_values=jnp.asarray(
+        ctx.attr("pad_value", 0.0), x.dtype)))
+
+
+@register_op("pad_grad")
+def pad_grad(ctx):
+    x = data_of(ctx.input("X"))
+    dy = data_of(ctx.input("Out@GRAD"))
+    pairs = _pad_pairs(ctx.attr, x.ndim)
+    sl = tuple(slice(b, b + s) for (b, _), s in zip(pairs, x.shape))
+    ctx.set_output("X@GRAD", dy[sl])
+
+
+# ---------------------------------------------------------------------------
+# crop
+# ---------------------------------------------------------------------------
+
+def _crop_shape(ctx):
+    if ctx.has_input("Y"):
+        return data_of(ctx.input("Y")).shape
+    return tuple(int(s) for s in ctx.attr("shape"))
+
+
+def _crop_infer(op, block):
+    if op.attrs.get("shape"):
+        x = block.var(op.input("X")[0])
+        infer_output(op, block, "Out", tuple(op.attrs["shape"]), dtype=x.dtype)
+
+
+@register_op("crop", infer_shape=_crop_infer, grad=lambda op: [OpSpec(
+    "crop_grad", {"X": op.input("X"), "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
+def crop(ctx):
+    """crop_op.h: slice ``shape`` out of X at ``offsets`` (shape optionally
+    borrowed from reference input Y, crop_op.cc:60-64)."""
+    x = data_of(ctx.input("X"))
+    shape = _crop_shape(ctx)
+    offsets = [int(o) for o in ctx.attr("offsets", [0] * x.ndim)]
+    ctx.set_output("Out", lax.slice(
+        x, offsets, [o + s for o, s in zip(offsets, shape)]))
+
+
+@register_op("crop_grad")
+def crop_grad(ctx):
+    x = data_of(ctx.input("X"))
+    dy = data_of(ctx.input("Out@GRAD"))
+    offsets = [int(o) for o in ctx.attr("offsets", [0] * x.ndim)]
+    pairs = [(o, xs - o - ds)
+             for o, xs, ds in zip(offsets, x.shape, dy.shape)]
+    ctx.set_output("X@GRAD", jnp.pad(dy, pairs))
+
+
+# ---------------------------------------------------------------------------
+# label_smooth
+# ---------------------------------------------------------------------------
+
+@register_op("label_smooth", infer_shape=same_shape("X", "Out"),
+             grad=lambda op: [OpSpec(
+                 "label_smooth_grad", {"Out@GRAD": G(op.output("Out"))},
+                 {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
+def label_smooth(ctx):
+    """label_smooth_op.h: (1-ε)·x + ε·prior (uniform 1/num_classes when no
+    PriorDist input)."""
+    x = data_of(ctx.input("X"))
+    eps = ctx.attr("epsilon", 0.0)
+    if ctx.has_input("PriorDist"):
+        prior = data_of(ctx.input("PriorDist")).reshape(-1)
+        out = (1.0 - eps) * x + eps * prior
+    else:
+        out = (1.0 - eps) * x + eps / x.shape[-1]
+    ctx.set_output("Out", out.astype(x.dtype))
+
+
+@register_op("label_smooth_grad")
+def label_smooth_grad(ctx):
+    dy = data_of(ctx.input("Out@GRAD"))
+    ctx.set_output("X@GRAD", (1.0 - ctx.attr("epsilon", 0.0)) * dy)
+
+
+# ---------------------------------------------------------------------------
+# minus / l1_norm
+# ---------------------------------------------------------------------------
+
+@register_op("minus", infer_shape=same_shape("X", "Out"), grad=lambda op: [
+    OpSpec("scale", {"X": G(op.output("Out"))}, {"Out": G(op.input("X"))},
+           {"scale": 1.0}),
+    OpSpec("scale", {"X": G(op.output("Out"))}, {"Out": G(op.input("Y"))},
+           {"scale": -1.0})])
+def minus(ctx):
+    """minus_op.cc: Out = X - Y (same shape; grads are ±identity scales,
+    exactly the reference's MinusGradMaker pair of scale ops)."""
+    x, y = data_of(ctx.input("X")), data_of(ctx.input("Y"))
+    ctx.set_output("Out", like(ctx.input("X"), x - y))
+
+
+@register_op("l1_norm", grad=lambda op: [OpSpec(
+    "l1_norm_grad", {"X": op.input("X"), "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))})])
+def l1_norm(ctx):
+    """l1_norm_op.h: scalar Σ|x|; grad is sign(x)·dout."""
+    x = data_of(ctx.input("X"))
+    ctx.set_output("Out", jnp.sum(jnp.abs(x)).reshape(()))
+
+
+@register_op("l1_norm_grad")
+def l1_norm_grad(ctx):
+    x = data_of(ctx.input("X"))
+    dy = data_of(ctx.input("Out@GRAD")).reshape(())
+    ctx.set_output("X@GRAD", jnp.sign(x) * dy)
+
+
+# ---------------------------------------------------------------------------
+# conv_shift (circular correlation)
+# ---------------------------------------------------------------------------
+
+def _conv_shift_compute(x, y):
+    # out[b, i] = Σ_j x[b, (i + j - M//2) mod W] · y[b, j]
+    # (conv_shift_op.cu:84-95 index arithmetic). Gather-free lowering: roll x
+    # once per tap — M is small and odd (InferShape enforces M ≤ W).
+    w = x.shape[1]
+    m = y.shape[1]
+    half = m // 2
+    taps = [jnp.roll(x, shift=half - j, axis=1) * y[:, j:j + 1]
+            for j in range(m)]
+    del w
+    return sum(taps)
+
+
+@register_op("conv_shift", infer_shape=same_shape("X", "Out"),
+             grad=lambda op: [OpSpec(
+                 "conv_shift_grad",
+                 {"X": op.input("X"), "Y": op.input("Y"),
+                  "Out@GRAD": G(op.output("Out"))},
+                 {"X@GRAD": G(op.input("X")), "Y@GRAD": G(op.input("Y"))})])
+def conv_shift(ctx):
+    x, y = data_of(ctx.input("X")), data_of(ctx.input("Y"))
+    ctx.set_output("Out", _conv_shift_compute(x, y))
+
+
+@register_op("conv_shift_grad")
+def conv_shift_grad(ctx):
+    x, y = data_of(ctx.input("X")), data_of(ctx.input("Y"))
+    dy = data_of(ctx.input("Out@GRAD"))
+    _, vjp = jax.vjp(_conv_shift_compute, x, y)
+    dx, dyy = vjp(dy)
+    ctx.set_output("X@GRAD", dx)
+    ctx.set_output("Y@GRAD", dyy)
+
+
+# ---------------------------------------------------------------------------
+# modified_huber_loss
+# ---------------------------------------------------------------------------
+
+@register_op("modified_huber_loss", grad=lambda op: [OpSpec(
+    "modified_huber_loss_grad",
+    {"Y": op.input("Y"), "IntermediateVal": op.output("IntermediateVal"),
+     "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X"))})])
+def modified_huber_loss(ctx):
+    """modified_huber_loss_op.h: inter = x·(2y-1) with y ∈ {0,1};
+    loss = -4·inter if inter < -1, (1-inter)² if inter < 1, else 0."""
+    x = data_of(ctx.input("X")).reshape(-1)
+    y = data_of(ctx.input("Y")).reshape(-1)
+    inter = x * (2.0 * y - 1.0)
+    loss = jnp.where(inter < -1.0, -4.0 * inter,
+                     jnp.where(inter < 1.0, jnp.square(1.0 - inter), 0.0))
+    shape = data_of(ctx.input("X")).shape
+    ctx.set_output("IntermediateVal", inter.reshape(shape))
+    ctx.set_output("Out", loss.reshape(shape))
+
+
+@register_op("modified_huber_loss_grad")
+def modified_huber_loss_grad(ctx):
+    y = data_of(ctx.input("Y")).reshape(-1)
+    inter = data_of(ctx.input("IntermediateVal")).reshape(-1)
+    dy = data_of(ctx.input("Out@GRAD")).reshape(-1)
+    sign = 2.0 * y - 1.0
+    dx = jnp.where(inter < -1.0, -4.0 * sign * dy,
+                   jnp.where(inter < 1.0, -2.0 * (1.0 - inter) * sign * dy,
+                             0.0))
+    ctx.set_output("X@GRAD", dx.reshape(data_of(ctx.input("Y")).shape))
+
+
+# ---------------------------------------------------------------------------
+# uniform/gaussian_random_batch_size_like (batch_size_like.h)
+# ---------------------------------------------------------------------------
+
+def _batch_size_like_shape(ctx):
+    ref = data_of(ctx.input("Input"))
+    shape = [int(s) for s in ctx.attr("shape")]
+    shape[int(ctx.attr("output_dim_idx", 0))] = \
+        ref.shape[int(ctx.attr("input_dim_idx", 0))]
+    return tuple(shape)
+
+
+@register_op("uniform_random_batch_size_like")
+def uniform_random_batch_size_like(ctx):
+    shape = _batch_size_like_shape(ctx)
+    dtype = np_dtype(ctx.attr("dtype", "float32"))
+    out = jax.random.uniform(ctx.next_rng(), shape, jnp.float32,
+                             ctx.attr("min", -1.0), ctx.attr("max", 1.0))
+    ctx.set_output("Out", out.astype(dtype))
+
+
+@register_op("gaussian_random_batch_size_like")
+def gaussian_random_batch_size_like(ctx):
+    shape = _batch_size_like_shape(ctx)
+    dtype = np_dtype(ctx.attr("dtype", "float32"))
+    out = ctx.attr("mean", 0.0) + ctx.attr("std", 1.0) * jax.random.normal(
+        ctx.next_rng(), shape, jnp.float32)
+    ctx.set_output("Out", out.astype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# conv3d_transpose
+# ---------------------------------------------------------------------------
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        v = list(v) + [v[-1]] * (3 - len(v))
+        return tuple(int(i) for i in v[:3])
+    return (int(v),) * 3
+
+
+def _conv3d_transpose_compute(x, w, strides, paddings, dilations):
+    """Same lhs-dilation trick as conv2d_transpose (conv_ops.py): the
+    reference's filter layout is [C_in, C_out, kd, kh, kw]
+    (conv_transpose_op.cc Conv3DTransposeOpMaker)."""
+    from ..core.amp import cast_compute
+    ks = w.shape[2:]
+    ke = [dilations[i] * (ks[i] - 1) + 1 for i in range(3)]
+    x, w = cast_compute(x, w)
+    w_t = jnp.flip(w.transpose(1, 0, 2, 3, 4), axis=(2, 3, 4))
+    return lax.conv_general_dilated(
+        x, w_t,
+        window_strides=(1, 1, 1),
+        padding=[(ke[i] - 1 - paddings[i],) * 2 for i in range(3)],
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+
+
+def _conv3d_transpose_infer(op, block):
+    x = block.var(op.input("Input")[0])
+    w = block.var(op.input("Filter")[0])
+    if x.shape is None or w.shape is None:
+        return
+    s = _triple(op.attrs.get("strides", [1, 1, 1]))
+    p = _triple(op.attrs.get("paddings", [0, 0, 0]))
+    d = _triple(op.attrs.get("dilations", [1, 1, 1]))
+    n = x.shape[0]
+    m = w.shape[1]
+    spatial = tuple(
+        (x.shape[2 + i] - 1) * s[i] - 2 * p[i] + (d[i] * (w.shape[2 + i] - 1)
+                                                  + 1)
+        for i in range(3))
+    infer_output(op, block, "Output", (n, m) + spatial, dtype=x.dtype)
+
+
+@register_op("conv3d_transpose", infer_shape=_conv3d_transpose_infer,
+             grad=lambda op: [OpSpec(
+                 "conv3d_transpose_grad",
+                 {"Input": op.input("Input"), "Filter": op.input("Filter"),
+                  "Output@GRAD": G(op.output("Output"))},
+                 {"Input@GRAD": G(op.input("Input")),
+                  "Filter@GRAD": G(op.input("Filter"))},
+                 dict(op.attrs))])
+def conv3d_transpose(ctx):
+    x = data_of(ctx.input("Input"))
+    w = data_of(ctx.input("Filter"))
+    s = _triple(ctx.attr("strides", [1, 1, 1]))
+    p = _triple(ctx.attr("paddings", [0, 0, 0]))
+    d = _triple(ctx.attr("dilations", [1, 1, 1]))
+    ctx.set_output("Output", _conv3d_transpose_compute(x, w, s, p, d))
+
+
+@register_op("conv3d_transpose_grad")
+def conv3d_transpose_grad(ctx):
+    x = data_of(ctx.input("Input"))
+    w = data_of(ctx.input("Filter"))
+    dy = data_of(ctx.input("Output@GRAD"))
+    s = _triple(ctx.attr("strides", [1, 1, 1]))
+    p = _triple(ctx.attr("paddings", [0, 0, 0]))
+    d = _triple(ctx.attr("dilations", [1, 1, 1]))
+    out, vjp = jax.vjp(
+        lambda a, b: _conv3d_transpose_compute(a, b, s, p, d), x, w)
+    dx, dw = vjp(dy.astype(out.dtype))
+    ctx.set_output("Input@GRAD", dx)
+    ctx.set_output("Filter@GRAD", dw)
+
+
+# ---------------------------------------------------------------------------
+# max_pool3d_with_index
+# ---------------------------------------------------------------------------
+
+@register_op("max_pool3d_with_index")
+def max_pool3d_with_index(ctx):
+    """pool_with_index_op.cc 3-D form (math/pooling.cc
+    MaxPool3dWithIndexFunctor): mask holds the flat argmax offset within the
+    [D, H, W] volume."""
+    x = data_of(ctx.input("X"))
+    ks = _triple(ctx.attr("ksize"))
+    st = _triple(ctx.attr("strides", ks))
+    n, c, dd, h, w = x.shape
+    od = (dd - ks[0]) // st[0] + 1
+    oh = (h - ks[1]) // st[1] + 1
+    ow = (w - ks[2]) // st[2] + 1
+    patches = jnp.stack([
+        x[:, :,
+          a:a + st[0] * od:st[0],
+          b:b + st[1] * oh:st[1],
+          e:e + st[2] * ow:st[2]]
+        for a in range(ks[0]) for b in range(ks[1]) for e in range(ks[2])],
+        axis=-1)
+    arg = jnp.argmax(patches, axis=-1)
+    out = jnp.max(patches, axis=-1)
+    ka = arg // (ks[1] * ks[2])
+    kb = (arg // ks[2]) % ks[1]
+    ke = arg % ks[2]
+    ds = jnp.arange(od)[None, None, :, None, None] * st[0] + ka
+    hs = jnp.arange(oh)[None, None, None, :, None] * st[1] + kb
+    ws = jnp.arange(ow)[None, None, None, None, :] * st[2] + ke
+    ctx.set_output("Out", out)
+    ctx.set_output("Mask", ((ds * h + hs) * w + ws).astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# positive_negative_pair
+# ---------------------------------------------------------------------------
+
+@register_op("positive_negative_pair")
+def positive_negative_pair(ctx):
+    """positive_negative_pair_op.h: over all in-batch pairs sharing a QueryID
+    with different labels, count score-order-concordant (positive),
+    discordant (negative) and tied (neutral) pairs, weighted by the mean of
+    the two instance weights; accumulate onto the Accumulate* inputs."""
+    score = data_of(ctx.input("Score"))
+    label = data_of(ctx.input("Label")).reshape(-1)
+    query = data_of(ctx.input("QueryID")).reshape(-1)
+    col = int(ctx.attr("column", -1))
+    s = score[:, col].reshape(-1)
+    n = s.shape[0]
+    w = data_of(ctx.input("Weight")).reshape(-1) if ctx.has_input("Weight") \
+        else jnp.ones((n,), jnp.float32)
+
+    same_query = query[:, None] == query[None, :]
+    diff_label = label[:, None] != label[None, :]
+    upper = jnp.triu(jnp.ones((n, n), bool), k=1)
+    eligible = same_query & diff_label & upper
+    pw = (w[:, None] + w[None, :]) * 0.5
+    concord = (s[:, None] - s[None, :]) * (label[:, None] - label[None, :]) > 0
+    tied = s[:, None] == s[None, :]
+
+    pos = jnp.sum(jnp.where(eligible & ~tied & concord, pw, 0.0))
+    neg = jnp.sum(jnp.where(eligible & ~tied & ~concord, pw, 0.0))
+    neu = jnp.sum(jnp.where(eligible & tied, pw, 0.0))
+    # NOTE reference quirk (positive_negative_pair_op.h:96-103): tied pairs
+    # add to neutral AND to pos/neg via the unguarded ternary; we follow the
+    # documented semantics (tied -> neutral only), matching the evaluator's
+    # use and the v2 PnpairEvaluator.
+    for slot, val in (("PositivePair", pos), ("NegativePair", neg),
+                      ("NeutralPair", neu)):
+        acc = "Accumulate" + slot
+        if ctx.has_input(acc):
+            val = val + data_of(ctx.input(acc)).reshape(())
+        ctx.set_output(slot, val.reshape((1,)))
+
+
+# ---------------------------------------------------------------------------
+# average_accumulates (ParamAverage windows)
+# ---------------------------------------------------------------------------
+
+@register_op("average_accumulates")
+def average_accumulates(ctx):
+    """average_accumulates_op.h: maintain Polyak-average sums of a parameter
+    over a sliding window. sum_1 accumulates every step; every 16384 updates
+    it folds into sum_2 (precision); when the window outgrows
+    max(min_average_window, min(max_average_window, num_updates ·
+    average_window)) everything folds into sum_3 and restarts. All branch
+    decisions lower to jnp.where so the op stays jit-compilable."""
+    param = data_of(ctx.input("param"))
+    s1 = data_of(ctx.input("in_sum_1"))
+    s2 = data_of(ctx.input("in_sum_2"))
+    s3 = data_of(ctx.input("in_sum_3"))
+    num_updates = data_of(ctx.input("in_num_updates")).reshape(()).astype(
+        jnp.int64)
+    num_acc = data_of(ctx.input("in_num_accumulates")).reshape(()).astype(
+        jnp.int64)
+    old_num_acc = data_of(
+        ctx.input("in_old_num_accumulates")).reshape(()).astype(jnp.int64)
+
+    avg_window = ctx.attr("average_window", 0.0)
+    # clamp the huge C++ default below int32 max: jnp.int64 silently becomes
+    # int32 without jax_enable_x64 (the repo default) and a 2**62 literal
+    # would overflow at conversion
+    int_max = np.iinfo(np.int32).max
+    max_w = min(int(ctx.attr("max_average_window", int_max)), int_max)
+    min_w = min(int(ctx.attr("min_average_window", 10000)), max_w)
+    k_max_num = 16384  # kMaxNumAccumulates
+
+    num_updates = num_updates + 1
+    num_acc = num_acc + 1
+    in_s1, in_s2 = s1, s2
+    s1 = s1 + param
+
+    # both folds use the PRE-UPDATE in_sum_1/in_sum_2 and zero out_sum_1,
+    # exactly like the reference (average_accumulates_op.h: out_sum_2 =
+    # in_sum_2 + in_sum_1; out_sum_3 = in_sum_1 + in_sum_2) — meaning the
+    # fold step's own param never enters an accumulator (reference quirk,
+    # kept for parity)
+    fold2 = (num_updates % k_max_num) == 0
+    s2 = jnp.where(fold2, in_s2 + in_s1, s2)
+    s1 = jnp.where(fold2, jnp.zeros_like(s1), s1)
+
+    window_full = (num_acc >= min_w) & (
+        num_acc >= jnp.minimum(
+            jnp.asarray(max_w, jnp.int64),
+            (num_updates.astype(jnp.float32) * avg_window).astype(jnp.int64)))
+    s3 = jnp.where(window_full, in_s1 + in_s2, s3)
+    s1 = jnp.where(window_full, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(window_full, jnp.zeros_like(s2), s2)
+    old_num_acc = jnp.where(window_full, num_acc, old_num_acc)
+    num_acc = jnp.where(window_full, jnp.zeros_like(num_acc), num_acc)
+
+    ctx.set_output("out_sum_1", s1)
+    ctx.set_output("out_sum_2", s2)
+    ctx.set_output("out_sum_3", s3)
+    ctx.set_output("out_num_updates", num_updates.reshape((1,)))
+    ctx.set_output("out_num_accumulates", num_acc.reshape((1,)))
+    ctx.set_output("out_old_num_accumulates", old_num_acc.reshape((1,)))
+
+
+# ---------------------------------------------------------------------------
+# detection_map (op form of the mAP evaluator)
+# ---------------------------------------------------------------------------
+
+def _ap_from_tp_fp(tp_sorted_desc_scores, tps, fps, n_pos, ap_type):
+    """11-point or integral AP given per-detection (score-desc) tp/fp flags
+    and the positive count (detection_map_op.h GetMAP)."""
+    import numpy as onp
+    acc_tp = onp.cumsum(tps)
+    acc_fp = onp.cumsum(fps)
+    if n_pos == 0 or len(tps) == 0:
+        return 0.0
+    precision = acc_tp / onp.maximum(acc_tp + acc_fp, 1e-12)
+    recall = acc_tp / n_pos
+    if ap_type == "11point":
+        max_precisions = onp.zeros(11)
+        start_idx = len(tps) - 1
+        for j in range(10, -1, -1):
+            for i in range(start_idx, -1, -1):
+                if recall[i] < j / 10.0:
+                    start_idx = i
+                    if j > 0:
+                        max_precisions[j - 1] = max_precisions[j]
+                    break
+                if max_precisions[j] < precision[i]:
+                    max_precisions[j] = precision[i]
+        return float(max_precisions.sum() / 11.0)
+    # integral
+    ap = 0.0
+    prev_recall = 0.0
+    for i in range(len(tps)):
+        ap += precision[i] * (recall[i] - prev_recall)
+        prev_recall = recall[i]
+    return float(ap)
+
+
+@register_op("detection_map")
+def detection_map(ctx):
+    """detection_map_op.cc as an eager/host op: DetectRes is a LoD tensor of
+    [label, score, xmin, ymin, xmax, ymax] rows per image, Label a LoD tensor
+    of [label, xmin, ymin, xmax, ymax] (or with a difficult flag at column 1,
+    detection_map_op.cc:90-97); emits MAP plus accumulated state. Runs on
+    host numpy — it is an evaluation metric, not a training-path op (the
+    reference's kernel is likewise pure CPU)."""
+    import numpy as onp
+
+    det = ctx.input("DetectRes")
+    gt = ctx.input("Label")
+    overlap_t = float(ctx.attr("overlap_threshold", 0.5))
+    evaluate_difficult = bool(ctx.attr("evaluate_difficult", True))
+    ap_type = ctx.attr("ap_type", "integral")
+    class_num = int(ctx.attr("class_num"))
+
+    def rows_per_seq(v):
+        data = onp.asarray(data_of(v))
+        if isinstance(v, LoDArray):
+            out = []
+            lens = onp.asarray(v.lens).reshape(-1)
+            for i, ln in enumerate(lens):
+                out.append(data[i][:int(ln)])
+            return out
+        return [data.reshape(-1, data.shape[-1])]
+
+    det_seqs = rows_per_seq(det)
+    gt_seqs = rows_per_seq(gt)
+
+    # state: per-class positive count, and (score, tp/fp flag) lists
+    pos_count = onp.zeros(class_num, onp.int64)
+    true_pos = {c: [] for c in range(class_num)}
+    false_pos = {c: [] for c in range(class_num)}
+
+    for dets, gts in zip(det_seqs, gt_seqs):
+        has_difficult = gts.shape[1] == 6
+        if has_difficult:
+            g_label = gts[:, 0].astype(int)
+            g_diff = gts[:, 1].astype(bool)
+            g_box = gts[:, 2:6]
+        else:
+            g_label = gts[:, 0].astype(int)
+            g_diff = onp.zeros(len(gts), bool)
+            g_box = gts[:, 1:5]
+        for c in onp.unique(g_label):
+            n = int(onp.sum((g_label == c) & (evaluate_difficult |
+                                              ~g_diff)))
+            pos_count[int(c)] += n
+        matched = onp.zeros(len(gts), bool)
+        order = onp.argsort(-dets[:, 1])
+        for i in order:
+            c = int(dets[i, 0])
+            box = dets[i, 2:6]
+            cand = onp.where(g_label == c)[0]
+            best_iou, best_j = 0.0, -1
+            for j in cand:
+                gb = g_box[j]
+                ix1, iy1 = max(box[0], gb[0]), max(box[1], gb[1])
+                ix2, iy2 = min(box[2], gb[2]), min(box[3], gb[3])
+                iw, ih = max(0.0, ix2 - ix1), max(0.0, iy2 - iy1)
+                inter = iw * ih
+                ua = ((box[2] - box[0]) * (box[3] - box[1])
+                      + (gb[2] - gb[0]) * (gb[3] - gb[1]) - inter)
+                iou = inter / ua if ua > 0 else 0.0
+                if iou > best_iou:
+                    best_iou, best_j = iou, j
+            if best_iou > overlap_t:
+                if (not evaluate_difficult) and g_diff[best_j]:
+                    continue
+                if not matched[best_j]:
+                    matched[best_j] = True
+                    true_pos[c].append((float(dets[i, 1]), 1))
+                    false_pos[c].append((float(dets[i, 1]), 0))
+                else:
+                    true_pos[c].append((float(dets[i, 1]), 0))
+                    false_pos[c].append((float(dets[i, 1]), 1))
+            else:
+                true_pos[c].append((float(dets[i, 1]), 0))
+                false_pos[c].append((float(dets[i, 1]), 1))
+
+    # merge accumulated state from inputs (PosCount/TruePos/FalsePos)
+    if ctx.has_input("PosCount") and not (
+            ctx.has_input("HasState")
+            and int(onp.asarray(data_of(ctx.input("HasState"))).reshape(-1)[0])
+            == 0):
+        prev_pos = onp.asarray(data_of(ctx.input("PosCount"))).reshape(-1)
+        pos_count[:len(prev_pos)] += prev_pos.astype(onp.int64)
+        for name, store in (("TruePos", true_pos), ("FalsePos", false_pos)):
+            v = ctx.input(name)
+            rows = onp.asarray(data_of(v))
+            lens = onp.asarray(v.lens).reshape(-1) if isinstance(v, LoDArray) \
+                else onp.asarray([len(rows)] * 0)
+            for c, ln in enumerate(lens):
+                seq = rows[c][:int(ln)]
+                store.setdefault(c, [])
+                store[c].extend((float(s), int(f)) for s, f in seq)
+
+    m_ap, count = 0.0, 0
+    for c in range(class_num):
+        if pos_count[c] == 0 or not true_pos[c]:
+            continue
+        entries = sorted(true_pos[c], key=lambda e: -e[0])
+        f_entries = sorted(false_pos[c], key=lambda e: -e[0])
+        tps = onp.asarray([e[1] for e in entries])
+        fps = onp.asarray([e[1] for e in f_entries])
+        m_ap += _ap_from_tp_fp(None, tps, fps, int(pos_count[c]), ap_type)
+        count += 1
+    m_ap = m_ap / count if count else 0.0
+
+    ctx.set_output("MAP", jnp.asarray(m_ap, jnp.float32).reshape((1,)))
+    ctx.set_output("AccumPosCount",
+                   jnp.asarray(pos_count, jnp.int32).reshape(-1, 1))
+
+    def pack(store):
+        max_len = max((len(v) for v in store.values()), default=0)
+        arr = onp.zeros((class_num, max(max_len, 1), 2), onp.float32)
+        lens = onp.zeros(class_num, onp.int32)
+        for c, v in store.items():
+            lens[c] = len(v)
+            for i, (s, f) in enumerate(sorted(v, key=lambda e: -e[0])):
+                arr[c, i] = (s, f)
+        return LoDArray(jnp.asarray(arr), jnp.asarray(lens))
+
+    ctx.set_output("AccumTruePos", pack(true_pos))
+    ctx.set_output("AccumFalsePos", pack(false_pos))

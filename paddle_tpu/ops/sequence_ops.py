@@ -28,6 +28,18 @@ def _seq(v):
     return v
 
 
+def _rows_to_level0(y):
+    """[batch_rows] int32: the LEVEL-0 (outermost) group index of each data
+    row, composing the parent maps through every outer level — ref_level=0
+    addresses the outermost LoD level regardless of nesting depth
+    (reference sequence_expand_op.cc ref_level semantics over N-level LoD,
+    lod_tensor.h:55)."""
+    idx = y.row_to_outer()                    # rows -> innermost outer groups
+    for level in range(len(y.outer_levels) - 2, -1, -1):
+        idx = y.row_to_outer(level)[idx]      # groups -> parents, composed
+    return idx
+
+
 def _mask(data, lens, dtype=None):
     m = jnp.arange(data.shape[1])[None, :] < lens[:, None]
     if dtype is not None:
@@ -177,8 +189,8 @@ def sequence_expand(ctx):
             raise NotImplementedError(
                 "sequence_expand ref_level=0 with a LoD-carrying X (ragged "
                 "rows) is not supported; expand dense per-sequence rows")
-        x = data_of(xv)                       # [n_outer, *feat]
-        out = x[y.row_to_outer()]             # [batch_rows, *feat]
+        x = data_of(xv)                       # [n_level0, *feat]
+        out = x[_rows_to_level0(y)]           # [batch_rows, *feat]
         ctx.set_output("Out", out)
         return
     if isinstance(xv, LoDArray):
@@ -198,9 +210,9 @@ def sequence_expand_grad(ctx):
     ref_level = int(ctx.attr("ref_level", -1))
     if ref_level == 0 and y.outer_lens is not None:
         d = data_of(dy_v)                     # [batch_rows, *feat]
-        n_outer = y.outer_lens.shape[0]
+        n_outer = y.outer_levels[0].shape[0]
         ctx.set_output("X@GRAD", jax.ops.segment_sum(
-            d, y.row_to_outer(), num_segments=n_outer))
+            d, _rows_to_level0(y), num_segments=n_outer))
         return
     dy = _seq(dy_v)
     d = dy.data * _feat_mask(dy.data, y.lens)

@@ -1,5 +1,6 @@
 from .decorator import (map_readers, buffered, compose, chain, shuffle,
-                        ComposeNotAligned, firstn, xmap_readers, cache)
+                        ComposeNotAligned, firstn, xmap_readers, cache,
+                        bucket_by_length, bucket_bound_for)
 from .minibatch import batch
 from .prefetch import DeviceFeedIterator, double_buffer
 from . import creator
@@ -8,6 +9,7 @@ from .creator import convert_reader_to_recordio_file
 __all__ = [
     "map_readers", "buffered", "compose", "chain", "shuffle",
     "ComposeNotAligned", "firstn", "xmap_readers", "cache", "batch",
+    "bucket_by_length", "bucket_bound_for",
     "DeviceFeedIterator", "double_buffer", "creator",
     "convert_reader_to_recordio_file",
 ]

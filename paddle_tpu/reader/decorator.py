@@ -209,3 +209,59 @@ def cache(reader):
         filled[0] = True
 
     return cached_reader
+
+
+def bucket_by_length(reader, key, bucket_bounds, batch_size, drop_last=False):
+    """Group samples into length buckets and emit per-bucket batches — the
+    TPU-native answer to the reference's batch-shrinking RNN machinery
+    (operators/lod_rank_table_op.cc + shrink_rnn_memory_op.cc: sort by
+    length, retire finished sequences each step). Under XLA's static shapes
+    we cannot shrink a live batch, so the win is moved to the feed side:
+    batching sequences of similar length means each padded batch runs
+    scan steps ~equal to ITS OWN max length, not the corpus max — and the
+    bucket bounds cap the set of distinct compiled shapes (pad each batch to
+    its bucket's bound and every bucket compiles exactly once).
+
+    Args:
+        reader: sample reader.
+        key: sample -> int length (e.g. ``lambda s: len(s[0])``).
+        bucket_bounds: ascending upper bounds; a final unbounded bucket
+            catches the tail (longer sequences).
+        batch_size: samples per emitted batch.
+        drop_last: drop per-bucket remainders at exhaustion.
+
+    Returns a reader over plain batches (lists of samples), like
+    paddle.batch; the pad target for a batch is
+    ``bucket_bound_for(bucket_bounds, max(key(s) for s in batch))``.
+    """
+    bounds = sorted(int(b) for b in bucket_bounds)
+
+    def which(n):
+        for i, b in enumerate(bounds):
+            if n <= b:
+                return i
+        return len(bounds)
+
+    def bucketed_reader():
+        buckets = [[] for _ in range(len(bounds) + 1)]
+        for sample in reader():
+            b = buckets[which(key(sample))]
+            b.append(sample)
+            if len(b) == batch_size:
+                yield list(b)
+                del b[:]
+        if not drop_last:
+            for b in buckets:
+                if b:
+                    yield list(b)
+
+    return bucketed_reader
+
+
+def bucket_bound_for(bucket_bounds, length):
+    """The padded length a batch of max sample length ``length`` compiles at
+    (the companion of bucket_by_length: feed-side pad target)."""
+    for b in sorted(int(x) for x in bucket_bounds):
+        if length <= b:
+            return b
+    return length

@@ -1,0 +1,447 @@
+"""OpTests for the round-4 breadth ops.
+
+Reference tests: python/paddle/fluid/tests/unittests/test_{expand,pad,crop,
+label_smooth,minus,l1_norm,conv_shift,modified_huber_loss,
+fill_constant_batch_size_like,uniform_random_batch_size_like,
+gaussian_random_batch_size_like,conv3d_transpose,pool_max,
+positive_negative_pair,average_accumulates,detection_map}_op.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from op_test import OpTest
+
+layers = fluid.layers
+
+
+class TestExpand(OpTest):
+    op_type = "expand"
+
+    def setup(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 3, 4).astype("float32")
+        times = [2, 1, 3]
+        self.inputs = {"X": x}
+        self.attrs = {"expand_times": times}
+        self.outputs = {"Out": np.tile(x, times)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out")
+
+
+class TestPad(OpTest):
+    op_type = "pad"
+
+    def setup(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"paddings": [1, 2, 0, 3], "pad_value": 0.5}
+        self.outputs = {"Out": np.pad(x, [(1, 2), (0, 3)],
+                                      constant_values=0.5)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out")
+
+
+class TestCrop(OpTest):
+    op_type = "crop"
+
+    def setup(self):
+        rng = np.random.RandomState(2)
+        x = rng.rand(5, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"offsets": [1, 2], "shape": [2, 3]}
+        self.outputs = {"Out": x[1:3, 2:5]}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out")
+
+
+class TestLabelSmooth(OpTest):
+    op_type = "label_smooth"
+
+    def setup(self, with_prior=False):
+        rng = np.random.RandomState(3)
+        eps = 0.1
+        label = np.zeros((4, 6), "float32")
+        label[np.arange(4), rng.randint(0, 6, 4)] = 1.0
+        self.inputs = {"X": label}
+        self.attrs = {"epsilon": eps}
+        if with_prior:
+            prior = rng.dirichlet(np.ones(6)).astype("float32")
+            self.inputs["PriorDist"] = prior
+            self.outputs = {"Out": (1 - eps) * label + eps * prior}
+        else:
+            self.outputs = {"Out": (1 - eps) * label + eps / 6.0}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_output_prior(self):
+        self.setup(with_prior=True)
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out")
+
+
+class TestMinus(OpTest):
+    op_type = "minus"
+
+    def setup(self):
+        rng = np.random.RandomState(4)
+        x = rng.rand(3, 4).astype("float32")
+        y = rng.rand(3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x - y}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestL1Norm(OpTest):
+    op_type = "l1_norm"
+
+    def setup(self):
+        rng = np.random.RandomState(5)
+        # keep |x| away from 0 so the finite-difference grad is stable
+        x = rng.uniform(0.2, 1.0, (4, 5)).astype("float32") \
+            * rng.choice([-1, 1], (4, 5)).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.abs(x).sum()}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out", numeric_grad_delta=1e-3)
+
+
+def _conv_shift_np(x, y):
+    b, w = x.shape
+    m = y.shape[1]
+    out = np.zeros_like(x)
+    for i in range(w):
+        for j in range(m):
+            out[:, i] += x[:, (i + j - m // 2) % w] * y[:, j]
+    return out
+
+
+class TestConvShift(OpTest):
+    op_type = "conv_shift"
+
+    def setup(self):
+        rng = np.random.RandomState(6)
+        x = rng.rand(3, 8).astype("float32")
+        y = rng.rand(3, 3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": _conv_shift_np(x, y)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestModifiedHuberLoss(OpTest):
+    op_type = "modified_huber_loss"
+
+    def setup(self):
+        rng = np.random.RandomState(7)
+        x = rng.uniform(-3, 3, (10, 1)).astype("float32")
+        y = rng.randint(0, 2, (10, 1)).astype("float32")
+        inter = x * (2 * y - 1)
+        loss = np.where(inter < -1, -4 * inter,
+                        np.where(inter < 1, (1 - inter) ** 2, 0.0))
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"IntermediateVal": inter,
+                        "Out": loss.astype("float32")}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def test_uniform_random_batch_size_like():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ref = layers.data("ref", shape=[7])
+        block = main.global_block()
+        block.create_var(name="u")
+        block.append_op("uniform_random_batch_size_like",
+                        {"Input": ["ref"]}, {"Out": ["u"]},
+                        {"shape": [-1, 11], "min": 2.0, "max": 3.0})
+        block.create_var(name="g")
+        block.append_op("gaussian_random_batch_size_like",
+                        {"Input": ["ref"]}, {"Out": ["g"]},
+                        {"shape": [-1, 5], "mean": 10.0, "std": 0.1})
+    exe = fluid.Executor(fluid.CPUPlace())
+    u, g = exe.run(main, feed={"ref": np.zeros((4, 7), "float32")},
+                   fetch_list=["u", "g"])
+    assert u.shape == (4, 11) and (u >= 2.0).all() and (u <= 3.0).all()
+    assert g.shape == (4, 5) and abs(g.mean() - 10.0) < 0.5
+
+
+def _conv3d_transpose_np(x, w, stride):
+    n, c, d, h, wd = x.shape
+    _, m, kd, kh, kw = w.shape
+    od = (d - 1) * stride + kd
+    oh = (h - 1) * stride + kh
+    ow = (wd - 1) * stride + kw
+    out = np.zeros((n, m, od, oh, ow), "float64")
+    for b in range(n):
+        for ci in range(c):
+            for z in range(d):
+                for i in range(h):
+                    for j in range(wd):
+                        out[b, :, z * stride:z * stride + kd,
+                            i * stride:i * stride + kh,
+                            j * stride:j * stride + kw] += \
+                            x[b, ci, z, i, j] * w[ci]
+    return out.astype("float32")
+
+
+class TestConv3dTranspose(OpTest):
+    op_type = "conv3d_transpose"
+
+    def setup(self):
+        rng = np.random.RandomState(8)
+        x = rng.rand(2, 3, 2, 3, 3).astype("float32")
+        w = rng.rand(3, 4, 2, 2, 2).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [2, 2, 2], "paddings": [0, 0, 0]}
+        self.outputs = {"Output": _conv3d_transpose_np(x, w, 2)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=0.02)
+
+
+def test_max_pool3d_with_index():
+    rng = np.random.RandomState(9)
+    x = rng.rand(2, 3, 4, 4, 4).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", shape=[3, 4, 4, 4])
+        out, mask = layers.max_pool3d_with_index(xv, pool_size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, got_mask = exe.run(main, feed={"x": x},
+                            fetch_list=[out, mask])
+    # numpy reference
+    exp = x.reshape(2, 3, 2, 2, 2, 2, 2, 2).transpose(
+        0, 1, 2, 4, 6, 3, 5, 7).reshape(2, 3, 2, 2, 2, 8).max(-1)
+    np.testing.assert_allclose(got, exp, rtol=1e-6)
+    # mask points at the argmax element
+    d = h = w = 4
+    for b in range(2):
+        for c in range(3):
+            for z in range(2):
+                for i in range(2):
+                    for j in range(2):
+                        flat = int(got_mask[b, c, z, i, j])
+                        zz, rest = flat // (h * w), flat % (h * w)
+                        ii, jj = rest // w, rest % w
+                        assert x[b, c, zz, ii, jj] == got[b, c, z, i, j]
+
+
+def test_positive_negative_pair():
+    score = np.array([[0.9], [0.2], [0.8], [0.4], [0.5]], "float32")
+    label = np.array([[1.0], [0.0], [1.0], [0.0], [1.0]], "float32")
+    query = np.array([[1], [1], [1], [2], [2]], "int64")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        for name, arr in (("Score", score), ("Label", label),
+                          ("QueryID", query)):
+            block.create_var(name=name, shape=arr.shape,
+                             dtype=str(arr.dtype), is_data=True)
+        for name in ("PositivePair", "NegativePair", "NeutralPair"):
+            block.create_var(name=name)
+        block.append_op("positive_negative_pair",
+                        {"Score": ["Score"], "Label": ["Label"],
+                         "QueryID": ["QueryID"]},
+                        {"PositivePair": ["PositivePair"],
+                         "NegativePair": ["NegativePair"],
+                         "NeutralPair": ["NeutralPair"]},
+                        {"column": -1})
+    exe = fluid.Executor(fluid.CPUPlace())
+    pos, neg, neu = exe.run(
+        main, feed={"Score": score, "Label": label, "QueryID": query},
+        fetch_list=["PositivePair", "NegativePair", "NeutralPair"])
+    # query 1: pairs (0,1): 0.9>0.2 & 1>0 -> pos; (1,2): 0.2<0.8 & 0<1 -> pos
+    # query 2: (3,4): 0.4<0.5 & 0<1 -> pos
+    assert float(pos[0]) == 3.0
+    assert float(neg[0]) == 0.0
+    assert float(neu[0]) == 0.0
+
+
+def test_average_accumulates_window_rollover():
+    dim = 4
+    param = np.full(dim, 2.0, "float32")
+
+    def run_step(state):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            names = ["param", "in_sum_1", "in_sum_2", "in_sum_3",
+                     "in_num_updates", "in_num_accumulates",
+                     "in_old_num_accumulates"]
+            feeds = {"param": param, "in_sum_1": state["s1"],
+                     "in_sum_2": state["s2"], "in_sum_3": state["s3"],
+                     "in_num_updates": state["nu"],
+                     "in_num_accumulates": state["na"],
+                     "in_old_num_accumulates": state["ona"]}
+            for n in names:
+                block.create_var(name=n, shape=feeds[n].shape,
+                                 dtype=str(feeds[n].dtype), is_data=True)
+            outs = ["out_sum_1", "out_sum_2", "out_sum_3",
+                    "out_num_updates", "out_num_accumulates",
+                    "out_old_num_accumulates"]
+            for n in outs:
+                block.create_var(name=n)
+            block.append_op("average_accumulates",
+                            {n: [n] for n in names},
+                            {n: [n] for n in outs},
+                            {"average_window": 0.5,
+                             "max_average_window": 3,
+                             "min_average_window": 2})
+        exe = fluid.Executor(fluid.CPUPlace())
+        r = exe.run(main, feed=feeds, fetch_list=outs)
+        return {"s1": r[0].astype("float32"),
+                "s2": r[1].astype("float32"), "s3": r[2].astype("float32"),
+                "nu": r[3].astype("int64"), "na": r[4].astype("int64"),
+                "ona": r[5].astype("int64")}
+
+    state = {"s1": np.zeros(dim, "float32"), "s2": np.zeros(dim, "float32"),
+             "s3": np.zeros(dim, "float32"),
+             "nu": np.zeros(1, "int64"), "na": np.zeros(1, "int64"),
+             "ona": np.zeros(1, "int64")}
+    state = run_step(state)      # num_acc=1 < min_window 2: accumulate only
+    np.testing.assert_allclose(state["s1"], param)
+    assert int(state["na"][0]) == 1
+    state = run_step(state)      # num_acc=2 >= min(3, 2*0.5=1)->2: rollover
+    # reference quirk (average_accumulates_op.h): the fold uses in_sum_1 +
+    # in_sum_2 (PRE-update), so the rollover step's own param is dropped
+    np.testing.assert_allclose(state["s3"], param)
+    np.testing.assert_allclose(state["s1"], 0.0)
+    assert int(state["na"][0]) == 0 and int(state["ona"][0]) == 2
+
+
+def test_detection_map_op():
+    # one image, two gt boxes of class 0/1, three detections
+    dets = [np.array([[0, 0.9, 0.0, 0.0, 1.0, 1.0],
+                      [0, 0.6, 5.0, 5.0, 6.0, 6.0],
+                      [1, 0.8, 2.0, 2.0, 3.0, 3.0]], "float32")]
+    gts = [np.array([[0, 0.0, 0.0, 1.0, 1.0],
+                     [1, 2.0, 2.0, 3.0, 3.0]], "float32")]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        block.create_var(name="DetectRes", lod_level=1, dtype="float32",
+                         is_data=True)
+        block.create_var(name="Label", lod_level=1, dtype="float32",
+                         is_data=True)
+        for n in ("MAP", "AccumPosCount", "AccumTruePos", "AccumFalsePos"):
+            block.create_var(name=n)
+        block.append_op("detection_map",
+                        {"DetectRes": ["DetectRes"], "Label": ["Label"]},
+                        {"MAP": ["MAP"], "AccumPosCount": ["AccumPosCount"],
+                         "AccumTruePos": ["AccumTruePos"],
+                         "AccumFalsePos": ["AccumFalsePos"]},
+                        {"class_num": 2, "overlap_threshold": 0.5,
+                         "ap_type": "integral"})
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    got = exe.run(main, feed={"DetectRes": [dets[0]], "Label": [gts[0]]},
+                  fetch_list=["MAP"], use_program_cache=False)
+    # class 0: det .9 matches (tp), det .6 misses (fp) -> AP = 1.0
+    # class 1: det .8 matches -> AP = 1.0  => mAP = 1.0
+    np.testing.assert_allclose(np.asarray(got[0]), [1.0], atol=1e-6)
+
+
+def test_nn_wrappers_l2_normalize_multiplex_one_hot_smooth_l1():
+    rng = np.random.RandomState(11)
+    x = rng.normal(0, 1, (4, 6)).astype("float32")
+    y = rng.normal(0, 1, (4, 6)).astype("float32")
+    ids = np.array([[1], [0], [1], [0]], "int32")
+    labels = np.array([[2], [0], [1], [3]], "int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", shape=[6])
+        yv = layers.data("y", shape=[6])
+        iv = layers.data("ids", shape=[1], dtype="int32")
+        lv = layers.data("lab", shape=[1], dtype="int64")
+        norm = layers.l2_normalize(xv, axis=1)
+        mux = layers.multiplex([xv, yv], iv)
+        oh = layers.one_hot(lv, depth=4)
+        sl1 = layers.smooth_l1(xv, yv)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feeds = {"x": x, "y": y, "ids": ids, "lab": labels}
+    n, m, o, s = exe.run(main, feed=feeds, fetch_list=[norm, mux, oh, sl1])
+
+    np.testing.assert_allclose(
+        n, x / np.sqrt((x ** 2).sum(1, keepdims=True)), rtol=1e-5)
+    np.testing.assert_allclose(m, np.where(ids == 1, y, x), rtol=1e-6)
+    np.testing.assert_allclose(o, np.eye(4, dtype="float32")[labels[:, 0]])
+    d = x - y
+    per = np.where(np.abs(d) < 1.0, 0.5 * d * d, np.abs(d) - 0.5).sum(1)
+    np.testing.assert_allclose(s.reshape(-1), per, rtol=1e-5)
+
+
+def test_nn_wrappers_expand_pad_crop_label_smooth():
+    rng = np.random.RandomState(12)
+    x = rng.rand(2, 3).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", shape=[3])
+        e = layers.expand(xv, [2, 1])
+        p = layers.pad(xv, [0, 0, 1, 1], pad_value=9.0)
+        c = layers.crop(xv, shape=[2, 2], offsets=[0, 1])
+        ls = layers.label_smooth(xv, epsilon=0.2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    ev, pv, cv, lsv = exe.run(main, feed={"x": x},
+                              fetch_list=[e, p, c, ls])
+    np.testing.assert_allclose(ev, np.tile(x, (2, 1)), rtol=1e-6)
+    np.testing.assert_allclose(
+        pv, np.pad(x, [(0, 0), (1, 1)], constant_values=9.0), rtol=1e-6)
+    np.testing.assert_allclose(cv, x[0:2, 1:3], rtol=1e-6)
+    np.testing.assert_allclose(lsv, 0.8 * x + 0.2 / 3, rtol=1e-5)

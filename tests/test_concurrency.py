@@ -88,3 +88,95 @@ def test_go_producer_feeds_training_through_channel():
         g.join(5.0)
     assert len(losses) == 30
     assert losses[-1] < 0.05 * losses[0]
+
+
+# ---------------------------------------------------------------------------
+# Select (reference fluid/concurrency.py:193, operators/select_op.cc;
+# reference test: test_concurrency.py fibonacci via select send/recv cases)
+# ---------------------------------------------------------------------------
+
+def test_select_fibonacci():
+    """The reference's CSP fibonacci: a select alternating a send of the
+    running term with a recv on the quit channel."""
+    ch = fluid.make_channel("int64", capacity=1)
+    quit_ch = fluid.make_channel("int64", capacity=1)
+    result = []
+
+    with fluid.Go() as g:
+        @g.run
+        def consumer():
+            for _ in range(10):
+                v, ok = fluid.channel_recv(ch)
+                result.append(v)
+            fluid.channel_send(quit_ch, 0)
+
+        x, y = 0, 1
+        done = False
+        while not done:
+            sel = fluid.Select()
+
+            @sel.case(fluid.channel_send, ch, x)
+            def send_case():
+                pass
+
+            @sel.case(fluid.channel_recv, quit_ch)
+            def quit_case(value, ok):
+                nonlocal done
+                done = True
+
+            fired = sel.run(timeout=10.0)
+            if fired == 0:
+                x, y = y, x + y
+        g.join(5.0)
+
+    assert result == [0, 1, 1, 2, 3, 5, 8, 13, 21, 34]
+
+
+def test_select_default_case():
+    ch = fluid.make_channel("float32", capacity=1)
+    hits = []
+
+    sel = fluid.Select()
+
+    @sel.case(fluid.channel_recv, ch)
+    def recv_case(value, ok):
+        hits.append(("recv", value, ok))
+
+    @sel.default
+    def default_case():
+        hits.append(("default",))
+
+    # nothing ready -> default fires immediately
+    assert sel.run() == 1
+    assert hits == [("default",)]
+
+    # now make the recv case ready: first-ready wins over default
+    fluid.channel_send(ch, 7.0)
+    assert sel.run() == 0
+    assert hits[-1] == ("recv", 7.0, True)
+
+
+def test_select_first_ready_ordering():
+    a = fluid.make_channel("int64", capacity=1)
+    b = fluid.make_channel("int64", capacity=1)
+    fluid.channel_send(b, 2)
+
+    sel = fluid.Select()
+    got = []
+
+    @sel.case(fluid.channel_recv, a)
+    def case_a(value, ok):
+        got.append(("a", value))
+
+    @sel.case(fluid.channel_recv, b)
+    def case_b(value, ok):
+        got.append(("b", value))
+
+    assert sel.run(timeout=1.0) == 1
+    assert got == [("b", 2)]
+
+    # closed-and-drained channels are READY with ok=False (select wakes on
+    # close, channel_impl.h close notifies all waiters)
+    fluid.channel_close(a)
+    assert sel.run(timeout=1.0) == 0
+    assert got[-1] == ("a", None)

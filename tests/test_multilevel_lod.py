@@ -124,3 +124,145 @@ def test_beam_search_decode_emits_2level_lod():
     flat, lod = lodarray_to_flat(out)
     assert len(lod) == 2
     assert lod[0] == [0, beam, 2 * beam]
+
+# ---------------------------------------------------------------------------
+# round 4: N-level LoD (the reference cap-free LoD = vector<Vector<size_t>>,
+# framework/lod_tensor.h:55) + feed-side length bucketing (the TPU answer to
+# shrink_rnn_memory_op.cc batch shrinking)
+# ---------------------------------------------------------------------------
+
+def test_flat_roundtrip_3level():
+    # [paragraph][sentence][phrase][tokens]: 2 paragraphs -> 3 sentences ->
+    # 5 phrases -> 11 tokens
+    flat = np.arange(22, dtype="float32").reshape(11, 2)
+    lod = [[0, 2, 3], [0, 2, 4, 5], [0, 2, 4, 7, 9, 11]]
+    arr = flat_to_lodarray(flat, lod)
+    assert arr.lod_level == 3
+    np.testing.assert_array_equal(np.asarray(arr.lens), [2, 2, 3, 2, 2])
+    outer = arr.outer_levels
+    assert len(outer) == 2
+    np.testing.assert_array_equal(np.asarray(outer[0]), [2, 1])
+    np.testing.assert_array_equal(np.asarray(outer[1]), [2, 2, 1])
+    back, lod2 = lodarray_to_flat(arr)
+    np.testing.assert_array_equal(back, flat)
+    assert lod2 == lod
+
+
+def test_3level_feed_through_executor():
+    """Nested python-list feed at depth 3 packs + fetches intact."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[1], lod_level=3)
+        y = layers.scale(x, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed_nested = [  # 2 paragraphs, each a list of sentences of phrases
+        [[np.array([[1.0], [2.0]], "float32"),
+          np.array([[3.0]], "float32")],
+         [np.array([[4.0], [5.0]], "float32")]],
+        [[np.array([[6.0]], "float32")]],
+    ]
+    got, = exe.run(main, feed={"x": feed_nested}, fetch_list=[y])
+    flat, lod = lodarray_to_flat(got)
+    np.testing.assert_allclose(flat.reshape(-1),
+                               [2, 4, 6, 8, 10, 12])
+    assert lod == [[0, 2, 3], [0, 2, 3, 4], [0, 2, 3, 5, 6]]
+
+
+def test_lodarray_3level_pytree_roundtrip():
+    import jax
+    arr = LoDArray(jnp.ones((4, 3)), jnp.asarray([1, 2, 3, 1]),
+                   (jnp.asarray([1, 1]), jnp.asarray([2, 2])))
+    leaves, treedef = jax.tree_util.tree_flatten(arr)
+    assert len(leaves) == 4
+    back = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert back.lod_level == 3
+    np.testing.assert_array_equal(np.asarray(back.outer_levels[1]), [2, 2])
+
+
+def test_row_to_outer_multilevel():
+    arr = LoDArray(jnp.zeros((5, 2)), jnp.asarray([1, 1, 1, 1, 1]),
+                   (jnp.asarray([2, 1]), jnp.asarray([2, 1, 2])))
+    # innermost outer level groups the 5 rows as [2, 1, 2]
+    np.testing.assert_array_equal(np.asarray(arr.row_to_outer()),
+                                  [0, 0, 1, 2, 2])
+    # outermost level groups the 3 groups as [2, 1]
+    np.testing.assert_array_equal(np.asarray(arr.row_to_outer(0)), [0, 0, 1])
+
+
+def test_bucket_by_length():
+    from paddle_tpu.reader import bucket_by_length, bucket_bound_for
+
+    rng = np.random.RandomState(0)
+    samples = [(list(range(n)),) for n in
+               rng.randint(1, 40, size=50).tolist()]
+
+    def reader():
+        return iter(samples)
+
+    bounds = [8, 16, 32, 64]
+    batched = bucket_by_length(reader, key=lambda s: len(s[0]),
+                               bucket_bounds=bounds, batch_size=4)
+    seen = 0
+    for batch in batched():
+        seen += len(batch)
+        lens = [len(s[0]) for s in batch]
+        pad_to = bucket_bound_for(bounds, max(lens))
+        # every sample in the batch fits its bucket bound, and the whole
+        # batch shares one compiled shape
+        assert all(l <= pad_to for l in lens)
+        assert bucket_bound_for(bounds, max(lens)) == \
+            bucket_bound_for(bounds, min(lens)) or len(set(
+                bucket_bound_for(bounds, l) for l in lens)) == 1
+    assert seen == 50  # nothing dropped
+
+    # wasted-padding win vs padding every batch to the corpus bucket bound
+    # (the compile-bounded no-bucketing baseline)
+    corpus_max = max(len(s[0]) for s in samples)
+    bucketed_steps = sum(
+        len(b) * bucket_bound_for(bounds, max(len(s[0]) for s in b))
+        for b in batched())
+    flat_steps = 50 * bucket_bound_for(bounds, corpus_max)
+    assert bucketed_steps < 0.7 * flat_steps
+
+
+def test_2level_feed_with_empty_outer_group():
+    """An empty outer sequence packs as a zero-length group (regression:
+    the N-level peel must not stop at an empty first group)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[1], lod_level=2)
+        y = layers.scale(x, scale=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = [[],  # first outer sequence empty
+            [np.array([[1.0], [2.0]], "float32"),
+             np.array([[3.0]], "float32")]]
+    got, = exe.run(main, feed={"x": feed}, fetch_list=[y])
+    flat, lod = lodarray_to_flat(got)
+    np.testing.assert_allclose(flat.reshape(-1), [1, 2, 3])
+    assert lod[0] == [0, 0, 2]
+
+
+def test_sequence_expand_ref_level0_3level():
+    """ref_level=0 must address the OUTERMOST level of a 3-level Y, and its
+    gradient must segment-sum back to level-0 groups."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2])
+        yv = layers.data("y", shape=[1], lod_level=3)
+        out = layers.sequence_expand(x, yv, ref_level=0)
+        loss = layers.mean(out)
+        fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    x_np = np.array([[1.0, 10.0], [2.0, 20.0]], "float32")
+    # 2 level-0 groups -> [2, 1] mid groups -> [1, 2, 2] rows
+    y_feed = [
+        [[np.array([[0.0]], "float32")],
+         [np.array([[0.0]], "float32"), np.array([[0.0]], "float32")]],
+        [[np.array([[0.0]], "float32"), np.array([[0.0]], "float32")]],
+    ]
+    got, dx = exe.run(main, feed={"x": x_np, "y": y_feed},
+                      fetch_list=[out, fluid.grad_var_name("x")])
+    # rows 0-2 belong to level-0 group 0; rows 3-4 to group 1
+    np.testing.assert_allclose(np.asarray(got)[:, 0], [1, 1, 1, 2, 2])
+    # d(mean)/dx: each of 5 rows x 2 cols contributes 1/10
+    np.testing.assert_allclose(np.asarray(dx), [[0.3, 0.3], [0.2, 0.2]])
