@@ -97,6 +97,79 @@ def build(batch, image_size, class_dim):
     return main, startup, avg_loss
 
 
+def build_lstm_textcls(batch, seq_len, hidden, vocab=30000, emb=128,
+                       lstm_num=2, class_dim=2):
+    """The reference RNN benchmark model (/root/reference/benchmark/paddle/
+    rnn/rnn.py): embedding(128) -> lstm_num x simple_lstm(hidden) ->
+    last_seq -> fc softmax, Adam, fixed seq len 100 (pad_seq=True), IMDB
+    vocab 30000. simple_lstm = fc(4h) + lstm (trainer_config_helpers
+    networks.py simple_lstm)."""
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data("words", shape=[1], dtype="int64",
+                                  lod_level=1)
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        net = fluid.layers.embedding(words, size=(vocab, emb))
+        for _ in range(lstm_num):
+            proj = fluid.layers.fc(net, hidden * 4)
+            net, _ = fluid.layers.dynamic_lstm(proj, size=hidden * 4)
+        last = fluid.layers.sequence_last_step(net)
+        logits = fluid.layers.fc(last, class_dim, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(logits, label))
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss, startup)
+    return main, startup, loss
+
+
+def run_lstm_lane(batch=64, seq_len=100, hidden=512, steps=32, warmup=3,
+                  use_pallas=False, vocab=30000):
+    """ms/batch for the LSTM text-classification lane, mirroring the
+    reference protocol (benchmark/README.md:115-127: 2xlstm+fc, bs64,
+    fixed len 100; K40m hid512 = 184 ms/batch)."""
+    import jax
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.core.lod import pack_sequences
+
+    main, startup, loss = build_lstm_textcls(batch, seq_len, hidden,
+                                             vocab=vocab)
+    rng = np.random.RandomState(0)
+    n_bufs = 2
+    feeds = []
+    for _ in range(n_bufs):
+        toks = [rng.randint(0, vocab, (seq_len, 1)).astype("int64")
+                for _ in range(batch)]
+        arr = pack_sequences(toks)
+        feeds.append({
+            "words": jax.device_put(arr),
+            "label": jax.device_put(
+                rng.randint(0, 2, (batch, 1)).astype("int64")),
+        })
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(mode="jit", donate=True)
+    set_flags({"use_pallas_rnn": bool(use_pallas)})
+    try:
+        with jax.default_matmul_precision("bfloat16"):
+            exe.run(startup, scope=scope)
+            for i in range(warmup):
+                v = exe.run(main, feed=feeds[i % n_bufs], fetch_list=[loss],
+                            scope=scope)
+            assert np.isfinite(v[0]), f"non-finite lstm loss {v[0]}"
+            t0 = time.perf_counter()
+            for i in range(steps):
+                v = exe.run(main, feed=feeds[i % n_bufs], fetch_list=[loss],
+                            scope=scope, return_numpy=False)
+            loss_v = np.asarray(v[0])
+            elapsed = time.perf_counter() - t0
+    finally:
+        set_flags({"use_pallas_rnn": False})
+    assert np.isfinite(loss_v), f"non-finite lstm loss {loss_v}"
+    return elapsed / steps * 1e3
+
+
 def main():
     ap = argparse.ArgumentParser()
     # 96 steps: the end-of-chain readback and per-run staging amortize to
@@ -111,6 +184,8 @@ def main():
                     help="let XLA pick the state entry layout (measured "
                          "perf-neutral on v5e: the boundary relayout copies "
                          "already overlap with compute; kept for A/B runs)")
+    ap.add_argument("--skip-lstm", action="store_true",
+                    help="only run the flagship ResNet-50 lane")
     args = ap.parse_args()
 
     if args.smoke:
@@ -126,6 +201,32 @@ def main():
     else:
         batch, image_size, class_dim = args.batch, 224, 1000
         steps, warmup = args.steps, args.warmup
+
+    # ---- LSTM text-cls lane (reference benchmark/README.md:115-127) ----
+    # printed BEFORE the flagship line so the driver's single-line parse
+    # still lands on the ResNet metric
+    if not args.skip_lstm:
+        lstm_kw = dict(batch=8, seq_len=12, hidden=16, steps=2, warmup=1) \
+            if args.smoke else dict(batch=64, seq_len=100, hidden=512,
+                                    steps=32, warmup=3)
+        jnp_ms = run_lstm_lane(use_pallas=False, **lstm_kw)
+        try:
+            pallas_ms = run_lstm_lane(use_pallas=True, **lstm_kw)
+        except Exception as e:  # pallas lowering unavailable on this backend
+            print(f"pallas lstm lane failed ({type(e).__name__}: {e}); "
+                  "reporting jnp path", file=sys.stderr)
+            pallas_ms = None
+        best = min(jnp_ms, pallas_ms) if pallas_ms is not None else jnp_ms
+        lstm_baseline = 184.0  # K40m ms/batch, bs64 hid512 (BASELINE.md)
+        print(json.dumps({
+            "metric": "lstm_textcls_train_ms_batch"
+                      + ("_smoke" if args.smoke else ""),
+            "value": round(best, 3),
+            "unit": "ms/batch (bs64 hid512 len100, lower is better)",
+            "vs_baseline": round(lstm_baseline / best, 4),
+            "jnp_ms": round(jnp_ms, 3),
+            "pallas_ms": None if pallas_ms is None else round(pallas_ms, 3),
+        }))
 
     main_prog, startup, avg_loss = build(batch, image_size, class_dim)
 

@@ -5,6 +5,11 @@ cached files from DATA_HOME when present and deterministic synthetic
 stand-ins otherwise (no network egress here — see common.download).
 """
 
-from . import common, mnist, cifar, imdb, uci_housing
+from . import (common, mnist, cifar, imdb, uci_housing, imikolov,
+               movielens, conll05, flowers, voc2012, wmt14, wmt16, mq2007,
+               sentiment)
 
-__all__ = ["common", "mnist", "cifar", "imdb", "uci_housing"]
+# mirrors /root/reference/python/paddle/v2/dataset/__init__.py __all__
+__all__ = ["mnist", "imikolov", "imdb", "cifar", "movielens", "conll05",
+           "sentiment", "uci_housing", "wmt14", "wmt16", "mq2007",
+           "flowers", "voc2012", "common"]

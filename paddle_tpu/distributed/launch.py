@@ -1,0 +1,91 @@
+"""Multi-process launcher — the cluster-train entry point.
+
+Reference capability: the k8s yamls and launch scripts that start N
+trainer/pserver processes (/root/reference/benchmark/cluster/vgg16/
+fluid_trainer.yaml sets TRAINERS/TRAINER_ID/PSERVER env vars for each pod;
+paddle/scripts/cluster_train_v2/). TPU-native: every process runs the SAME
+SPMD script; this launcher spawns them with the coordination env vars
+(PDTPU_COORDINATOR / PDTPU_NUM_PROCESSES / PDTPU_PROCESS_ID) that
+``paddle_tpu.parallel.init_multihost`` consumes, streaming each child's
+output with a rank prefix. On a real pod each host runs one process and the
+TPU runtime auto-discovers instead.
+
+    python -m paddle_tpu.distributed.launch --nproc 2 train.py --lr 0.1
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+ENV_COORD = "PDTPU_COORDINATOR"
+ENV_NPROC = "PDTPU_NUM_PROCESSES"
+ENV_RANK = "PDTPU_PROCESS_ID"
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(script, script_args=(), nproc=2, devices_per_proc=None,
+           coordinator=None, env_extra=None, timeout=None):
+    """Spawn ``nproc`` copies of ``script`` wired into one jax.distributed
+    runtime. Returns the list of exit codes."""
+    coordinator = coordinator or f"127.0.0.1:{free_port()}"
+    procs = []
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env[ENV_COORD] = coordinator
+        env[ENV_NPROC] = str(nproc)
+        env[ENV_RANK] = str(rank)
+        env.update(env_extra or {})
+        if devices_per_proc:
+            import re as _re
+            flags = _re.sub(r"--xla_force_host_platform_device_count=\d+",
+                            "", env.get("XLA_FLAGS", ""))
+            env["XLA_FLAGS"] = (flags +
+                                " --xla_force_host_platform_device_count="
+                                f"{devices_per_proc}").strip()
+            env["JAX_PLATFORMS"] = "cpu"
+        p = subprocess.Popen([sys.executable, script, *script_args],
+                             env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        procs.append(p)
+
+    codes = []
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        for line in (out or "").splitlines():
+            print(f"[rank {rank}] {line}")
+        codes.append(p.returncode)
+    return codes
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="spawn N coordinated SPMD processes on this host")
+    ap.add_argument("--nproc", type=int, default=2)
+    ap.add_argument("--devices-per-proc", type=int, default=None,
+                    help="virtual CPU devices per process (testing without "
+                         "TPU hardware)")
+    ap.add_argument("--timeout", type=float, default=None)
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    codes = launch(args.script, args.script_args, nproc=args.nproc,
+                   devices_per_proc=args.devices_per_proc,
+                   timeout=args.timeout)
+    return max(codes, default=0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,4 +26,5 @@ from . import (  # noqa: F401
     detection_ops,
     misc_ops,
     breadth_ops,
+    io_ops,
 )

@@ -647,18 +647,23 @@ def detection_map(ctx):
                 true_pos[c].append((float(dets[i, 1]), 0))
                 false_pos[c].append((float(dets[i, 1]), 1))
 
-    # merge accumulated state from inputs (PosCount/TruePos/FalsePos)
-    if ctx.has_input("PosCount") and not (
-            ctx.has_input("HasState")
-            and int(onp.asarray(data_of(ctx.input("HasState"))).reshape(-1)[0])
-            == 0):
+    # merge accumulated state from inputs (PosCount/TruePos/FalsePos) ONLY
+    # when HasState is wired and nonzero — the reference starts fresh
+    # otherwise (detection_map_op.h:91-98: `int state = 0; if (has_state)
+    # ...; if (in_pos_count != nullptr && state)`)
+    has_state = (ctx.has_input("HasState") and int(onp.asarray(
+        data_of(ctx.input("HasState"))).reshape(-1)[0]) != 0)
+    if ctx.has_input("PosCount") and has_state:
         prev_pos = onp.asarray(data_of(ctx.input("PosCount"))).reshape(-1)
         pos_count[:len(prev_pos)] += prev_pos.astype(onp.int64)
         for name, store in (("TruePos", true_pos), ("FalsePos", false_pos)):
             v = ctx.input(name)
             rows = onp.asarray(data_of(v))
-            lens = onp.asarray(v.lens).reshape(-1) if isinstance(v, LoDArray) \
-                else onp.asarray([len(rows)] * 0)
+            if isinstance(v, LoDArray):
+                lens = onp.asarray(v.lens).reshape(-1)
+            else:
+                # plain-tensor state: every per-class row is full width
+                lens = onp.full(rows.shape[0], rows.shape[1], onp.int64)
             for c, ln in enumerate(lens):
                 seq = rows[c][:int(ln)]
                 store.setdefault(c, [])

@@ -178,6 +178,63 @@ def _block_written(block):
     return written_names(block.program, block.idx)
 
 
+def _const_producer_value(name, blocks):
+    """The fill_constant value that produced ``name`` in any of ``blocks``
+    (None when the var is not a build-time constant)."""
+    for b in blocks:
+        for o in b.ops:
+            if name in o.output("Out") and o.type == "fill_constant":
+                return float(o.attrs.get("value", 0.0))
+    return None
+
+
+def _derive_while_bound(op):
+    """Static trip-count bound for a While without explicit max_iters —
+    the analog of the reference's unbounded while_grad (while_op.cc:35),
+    which can interpret its backward block for however many steps ran; a
+    reverse scan needs a static length, so derive one from the canonical
+    counter loop the reference's own decoders build
+    (layers/control_flow.py:607 While + increment + less_than):
+
+        i = fill_constant(C0);  limit = fill_constant(V)
+        while less_than(i, limit):  ...;  i = increment(i, S)
+
+    Returns ceil((V - C0)/S) (+1 for less_equal) — over-estimating is
+    harmless because the scan body is masked once the condition goes false
+    (_while_scan). Returns None when the pattern doesn't match (dynamic
+    limit), in which case the caller raises the explicit-bound error."""
+    block = op.block
+    program = block.program
+    sub = program.blocks[op.attrs["sub_block"]]
+    cond_name = op.input("Condition")[0]
+
+    cmp_op = None
+    for o in list(sub.ops) + list(block.ops):
+        if cond_name in o.output("Out") and o.type in ("less_than",
+                                                       "less_equal"):
+            cmp_op = o
+    if cmp_op is None:
+        return None
+    counter = cmp_op.input("X")[0]
+    limit = cmp_op.input("Y")[0]
+
+    v = _const_producer_value(limit, [block])
+    c0 = _const_producer_value(counter, [block])
+    if v is None or c0 is None:
+        return None
+    step = None
+    for o in sub.ops:
+        if o.type == "increment" and counter in o.output("Out"):
+            step = float(o.attrs.get("step", 1.0))
+    if not step or step <= 0:
+        return None
+    import math
+    bound = int(math.ceil((v - c0) / step))
+    if cmp_op.type == "less_equal":
+        bound += 1
+    return max(bound, 1)
+
+
 def _while_grad_maker(op):
     """while_grad consumes the pre-loop state snapshots + post-loop output
     grads and produces (a) grads for the free weights read by the body and
@@ -186,13 +243,19 @@ def _while_grad_maker(op):
     inits must see d/d(pre-loop value), not d/d(post-loop value). Requires a
     max_iters bound so the loop is a reverse-differentiable masked lax.scan
     (the reference's WhileGrad, while_op.cc:35, interprets a generated
-    backward block instead)."""
-    if op.attrs.get("max_iters") is None:
+    backward block instead); when absent, a bound is derived from the
+    counter/limit pattern (_derive_while_bound)."""
+    attrs = dict(op.attrs)
+    if attrs.get("max_iters") is None:
+        attrs["max_iters"] = _derive_while_bound(op)
+    if attrs.get("max_iters") is None:
         raise RuntimeError(
-            "while op lies on a gradient path but has no max_iters bound; "
-            "build it as fluid.layers.While(cond, max_iters=N) to train "
-            "through it (lax.while_loop itself is not reverse-"
-            "differentiable)")
+            "while op lies on a gradient path, has no max_iters bound, and "
+            "no static bound could be derived from its condition (expected "
+            "the counter pattern: fill_constant init, less_than/less_equal "
+            "against a fill_constant limit, increment in the body); build "
+            "it as fluid.layers.While(cond, max_iters=N) to train through "
+            "it (lax.while_loop itself is not reverse-differentiable)")
     diff = op.attrs.get("diff_vars", [])
     carried = op.attrs.get("carried", [])
     return [OpSpec(
@@ -201,7 +264,7 @@ def _while_grad_maker(op):
          "FreeVars": op.input("FreeVars"), "PreLoop": op.output("PreLoop"),
          "OutGrads": G(op.output("Out"))},
         {"DiffGrads": G(diff), "CarriedGrads": G(carried)},
-        dict(op.attrs),
+        attrs,
         overwrite_slots=frozenset({"CarriedGrads"}))]
 
 
@@ -629,14 +692,85 @@ def batch_gather(ctx):
 # beam search (dense [batch, beam] layout)
 # ---------------------------------------------------------------------------
 
+def _beam_search_lod(ctx):
+    """The reference's variable-width LoD beam step (beam_search_op.cc):
+    ids/scores arrive as a 2-level LoD tensor — level 0 groups PREFIXES per
+    source sentence, each prefix row holding K candidate (id, score) pairs —
+    plus flat pre_ids [n_prefix]. Per source: take the top beam_size
+    candidates across all its prefixes (descending score), regroup them by
+    prefix, drop every candidate of a finished prefix (pre_id == end_id —
+    finished hypotheses leave the beam), and emit per-prefix groups sorted
+    by ascending id. Output widths SHRINK as hypotheses finish: level 1 of
+    the output LoD has one (possibly empty) entry per input prefix.
+
+    Host-side op (dynamic output widths cannot jit); the dense [b, beam]
+    branch below is the jit-able fast path the book decoder uses."""
+    import numpy as onp
+
+    ids_v = ctx.input("ids")
+    scores_v = ctx.input("scores")
+    pre_ids = onp.asarray(data_of(ctx.input("pre_ids"))).reshape(-1)
+    beam = int(ctx.attr("beam_size"))
+    end_id = int(ctx.attr("end_id"))
+
+    cand_ids = onp.asarray(ids_v.data)          # [n_prefix, K, ...]
+    cand_scores = onp.asarray(scores_v.data)
+    lens = onp.asarray(ids_v.lens).reshape(-1)  # per-prefix candidate count
+    outer = onp.asarray(ids_v.outer_lens).reshape(-1)  # prefixes per source
+    n_prefix = cand_ids.shape[0]
+    cand_ids = cand_ids.reshape(n_prefix, -1)
+    cand_scores = cand_scores.reshape(n_prefix, -1)
+
+    # SelectTopBeamSizeItems: per source, top beam_size across prefixes
+    per_prefix = [[] for _ in range(n_prefix)]
+    start = 0
+    for width in outer:
+        items = []
+        for p in range(start, start + int(width)):
+            for c in range(int(lens[p])):
+                items.append((p, int(cand_ids[p, c]),
+                              float(cand_scores[p, c])))
+        items.sort(key=lambda it: -it[2])
+        for p, i, s in items[:beam]:
+            per_prefix[p].append((i, s))
+        start += int(width)
+
+    # PruneEndidCandidates: finished prefixes contribute nothing
+    for p in range(n_prefix):
+        if pre_ids[p] == end_id:
+            per_prefix[p] = []
+
+    widths = onp.asarray([len(v) for v in per_prefix], onp.int32)
+    max_w = max(int(widths.max()) if n_prefix else 0, 1)
+    out_ids = onp.zeros((n_prefix, max_w, 1), onp.int64)
+    out_scores = onp.zeros((n_prefix, max_w, 1), onp.float32)
+    for p, items in enumerate(per_prefix):
+        items.sort(key=lambda it: it[0])        # ascending id (reference)
+        for j, (i, s) in enumerate(items):
+            out_ids[p, j, 0] = i
+            out_scores[p, j, 0] = s
+
+    ctx.set_output("selected_ids",
+                   LoDArray(jnp.asarray(out_ids), jnp.asarray(widths),
+                            ids_v.outer_lens))
+    ctx.set_output("selected_scores",
+                   LoDArray(jnp.asarray(out_scores), jnp.asarray(widths),
+                            ids_v.outer_lens))
+
+
 @register_op("beam_search")
 def beam_search(ctx):
-    """One beam step. Inputs: pre_ids [b, beam] int, pre_scores [b, beam]
+    """One beam step. LoD-input form: the reference's variable-width
+    semantics (see _beam_search_lod). Dense form — inputs: pre_ids [b, beam]
+    int, pre_scores [b, beam]
     (accumulated log-probs), ids [b, beam, k] candidate tokens, scores
     [b, beam, k] candidate log-probs. Finished beams (pre_id == end_id) emit
     only end_id with unchanged score. Outputs selected_ids/selected_scores
     [b, beam] and parent_idx [b, beam] (which source beam each came from).
     Dense re-design of beam_search_op.h:96-193."""
+    if isinstance(ctx.input("ids"), LoDArray):
+        _beam_search_lod(ctx)
+        return
     pre_ids = data_of(ctx.input("pre_ids")).astype(jnp.int32)
     pre_scores = data_of(ctx.input("pre_scores"))
     cand_ids = data_of(ctx.input("ids")).astype(jnp.int32)

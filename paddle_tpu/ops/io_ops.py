@@ -1,0 +1,230 @@
+"""IO / runtime op forms: fill, delete_var, save, load, save_combine,
+load_combine, get_places, lod_array_length, read, channel ops, go.
+
+Reference: /root/reference/paddle/fluid/operators/{fill_op.cc (dtype + flat
+"data" attr reshaped to "shape"), save_op.cc / load_op.cc (file_path attr,
+overwrite check), save_combine_op.cc / load_combine_op.cc (many vars, one
+file, order-preserving), delete_var_op.cc, get_places_op.cc (device_count /
+device_type), lod_array_length_op.cc, read_op.cc (pops a batch from a READER
+var), channel_create/close/send/recv_op.cc (ChannelHolder var),
+go_op.cc (spawns the sub-block on the ThreadPool)}.
+
+TPU-native notes: checkpoint persistence is owned by fluid/io.py's
+manifest-based save/load (atomic renames); these op forms expose the same
+serialization through the reference's op-driven contract, so programs that
+embed save/load/fill ops (the reference's io.py builds exactly such tiny
+programs) run unchanged. They are HOST ops: they run in the eager
+interpreter or at trace time on concrete values — a jit-compiled training
+step never embeds them (the reference likewise runs save/load in separate
+tiny programs, python/paddle/fluid/io.py:145,234). Channels/Go wrap the
+host-side CSP objects of fluid/concurrency.py, keeping channel state in the
+scope exactly like the reference's ChannelHolder variables.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op
+from ..core.types import np_dtype
+
+
+def _require_concrete(op_type, *values):
+    for v in values:
+        for leaf in jax.tree_util.tree_leaves(v):
+            if isinstance(leaf, jax.core.Tracer):
+                raise RuntimeError(
+                    f"op {op_type!r} is a host op (IO/CSP) and cannot be "
+                    "traced into a jit-compiled step; run its program with "
+                    "Executor(mode='eager') like the reference's save/load "
+                    "programs")
+
+
+# ---------------------------------------------------------------------------
+# fill / delete_var / get_places
+# ---------------------------------------------------------------------------
+
+@register_op("fill")
+def fill(ctx):
+    """fill_op.cc: flat "data" attr values reshaped to "shape"."""
+    dtype = np_dtype(ctx.attr("dtype", "float32"))
+    shape = tuple(ctx.attr("shape"))
+    data = np.asarray(ctx.attr("data"), dtype=dtype).reshape(shape)
+    ctx.set_output("Out", jnp.asarray(data))
+
+
+@register_op("delete_var")
+def delete_var(ctx):
+    """delete_var_op.cc: drop variables from the runtime environment."""
+    for name in ctx.op.input("X"):
+        ctx.env.pop(name, None)
+
+
+@register_op("get_places")
+def get_places(ctx):
+    """get_places_op.cc: emit the device list (device_count=0 -> all)."""
+    kind = ctx.attr("device_type", "AUTO")
+    count = int(ctx.attr("device_count", 0) or 0)
+    if kind in ("CPU",):
+        devs = jax.devices("cpu")
+    else:
+        devs = jax.devices()
+    if count:
+        devs = devs[:count]
+    ctx.set_output("Out", list(devs))
+
+
+# ---------------------------------------------------------------------------
+# save / load (single var)  +  save_combine / load_combine
+# ---------------------------------------------------------------------------
+
+def _to_numpy(v):
+    if isinstance(v, LoDArray):
+        return {"data": np.asarray(v.data), "lens": np.asarray(v.lens),
+                "outer": [np.asarray(o) for o in v.outer_levels]}
+    return np.asarray(v)
+
+
+def _from_numpy(v):
+    if isinstance(v, dict):
+        return LoDArray(jnp.asarray(v["data"]), jnp.asarray(v["lens"]),
+                        tuple(jnp.asarray(o) for o in v["outer"]) or None)
+    return jnp.asarray(v)
+
+
+@register_op("save")
+def save(ctx):
+    v = ctx.input("X")
+    _require_concrete("save", v)
+    path = ctx.attr("file_path")
+    if not ctx.attr("overwrite", True) and os.path.exists(path):
+        raise FileExistsError(f"save: {path} exists and overwrite=False "
+                              "(save_op.cc overwrite check)")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.save(f, _to_numpy(v), allow_pickle=True)
+    os.replace(tmp, path)
+
+
+@register_op("load")
+def load(ctx):
+    path = ctx.attr("file_path")
+    with open(path, "rb") as f:
+        v = np.load(f, allow_pickle=True)
+    if v.dtype == object:
+        v = v.item()
+    ctx.set_output("Out", _from_numpy(v))
+
+
+@register_op("save_combine")
+def save_combine(ctx):
+    vs = ctx.inputs("X")
+    _require_concrete("save_combine", *vs)
+    path = ctx.attr("file_path")
+    if not ctx.attr("overwrite", True) and os.path.exists(path):
+        raise FileExistsError(f"save_combine: {path} exists")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    # order-preserving container (load_combine restores by position,
+    # save_combine_op.cc serializes sequentially). Build the object vector
+    # explicitly: np.asarray(list, dtype=object) would collapse same-shaped
+    # tensors into one deep (N, *shape) array and break the round-trip.
+    container = np.empty(len(vs), dtype=object)
+    container[:] = [_to_numpy(v) for v in vs]
+    with open(tmp, "wb") as f:
+        np.save(f, container, allow_pickle=True)
+    os.replace(tmp, path)
+
+
+@register_op("load_combine")
+def load_combine(ctx):
+    path = ctx.attr("file_path")
+    with open(path, "rb") as f:
+        vs = np.load(f, allow_pickle=True)
+    ctx.set_outputs("Out", [_from_numpy(v) for v in vs])
+
+
+# ---------------------------------------------------------------------------
+# lod_array_length / read
+# ---------------------------------------------------------------------------
+
+@register_op("lod_array_length")
+def lod_array_length(ctx):
+    """lod_array_length_op.cc: scalar int64 length of a tensor array."""
+    arr = ctx.input("X")
+    ctx.set_output("Out", arr.length.astype(jnp.int64).reshape((1,)))
+
+
+@register_op("read")
+def read(ctx):
+    """read_op.cc: pop the next sample batch from a READER variable (here a
+    host iterator placed in the scope by the reader framework) into the
+    output vars; raises StopIteration at end-of-data like the reference
+    (executor catches it to end the pass)."""
+    reader = ctx.input("Reader")
+    if callable(reader) and not hasattr(reader, "__next__"):
+        # a reader creator: instantiate once, keep the iterator in its place
+        reader = iter(reader())
+        ctx.env[ctx.op.input("Reader")[0]] = reader
+    batch = next(reader)
+    outs = ctx.op.output("Out")
+    if len(outs) == 1 and not isinstance(batch, (tuple, list)):
+        batch = (batch,)
+    ctx.set_outputs("Out", [jnp.asarray(np.asarray(b)) for b in batch])
+
+
+# ---------------------------------------------------------------------------
+# CSP channel ops + go (host concurrency through the scope)
+# ---------------------------------------------------------------------------
+
+@register_op("channel_create")
+def channel_create(ctx):
+    from ..fluid.concurrency import Channel
+    ctx.set_output("Out", Channel(dtype=ctx.attr("data_type", "float32"),
+                                  capacity=int(ctx.attr("capacity", 0))))
+
+
+@register_op("channel_send")
+def channel_send(ctx):
+    ch = ctx.input("Channel")
+    v = ctx.input("X")
+    _require_concrete("channel_send", v)
+    ch.send(v)
+
+
+@register_op("channel_recv")
+def channel_recv(ctx):
+    ch = ctx.input("Channel")
+    v, ok = ch.recv()
+    ctx.set_output("Out", v)
+    ctx.set_output("Status", jnp.asarray(ok))
+
+
+@register_op("channel_close")
+def channel_close(ctx):
+    ctx.input("Channel").close()
+
+
+@register_op("go", is_control_flow=True)
+def go(ctx):
+    """go_op.cc: run the sub-block concurrently on a daemon thread over a
+    snapshot of the environment (channels inside it are shared objects — the
+    communication medium, like the reference's captured scope)."""
+    sub = ctx.sub_block()
+    env_snapshot = dict(ctx.env)
+    _require_concrete("go", *[v for v in env_snapshot.values()
+                              if isinstance(v, jax.Array)])
+    exec_state = ctx._exec
+    from ..core.executor import _run_ops
+
+    t = threading.Thread(target=_run_ops, args=(sub, env_snapshot, exec_state),
+                         daemon=True)
+    t.start()
+    ctx.set_output("Out", t)

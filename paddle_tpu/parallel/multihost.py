@@ -25,7 +25,18 @@ def init_multihost(coordinator_address=None, num_processes=None,
     """Join this process into a multi-host JAX runtime (DCN). On TPU pods
     the three None defaults auto-discover from the TPU environment; on
     CPU/GPU clusters pass them explicitly (the reference's trainer_id /
-    pserver endpoint flags, distribute_transpiler.py transpile args)."""
+    pserver endpoint flags, distribute_transpiler.py transpile args) or
+    launch via ``paddle_tpu.distributed.launch``, whose env vars are read
+    here as defaults."""
+    import os
+    from ..distributed.launch import ENV_COORD, ENV_NPROC, ENV_RANK
+
+    if coordinator_address is None:
+        coordinator_address = os.environ.get(ENV_COORD)
+    if num_processes is None and os.environ.get(ENV_NPROC):
+        num_processes = int(os.environ[ENV_NPROC])
+    if process_id is None and os.environ.get(ENV_RANK):
+        process_id = int(os.environ[ENV_RANK])
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id,

@@ -13,5 +13,13 @@ test() over a held-out reader — used exactly like
 
 from . import event
 from .trainer import SGD
+from . import (activation, attr, config_helpers, data_type, layer,
+               optimizer, pooling)
+from .config_helpers import parse_config
 
-__all__ = ["event", "SGD"]
+# paddle.v2.trainer.SGD spelling (reference v2/trainer.py)
+from . import trainer
+
+__all__ = ["event", "SGD", "trainer", "layer", "activation", "pooling",
+           "attr", "data_type", "optimizer", "config_helpers",
+           "parse_config"]

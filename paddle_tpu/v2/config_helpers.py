@@ -1,0 +1,756 @@
+"""trainer_config_helpers — the legacy v2 layer-config DSL.
+
+Reference: /root/reference/python/paddle/trainer_config_helpers/layers.py
+(7,531 LoC layer DSL), networks.py (img_conv_group, simple_lstm),
+config_parser.py (the Python->ModelConfig compiler, 4,399 LoC — shape
+inference incl. square-image sqrt rule and caffe/ceil output-size modes),
+python/paddle/trainer_config_helpers/{activations.py, poolings.py,
+attrs.py, optimizers.py}.
+
+TPU-native redesign: the reference compiles this DSL to a ModelConfig proto
+interpreted by the C++ GradientMachine; here every ``*_layer`` call lowers
+EAGERLY onto the fluid Program builder (paddle_tpu.fluid.layers), so a v2
+config script *is* a fluid topology — one IR, one executor, one compiled
+XLA step for both generations. Sequence layers carry LoD metadata; image
+layers carry (C, H, W) metadata with the reference's shape rules
+(config_parser.py cnn_output_size: caffe mode for conv, ceil mode for
+pooling; height = width = sqrt(size / channels) when unspecified).
+
+Data layers are LAZY: the reference's data_layer declares only a size —
+whether it is a float image, an integer label, or a token sequence is
+decided by the data provider. Here the first consumer materializes the
+variable with the right dtype/lod (conv -> float image, cost label ->
+int64, embedding -> int64 sequence), preserving the reference's config
+scripts verbatim.
+
+Run a reference config with ``parse_config(source)`` (the ``paddle train
+--config=`` analog) and feed the result to ``paddle_tpu.v2.SGD``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = [
+    # plumbing
+    "settings", "get_config_arg", "set_config_args", "outputs",
+    "define_py_data_sources2", "get_topology", "parse_config", "Topology",
+    # activations
+    "ReluActivation", "LinearActivation", "SoftmaxActivation",
+    "SigmoidActivation", "TanhActivation", "IdentityActivation",
+    # poolings
+    "MaxPooling", "AvgPooling", "SumPooling",
+    # attrs
+    "ExtraAttr", "ExtraLayerAttribute", "ParamAttr", "ParameterAttribute",
+    # optimizers / regularizers
+    "MomentumOptimizer", "AdamOptimizer", "AdamaxOptimizer",
+    "RMSPropOptimizer", "AdaGradOptimizer", "DecayedAdaGradOptimizer",
+    "AdaDeltaOptimizer", "L2Regularization", "L1Regularization",
+    # layers
+    "data_layer", "fc_layer", "img_conv_layer", "img_pool_layer",
+    "img_cmrnorm_layer", "batch_norm_layer", "addto_layer", "concat_layer",
+    "dropout_layer", "embedding_layer", "lstmemory", "simple_lstm",
+    "grumemory", "simple_gru", "last_seq", "first_seq", "pooling_layer",
+    "cross_entropy", "classification_cost", "regression_cost",
+    "img_conv_group", "conv_projection", "LayerOutput",
+]
+
+
+# ---------------------------------------------------------------------------
+# global config state (the reference keeps this in config_parser globals)
+# ---------------------------------------------------------------------------
+
+_SETTINGS: dict = {}
+_CONFIG_ARGS: dict = {}
+_OUTPUTS: list = []
+_DATA_LAYERS: list = []
+_DATA_SOURCES: dict = {}
+
+
+def _reset_config():
+    _SETTINGS.clear()
+    _CONFIG_ARGS.clear()
+    del _OUTPUTS[:]
+    del _DATA_LAYERS[:]
+    _DATA_SOURCES.clear()
+
+
+def set_config_args(**kwargs):
+    """Provide the values get_config_arg reads (the reference passes them on
+    the paddle_trainer command line: --config_args=batch_size=64,...)."""
+    _CONFIG_ARGS.update(kwargs)
+
+
+def get_config_arg(name, type_, default=None):
+    v = _CONFIG_ARGS.get(name, default)
+    if v is None:
+        return None
+    if type_ is bool and isinstance(v, str):
+        return v.lower() in ("1", "true", "yes")
+    return type_(v)
+
+
+def settings(batch_size=None, learning_rate=1e-3, learning_method=None,
+             regularization=None, gradient_clipping_threshold=None, **kw):
+    _SETTINGS.update(dict(
+        batch_size=batch_size, learning_rate=learning_rate,
+        learning_method=learning_method, regularization=regularization,
+        gradient_clipping_threshold=gradient_clipping_threshold, **kw))
+
+
+def define_py_data_sources2(train_list, test_list, module=None, obj=None,
+                            args=None):
+    """Recorded for introspection only: the v2 trainer contract feeds
+    readers directly (reference PyDataProvider2 pulled batches through an
+    embedded interpreter; here the reader decorators own that job)."""
+    _DATA_SOURCES.update(dict(train_list=train_list, test_list=test_list,
+                              module=module, obj=obj, args=args or {}))
+
+
+def outputs(*layers):
+    del _OUTPUTS[:]
+    _OUTPUTS.extend(layers)
+
+
+# ---------------------------------------------------------------------------
+# activations / poolings / attrs / optimizers
+# ---------------------------------------------------------------------------
+
+class _Activation:
+    act = None
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class ReluActivation(_Activation):
+    act = "relu"
+
+
+class LinearActivation(_Activation):
+    act = None
+
+
+IdentityActivation = LinearActivation
+
+
+class SoftmaxActivation(_Activation):
+    act = "softmax"
+
+
+class SigmoidActivation(_Activation):
+    act = "sigmoid"
+
+
+class TanhActivation(_Activation):
+    act = "tanh"
+
+
+def _act_str(act):
+    if act is None:
+        return None
+    if isinstance(act, str):
+        return act
+    return act.act
+
+
+class _Pooling:
+    pool_type = "max"
+
+
+class MaxPooling(_Pooling):
+    pool_type = "max"
+
+
+class AvgPooling(_Pooling):
+    pool_type = "avg"
+
+
+class SumPooling(_Pooling):
+    pool_type = "sum"
+
+
+class ExtraLayerAttribute:
+    def __init__(self, error_clipping_threshold=None, drop_rate=None,
+                 device=None):
+        self.drop_rate = drop_rate
+        self.error_clipping_threshold = error_clipping_threshold
+        self.device = device
+
+
+ExtraAttr = ExtraLayerAttribute
+
+
+class ParameterAttribute:
+    """Maps the commonly used subset onto fluid.ParamAttr (reference
+    attrs.py ParameterAttribute has ~15 knobs tied to the legacy updater)."""
+
+    def __init__(self, name=None, initial_std=None, initial_mean=None,
+                 learning_rate=None, l1_rate=None, l2_rate=None,
+                 is_static=False, **kw):
+        self.name = name
+        self.initial_std = initial_std
+        self.initial_mean = initial_mean
+        self.learning_rate = learning_rate
+        self.l2_rate = l2_rate
+        self.is_static = is_static
+
+    def to_fluid(self):
+        from ..fluid.param_attr import ParamAttr as FluidParamAttr
+        from ..fluid.initializer import Normal
+        init = None
+        if self.initial_std is not None or self.initial_mean is not None:
+            init = Normal(loc=self.initial_mean or 0.0,
+                          scale=self.initial_std
+                          if self.initial_std is not None else 0.01)
+        return FluidParamAttr(name=self.name, initializer=init,
+                              learning_rate=self.learning_rate
+                              if self.learning_rate is not None else 1.0,
+                              trainable=not self.is_static)
+
+
+ParamAttr = ParameterAttribute
+
+
+def _fluid_param_attr(attr):
+    if attr is None or attr is True:
+        return None
+    if isinstance(attr, ParameterAttribute):
+        return attr.to_fluid()
+    return attr
+
+
+class _OptimizerSpec:
+    fluid_cls = None
+    kwargs: dict = {}
+
+    def create(self, learning_rate, regularization=None):
+        import paddle_tpu.fluid as fluid
+        cls = getattr(fluid.optimizer, self.fluid_cls)
+        return cls(learning_rate=learning_rate,
+                   regularization=regularization, **self.kwargs)
+
+
+class MomentumOptimizer(_OptimizerSpec):
+    fluid_cls = "Momentum"
+
+    def __init__(self, momentum=0.9, sparse=False):
+        self.kwargs = {"momentum": momentum}
+
+
+class AdamOptimizer(_OptimizerSpec):
+    fluid_cls = "Adam"
+
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.kwargs = {"beta1": beta1, "beta2": beta2, "epsilon": epsilon}
+
+
+class AdamaxOptimizer(_OptimizerSpec):
+    fluid_cls = "Adamax"
+
+    def __init__(self, beta1=0.9, beta2=0.999):
+        self.kwargs = {"beta1": beta1, "beta2": beta2}
+
+
+class RMSPropOptimizer(_OptimizerSpec):
+    fluid_cls = "RMSProp"
+
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.kwargs = {"rho": rho, "epsilon": epsilon}
+
+
+class AdaGradOptimizer(_OptimizerSpec):
+    fluid_cls = "Adagrad"
+
+    def __init__(self, epsilon=1e-6):
+        self.kwargs = {"epsilon": epsilon}
+
+
+class DecayedAdaGradOptimizer(_OptimizerSpec):
+    fluid_cls = "DecayedAdagrad"
+
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.kwargs = {"decay": rho, "epsilon": epsilon}
+
+
+class AdaDeltaOptimizer(_OptimizerSpec):
+    fluid_cls = "Adadelta"
+
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.kwargs = {"rho": rho, "epsilon": epsilon}
+
+
+class L2Regularization:
+    def __init__(self, rate):
+        self.rate = rate
+
+    def to_fluid(self):
+        from ..fluid.regularizer import L2Decay
+        return L2Decay(self.rate)
+
+
+class L1Regularization:
+    def __init__(self, rate):
+        self.rate = rate
+
+    def to_fluid(self):
+        from ..fluid.regularizer import L1Decay
+        return L1Decay(self.rate)
+
+
+# ---------------------------------------------------------------------------
+# LayerOutput
+# ---------------------------------------------------------------------------
+
+class LayerOutput:
+    """A DSL node: the lowered fluid Variable plus v2 metadata. Data layers
+    defer materialization to their first consumer (see module docstring)."""
+
+    def __init__(self, var=None, size=None, hwc=None, is_seq=False,
+                 name=None, data_size=None):
+        self._var = var
+        self.size = size
+        self.hwc = hwc            # (channels, height, width) when image-like
+        self.is_seq = is_seq
+        self.name = name
+        self._data_size = data_size   # pending data layer: declared size
+
+    # ---- lazy data-layer materialization ----
+    @property
+    def is_pending(self):
+        return self._var is None
+
+    def materialize(self, kind="dense"):
+        """kind: dense [-1, size] float | label [-1, 1] int64 |
+        seq_ids [-1, 1] int64 lod 1 | seq_dense [-1, size] float lod 1."""
+        if self._var is not None:
+            return self._var
+        import paddle_tpu.fluid as fluid
+        if kind == "label":
+            self._var = fluid.layers.data(self.name, shape=[1],
+                                          dtype="int64")
+        elif kind == "seq_ids":
+            self._var = fluid.layers.data(self.name, shape=[1],
+                                          dtype="int64", lod_level=1)
+            self.is_seq = True
+        elif kind == "seq_dense":
+            self._var = fluid.layers.data(self.name, shape=[self._data_size],
+                                          lod_level=1)
+            self.is_seq = True
+        else:
+            self._var = fluid.layers.data(self.name,
+                                          shape=[self._data_size])
+        self.size = self._data_size
+        return self._var
+
+    @property
+    def var(self):
+        return self.materialize()
+
+    def __repr__(self):
+        return (f"LayerOutput(name={self.name!r}, size={self.size}, "
+                f"hwc={self.hwc}, seq={self.is_seq}, "
+                f"pending={self.is_pending})")
+
+
+def _unwrap(v, kind="dense"):
+    if isinstance(v, LayerOutput):
+        return v.materialize(kind) if v.is_pending else v.var
+    return v
+
+
+def _img_meta(input, num_channels=None):
+    """(C, H, W) of a layer input, inferring square images from flat sizes
+    (config_parser.py: img_size = sqrt(size / channels) when not given)."""
+    if isinstance(input, LayerOutput) and input.hwc is not None:
+        return input.hwc
+    size = (input.size or input._data_size) \
+        if isinstance(input, LayerOutput) else None
+    if num_channels is None:
+        raise ValueError(
+            "img layer needs num_channels when its input carries no image "
+            "metadata (reference config_parser infers only from a prior "
+            "image layer)")
+    if size is None:
+        raise ValueError("cannot infer image height/width: input size "
+                         "unknown")
+    hw = int(math.isqrt(size // num_channels))
+    if hw * hw * num_channels != size:
+        raise ValueError(
+            f"input size {size} is not a square image of {num_channels} "
+            "channels")
+    return (num_channels, hw, hw)
+
+
+def _as_image_var(input, num_channels=None):
+    """Fluid var reshaped to [-1, C, H, W] + its (C,H,W)."""
+    import paddle_tpu.fluid as fluid
+    c, h, w = _img_meta(input, num_channels)
+    var = _unwrap(input)
+    if var.shape is not None and len(var.shape) == 2:
+        var = fluid.layers.reshape(var, [-1, c, h, w])
+    return var, (c, h, w)
+
+
+def _conv_out(sz, f, p, s, caffe_mode=True):
+    """config_parser.py cnn_output_size: caffe mode floors, legacy pooling
+    mode ceils."""
+    if caffe_mode:
+        return (sz - f + 2 * p) // s + 1
+    return int(math.ceil((sz - f + 2 * p) / s)) + 1
+
+
+def _apply_drop(out_var, layer_attr):
+    import paddle_tpu.fluid as fluid
+    if isinstance(layer_attr, ExtraLayerAttribute) and layer_attr.drop_rate:
+        return fluid.layers.dropout(out_var, layer_attr.drop_rate)
+    return out_var
+
+
+# ---------------------------------------------------------------------------
+# layers
+# ---------------------------------------------------------------------------
+
+def data_layer(name, size, height=None, width=None, **kw):
+    out = LayerOutput(name=name, data_size=size)
+    if height and width:
+        c = size // (height * width)
+        out.hwc = (c, height, width)
+    _DATA_LAYERS.append(out)
+    return out
+
+
+def fc_layer(input, size, act=None, param_attr=None, bias_attr=True,
+             layer_attr=None, name=None):
+    import paddle_tpu.fluid as fluid
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    vars_ = [_unwrap(i) for i in inputs]
+    out = fluid.layers.fc(vars_ if len(vars_) > 1 else vars_[0], size,
+                          act=_act_str(act),
+                          param_attr=_fluid_param_attr(param_attr),
+                          bias_attr=None if bias_attr is True else bias_attr,
+                          name=name)
+    out = _apply_drop(out, layer_attr)
+    is_seq = any(isinstance(i, LayerOutput) and i.is_seq for i in inputs)
+    return LayerOutput(out, size=size, name=name, is_seq=is_seq)
+
+
+def img_conv_layer(input, filter_size, num_filters, name=None,
+                   num_channels=None, stride=1, padding=0, groups=1,
+                   act=None, bias_attr=True, param_attr=None,
+                   layer_attr=None, **kw):
+    import paddle_tpu.fluid as fluid
+    var, (c, h, w) = _as_image_var(input, num_channels)
+    out = fluid.layers.conv2d(
+        var, num_filters=num_filters, filter_size=filter_size,
+        stride=stride, padding=padding, groups=groups, act=_act_str(act),
+        bias_attr=None if bias_attr is True else bias_attr,
+        param_attr=_fluid_param_attr(param_attr), name=name)
+    oh = _conv_out(h, filter_size, padding, stride)
+    ow = _conv_out(w, filter_size, padding, stride)
+    out = _apply_drop(out, layer_attr)
+    return LayerOutput(out, size=num_filters * oh * ow,
+                       hwc=(num_filters, oh, ow), name=name)
+
+
+def img_pool_layer(input, pool_size, name=None, num_channels=None, stride=1,
+                   padding=0, pool_type=None, layer_attr=None, **kw):
+    import paddle_tpu.fluid as fluid
+    var, (c, h, w) = _as_image_var(input, num_channels)
+    ptype = (pool_type or MaxPooling()).pool_type
+    out = fluid.layers.pool2d(var, pool_size=pool_size, pool_type=ptype,
+                              pool_stride=stride, pool_padding=padding,
+                              ceil_mode=True)
+    # legacy pooling uses the ceil output size (config_parser.py
+    # cnn_output_size with caffe_mode=False)
+    oh = _conv_out(h, pool_size, padding, stride, caffe_mode=False)
+    ow = _conv_out(w, pool_size, padding, stride, caffe_mode=False)
+    return LayerOutput(out, size=c * oh * ow, hwc=(c, oh, ow), name=name)
+
+
+def img_cmrnorm_layer(input, size=5, scale=0.0001, power=0.75, name=None,
+                      num_channels=None, **kw):
+    """Cross-map response normalization (reference layers.py
+    img_cmrnorm_layer -> config_parser divides scale by size before the
+    kernel, gserver NormProjectionLayer)."""
+    import paddle_tpu.fluid as fluid
+    var, hwc = _as_image_var(input, num_channels)
+    out = fluid.layers.lrn(var, n=size, k=1.0, alpha=scale / size,
+                           beta=power)
+    lo = LayerOutput(out, size=hwc[0] * hwc[1] * hwc[2], hwc=hwc, name=name)
+    return lo
+
+
+def batch_norm_layer(input, act=None, name=None, num_channels=None,
+                     use_global_stats=None, moving_average_fraction=0.9,
+                     bias_attr=True, param_attr=None, layer_attr=None, **kw):
+    import paddle_tpu.fluid as fluid
+    var, hwc = _as_image_var(input, num_channels)
+    out = fluid.layers.batch_norm(
+        var, act=_act_str(act), is_test=bool(use_global_stats),
+        momentum=moving_average_fraction,
+        param_attr=_fluid_param_attr(param_attr))
+    return LayerOutput(out, size=hwc[0] * hwc[1] * hwc[2], hwc=hwc,
+                       name=name)
+
+
+def addto_layer(input, act=None, name=None, bias_attr=False, **kw):
+    import paddle_tpu.fluid as fluid
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    acc = _unwrap(inputs[0])
+    for other in inputs[1:]:
+        acc = fluid.layers.elementwise_add(acc, _unwrap(other))
+    if _act_str(act):
+        acc = getattr(fluid.layers, _act_str(act))(acc)
+    first = inputs[0]
+    return LayerOutput(acc, size=getattr(first, "size", None),
+                       hwc=getattr(first, "hwc", None), name=name,
+                       is_seq=getattr(first, "is_seq", False))
+
+
+def concat_layer(input, act=None, name=None, **kw):
+    import paddle_tpu.fluid as fluid
+    inputs = list(input)
+    imgs = [i for i in inputs if isinstance(i, LayerOutput)
+            and i.hwc is not None]
+    if len(imgs) == len(inputs):
+        vars_ = [_as_image_var(i)[0] for i in inputs]
+        out = fluid.layers.concat(vars_, axis=1)   # channel concat
+        c = sum(i.hwc[0] for i in inputs)
+        h, w = inputs[0].hwc[1], inputs[0].hwc[2]
+        if _act_str(act):
+            out = getattr(fluid.layers, _act_str(act))(out)
+        return LayerOutput(out, size=c * h * w, hwc=(c, h, w), name=name)
+    vars_ = [_unwrap(i) for i in inputs]
+    out = fluid.layers.concat(vars_, axis=1)
+    if _act_str(act):
+        out = getattr(fluid.layers, _act_str(act))(out)
+    size = sum(i.size for i in inputs if isinstance(i, LayerOutput))
+    return LayerOutput(out, size=size or None, name=name,
+                       is_seq=any(getattr(i, "is_seq", False)
+                                  for i in inputs))
+
+
+def dropout_layer(input, dropout_rate, name=None):
+    import paddle_tpu.fluid as fluid
+    out = fluid.layers.dropout(_unwrap(input), dropout_rate)
+    return LayerOutput(out, size=getattr(input, "size", None),
+                       hwc=getattr(input, "hwc", None), name=name,
+                       is_seq=getattr(input, "is_seq", False))
+
+
+def embedding_layer(input, size, param_attr=None, name=None, **kw):
+    import paddle_tpu.fluid as fluid
+    var = _unwrap(input, kind="seq_ids")
+    vocab = input.size if isinstance(input, LayerOutput) and input.size \
+        else input._data_size
+    out = fluid.layers.embedding(var, size=(vocab, size),
+                                 param_attr=_fluid_param_attr(param_attr))
+    return LayerOutput(out, size=size, is_seq=True, name=name)
+
+
+def lstmemory(input, size=None, reverse=False, act=None,
+              gate_act=None, state_act=None, name=None, param_attr=None,
+              bias_attr=True, **kw):
+    """input must be width 4*size (the reference requires the projection done
+    by a preceding mixed/fc layer, layers.py lstmemory docs)."""
+    import paddle_tpu.fluid as fluid
+    var = _unwrap(input)
+    in_size = input.size if isinstance(input, LayerOutput) else None
+    size = size or (in_size // 4 if in_size else None)
+    hidden, _ = fluid.layers.dynamic_lstm(
+        var, size=size * 4, is_reverse=reverse,
+        gate_activation=_act_str(gate_act) or "sigmoid",
+        cell_activation=_act_str(state_act) or "tanh",
+        candidate_activation=_act_str(act) or "tanh",
+        param_attr=_fluid_param_attr(param_attr))
+    return LayerOutput(hidden, size=size, is_seq=True, name=name)
+
+
+def simple_lstm(input, size, reverse=False, mat_param_attr=None,
+                bias_param_attr=True, inner_param_attr=None, act=None,
+                gate_act=None, state_act=None, name=None, **kw):
+    """networks.py simple_lstm: mixed(4*size, linear) + lstmemory."""
+    proj = fc_layer(input, size * 4, act=LinearActivation(),
+                    param_attr=mat_param_attr, bias_attr=bias_param_attr)
+    return lstmemory(proj, size=size, reverse=reverse, act=act,
+                     gate_act=gate_act, state_act=state_act,
+                     param_attr=inner_param_attr, name=name)
+
+
+def grumemory(input, size=None, reverse=False, act=None, gate_act=None,
+              name=None, param_attr=None, **kw):
+    import paddle_tpu.fluid as fluid
+    var = _unwrap(input)
+    in_size = input.size if isinstance(input, LayerOutput) else None
+    size = size or (in_size // 3 if in_size else None)
+    hidden = fluid.layers.dynamic_gru(
+        var, size=size, is_reverse=reverse,
+        candidate_activation=_act_str(act) or "tanh",
+        gate_activation=_act_str(gate_act) or "sigmoid",
+        param_attr=_fluid_param_attr(param_attr))
+    return LayerOutput(hidden, size=size, is_seq=True, name=name)
+
+
+def simple_gru(input, size, reverse=False, act=None, gate_act=None,
+               name=None, **kw):
+    proj = fc_layer(input, size * 3, act=LinearActivation())
+    return grumemory(proj, size=size, reverse=reverse, act=act,
+                     gate_act=gate_act, name=name)
+
+
+def last_seq(input, name=None, **kw):
+    import paddle_tpu.fluid as fluid
+    out = fluid.layers.sequence_last_step(_unwrap(input))
+    return LayerOutput(out, size=getattr(input, "size", None), name=name)
+
+
+def first_seq(input, name=None, **kw):
+    import paddle_tpu.fluid as fluid
+    out = fluid.layers.sequence_first_step(_unwrap(input))
+    return LayerOutput(out, size=getattr(input, "size", None), name=name)
+
+
+def pooling_layer(input, pooling_type=None, name=None, **kw):
+    import paddle_tpu.fluid as fluid
+    ptype = (pooling_type or MaxPooling()).pool_type
+    out = fluid.layers.sequence_pool(_unwrap(input), ptype)
+    return LayerOutput(out, size=getattr(input, "size", None), name=name)
+
+
+def cross_entropy(input, label, name=None, coeff=1.0, **kw):
+    """Cost over an already-softmaxed input (the reference image configs
+    apply SoftmaxActivation on the last fc, then cross_entropy)."""
+    import paddle_tpu.fluid as fluid
+    lab = _unwrap(label, kind="label")
+    ce = fluid.layers.cross_entropy(_unwrap(input), lab)
+    cost = fluid.layers.mean(ce)
+    if coeff != 1.0:
+        cost = fluid.layers.scale(cost, scale=float(coeff))
+    return LayerOutput(cost, size=1, name=name)
+
+
+def classification_cost(input, label, name=None, **kw):
+    return cross_entropy(input, label, name=name)
+
+
+def regression_cost(input, label, name=None, **kw):
+    import paddle_tpu.fluid as fluid
+    lab = _unwrap(label)
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(_unwrap(input),
+                                                            lab))
+    return LayerOutput(cost, size=1, name=name)
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=None,
+                    stride=1, padding=0, param_attr=None, name=None, **kw):
+    """Reference conv_projection (layers.py) is a projection for
+    concat/mixed layers; under eager fluid lowering a projection IS a conv
+    output, so this is img_conv_layer without activation."""
+    return img_conv_layer(input, filter_size=filter_size,
+                          num_filters=num_filters,
+                          num_channels=num_channels, stride=stride,
+                          padding=padding, param_attr=param_attr,
+                          act=LinearActivation(), name=name)
+
+
+def img_conv_group(input, conv_num_filter, num_channels=None,
+                   pool_size=None, pool_stride=1, pool_type=None,
+                   conv_padding=1, conv_filter_size=3, conv_act=None,
+                   conv_batchnorm_drop_rate=None, conv_with_batchnorm=False,
+                   pool_padding=0, **kw):
+    """networks.py img_conv_group: conv (+optional BN) stack then one pool."""
+    tmp = input
+    n = len(conv_num_filter)
+
+    def per(v, i):
+        return v[i] if isinstance(v, (list, tuple)) else v
+
+    for i, nf in enumerate(conv_num_filter):
+        tmp = img_conv_layer(
+            tmp, filter_size=per(conv_filter_size, i), num_filters=nf,
+            num_channels=num_channels if i == 0 else None,
+            padding=per(conv_padding, i),
+            act=None if per(conv_with_batchnorm, i) else conv_act)
+        if per(conv_with_batchnorm, i):
+            tmp = batch_norm_layer(tmp, act=conv_act)
+            dr = per(conv_batchnorm_drop_rate, i) \
+                if conv_batchnorm_drop_rate else None
+            if dr:
+                tmp = dropout_layer(tmp, dr)
+    return img_pool_layer(tmp, pool_size=pool_size, stride=pool_stride,
+                          padding=pool_padding, pool_type=pool_type)
+
+
+# ---------------------------------------------------------------------------
+# topology extraction
+# ---------------------------------------------------------------------------
+
+class Topology:
+    """What a parsed config yields: the cost var (fluid), data layers in
+    declaration order, and an optimizer built from settings() — everything
+    paddle_tpu.v2.SGD needs."""
+
+    def __init__(self, cost, outputs, data_layers, settings_dict,
+                 data_sources):
+        self.cost = cost
+        self.outputs = outputs
+        self.data_layers = list(data_layers)
+        self.settings = dict(settings_dict)
+        self.data_sources = dict(data_sources)
+
+    @property
+    def feed_order(self):
+        return [d.name for d in self.data_layers if not d.is_pending]
+
+    def create_optimizer(self):
+        import paddle_tpu.fluid as fluid
+        lr = self.settings.get("learning_rate", 1e-3)
+        method = self.settings.get("learning_method")
+        reg = self.settings.get("regularization")
+        reg = reg.to_fluid() if reg is not None else None
+        if method is None:
+            return fluid.optimizer.SGD(learning_rate=lr, regularization=reg)
+        return method.create(lr, regularization=reg)
+
+
+def get_topology():
+    if not _OUTPUTS:
+        raise RuntimeError("config declared no outputs(...)")
+    cost_node = _OUTPUTS[-1]
+    cost = cost_node.var if isinstance(cost_node, LayerOutput) else cost_node
+    return Topology(cost, list(_OUTPUTS), _DATA_LAYERS, _SETTINGS,
+                    _DATA_SOURCES)
+
+
+def parse_config(source, config_args=None, main_program=None,
+                 startup_program=None):
+    """Run a v2 config script (source text or file path) against fresh (or
+    given) fluid programs — the ``paddle train --config=X.py
+    --config_args=...`` entry point. Returns (topology, main, startup)."""
+    import paddle_tpu.fluid as fluid
+    import os
+
+    _reset_config()
+    if config_args:
+        set_config_args(**config_args)
+    if os.path.exists(source):
+        with open(source) as f:
+            source = f.read()
+    # py2-era compatibility shim so reference configs run unedited: the
+    # benchmark configs are python2 (xrange) and import the reference
+    # package name
+    source = source.replace("paddle.trainer_config_helpers",
+                            "paddle_tpu.trainer_config_helpers")
+    source = source.replace("xrange", "range")
+
+    main = main_program or fluid.Program()
+    startup = startup_program or fluid.Program()
+    glb = {"__name__": "__paddle_tpu_config__"}
+    exec("from paddle_tpu.trainer_config_helpers import *", glb)
+    with fluid.program_guard(main, startup):
+        exec(compile(source, "<v2-config>", "exec"), glb)
+        topo = get_topology()
+    return topo, main, startup

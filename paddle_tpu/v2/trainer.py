@@ -35,15 +35,42 @@ class SGD:
     names (the reference's ``feeding``).
     """
 
-    def __init__(self, cost, optimizer, feed_order, metrics=None,
-                 place=None, main_program=None, startup_program=None):
+    def __init__(self, cost, optimizer=None, feed_order=None, metrics=None,
+                 place=None, main_program=None, startup_program=None,
+                 parameters=None, update_equation=None):
         import paddle_tpu.fluid as fluid
         from paddle_tpu.fluid.framework import (default_main_program,
                                                 default_startup_program)
+        from .config_helpers import LayerOutput, _DATA_LAYERS
+
+        # v2 calling form: SGD(cost=layer_out, parameters=...,
+        # update_equation=paddle.v2.optimizer.Momentum(...)) — reference
+        # v2/trainer.py:48. `parameters` (paddle.parameters.create) is
+        # accepted for API parity; fluid startup initialization owns the
+        # actual parameter creation.
+        if isinstance(cost, LayerOutput):
+            cost = cost.var
+        if update_equation is not None and optimizer is None:
+            optimizer = update_equation.to_fluid() \
+                if hasattr(update_equation, "to_fluid") else update_equation
+        if optimizer is None:
+            raise ValueError("SGD needs optimizer= or update_equation=")
+        del parameters  # parity arg; fluid scope owns parameter storage
 
         self._cost = cost
         self._main = main_program or default_main_program()
         self._startup = startup_program or default_startup_program()
+        if feed_order is None:
+            # default to the v2 data layers declared IN THIS PROGRAM, in
+            # declaration order (the reference derives feeding from the
+            # topology's data layers, v2/trainer.py data_feeder setup)
+            block = self._main.global_block()
+            feed_order = list(dict.fromkeys(
+                d.name for d in _DATA_LAYERS
+                if not d.is_pending and block.has_var(d.name)))
+            if not feed_order:
+                raise ValueError(
+                    "feed_order not given and no v2 data layers declared")
         self._feed_order = list(feed_order)
         self._metrics = dict(metrics or {})
         self._exe = fluid.Executor(place)

@@ -1,67 +1,70 @@
 """Book chapter: label_semantic_roles (SRL with linear-chain CRF).
 
 Reference: /root/reference/python/paddle/fluid/tests/book/
-test_label_semantic_roles.py — word + predicate + context-mark embeddings
-(is_sparse) into a mixed hidden layer and stacked bidirectional-ish LSTMs,
-trained with linear_chain_crf NLL and decoded with crf_decoding (viterbi).
-The conll05 corpus stands in as a synthetic taggable task: each token's
-IOB tag is a deterministic function of (word class, predicate, position
-parity) plus noise, which a CRF over LSTM features learns in seconds.
-Decoded tags are scored with the ChunkEvaluator (IOB), like the
-reference's chunk_eval pipeline.
+test_label_semantic_roles.py — word + predicate + context + mark embeddings
+(is_sparse) into a mixed hidden layer and LSTM features, trained with
+linear_chain_crf NLL and decoded with crf_decoding (viterbi) — fed from
+the conll05 dataset module (paddle_tpu.dataset.conll05 mirrors
+python/paddle/v2/dataset/conll05.py's 9-slot sample; its synthetic fallback
+has grammar-like BIO role structure around each verb). Decoded tags are
+scored with chunk F1 (IOB), like the reference's chunk_eval pipeline.
 """
 
 import numpy as np
 
 import paddle_tpu.fluid as fluid
+import paddle_tpu.dataset as dataset
 from paddle_tpu.ops.metrics import extract_chunks
 
 layers = fluid.layers
 
-WORD_DICT = 30
-PRED_DICT = 6
-LABEL_TYPES = 2                  # chunk types -> 2*2+1 IOB tags
-NUM_TAGS = LABEL_TYPES * 2 + 1   # B0 I0 B1 I1 O
+WORD_DICT, VERB_DICT, LABEL_DICT = dataset.conll05.get_dict()
+NUM_TAGS = len(LABEL_DICT)
+LABEL_TYPES = (NUM_TAGS - 1) // 2        # IOB int scheme, O last
 EMB, HID = 16, 24
 BATCH = 12
 
 
-def _synthetic_batch(rng, batch=BATCH):
-    """Tokens tagged by a learnable rule: word class w%3==0 starts a chunk
-    of type (pred % 2); a following w%3==1 continues it; else Outside."""
-    words, preds, labels = [], [], []
-    for _ in range(batch):
-        ln = int(rng.randint(4, 9))
-        w = rng.randint(0, WORD_DICT, ln)
-        p = int(rng.randint(0, PRED_DICT))
-        tags = []
-        prev_in = False
-        for t in w:
-            if t % 3 == 0:
-                tags.append((p % 2) * 2)          # B of type p%2
-                prev_in = True
-            elif t % 3 == 1 and prev_in:
-                tags.append(tags[-1] // 2 * 2 + 1)  # I, same type
-            else:
-                tags.append(NUM_TAGS - 1)         # Outside
-                prev_in = False
-        words.append(w.reshape(-1, 1).astype("int64"))
-        preds.append(np.full((ln, 1), p, "int64"))
-        labels.append(np.array(tags, "int64").reshape(-1, 1))
-    return words, preds, labels
+def _batches(reader, batch=BATCH):
+    """conll05 9-slot samples -> feed lists (word, ctx_0, pred, mark,
+    label). The model embeds the subset of slots it uses; all slots have
+    per-token alignment."""
+    buf = []
+    for s in reader():
+        buf.append(s)
+        if len(buf) == batch:
+            yield buf
+            buf = []
+
+
+def _feed_from(samples):
+    def col(i, dtype="int64"):
+        return [np.asarray(s[i], dtype).reshape(-1, 1) for s in samples]
+
+    return {"word": col(0), "ctx_0": col(3), "pred": col(6),
+            "mark": col(7), "label": col(8)}
 
 
 def _build_train():
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         word = layers.data("word", shape=[1], dtype="int64", lod_level=1)
+        ctx0 = layers.data("ctx_0", shape=[1], dtype="int64", lod_level=1)
         pred = layers.data("pred", shape=[1], dtype="int64", lod_level=1)
+        mark = layers.data("mark", shape=[1], dtype="int64", lod_level=1)
         label = layers.data("label", shape=[1], dtype="int64", lod_level=1)
-        w_emb = layers.embedding(word, size=[WORD_DICT, EMB], is_sparse=True,
+        w_emb = layers.embedding(word, size=[len(WORD_DICT), EMB],
+                                 is_sparse=True,
                                  param_attr=fluid.ParamAttr(name="word_emb"))
-        p_emb = layers.embedding(pred, size=[PRED_DICT, EMB], is_sparse=True,
+        c_emb = layers.embedding(ctx0, size=[len(WORD_DICT), EMB],
+                                 is_sparse=True,
+                                 param_attr=fluid.ParamAttr(name="ctx_emb"))
+        p_emb = layers.embedding(pred, size=[len(VERB_DICT), EMB],
+                                 is_sparse=True,
                                  param_attr=fluid.ParamAttr(name="pred_emb"))
-        mix = layers.fc(layers.concat([w_emb, p_emb], axis=-1),
+        m_emb = layers.embedding(mark, size=[2, EMB], is_sparse=True,
+                                 param_attr=fluid.ParamAttr(name="mark_emb"))
+        mix = layers.fc(layers.concat([w_emb, c_emb, p_emb, m_emb], axis=-1),
                         size=HID, act="tanh",
                         param_attr=fluid.ParamAttr(name="mix_w"))
         lstm_in = layers.fc(mix, size=HID * 4,
@@ -92,26 +95,27 @@ def test_label_semantic_roles_converges_and_decodes():
     main, startup, avg_cost, decode, label_var = _build_train()
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)                      # global scope, like the reference
-    rng = np.random.RandomState(0)
 
     first = last = None
-    for step in range(120):
-        words, preds, labels = _synthetic_batch(rng)
-        feed = {"word": words, "pred": preds, "label": labels}
-        cost, = exe.run(main, feed=feed, fetch_list=[avg_cost])
-        if first is None:
-            first = float(cost)
-        last = float(cost)
+    for epoch in range(6):
+        for samples in _batches(dataset.conll05.train()):
+            cost, = exe.run(main, feed=_feed_from(samples),
+                            fetch_list=[avg_cost])
+            if first is None:
+                first = float(cost)
+            last = float(cost)
+        if last < 0.35 * first:
+            break
     assert last < 0.35 * first, (first, last)
 
-    # viterbi decode + chunk F1 on fresh data (the reference evaluates with
-    # chunk_eval over crf_decoding output)
-    words, preds, labels = _synthetic_batch(rng)
-    out = exe.run(main, feed={"word": words, "pred": preds,
-                              "label": labels}, fetch_list=[decode],
-                  )[0]
+    # viterbi decode + chunk F1 on the held-out split (the reference
+    # evaluates with chunk_eval over crf_decoding output)
+    samples = next(_batches(dataset.conll05.test()))
+    feed = _feed_from(samples)
+    out = exe.run(main, feed=feed, fetch_list=[decode])[0]
     path = np.asarray(out.data).reshape(out.data.shape[0], -1)
     lens = np.asarray(out.lens)
+    labels = feed["label"]
     n_inf = n_lab = n_cor = 0
     for i in range(len(lens)):
         inf = extract_chunks(path[i, :lens[i]], "IOB", LABEL_TYPES)
@@ -128,11 +132,12 @@ def test_label_semantic_roles_converges_and_decodes():
     import tempfile
     from paddle_tpu.core.scope import reset_global_scope
     d = tempfile.mkdtemp()
-    fluid.io.save_inference_model(d, ["word", "pred"], [decode], exe,
-                                  main_program=main)
+    fluid.io.save_inference_model(d, ["word", "ctx_0", "pred", "mark"],
+                                  [decode], exe, main_program=main)
     reset_global_scope()
     prog2, feeds2, fetches2 = fluid.io.load_inference_model(d, exe)
-    out2 = exe.run(prog2, feed={"word": words, "pred": preds},
+    out2 = exe.run(prog2, feed={k: feed[k] for k in
+                                ("word", "ctx_0", "pred", "mark")},
                    fetch_list=fetches2)[0]
     np.testing.assert_array_equal(np.asarray(out2.data),
                                   np.asarray(out.data))

@@ -4,15 +4,23 @@ Reference: /root/reference/python/paddle/fluid/tests/book/
 test_recommender_system.py — user tower (id/gender/age/job embeddings → fc)
 and movie tower (id embedding + ragged category pooled + ragged title via
 sequence_conv_pool) combined with cos_sim, trained with square error against
-the rating. Synthetic preference structure stands in for movielens.
+the rating — fed from the movielens dataset module (paddle_tpu.dataset.
+movielens mirrors python/paddle/v2/dataset/movielens.py; its synthetic
+fallback carries the same low-rank preference structure and schema).
 """
 
 import numpy as np
 
 import paddle_tpu.fluid as fluid
+import paddle_tpu.dataset as dataset
 
-USER_CT, GENDER_CT, AGE_CT, JOB_CT = 30, 2, 7, 10
-MOVIE_CT, CATEGORY_CT, TITLE_DICT = 40, 8, 50
+ml = dataset.movielens
+USER_CT = ml.max_user_id() + 1
+GENDER_CT, AGE_CT = 2, 7
+JOB_CT = ml.max_job_id() + 1
+MOVIE_CT = ml.max_movie_id() + 1
+CATEGORY_CT = len(ml.movie_categories())
+TITLE_DICT = len(ml.get_movie_title_dict())
 
 
 def get_usr_combined_features():
@@ -60,19 +68,18 @@ def get_mov_combined_features():
     return fluid.layers.fc(concat, size=32, act="tanh")
 
 
-def _synthetic_interactions(n=512, seed=9):
-    rng = np.random.RandomState(seed)
-    u_vec = rng.normal(0, 1, (USER_CT, 4))
-    m_vec = rng.normal(0, 1, (MOVIE_CT, 4))
+def _interactions(n=512):
+    """movielens samples [uid, gender, age, job, mid, cats, title, [score]]
+    reshaped into the feed rows (reference book test's feeder order)."""
     rows = []
-    for _ in range(n):
-        u, m = rng.randint(USER_CT), rng.randint(MOVIE_CT)
-        score = 2.5 + 2.5 * np.tanh(u_vec[u] @ m_vec[m])
-        rows.append((u, rng.randint(GENDER_CT), rng.randint(AGE_CT),
-                     rng.randint(JOB_CT), m,
-                     rng.randint(0, CATEGORY_CT, rng.randint(1, 4)),
-                     rng.randint(0, TITLE_DICT, rng.randint(2, 6)),
-                     score))
+    for s in ml.train()():
+        uid, gender, age, job, mid, cats, title, rating = s
+        rows.append((uid, gender, age, job, mid,
+                     np.asarray(cats or [0], dtype="int64"),
+                     np.asarray(title or [0], dtype="int64"),
+                     float(rating[0])))
+        if len(rows) >= n:
+            break
     return rows
 
 
@@ -92,7 +99,7 @@ def test_recommender_converges():
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
 
-    rows = _synthetic_interactions()
+    rows = _interactions()
     batch = 64
     first, last = None, None
     for epoch in range(12):

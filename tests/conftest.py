@@ -31,5 +31,7 @@ def _fresh_programs():
     framework.switch_startup_program(framework.Program())
     framework.reset_unique_name()
     scope_mod.reset_global_scope()
+    from paddle_tpu.v2 import config_helpers
+    config_helpers._reset_config()
     np.random.seed(123)
     yield

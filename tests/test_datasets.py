@@ -1,0 +1,140 @@
+"""Dataset-module schema tests: every reader yields the reference's exact
+sample structure (python/paddle/v2/dataset/*), synthetic fallback or real
+files alike.
+
+Reference tests: python/paddle/v2/dataset/tests/*_test.py.
+"""
+
+import numpy as np
+
+import paddle_tpu.dataset as dataset
+
+
+def _take(reader, n):
+    out = []
+    for i, s in enumerate(reader()):
+        if i >= n:
+            break
+        out.append(s)
+    assert out, "reader yielded nothing"
+    return out
+
+
+def test_dataset_all_matches_reference():
+    ref_all = ["mnist", "imikolov", "imdb", "cifar", "movielens", "conll05",
+               "sentiment", "uci_housing", "wmt14", "wmt16", "mq2007",
+               "flowers", "voc2012", "common"]
+    assert set(dataset.__all__) == set(ref_all)
+
+
+def test_imikolov_ngram_and_seq():
+    word_idx = dataset.imikolov.build_dict()
+    assert "<unk>" in word_idx and "<s>" in word_idx and "<e>" in word_idx
+    for gram in _take(dataset.imikolov.train(word_idx, 5), 20):
+        assert len(gram) == 5
+        assert all(0 <= g < len(word_idx) for g in gram)
+    for src, trg in _take(
+            dataset.imikolov.test(word_idx, -1,
+                                  dataset.imikolov.DataType.SEQ), 10):
+        assert len(src) == len(trg)
+        assert src[0] == word_idx["<s>"] and trg[-1] == word_idx["<e>"]
+
+
+def test_movielens_schema():
+    samples = _take(dataset.movielens.train(), 20)
+    max_user = dataset.movielens.max_user_id()
+    max_movie = dataset.movielens.max_movie_id()
+    n_cat = len(dataset.movielens.movie_categories())
+    n_title = len(dataset.movielens.get_movie_title_dict())
+    for s in samples:
+        uid, gender, age, job, mid, cats, title, rating = s
+        assert 1 <= uid <= max_user and 1 <= mid <= max_movie
+        assert gender in (0, 1) and 0 <= age < 7
+        assert 0 <= job <= dataset.movielens.max_job_id()
+        assert all(0 <= c < n_cat for c in cats)
+        assert all(0 <= t < n_title for t in title)
+        assert -5.0 <= rating[0] <= 5.0
+    # train/test split is disjoint-ish and deterministic
+    t1 = _take(dataset.movielens.test(), 5)
+    t2 = _take(dataset.movielens.test(), 5)
+    assert all((a[0], a[4]) == (b[0], b[4]) for a, b in zip(t1, t2))
+
+
+def test_conll05_schema():
+    word_dict, verb_dict, label_dict = dataset.conll05.get_dict()
+    emb = dataset.conll05.get_embedding()
+    assert emb.shape[0] == len(word_dict)
+    for s in _take(dataset.conll05.test(), 15):
+        assert len(s) == 9
+        word, cn2, cn1, c0, cp1, cp2, pred, mark, label = s
+        n = len(word)
+        for seq in (cn2, cn1, c0, cp1, cp2, pred, mark, label):
+            assert len(seq) == n
+        assert set(mark) <= {0, 1} and 1 in mark
+        # context slots repeat one word id across the sentence
+        assert len(set(cn2)) == 1 and len(set(pred)) == 1
+        assert all(0 <= l < len(label_dict) for l in label)
+
+
+def test_flowers_schema():
+    for img, label in _take(dataset.flowers.train(), 3):
+        assert img.shape == (3, 224, 224) and img.dtype == np.float32
+        assert 0.0 <= img.min() and img.max() <= 1.0
+        assert 0 <= label < 102
+    assert len(_take(dataset.flowers.valid(), 3)) == 3
+
+
+def test_voc2012_schema():
+    for img, label in _take(dataset.voc2012.train(), 3):
+        assert img.ndim == 3 and img.shape[2] == 3 and img.dtype == np.uint8
+        assert label.shape == img.shape[:2] and label.dtype == np.uint8
+        assert label.max() <= 21 or label.max() == 255
+
+
+def _check_nmt_triple(src, trg, trg_next, dict_size):
+    assert src[0] == 0 and src[-1] == 1          # <s> ... <e>
+    assert trg[0] == 0                            # <s> prefix
+    assert trg_next[-1] == 1                      # <e> suffix
+    assert trg[1:] == trg_next[:-1]               # shifted pair
+    assert all(0 <= t < dict_size for t in src + trg + trg_next)
+
+
+def test_wmt14_schema():
+    dict_size = 40
+    for src, trg, trg_next in _take(dataset.wmt14.train(dict_size), 15):
+        _check_nmt_triple(src, trg, trg_next, dict_size)
+    src_d, trg_d = dataset.wmt14.get_dict(dict_size, reverse=False)
+    assert src_d["<s>"] == 0 and trg_d["<e>"] == 1
+
+
+def test_wmt16_schema():
+    for src, trg, trg_next in _take(dataset.wmt16.train(40, 40), 15):
+        _check_nmt_triple(src, trg, trg_next, 40)
+    d = dataset.wmt16.get_dict("en", 40)
+    assert d["<s>"] == 0 and d["<unk>"] == 2
+    assert len(_take(dataset.wmt16.validation(40, 40), 3)) == 3
+
+
+def test_mq2007_formats():
+    for rel, feat in _take(dataset.mq2007.train(format="pointwise"), 10):
+        assert feat.shape == (46,)
+        assert rel in (0, 1, 2)
+    for label, better, worse in _take(dataset.mq2007.train(
+            format="pairwise"), 10):
+        assert label[0] == 1.0
+        assert better.shape == worse.shape == (46,)
+    for scores, feats in _take(dataset.mq2007.test(format="listwise"), 4):
+        assert feats.shape == (len(scores), 46)
+    # pairwise samples are genuinely ordered under the synthetic rule
+    pairs = _take(dataset.mq2007.train(format="pairwise"), 40)
+    assert len(pairs) >= 20
+
+
+def test_sentiment_schema():
+    wd = dataset.sentiment.get_word_dict()
+    train = _take(dataset.sentiment.train(), 20)
+    test = _take(dataset.sentiment.test(), 20)
+    labels = {l for _, l in train + test}
+    assert labels == {0, 1}
+    for ids, label in train:
+        assert all(0 <= i < len(wd) for i in ids)

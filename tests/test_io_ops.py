@@ -1,0 +1,197 @@
+"""Op-form IO/runtime tests: fill, save/load(_combine), delete_var,
+get_places, lod_array_length, read, channel ops, go.
+
+Reference tests: test_fill_op.py, operators/save_load_op_test.cc,
+save_load_combine_op_test.cc, test_lod_array_length_op.py,
+framework/channel_test.cc, test_get_places_op.py
+(python/paddle/fluid/tests/unittests/).
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+layers = fluid.layers
+
+
+def _block(main):
+    return main.global_block()
+
+
+def test_fill_op():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        b = _block(main)
+        b.create_var(name="out")
+        b.append_op("fill", {}, {"Out": ["out"]},
+                    {"shape": [2, 3], "dtype": "float32",
+                     "data": [1, 2, 3, 4, 5, 6]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(main, fetch_list=["out"])
+    np.testing.assert_allclose(got, [[1, 2, 3], [4, 5, 6]])
+
+
+def test_save_load_roundtrip():
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "w.npy")
+    val = np.arange(12, dtype="float32").reshape(3, 4)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        b = _block(main)
+        b.append_op("save", {"X": ["x"]}, {}, {"file_path": path})
+        b.create_var(name="loaded")
+        b.append_op("load", {}, {"Out": ["loaded"]}, {"file_path": path})
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    got, = exe.run(main, feed={"x": val}, fetch_list=["loaded"],
+                   use_program_cache=False)
+    np.testing.assert_allclose(got, val)
+
+
+def test_save_load_combine_order():
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "all.npy")
+    a = np.ones((2, 2), "float32")
+    b_val = np.full((3,), 7.0, "float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xa = layers.data("a", shape=[2])
+        xb = layers.data("b", shape=[3], append_batch_size=False)
+        blk = _block(main)
+        blk.append_op("save_combine", {"X": ["a", "b"]}, {},
+                      {"file_path": path})
+        blk.create_var(name="la")
+        blk.create_var(name="lb")
+        blk.append_op("load_combine", {}, {"Out": ["la", "lb"]},
+                      {"file_path": path})
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    ga, gb = exe.run(main, feed={"a": a, "b": b_val},
+                     fetch_list=["la", "lb"], use_program_cache=False)
+    np.testing.assert_allclose(ga, a)
+    np.testing.assert_allclose(gb, b_val)
+
+
+def test_delete_var_and_get_places():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2])
+        y = layers.scale(x, scale=3.0)
+        b = _block(main)
+        b.append_op("delete_var", {"X": ["x"]}, {}, {})
+        b.create_var(name="places")
+        b.append_op("get_places", {}, {"Out": ["places"]},
+                    {"device_type": "CPU"})
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    got = exe.run(main, feed={"x": np.ones((1, 2), "float32")},
+                  fetch_list=[y, "places"], return_numpy=False,
+                  use_program_cache=False)
+    np.testing.assert_allclose(np.asarray(got[0]), [[3.0, 3.0]])
+    assert len(got[1]) >= 1  # device list
+
+
+def test_lod_array_length():
+    from paddle_tpu.ops.control_flow_ops import TensorArrayVal
+    import jax.numpy as jnp
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        b = _block(main)
+        b.create_var(name="arr")
+        b.create_var(name="n")
+        b.append_op("lod_array_length", {"X": ["arr"]}, {"Out": ["n"]}, {})
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    scope = fluid.Scope()
+    scope.set("arr", TensorArrayVal(jnp.zeros((8, 2)),
+                                    jnp.asarray(5, jnp.int32)))
+    got, = exe.run(main, fetch_list=["n"], scope=scope,
+                   use_program_cache=False)
+    assert int(got[0]) == 5
+
+
+def test_read_op_pops_reader():
+    batches = [(np.full((2, 3), i, "float32"),
+                np.full((2, 1), i, "int64")) for i in range(3)]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        b = _block(main)
+        b.create_var(name="r")
+        b.create_var(name="img")
+        b.create_var(name="lbl")
+        b.append_op("read", {"Reader": ["r"]}, {"Out": ["img", "lbl"]}, {})
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    scope = fluid.Scope()
+    # a READER variable is a live iterator in the scope (the reference keeps
+    # a ReaderHolder in the scope the same way, framework/reader.h:68); the
+    # read op advances it in place across runs
+    scope.set("r", iter(batches))
+    for i in range(3):
+        img, lbl = exe.run(main, fetch_list=["img", "lbl"], scope=scope,
+                           use_program_cache=False)
+        np.testing.assert_allclose(img, batches[i][0])
+    try:
+        exe.run(main, fetch_list=["img"], scope=scope,
+                use_program_cache=False)
+        assert False, "expected StopIteration at end of data"
+    except StopIteration:
+        pass
+
+
+def test_channel_ops_and_go_producer_consumer():
+    """CSP through the op forms: a go sub-block sends, the main block
+    receives (reference framework/concurrency_test.cc shape)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        b = _block(main)
+        b.create_var(name="ch")
+        b.append_op("channel_create", {}, {"Out": ["ch"]}, {"capacity": 2})
+        # sub-block for go: sends x into ch
+        sub = main.create_block()
+        sub.append_op("channel_send", {"Channel": ["ch"], "X": ["x"]}, {}, {})
+        main.rollback()
+        b.create_var(name="t")
+        b.append_op("go", {}, {"Out": ["t"]}, {"sub_block": sub.idx})
+        b.create_var(name="got")
+        b.create_var(name="ok")
+        b.append_op("channel_recv", {"Channel": ["ch"]},
+                    {"Out": ["got"], "Status": ["ok"]}, {})
+        b.append_op("channel_close", {"Channel": ["ch"]}, {}, {})
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    x = np.array([[9.0, 8.0]], "float32")
+    blk = main.global_block()
+    blk.create_var(name="x", shape=[1, 2], dtype="float32", is_data=True)
+    got, ok = exe.run(main, feed={"x": x}, fetch_list=["got", "ok"],
+                      return_numpy=False, use_program_cache=False)
+    np.testing.assert_allclose(np.asarray(got), x)
+    assert bool(np.asarray(ok))
+
+
+def test_save_load_combine_same_shapes():
+    """Same-shaped tensors must round-trip (regression: a naive object
+    np.asarray collapses equal shapes into one deep array)."""
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "same.npy")
+    a = np.arange(12, dtype="float32").reshape(3, 4)
+    b_val = a * 2 + 1
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        layers.data("a", shape=[4])
+        layers.data("b", shape=[4])
+        blk = _block(main)
+        blk.append_op("save_combine", {"X": ["a", "b"]}, {},
+                      {"file_path": path})
+        blk.create_var(name="la")
+        blk.create_var(name="lb")
+        blk.append_op("load_combine", {}, {"Out": ["la", "lb"]},
+                      {"file_path": path})
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    ga, gb = exe.run(main, feed={"a": a, "b": b_val},
+                     fetch_list=["la", "lb"], use_program_cache=False)
+    np.testing.assert_allclose(ga, a)
+    np.testing.assert_allclose(gb, b_val)

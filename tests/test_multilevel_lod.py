@@ -266,3 +266,76 @@ def test_sequence_expand_ref_level0_3level():
     np.testing.assert_allclose(np.asarray(got)[:, 0], [1, 1, 1, 2, 2])
     # d(mean)/dx: each of 5 rows x 2 cols contributes 1/10
     np.testing.assert_allclose(np.asarray(dx), [[0.3, 0.3], [0.2, 0.2]])
+
+
+# ---------------------------------------------------------------------------
+# variable-width LoD beam search (reference beam_search_op.cc; the ported
+# case is operators/beam_search_op_test.cc verbatim)
+# ---------------------------------------------------------------------------
+
+def _run_lod_beam(cand_ids, cand_scores, outer, pre_ids, beam, end_id):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        b = main.global_block()
+        for n in ("ids", "scores"):
+            b.create_var(name=n, lod_level=2)
+        b.create_var(name="pre_ids", shape=[-1, 1], dtype="int64",
+                     is_data=True)
+        for n in ("sel_ids", "sel_scores"):
+            b.create_var(name=n, lod_level=2)
+        b.append_op("beam_search",
+                    {"pre_ids": ["pre_ids"], "ids": ["ids"],
+                     "scores": ["scores"]},
+                    {"selected_ids": ["sel_ids"],
+                     "selected_scores": ["sel_scores"]},
+                    {"beam_size": beam, "end_id": end_id, "level": 0})
+    k = cand_ids.shape[1]
+    ids_arr = LoDArray(jnp.asarray(cand_ids[:, :, None]),
+                       jnp.full((len(cand_ids),), k, jnp.int32),
+                       jnp.asarray(outer))
+    sc_arr = LoDArray(jnp.asarray(cand_scores[:, :, None]),
+                      jnp.full((len(cand_ids),), k, jnp.int32),
+                      jnp.asarray(outer))
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    scope = fluid.Scope()
+    scope.set("ids", ids_arr)
+    scope.set("scores", sc_arr)
+    got_ids, got_scores = exe.run(
+        main, feed={"pre_ids": pre_ids.reshape(-1, 1)},
+        fetch_list=["sel_ids", "sel_scores"], scope=scope,
+        use_program_cache=False)
+    return got_ids, got_scores
+
+
+def test_beam_search_lod_reference_case():
+    """operators/beam_search_op_test.cc: 2 sources with [1, 3] prefixes,
+    K=3 candidates each, beam 2 -> data [2,4,3,8], scores [.3,.5,.9,.7],
+    level1 widths [2,0,1,1] (prefix 1 retires: none of its candidates make
+    the source's top-2)."""
+    cand_ids = np.array([[4, 2, 5], [2, 1, 3], [3, 5, 2], [8, 2, 1]],
+                        "int64")
+    cand_scores = np.array([[0.5, 0.3, 0.2], [0.6, 0.3, 0.1],
+                            [0.9, 0.5, 0.1], [0.7, 0.5, 0.1]], "float32")
+    pre_ids = np.array([1, 2, 3, 4], "int64")
+    got_ids, got_scores = _run_lod_beam(cand_ids, cand_scores, [1, 3],
+                                        pre_ids, beam=2, end_id=0)
+    flat, lod = lodarray_to_flat(got_ids)
+    np.testing.assert_array_equal(flat.reshape(-1), [2, 4, 3, 8])
+    sflat, slod = lodarray_to_flat(got_scores)
+    np.testing.assert_allclose(sflat.reshape(-1), [0.3, 0.5, 0.9, 0.7])
+    assert lod == slod == [[0, 1, 4], [0, 2, 2, 3, 4]]
+
+
+def test_beam_search_lod_finished_prefix_leaves_beam():
+    """A prefix whose pre_id == end_id contributes nothing, shrinking the
+    live beam (beam_search_op.cc PruneEndidCandidates)."""
+    cand_ids = np.array([[4, 2], [9, 7]], "int64")
+    cand_scores = np.array([[0.9, 0.8], [0.95, 0.7]], "float32")
+    pre_ids = np.array([1, 0], "int64")     # second prefix finished (end=0)
+    got_ids, _ = _run_lod_beam(cand_ids, cand_scores, [2], pre_ids,
+                               beam=3, end_id=0)
+    flat, lod = lodarray_to_flat(got_ids)
+    # top-3 across both prefixes = 9(.95), 4(.9), 2(.8); prefix 1's 9 is
+    # then pruned -> only prefix 0's [2, 4] remain (id-ascending)
+    np.testing.assert_array_equal(flat.reshape(-1), [2, 4])
+    assert lod == [[0, 2], [0, 2, 2]]

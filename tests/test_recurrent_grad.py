@@ -253,15 +253,58 @@ def test_while_training_loop():
         np.testing.assert_allclose(g.flat[idx], num, rtol=5e-2, atol=1e-4)
 
 
-def test_while_without_max_iters_raises_on_backward():
+def test_while_without_max_iters_trains_via_derived_bound():
+    """The canonical counter loop (fill_constant init/limit + increment +
+    less_than) needs no explicit max_iters: while_grad derives the bound
+    statically (reference while_grad is unbounded, while_op.cc:35 — here
+    the bound becomes a masked-scan length)."""
+    batch, T, hid = 4, 3, 2
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3])
+        y = layers.data("y", shape=[hid])
+        i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+        limit = layers.fill_constant(shape=[1], dtype="int64", value=T)
+        acc = layers.fill_constant(shape=[batch, hid], dtype="float32",
+                                   value=0.0)
+        cond = layers.less_than(i, limit)
+        w = fluid.layers.While(cond)   # no max_iters: derived
+        with w.block():
+            h = layers.fc(x, size=hid, act="tanh",
+                          param_attr=fluid.ParamAttr(name="dw"))
+            layers.assign(layers.elementwise_add(acc, h), output=acc)
+            layers.increment(i, value=1)
+            layers.less_than(i, limit, cond=cond)
+        loss = layers.mean(layers.square(layers.elementwise_sub(acc, y)))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss, startup)
+    # the derived bound lands on the while_grad op
+    grads = [op for op in main.global_block().ops if op.type == "while_grad"]
+    assert grads and int(grads[0].attrs["max_iters"]) == T
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(3)
+    feed = {"x": rng.normal(0, 1, (batch, 3)).astype("float32"),
+            "y": rng.normal(0, 1, (batch, hid)).astype("float32")}
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss],
+                            scope=scope)[0]) for _ in range(40)]
+    assert losses[-1] < 0.3 * losses[0], losses[::8]
+
+
+def test_while_underivable_bound_raises_on_backward():
+    """A limit that is not a build-time constant (fed at runtime) still
+    raises the explicit-bound error."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         x = layers.data("x", shape=[3])
+        limit = layers.data("limit", shape=[1], dtype="int64",
+                            append_batch_size=False)
         i = layers.fill_constant(shape=[1], dtype="int64", value=0)
-        limit = layers.fill_constant(shape=[1], dtype="int64", value=3)
         acc = layers.fill_constant(shape=[4, 2], dtype="float32", value=0.0)
         cond = layers.less_than(i, limit)
-        w = fluid.layers.While(cond)   # no max_iters
+        w = fluid.layers.While(cond)   # no max_iters, dynamic limit
         with w.block():
             h = layers.fc(x, size=2, act="tanh")
             layers.assign(layers.elementwise_add(acc, h), output=acc)
