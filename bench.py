@@ -7,22 +7,30 @@ images/sec/chip. The whole training step (forward + IR-autodiff backward +
 momentum update) compiles to one XLA computation; matmuls/convs run through
 the MXU in bfloat16 (mixed precision: fp32 params, bf16 compute).
 
-Roofline status (v5e single chip, measured round 3): ~2546 img/s at bs256
-= ~100.5 ms/step. The compiled step accesses ~79 GB of HBM per step
-(XLA cost analysis), which at the chip's ~819 GB/s is ~96 ms — the step is
-HBM-BANDWIDTH-BOUND at ~93% of peak, with FLOPs at only ~30% of the MXU
-(59/197 TFLOPs). Byte attribution: conv fwd+bwd IO ~45 GB, batch-norm
-reads ~22 GB, residual adds ~8 GB — all intrinsic to the ResNet-50 bs256
-bf16 dataflow (activations dominate; the stem is only ~1.3 ms). Measured
-and REJECTED as regressions or no-ops: run_steps scan (parity — dispatch
-already overlaps), bs384/512 (slower), single-pass variadic BN reductions
-(slower: XLA's specialized column-reduce emitter only fires for plain
-monoid reduces), shifted-compare maxpool gradient (slower than
-select_and_scatter), scoped-vmem 96/112 MiB via compiler_options (slower).
-Banked: 96-step readback amortization (+83 img/s), NHWC end-to-end, AMP,
-donation, device-resident bf16 feeds.
+Roofline status (v5e single chip, re-measured round 4): ~2545 img/s at
+bs256 = ~100.5 ms/step. Round-4 decomposition (tools/bench_variants.py,
+tools/hlo_report.py): fwd-only 33.4 ms, BN-frozen 88.0 ms, BN-removed
+75.6 ms — batch statistics cost ~17 ms/step and BN ~29 ms total. The
+optimized HLO shows XLA already fuses BOTH BN stat reductions AND the
+previous layer's normalize+relu INTO the conv kernels (one
+convert_reduce_fusion per layer reads the conv input once, emits conv
+output + two f32 moments), so the dataflow is structurally near-minimal
+for train-mode BN; the ~79 GB cost-analysis figure overcounts conv
+operand bytes vs actual post-fusion traffic (static sum over the fusion
+graph is ~37 GB), meaning the step sits between the bandwidth floor
+(~45 ms) and measured 100 ms mostly on conv/VPU efficiency at these
+shapes, not on removable passes. Measured and REJECTED in round 4:
+auto_layout state entry layouts (kills ~8 GB/step of filter relayout
+copies in the HLO, wall-clock NEUTRAL — the async copies already
+overlap; kept as an Executor option), bs288/320 (2284 img/s, worse).
+Previously rejected: run_steps scan (parity), bs384/512, variadic BN
+reduces, shifted-compare maxpool grad, scoped-vmem compiler options.
+Banked: 96-step readback amortization, NHWC end-to-end, AMP, donation,
+device-resident bf16 feeds.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one json line per lane, the flagship ResNet line LAST:
+{"metric", "value", "unit", "vs_baseline"} (+ jnp/pallas detail for the
+LSTM lane, reference benchmark/README.md:115-127 protocol).
 """
 
 import argparse

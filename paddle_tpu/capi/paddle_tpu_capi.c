@@ -1,0 +1,164 @@
+/* paddle_tpu C inference API implementation: CPython embedding.
+ *
+ * See paddle_tpu_capi.h. The reference's capi wraps its C++ runtime
+ * (capi/gradient_machine.cpp); here the runtime is the Python-hosted
+ * JAX/StableHLO loader (paddle_tpu.fluid.aot.load_inference_artifact),
+ * embedded via the CPython C API (pybind11 is deliberately absent — see
+ * the build notes in paddle_tpu/native/).
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdio.h>
+#include <string.h>
+
+#include "paddle_tpu_capi.h"
+
+static int g_initialized = 0;
+
+typedef struct {
+  PyObject* artifact; /* paddle_tpu.fluid.aot.InferenceArtifact */
+} model_t;
+
+pd_tpu_error pd_tpu_init(void) {
+  if (g_initialized) return PD_TPU_OK;
+  Py_Initialize();
+  /* force the CPU backend before jax touches a device (the TPU tunnel is
+   * not a serving target; axon sitecustomize would otherwise grab it) */
+  PyRun_SimpleString(
+      "import os\n"
+      "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+      "import jax\n"
+      "jax.config.update('jax_platforms', 'cpu')\n");
+  g_initialized = 1;
+  return PD_TPU_OK;
+}
+
+pd_tpu_error pd_tpu_model_load(const char* artifact_dir, pd_tpu_model* out) {
+  if (!g_initialized) return PD_TPU_NOT_INITIALIZED;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.fluid.aot");
+  if (!mod) {
+    PyErr_Print();
+    return PD_TPU_ERROR;
+  }
+  PyObject* loader = PyObject_GetAttrString(mod, "load_inference_artifact");
+  Py_DECREF(mod);
+  if (!loader) {
+    PyErr_Print();
+    return PD_TPU_ERROR;
+  }
+  PyObject* artifact =
+      PyObject_CallFunction(loader, "s", artifact_dir);
+  Py_DECREF(loader);
+  if (!artifact) {
+    PyErr_Print();
+    return PD_TPU_ERROR;
+  }
+  model_t* m = (model_t*)malloc(sizeof(model_t));
+  m->artifact = artifact;
+  *out = (pd_tpu_model)m;
+  return PD_TPU_OK;
+}
+
+pd_tpu_error pd_tpu_model_run(pd_tpu_model model, const float* in_data,
+                              int64_t batch, int64_t feature_dim,
+                              float* out_data, int64_t out_capacity,
+                              int64_t* out_rows, int64_t* out_cols) {
+  if (!g_initialized) return PD_TPU_NOT_INITIALIZED;
+  model_t* m = (model_t*)model;
+
+  /* build a [batch, feature_dim] float32 numpy array from the C buffer via
+   * a bytes round-trip (keeps this file free of the numpy C ABI) */
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (!np) {
+    PyErr_Print();
+    return PD_TPU_ERROR;
+  }
+  PyObject* frombuffer = PyObject_GetAttrString(np, "frombuffer");
+  PyObject* raw = PyBytes_FromStringAndSize(
+      (const char*)in_data, (Py_ssize_t)(batch * feature_dim * 4));
+  PyObject* flat = PyObject_CallFunction(frombuffer, "Os", raw, "float32");
+  Py_DECREF(frombuffer);
+  Py_DECREF(raw);
+  if (!flat) {
+    Py_DECREF(np);
+    PyErr_Print();
+    return PD_TPU_ERROR;
+  }
+  PyObject* arr = PyObject_CallMethod(flat, "reshape", "ll", (long)batch,
+                                      (long)feature_dim);
+  Py_DECREF(flat);
+  if (!arr) {
+    Py_DECREF(np);
+    PyErr_Print();
+    return PD_TPU_ERROR;
+  }
+
+  /* feed dict keyed by the artifact's (single) feed name */
+  PyObject* feed_names = PyObject_GetAttrString(m->artifact, "feed_names");
+  PyObject* name0 = PySequence_GetItem(feed_names, 0);
+  Py_DECREF(feed_names);
+  PyObject* feed = PyDict_New();
+  PyDict_SetItem(feed, name0, arr);
+  Py_DECREF(name0);
+  Py_DECREF(arr);
+
+  PyObject* outs = PyObject_CallMethod(m->artifact, "run", "O", feed);
+  Py_DECREF(feed);
+  if (!outs) {
+    Py_DECREF(np);
+    PyErr_Print();
+    return PD_TPU_ERROR;
+  }
+  PyObject* first = PySequence_GetItem(outs, 0);
+  Py_DECREF(outs);
+
+  /* shape */
+  PyObject* shape = PyObject_GetAttrString(first, "shape");
+  long rows = 1, cols = 1;
+  Py_ssize_t nd = PyTuple_Size(shape);
+  if (nd >= 1) rows = PyLong_AsLong(PyTuple_GetItem(shape, 0));
+  if (nd >= 2) cols = PyLong_AsLong(PyTuple_GetItem(shape, 1));
+  Py_DECREF(shape);
+  if (out_rows) *out_rows = rows;
+  if (out_cols) *out_cols = cols;
+
+  if (rows * cols > out_capacity) {
+    Py_DECREF(first);
+    Py_DECREF(np);
+    fprintf(stderr, "pd_tpu_model_run: output %ldx%ld exceeds capacity\n",
+            rows, cols);
+    return PD_TPU_ERROR;
+  }
+
+  /* copy out through tobytes() */
+  PyObject* f32 = PyObject_CallMethod(first, "astype", "s", "float32");
+  Py_DECREF(first);
+  PyObject* buf = PyObject_CallMethod(f32, "tobytes", NULL);
+  Py_DECREF(f32);
+  Py_DECREF(np);
+  if (!buf) {
+    PyErr_Print();
+    return PD_TPU_ERROR;
+  }
+  memcpy(out_data, PyBytes_AsString(buf), (size_t)(rows * cols * 4));
+  Py_DECREF(buf);
+  return PD_TPU_OK;
+}
+
+pd_tpu_error pd_tpu_model_destroy(pd_tpu_model model) {
+  model_t* m = (model_t*)model;
+  if (m) {
+    Py_XDECREF(m->artifact);
+    free(m);
+  }
+  return PD_TPU_OK;
+}
+
+pd_tpu_error pd_tpu_shutdown(void) {
+  if (g_initialized) {
+    Py_Finalize();
+    g_initialized = 0;
+  }
+  return PD_TPU_OK;
+}

@@ -1,0 +1,62 @@
+/* paddle_tpu C inference API.
+ *
+ * The TPU-native analog of the reference's pure-C deployment surface
+ * (/root/reference/paddle/capi/capi.h: paddle_init,
+ * paddle_gradient_machine_create_for_inference,
+ * paddle_gradient_machine_forward; example
+ * capi/examples/model_inference/dense/main.c:29-35).
+ *
+ * A model here is an AOT artifact directory produced by
+ * paddle_tpu.fluid.aot.export_inference_artifact: a serialized StableHLO
+ * computation with the trained parameters baked in. This C layer hosts the
+ * artifact through an embedded CPython + JAX runtime (the reference's capi
+ * likewise links the full C++ runtime behind its C surface); the artifact
+ * itself is runtime-portable StableHLO, so a non-Python serving stack can
+ * execute the same bytes with any StableHLO-capable loader (IREE/PJRT).
+ */
+
+#ifndef PADDLE_TPU_CAPI_H
+#define PADDLE_TPU_CAPI_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+  PD_TPU_OK = 0,
+  PD_TPU_ERROR = 1,
+  PD_TPU_NOT_INITIALIZED = 2,
+} pd_tpu_error;
+
+typedef void* pd_tpu_model;
+
+/* Initialize the embedded runtime (Py_Initialize + jax on CPU).
+ * Mirrors paddle_init(argc, argv). Safe to call once per process. */
+pd_tpu_error pd_tpu_init(void);
+
+/* Load an AOT artifact directory (aot.export_inference_artifact output).
+ * Mirrors paddle_gradient_machine_create_for_inference. */
+pd_tpu_error pd_tpu_model_load(const char* artifact_dir, pd_tpu_model* out);
+
+/* Run the model on one dense float32 input [batch, feature_dim] and copy
+ * the FIRST fetch into out_data (caller-allocated, out_capacity floats).
+ * out_rows/out_cols receive the fetch shape. Mirrors the dense example's
+ * forward (capi/examples/model_inference/dense/main.c). */
+pd_tpu_error pd_tpu_model_run(pd_tpu_model model, const float* in_data,
+                              int64_t batch, int64_t feature_dim,
+                              float* out_data, int64_t out_capacity,
+                              int64_t* out_rows, int64_t* out_cols);
+
+/* Destroy a loaded model. */
+pd_tpu_error pd_tpu_model_destroy(pd_tpu_model model);
+
+/* Tear down the embedded runtime. */
+pd_tpu_error pd_tpu_shutdown(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_CAPI_H */

@@ -128,6 +128,8 @@ def _real_corpus():
                             if x[0] != "-":
                                 verb_list.append(x[0])
                         for i, lbl in enumerate(labels):
+                            lemma = verb_list[i] \
+                                if i < len(verb_list) else None
                             cur_tag = "O"
                             is_in_bracket = False
                             lbl_seq = []
@@ -151,7 +153,7 @@ def _real_corpus():
                                     raise RuntimeError(f"unexpected label: {l}")
                             verb_idx = lbl_seq.index("B-V") \
                                 if "B-V" in lbl_seq else 0
-                            yield sentences, verb_idx, lbl_seq
+                            yield sentences, verb_idx, lbl_seq, lemma
                     sentences = []
                     labels = []
                     one_seg = []
@@ -162,15 +164,28 @@ def _real_corpus():
 
 def reader_creator(corpus, word_dict, predicate_dict, label_dict):
     def reader():
-        for sentence, verb_index, labels in corpus():
+        for item in corpus():
+            # corpus yields (sentence, verb_index, labels[, lemma]): the
+            # real props files carry the predicate LEMMA (verbDict is
+            # lemma-keyed, reference conll05.py:130 verb_list[i]); the
+            # synthetic corpus's surface form IS its lemma
+            sentence, verb_index, labels = item[:3]
+            lemma = item[3] if len(item) > 3 and item[3] is not None \
+                else (sentence[verb_index]
+                      if verb_index < len(sentence) else None)
             sen_len = len(sentence)
-            if verb_index >= sen_len:
+            if verb_index >= sen_len or lemma is None:
                 continue
-            predicate = sentence[verb_index]
+            predicate = lemma
             if predicate not in predicate_dict:
                 continue
+            # mark covers the 5-token context window around the verb
+            # (reference reader_creator:156-181 sets mark at verb_index-2
+            # .. verb_index+2)
             mark = [0] * sen_len
-            mark[verb_index] = 1
+            for off in range(-2, 3):
+                if 0 <= verb_index + off < sen_len:
+                    mark[verb_index + off] = 1
 
             def ctx(off, default):
                 i = verb_index + off

@@ -44,7 +44,7 @@ def _synth_reader(per_class, seed):
     return reader
 
 
-def _real_reader(split, mapper=None):
+def _real_reader(split):
     def reader():
         try:
             from PIL import Image
@@ -82,19 +82,29 @@ def _have_real():
             and common.have_file(SETID_URL, "flowers"))
 
 
+def _with_mapper(reader, mapper):
+    """Apply the reference's per-sample mapper contract (flowers.py maps
+    every (img, label) through it, via xmap in the original)."""
+    if mapper is None:
+        return reader
+
+    def mapped():
+        for sample in reader():
+            yield mapper(sample)
+
+    return mapped
+
+
 def train(mapper=None, buffered_size=1024, use_xmap=True):
-    if _have_real():
-        return _real_reader("train", mapper)
-    return _synth_reader(SYNTH_PER_CLASS_TRAIN, 3)
+    base = _real_reader("train") if _have_real()         else _synth_reader(SYNTH_PER_CLASS_TRAIN, 3)
+    return _with_mapper(base, mapper)
 
 
 def test(mapper=None, buffered_size=1024, use_xmap=True):
-    if _have_real():
-        return _real_reader("test", mapper)
-    return _synth_reader(SYNTH_PER_CLASS_TEST, 7)
+    base = _real_reader("test") if _have_real()         else _synth_reader(SYNTH_PER_CLASS_TEST, 7)
+    return _with_mapper(base, mapper)
 
 
 def valid(mapper=None, buffered_size=1024, use_xmap=True):
-    if _have_real():
-        return _real_reader("valid", mapper)
-    return _synth_reader(SYNTH_PER_CLASS_TEST, 13)
+    base = _real_reader("valid") if _have_real()         else _synth_reader(SYNTH_PER_CLASS_TEST, 13)
+    return _with_mapper(base, mapper)

@@ -33,12 +33,13 @@ def _tokenize(text):
 
 def _synth_corpus():
     rng = np.random.RandomState(21)
-    pos_markers = list(range(2, 10))
-    neg_markers = list(range(10, 18))
+    # reference label convention (sentiment.py:98): neg=0, pos=1
+    neg_markers = list(range(2, 10))
+    pos_markers = list(range(10, 18))
     samples = []
     for i in range(NUM_TOTAL_INSTANCES):
         label = i % 2
-        markers = pos_markers if label == 0 else neg_markers
+        markers = neg_markers if label == 0 else pos_markers
         ln = int(rng.randint(10, 50))
         seq = rng.randint(18, SYNTH_VOCAB, ln).tolist()
         for _ in range(max(2, ln // 8)):
@@ -57,7 +58,8 @@ def _real_corpus():
             m = re.match(r"movie_reviews/(pos|neg)/.*\.txt$", name)
             if not m:
                 continue
-            label = 0 if m.group(1) == "pos" else 1
+            # reference sentiment.py:98: neg -> 0, pos -> 1
+            label = 0 if m.group(1) == "neg" else 1
             samples.append((_tokenize(z.read(name).decode("latin1")),
                             label))
     order = np.random.RandomState(8).permutation(len(samples))
@@ -75,15 +77,23 @@ def _corpus():
     return _CORPUS
 
 
+_WORD_DICT = None
+
+
 def get_word_dict():
     """Frequency-ranked word->id over the whole corpus (reference
-    sentiment.get_word_dict sorts by descending count)."""
+    sentiment.get_word_dict sorts by descending count). Cached: readers
+    call this per epoch."""
+    global _WORD_DICT
+    if _WORD_DICT is not None:
+        return _WORD_DICT
     freq = {}
     for words, _ in _corpus():
         for w in words:
             freq[w] = freq.get(w, 0) + 1
     ranked = sorted(freq.items(), key=lambda x: (-x[1], x[0]))
-    return {w: i for i, (w, _) in enumerate(ranked)}
+    _WORD_DICT = {w: i for i, (w, _) in enumerate(ranked)}
+    return _WORD_DICT
 
 
 def reader_creator(data):

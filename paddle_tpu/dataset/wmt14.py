@@ -106,15 +106,15 @@ def reader_creator(file_name, dict_size, synth_n, synth_seed):
 
 
 def train(dict_size):
-    return reader_creator("src-train", dict_size, SYNTH_TRAIN, 5)
+    return reader_creator("train/train", dict_size, SYNTH_TRAIN, 5)
 
 
 def test(dict_size):
-    return reader_creator("src-test", dict_size, SYNTH_TEST, 9)
+    return reader_creator("test/test", dict_size, SYNTH_TEST, 9)
 
 
 def gen(dict_size):
-    return reader_creator("src-gen", dict_size, SYNTH_TEST, 13)
+    return reader_creator("gen/gen", dict_size, SYNTH_TEST, 13)
 
 
 def get_dict(dict_size, reverse=True):

@@ -57,16 +57,43 @@ def launch(script, script_args=(), nproc=2, devices_per_proc=None,
                              stderr=subprocess.STDOUT, text=True)
         procs.append(p)
 
+    # drain every child's pipe CONCURRENTLY: a sequential communicate()
+    # would deadlock the coordinated group once any later rank fills its
+    # 64KB pipe while an earlier rank blocks in a collective waiting on it
+    import threading
+    import time as _time
+
+    outputs = [""] * nproc
+
+    def drain(rank, p):
+        chunks = []
+        for line in p.stdout:
+            chunks.append(line)
+        outputs[rank] = "".join(chunks)
+
+    threads = [threading.Thread(target=drain, args=(r, p), daemon=True)
+               for r, p in enumerate(procs)]
+    for t in threads:
+        t.start()
+
+    deadline = None if timeout is None else _time.monotonic() + timeout
     codes = []
     for rank, p in enumerate(procs):
         try:
-            out, _ = p.communicate(timeout=timeout)
+            remaining = None if deadline is None \
+                else max(0.1, deadline - _time.monotonic())
+            p.wait(timeout=remaining)
         except subprocess.TimeoutExpired:
-            p.kill()
-            out, _ = p.communicate()
-        for line in (out or "").splitlines():
-            print(f"[rank {rank}] {line}")
+            for q in procs:   # kill the whole group: one hung rank wedges all
+                if q.poll() is None:
+                    q.kill()
+            p.wait()
         codes.append(p.returncode)
+    for t in threads:
+        t.join(5.0)
+    for rank in range(nproc):
+        for line in outputs[rank].splitlines():
+            print(f"[rank {rank}] {line}")
     return codes
 
 

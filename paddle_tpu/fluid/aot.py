@@ -1,0 +1,136 @@
+"""AOT inference export: compile a pruned inference program to a
+self-contained StableHLO artifact + loader.
+
+The reference ships out-of-Python deployment twice: the C++ inference lib
+(/root/reference/paddle/fluid/inference/io.cc:95 Load -> Executor) and the
+pure-C capi (/root/reference/paddle/capi/capi.h,
+capi/examples/model_inference/dense/main.c). TPU-native equivalent: the
+model config IS the compiled computation — the inference program (pruned to
+feed/fetch like fluid.io.save_inference_model) traces to one XLA function
+with the trained parameters baked in as constants, serialized with
+jax.export (StableHLO + calling convention). The artifact is runtime-
+independent of the Python program that built it: any process (or the C API
+in paddle_tpu/capi) deserializes and calls it without the Program, the op
+registry, or the Scope.
+
+Layout on disk:
+    <dirname>/__inference__.stablehlo   serialized jax.export artifact
+    <dirname>/AOT_MANIFEST.json         feed names/shapes/dtypes + fetches
+
+The batch dimension is exported symbolically (jax.export symbolic shapes),
+so one artifact serves any batch size — the AOT analog of the reference's
+-1 batch dims in the saved ProgramDesc.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+ARTIFACT_FILENAME = "__inference__.stablehlo"
+MANIFEST_FILENAME = "AOT_MANIFEST.json"
+
+
+def export_inference_artifact(dirname, feeded_var_names, target_vars,
+                              executor, main_program=None, scope=None,
+                              batch_symbol="b"):
+    """Prune ``main_program`` to the feed->fetch slice, bake the scope's
+    trained parameters in as constants, and serialize the whole computation.
+
+    Mirrors fluid.io.save_inference_model's signature (io.py:298) so the
+    book-test save sites can switch between the two export forms."""
+    import jax
+    from jax import export as jax_export
+    import jax.numpy as jnp
+
+    from ..core.executor import _run_ops, _collect_free_inputs, _RNG_KEY
+    from ..core.scope import global_scope
+    from .framework import default_main_program
+    from . import io as fluid_io
+
+    program = main_program or default_main_program()
+    scope = scope or getattr(executor, "_scope", None) or global_scope()
+
+    fetch = [t if isinstance(t, str) else t.name for t in target_vars]
+    infer = fluid_io._prune_program(program, feeded_var_names, fetch)
+    block = infer.global_block()
+    fetch_names = fetch
+
+    free = _collect_free_inputs(infer, 0)
+    param_names = sorted(n for n in free if n not in feeded_var_names
+                         and scope.has_var(n))
+    params = {n: jnp.asarray(scope.find_var(n)) for n in param_names}
+
+    def fwd(feeds):
+        env = dict(params)
+        env.update(feeds)
+        env[_RNG_KEY] = jax.random.PRNGKey(0)
+        _run_ops(block, env, None)
+        return [env[n] for n in fetch_names]
+
+    # symbolic batch: every feed's leading -1 dim shares one symbol
+    feed_meta = {}
+    args_spec = {}
+    sym = jax_export.symbolic_shape(batch_symbol)[0]
+    for name in feeded_var_names:
+        v = block.var(name)
+        shape = list(v.shape if v.shape is not None else (-1,))
+        dtype = np.dtype(v.dtype or "float32")
+        feed_meta[name] = {"shape": shape, "dtype": str(dtype)}
+        sym_shape = tuple(sym if s in (-1, None) else int(s) for s in shape)
+        args_spec[name] = jax.ShapeDtypeStruct(sym_shape, dtype)
+
+    exported = jax_export.export(jax.jit(fwd))(args_spec)
+    data = exported.serialize()
+
+    os.makedirs(dirname, exist_ok=True)
+    tmp = os.path.join(dirname, ARTIFACT_FILENAME + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(bytes(data))
+    os.replace(tmp, os.path.join(dirname, ARTIFACT_FILENAME))
+    manifest = {
+        "feeds": [{"name": n, **feed_meta[n]} for n in feeded_var_names],
+        "fetches": fetch_names,
+        "batch_symbol": batch_symbol,
+        "format": "jax.export.stablehlo.v1",
+    }
+    mtmp = os.path.join(dirname, MANIFEST_FILENAME + ".tmp")
+    with open(mtmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+    os.replace(mtmp, os.path.join(dirname, MANIFEST_FILENAME))
+    return manifest
+
+
+class InferenceArtifact:
+    """A loaded AOT artifact: ``run(feed_dict)`` -> list of fetch arrays.
+    No Program, registry, or Scope involved — the deserialized computation
+    is the whole model (the capability of the reference's
+    paddle_gradient_machine_create_for_inference + forward)."""
+
+    def __init__(self, exported, manifest):
+        self._exported = exported
+        self.manifest = manifest
+        self.feed_names = [f["name"] for f in manifest["feeds"]]
+        self.fetch_names = manifest["fetches"]
+
+    def run(self, feed):
+        import jax.numpy as jnp
+
+        args = {}
+        for spec in self.manifest["feeds"]:
+            n = spec["name"]
+            args[n] = jnp.asarray(np.asarray(feed[n],
+                                             dtype=spec["dtype"]))
+        return [np.asarray(v) for v in self._exported.call(args)]
+
+
+def load_inference_artifact(dirname):
+    from jax import export as jax_export
+
+    with open(os.path.join(dirname, ARTIFACT_FILENAME), "rb") as f:
+        exported = jax_export.deserialize(bytearray(f.read()))
+    with open(os.path.join(dirname, MANIFEST_FILENAME)) as f:
+        manifest = json.load(f)
+    return InferenceArtifact(exported, manifest)

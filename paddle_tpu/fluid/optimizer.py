@@ -61,6 +61,10 @@ class Optimizer:
         dtype = dtype or param.dtype
         v = block.create_var(name=vname, shape=shape, dtype=dtype,
                              persistable=True)
+        # sharding metadata: accumulator<->param pairing comes from THIS
+        # registry, not from name patterns (parallel/sharding.py consumes it
+        # so a new accumulator name can never silently fall out of ZeRO-1)
+        v.optimizer_accumulator_for = param.name
         startup.global_block().create_var(name=vname, shape=shape, dtype=dtype,
                                           persistable=True)
         startup.global_block().append_op(

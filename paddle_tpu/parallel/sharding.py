@@ -103,14 +103,22 @@ class ShardingPlan:
             return P(self.model_axis)
         return P()
 
-    def spec_for_param(self, name, shape):
+    def spec_for_param(self, name, shape, var=None):
         for pat, spec in self.rules:
             if re.search(pat, name):
                 return spec
         spec = self._base_spec(name, shape)
+        # accumulator detection: the optimizer's registry tags each
+        # accumulator Variable with its param (fluid/optimizer.py
+        # _add_accumulator) — authoritative, so arbitrary accumulator names
+        # shard correctly; the name-suffix regex additionally covers
+        # programs rebuilt without build-time metadata (deserialized
+        # __model__ files), matching the known optimizer suffixes
+        is_acc = (getattr(var, "optimizer_accumulator_for", None) is not None
+                  or _ACC_SUFFIX.search(name) is not None)
         if (spec == P() and self.shard_opt_state and self.data_axis
                 and self._dp > 1 and shape is not None and len(shape) >= 1
-                and _ACC_SUFFIX.search(name)
+                and is_acc
                 and shape[0] % self._dp == 0 and shape[0] >= 2 * self._dp):
             return P(*([self.data_axis] + [None] * (len(shape) - 1)))
         return spec
@@ -186,7 +194,9 @@ def shard_program_step(executor, program, feed_example, fetch_list, plan,
         if n == _RNG_KEY:
             state_shardings[n] = plan.named(P())
             continue
-        state_shardings[n] = plan.named(plan.spec_for_param(n, _shape_of(v)))
+        block_var = block.var(n) if block.has_var(n) else None
+        state_shardings[n] = plan.named(
+            plan.spec_for_param(n, _shape_of(v), var=block_var))
 
     state = {n: jax.device_put(v, state_shardings[n]) for n, v in state.items()}
     feeds = {n: place_feed(v, plan, n) for n, v in feeds.items()}

@@ -26,9 +26,10 @@ def data(name, type, height=None, width=None):
     import paddle_tpu.fluid as fluid
 
     out = LayerOutput(name=name, data_size=type.dim)
-    if type.seq_type and type.dtype == "int64":
+    is_seq = bool(type.seq_type) or type.lod_level > 0
+    if is_seq and type.dtype == "int64":
         out.materialize("seq_ids")
-    elif type.seq_type:
+    elif is_seq:
         out.materialize("seq_dense")
     elif type.dtype == "int64":
         out.materialize("label")

@@ -232,7 +232,22 @@ class TestPool2dMax(OpTest):
 
     def test_grad(self):
         if self.pool_type == "max":
-            pytest.skip("max pool grad is subgradient; checked via avg")
+            # the reference grad-checks max pool too; make the input
+            # TIE-FREE with element gaps >> the finite-difference delta so
+            # the subgradient kink is never straddled (reference op_test
+            # practice for selection ops)
+            rng = np.random.RandomState(11)
+            n = int(np.prod(self.shape))
+            x = (rng.permutation(n).astype("float32") * 0.05).reshape(
+                self.shape)
+            out = max_pool2D_forward_naive(
+                x, self.ksize, self.strides, self.paddings,
+                self.global_pool, self.ceil_mode)
+            self.inputs = {"X": x}
+            self.outputs = {"Out": out}
+            self.check_grad(["X"], "Out", max_relative_error=0.05,
+                            numeric_grad_delta=1e-3)
+            return
         self.check_grad(["X"], "Out", max_relative_error=0.05)
 
 

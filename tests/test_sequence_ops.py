@@ -55,8 +55,22 @@ class TestSeqAvgPool(OpTest):
         self.check_output()
 
     def test_grad(self):
-        if self.pooltype in ("MAX", "LAST", "FIRST"):
-            pytest.skip("subgradient / selection pools: forward-checked only")
+        if self.pooltype == "MAX":
+            # tie-free input: distinct values with gaps >> the numeric
+            # delta, so the max subgradient is locally linear (the
+            # reference grad-checks these the same way)
+            rng = np.random.RandomState(13)
+            x = _flat()
+            n = int(np.prod(x.shape))
+            x = (rng.permutation(n).astype("float32") * 0.05).reshape(
+                x.shape)
+            lod = _lod()
+            self.inputs = {"X": (x, lod)}
+            self.outputs = {"Out": self.ref(x, lod[0])}
+            self.check_grad(["X"], "Out", max_relative_error=0.03,
+                            numeric_grad_delta=1e-3)
+            return
+        # LAST/FIRST are linear selections: plain grad check
         self.check_grad(["X"], "Out", max_relative_error=0.03)
 
 
@@ -254,3 +268,20 @@ class TestSequenceConv(OpTest):
 
     def test_grad(self):
         self.check_grad(["X", "Filter"], "Out", max_relative_error=0.05)
+
+
+def test_max_sequence_len():
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1], lod_level=1)
+        b = main.global_block()
+        b.create_var(name="mx")
+        b.append_op("max_sequence_len", {"RankTable": ["x"]},
+                    {"Out": ["mx"]}, {})
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(main, feed={"x": [np.zeros((3, 1), "float32"),
+                                     np.zeros((7, 1), "float32"),
+                                     np.zeros((2, 1), "float32")]},
+                   fetch_list=["mx"])
+    assert int(np.asarray(got)[0]) == 7
