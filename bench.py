@@ -22,7 +22,12 @@ graph is ~37 GB), meaning the step sits between the bandwidth floor
 shapes, not on removable passes. Measured and REJECTED in round 4:
 auto_layout state entry layouts (kills ~8 GB/step of filter relayout
 copies in the HLO, wall-clock NEUTRAL — the async copies already
-overlap; kept as an Executor option), bs288/320 (2284 img/s, worse).
+overlap; kept as an Executor option), bs288/320 (2284 img/s, worse),
+bn_fusion_barrier (optimization barrier between convs and BN stat
+reduces to un-fuse them: 2216 img/s, 13% WORSE — the conv+stats fusion
+XLA picks is net positive, so the frozen-BN delta reflects the stats
+math itself, not fusion-induced conv inefficiency), bs128 (2522 img/s
+— per-image cost flat from 128..256, no fixed per-step overhead).
 Previously rejected: run_steps scan (parity), bs384/512, variadic BN
 reduces, shifted-compare maxpool grad, scoped-vmem compiler options.
 Banked: 96-step readback amortization, NHWC end-to-end, AMP, donation,
@@ -194,6 +199,9 @@ def main():
                          "already overlap with compute; kept for A/B runs)")
     ap.add_argument("--skip-lstm", action="store_true",
                     help="only run the flagship ResNet-50 lane")
+    ap.add_argument("--bn-barrier", action="store_true",
+                    help="A/B probe: optimization barrier between convs "
+                         "and BN stat reduces (flags.bn_fusion_barrier)")
     args = ap.parse_args()
 
     if args.smoke:
@@ -216,10 +224,16 @@ def main():
     if not args.skip_lstm:
         lstm_kw = dict(batch=8, seq_len=12, hidden=16, steps=2, warmup=1) \
             if args.smoke else dict(batch=64, seq_len=100, hidden=512,
-                                    steps=32, warmup=3)
-        jnp_ms = run_lstm_lane(use_pallas=False, **lstm_kw)
+                                    steps=64, warmup=4)
+        repeats = 1 if args.smoke else 2
+        # best-of-N repeats: the shared dev chip shows large run-to-run
+        # variance (8.7..14.4 ms measured for the identical program);
+        # min is the standard contended-machine protocol
+        jnp_ms = min(run_lstm_lane(use_pallas=False, **lstm_kw)
+                     for _ in range(repeats))
         try:
-            pallas_ms = run_lstm_lane(use_pallas=True, **lstm_kw)
+            pallas_ms = min(run_lstm_lane(use_pallas=True, **lstm_kw)
+                            for _ in range(repeats))
         except Exception as e:  # pallas lowering unavailable on this backend
             print(f"pallas lstm lane failed ({type(e).__name__}: {e}); "
                   "reporting jnp path", file=sys.stderr)
@@ -236,6 +250,9 @@ def main():
             "pallas_ms": None if pallas_ms is None else round(pallas_ms, 3),
         }))
 
+    if args.bn_barrier:
+        from paddle_tpu.core.flags import set_flags
+        set_flags({"bn_fusion_barrier": True})
     main_prog, startup, avg_loss = build(batch, image_size, class_dim)
 
     # Pre-stage a rotating pool of device-resident batches: the benchmark

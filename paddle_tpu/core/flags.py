@@ -76,6 +76,14 @@ DEFINE_flag("xla_compiler_options", "",
             "xla_tpu_scoped_vmem_limit_kib=114688 — the analog of the "
             "reference's backend gflags (platform/gpu_info.cc)")
 
+DEFINE_flag("bn_fusion_barrier", False,
+            "A/B probe (default off): optimization barrier between a conv "
+            "output and batch_norm's statistics reductions so XLA cannot "
+            "fuse the reduces INTO the conv kernel. MEASURED 13% WORSE on "
+            "the v5e ResNet-50 bench (2216 vs 2545 img/s, bench.py round-4 "
+            "notes) — the conv+stats fusion XLA picks is net positive; the "
+            "flag remains for future-hardware A/B runs only")
+
 # PDTPU_FLAGS=check_nan_inf=1,benchmark=0 — unknown names warn and are
 # ignored (a typo'd env var must not make the package unimportable)
 _env = os.environ.get("PDTPU_FLAGS", "")

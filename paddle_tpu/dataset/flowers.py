@@ -58,8 +58,10 @@ def _real_reader(split):
         labels = sio.loadmat(os.path.join(base, "imagelabels.mat"))[
             "labels"].ravel()
         setid = sio.loadmat(os.path.join(base, "setid.mat"))
-        # reference flowers.py: train uses trnid, test tstid, valid valid
-        ids = setid[{"train": "trnid", "test": "tstid",
+        # reference flowers.py:50-54 deliberately SWAPS the mat file's
+        # naming: TRAIN_FLAG='tstid' (the ~6k-image split, "test data is
+        # more than train data") and TEST_FLAG='trnid'
+        ids = setid[{"train": "tstid", "test": "trnid",
                      "valid": "valid"}[split]].ravel()
         with tarfile.open(os.path.join(base, DATA_URL.split("/")[-1])) as tf:
             members = {m.name: m for m in tf.getmembers()}
@@ -84,15 +86,12 @@ def _have_real():
 
 def _with_mapper(reader, mapper):
     """Apply the reference's per-sample mapper contract (flowers.py maps
-    every (img, label) through it, via xmap in the original)."""
+    every (img, label) through it, via xmap in the original) using the
+    reader-decorator layer, like the reference."""
     if mapper is None:
         return reader
-
-    def mapped():
-        for sample in reader():
-            yield mapper(sample)
-
-    return mapped
+    from ..reader.decorator import map_readers
+    return map_readers(mapper, reader)
 
 
 def train(mapper=None, buffered_size=1024, use_xmap=True):

@@ -12,9 +12,10 @@ the reference's listen_and_serv/ParameterServer2/Go-master designs
 """
 
 from .param_server import (ParameterServer, ParamClient, serve, shard_names,
-                           OPTIMIZERS)
+                           OPTIMIZERS, OverlappedRemoteUpdater)
 from .master import Master, MasterClient
 from .rpc import RpcServer, RpcClient
 
 __all__ = ["ParameterServer", "ParamClient", "serve", "shard_names",
-           "OPTIMIZERS", "Master", "MasterClient", "RpcServer", "RpcClient"]
+           "OPTIMIZERS", "OverlappedRemoteUpdater", "Master", "MasterClient",
+           "RpcServer", "RpcClient"]

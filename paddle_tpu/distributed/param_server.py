@@ -290,3 +290,68 @@ class ParamClient:
     def close(self):
         for c in self._clients:
             c.close()
+
+
+class OverlappedRemoteUpdater:
+    """Pipelined trainer-side updater: grad push + param pull run on a
+    background thread while the trainer computes its next batch — the
+    reference's CONCURRENT RemoteParameterUpdater
+    (/root/reference/paddle/trainer/RemoteParameterUpdater.h:180, which
+    overlaps send/recv with the backward pass on a separate thread).
+
+    Contract (one-step staleness, exactly the reference's):
+
+        upd = OverlappedRemoteUpdater(client, scope, ["w", "b"])
+        for batch in data:
+            upd.sync_in()                 # install freshest pulled params
+            grads = run_fwd_bwd(batch)    # overlaps the in-flight comm
+            upd.submit(grads)             # returns immediately
+        upd.finish()
+
+    ``submit`` enqueues push(grads)+pull() on the worker; ``sync_in`` waits
+    for the previous round-trip and writes the pulled params into the
+    scope. The params a step sees therefore exclude the immediately
+    preceding step's gradients — async-SGD staleness bounded at 1.
+    """
+
+    def __init__(self, client, scope, param_names):
+        self._client = client
+        self._scope = scope
+        self._names = set(param_names)   # install only these from pulls
+        self._pulled = None
+        self._error = None
+        self._worker = None
+
+    def sync_in(self):
+        """Wait for the in-flight push+pull and install its params."""
+        if self._worker is not None:
+            self._worker.join()
+            self._worker = None
+            if self._error is not None:
+                e, self._error = self._error, None
+                raise e
+            if self._pulled:
+                for n, v in self._pulled.items():
+                    if n in self._names:
+                        self._scope.set(n, v)
+                self._pulled = None
+
+    def submit(self, grads):
+        import threading
+
+        if self._worker is not None:
+            raise RuntimeError("submit before sync_in of the previous round")
+
+        def trip():
+            try:
+                self._client.push(dict(grads))
+                self._pulled = self._client.pull()
+            except Exception as e:   # surfaced at the next sync_in
+                self._error = e
+
+        self._worker = threading.Thread(target=trip, daemon=True)
+        self._worker.start()
+
+    def finish(self):
+        """Drain the pipeline (join the last round-trip)."""
+        self.sync_in()

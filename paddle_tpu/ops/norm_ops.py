@@ -81,6 +81,11 @@ def batch_norm(ctx):
     axes = _bn_axes(x, layout)
     bshape = _bn_bshape(x, layout)
 
+    from ..core.flags import get_flag
+    if get_flag("bn_fusion_barrier"):
+        # sever the producer conv from the stat reduces (see flags.py)
+        x = jax.lax.optimization_barrier(x)
+
     # stability island: statistics accumulate in float32 straight out of the
     # (possibly bf16) activations — single pass via E[x²]-E[x]², reductions
     # carry an fp32 accumulator (dtype=) so no upcast copy of x is ever
@@ -128,6 +133,9 @@ def batch_norm_grad(ctx):
     dy = data_of(ctx.input("Y@GRAD"))
     eps = ctx.attr("epsilon", 1e-5)
     layout = ctx.attr("data_layout", "NCHW")
+    from ..core.flags import get_flag
+    if get_flag("bn_fusion_barrier"):
+        x, dy = jax.lax.optimization_barrier((x, dy))
     axes = _bn_axes(x, layout)
     bshape = _bn_bshape(x, layout)
     m = x.size // x.shape[_bn_channel_axis(x, layout)]

@@ -343,3 +343,62 @@ def test_elastic_end_to_end_kill_trainer():
     np.testing.assert_allclose(w, w_true, atol=0.05)
     rpc.shutdown()
     ps_rpc.shutdown()
+
+def test_overlapped_remote_updater():
+    """The CONCURRENT updater contract (RemoteParameterUpdater.h:180):
+    push/pull run off the training thread, params carry one-step staleness,
+    and training still converges through the pserver."""
+    import threading
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed import OverlappedRemoteUpdater
+
+    ps, rpc = _start_ps(optimizer="sgd", opt_kwargs={"lr": 0.1},
+                        mode="async")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6])
+        y = fluid.layers.data("y", shape=[1])
+        pred = fluid.layers.fc(x, size=1, act=None,
+                               param_attr=fluid.ParamAttr(name="w"),
+                               bias_attr=fluid.ParamAttr(name="b"))
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+        fluid.append_backward(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    client = ParamClient([rpc.address])
+    client.init_params({n: np.asarray(scope.find_var(n))
+                        for n in ("w", "b")})
+
+    # instrument: communication must happen OFF the training thread
+    comm_threads = set()
+    orig_push = client.push
+
+    def spy_push(grads):
+        comm_threads.add(threading.get_ident())
+        return orig_push(grads)
+
+    client.push = spy_push
+
+    upd = OverlappedRemoteUpdater(client, scope, ["w", "b"])
+    rng = np.random.RandomState(1)
+    w_true = rng.normal(0, 1, (6, 1)).astype(np.float32)
+    losses = []
+    for _ in range(60):
+        upd.sync_in()
+        X = rng.normal(0, 1, (32, 6)).astype(np.float32)
+        l, gw, gb = exe.run(main, feed={"x": X, "y": X @ w_true},
+                            fetch_list=[loss, "w@GRAD", "b@GRAD"],
+                            scope=scope)
+        upd.submit({"w": np.asarray(gw), "b": np.asarray(gb)})
+        losses.append(float(l))
+    upd.finish()
+
+    assert comm_threads and threading.get_ident() not in comm_threads
+    assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])
+    client.close()
+    rpc.shutdown()

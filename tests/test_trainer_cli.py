@@ -1,0 +1,63 @@
+"""paddle_trainer CLI jobs (reference paddle/trainer/TrainerMain.cpp:24-61:
+--job one of train/test/checkgrad/time over a v2 config).
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIG = """
+from paddle_tpu.trainer_config_helpers import *
+
+num_class = 4
+batch_size = get_config_arg('batch_size', int, 8)
+
+settings(batch_size=batch_size, learning_rate=0.05,
+         learning_method=MomentumOptimizer(0.9))
+
+net = data_layer('data', size=12)
+net = fc_layer(input=net, size=10, act=ReluActivation())
+net = fc_layer(input=net, size=num_class, act=SoftmaxActivation())
+lab = data_layer('label', num_class)
+loss = classification_cost(input=net, label=lab)
+outputs(loss)
+"""
+
+
+def _run_cli(*cli_args):
+    cfg = os.path.join(tempfile.mkdtemp(), "cfg.py")
+    with open(cfg, "w") as f:
+        f.write(CONFIG)
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.v2.trainer_cli",
+         f"--config={cfg}", *cli_args],
+        env=env, capture_output=True, text=True, timeout=300)
+
+
+def test_job_train():
+    r = _run_cli("--job=train", "--num_passes=2")
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = [l for l in r.stdout.splitlines() if l.startswith("Pass")]
+    assert len(lines) == 2
+    costs = [float(l.split("cost=")[1]) for l in lines]
+    assert costs[1] < costs[0], costs
+
+
+def test_job_checkgrad():
+    """Every parameter's analytic directional gradient must match the
+    central finite difference within 1% (the reference's checkgrad gate,
+    Trainer.cpp:366 '***' threshold)."""
+    r = _run_cli("--job=checkgrad")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "***" not in r.stdout, r.stdout
+    assert "checkgrad max diff" in r.stdout
+
+
+def test_job_time():
+    r = _run_cli("--job=time", "--batches_per_pass=3")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ms/batch" in r.stdout
