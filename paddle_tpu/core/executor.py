@@ -432,7 +432,8 @@ class Executor:
         key = ("multi", id(program), program._version, feed_names,
                fetch_names, carry_keys, K, B, self.donate, self.amp,
                get_flag("xla_compiler_options"),
-               get_flag("use_pallas_rnn"), get_flag("bn_fusion_barrier"))
+               get_flag("use_pallas_rnn"), get_flag("bn_fusion_barrier"),
+               get_flag("use_pallas_ctc"))
         fn = self._cache.get(key)
         if fn is not None:
             return fn
@@ -471,7 +472,8 @@ class Executor:
         key = (id(program), program._version, feed_names, fetch_names,
                state_in, state_out, self.donate, self.amp, self.auto_layout,
                get_flag("xla_compiler_options"),
-               get_flag("use_pallas_rnn"), get_flag("bn_fusion_barrier"))
+               get_flag("use_pallas_rnn"), get_flag("bn_fusion_barrier"),
+               get_flag("use_pallas_ctc"))
         fn = self._cache.get(key)
         if fn is not None:
             return fn

@@ -76,6 +76,12 @@ DEFINE_flag("xla_compiler_options", "",
             "xla_tpu_scoped_vmem_limit_kib=114688 — the analog of the "
             "reference's backend gflags (platform/gpu_info.cc)")
 
+DEFINE_flag("use_pallas_ctc", False,
+            "use the Pallas whole-recurrence CTC forward (alpha kept "
+            "VMEM-resident across time, the warp-ctc shared-memory "
+            "pattern) inside warpctc; default off — numerics pinned "
+            "against the lax.scan path")
+
 DEFINE_flag("bn_fusion_barrier", False,
             "A/B probe (default off): optimization barrier between a conv "
             "output and batch_norm's statistics reductions so XLA cannot "

@@ -70,17 +70,35 @@ def export_inference_artifact(dirname, feeded_var_names, target_vars,
         _run_ops(block, env, None)
         return [env[n] for n in fetch_names]
 
-    # symbolic batch: every feed's leading -1 dim shares one symbol
+    # symbolic dims: every feed's leading -1 dim shares the batch symbol;
+    # LoD feeds become a (padded data, lens) LoDArray whose max_len is a
+    # SECOND symbol, so one artifact serves any batch and any padded
+    # length (the reference's -1 dims + LoD levels in the saved
+    # ProgramDesc)
+    from ..core.lod import LoDArray as _LoDArray
+    _register_lod_serialization()
+
     feed_meta = {}
     args_spec = {}
-    sym = jax_export.symbolic_shape(batch_symbol)[0]
+    # both symbols must share one symbolic scope
+    sym, sym_len = jax_export.symbolic_shape(
+        f"{batch_symbol}, {batch_symbol}_len")
     for name in feeded_var_names:
         v = block.var(name)
         shape = list(v.shape if v.shape is not None else (-1,))
         dtype = np.dtype(v.dtype or "float32")
-        feed_meta[name] = {"shape": shape, "dtype": str(dtype)}
-        sym_shape = tuple(sym if s in (-1, None) else int(s) for s in shape)
-        args_spec[name] = jax.ShapeDtypeStruct(sym_shape, dtype)
+        lod_level = int(v.lod_level or 0)
+        feed_meta[name] = {"shape": shape, "dtype": str(dtype),
+                           "lod_level": lod_level}
+        if lod_level > 0:
+            feat = tuple(int(s) for s in shape[1:] if s not in (-1, None))
+            data_spec = jax.ShapeDtypeStruct((sym, sym_len) + feat, dtype)
+            lens_spec = jax.ShapeDtypeStruct((sym,), np.dtype("int32"))
+            args_spec[name] = _LoDArray(data_spec, lens_spec)
+        else:
+            sym_shape = tuple(sym if s in (-1, None) else int(s)
+                              for s in shape)
+            args_spec[name] = jax.ShapeDtypeStruct(sym_shape, dtype)
 
     exported = jax_export.export(jax.jit(fwd))(args_spec)
     data = exported.serialize()
@@ -117,17 +135,53 @@ class InferenceArtifact:
 
     def run(self, feed):
         import jax.numpy as jnp
+        from ..core.lod import LoDArray, pack_sequences
 
         args = {}
         for spec in self.manifest["feeds"]:
             n = spec["name"]
-            args[n] = jnp.asarray(np.asarray(feed[n],
-                                             dtype=spec["dtype"]))
-        return [np.asarray(v) for v in self._exported.call(args)]
+            v = feed[n]
+            if spec.get("lod_level", 0) > 0:
+                if isinstance(v, LoDArray):
+                    arr = v
+                else:   # list of per-sequence arrays, the fluid feed form
+                    arr = pack_sequences([np.asarray(s, spec["dtype"])
+                                          for s in v])
+                args[n] = LoDArray(jnp.asarray(arr.data),
+                                   jnp.asarray(arr.lens, jnp.int32))
+            else:
+                args[n] = jnp.asarray(np.asarray(v, dtype=spec["dtype"]))
+        out = []
+        for v in self._exported.call(args):
+            out.append(v if isinstance(v, LoDArray) else np.asarray(v))
+        return out
+
+
+_LOD_SERIALIZATION_DONE = False
+
+
+def _register_lod_serialization():
+    """Teach jax.export to serialize the LoDArray pytree (once per
+    process): serialized as its (data, lens[, outer...]) children with the
+    outer-level count as auxiliary data."""
+    global _LOD_SERIALIZATION_DONE
+    if _LOD_SERIALIZATION_DONE:
+        return
+    from jax import export as jax_export
+    from ..core.lod import LoDArray
+
+    jax_export.register_pytree_node_serialization(
+        LoDArray,
+        serialized_name="paddle_tpu.LoDArray",
+        serialize_auxdata=lambda aux: str(int(aux)).encode(),
+        deserialize_auxdata=lambda b: int(b.decode()))
+    _LOD_SERIALIZATION_DONE = True
 
 
 def load_inference_artifact(dirname):
     from jax import export as jax_export
+
+    _register_lod_serialization()
 
     with open(os.path.join(dirname, ARTIFACT_FILENAME), "rb") as f:
         exported = jax_export.deserialize(bytearray(f.read()))

@@ -26,7 +26,17 @@ _NEG = -1e30
 
 
 def _ctc_loss(logits, x_lens, labels, y_lens, blank):
-    """logits [b, T, C] unnormalized; labels [b, U] int; returns [b, 1]."""
+    """logits [b, T, C] unnormalized; labels [b, U] int; returns [b, 1].
+    Dispatches to the Pallas whole-recurrence kernel under use_pallas_ctc
+    (backward always runs the scan path via custom_vjp, like the RNN
+    cells)."""
+    from ..core.flags import get_flag
+    if get_flag("use_pallas_ctc") and logits.shape[1] > 1:
+        return _ctc_loss_pallas(logits, x_lens, labels, y_lens, blank)
+    return _ctc_loss_scan(logits, x_lens, labels, y_lens, blank)
+
+
+def _ctc_loss_scan(logits, x_lens, labels, y_lens, blank):
     b, T, C = logits.shape
     U = labels.shape[1]
     S = 2 * U + 1
@@ -83,6 +93,71 @@ def _ctc_loss(logits, x_lens, labels, y_lens, blank):
     else:
         c = init
     return (-c["final"])[:, None]
+
+
+import functools
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _ctc_loss_pallas(logits, x_lens, labels, y_lens, blank):
+    """Pallas whole-recurrence CTC forward (alpha VMEM-resident across T,
+    the warp-ctc shared-memory pattern, pallas_kernels.ctc_alpha_pallas);
+    the emit gather, masks and t=0 init are precomputed here where XLA owns
+    them. Backward = jax.vjp of the scan path (custom_vjp)."""
+    from .pallas_kernels import ctc_alpha_pallas
+
+    b, T, C = logits.shape
+    U = labels.shape[1]
+    S = 2 * U + 1
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    logp = jnp.swapaxes(logp, 0, 1)                       # [T, b, C]
+
+    z = jnp.full((b, S), blank, dtype=jnp.int32)
+    z = z.at[:, 1::2].set(labels.astype(jnp.int32))
+    s_valid = jnp.arange(S)[None, :] < (2 * y_lens[:, None] + 1)
+    z_prev2 = jnp.pad(z, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    can_skip = (jnp.arange(S)[None, :] % 2 == 1) & (z != z_prev2)
+
+    alpha0 = jnp.full((b, S), _NEG)
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+    first_lab = jnp.take_along_axis(logp[0], z, axis=1)[:, 1]
+    alpha0 = alpha0.at[:, 1].set(jnp.where(y_lens > 0, first_lab, _NEG))
+    alpha0 = jnp.where(s_valid, alpha0, _NEG)
+
+    last = 2 * y_lens
+    a_last = jnp.take_along_axis(alpha0, last[:, None], axis=1)[:, 0]
+    a_lab = jnp.take_along_axis(alpha0, jnp.maximum(last - 1, 0)[:, None],
+                                axis=1)[:, 0]
+    a_lab = jnp.where(y_lens > 0, a_lab, _NEG)
+    final0 = jnp.where(x_lens == 1, jnp.logaddexp(a_last, a_lab), _NEG)
+
+    sp = max(8, -(-S // 8) * 8)              # pad S to a sublane multiple
+    pad = sp - S
+    e = jnp.swapaxes(jnp.take_along_axis(
+        logp, jnp.broadcast_to(z[None], (T, b, S)), axis=2), 0, 1)
+    e = jnp.pad(e, ((0, 0), (0, 0), (0, pad)), constant_values=_NEG)
+    a0 = jnp.pad(alpha0, ((0, 0), (0, pad)), constant_values=_NEG)
+    cs = jnp.pad(can_skip.astype(logp.dtype), ((0, 0), (0, pad)))
+    sv = jnp.pad(s_valid.astype(logp.dtype), ((0, 0), (0, pad)))
+    return ctc_alpha_pallas(
+        e, a0, final0[:, None].astype(logp.dtype), cs, sv,
+        x_lens.astype(jnp.int32).reshape(b, 1),
+        y_lens.astype(jnp.int32).reshape(b, 1))
+
+
+def _ctc_pallas_fwd(logits, x_lens, labels, y_lens, blank):
+    return (_ctc_loss_pallas(logits, x_lens, labels, y_lens, blank),
+            (logits, x_lens, labels, y_lens))
+
+
+def _ctc_pallas_bwd(blank, res, ct):
+    logits, x_lens, labels, y_lens = res
+    _, vjp = jax.vjp(
+        lambda lg: _ctc_loss_scan(lg, x_lens, labels, y_lens, blank), logits)
+    return (vjp(ct)[0], None, None, None)
+
+
+_ctc_loss_pallas.defvjp(_ctc_pallas_fwd, _ctc_pallas_bwd)
 
 
 def _warpctc_grad_maker(op):

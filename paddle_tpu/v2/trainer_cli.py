@@ -78,7 +78,7 @@ def job_checkgrad(topo, main, startup, args):
     import paddle_tpu.fluid as fluid
 
     with fluid.program_guard(main, startup):
-        grads = fluid.append_backward(topo.cost)
+        fluid.append_backward(topo.cost)
     exe = fluid.Executor(fluid.CPUPlace())
     scope = fluid.Scope()
     exe.run(startup, scope=scope)
@@ -213,11 +213,24 @@ def main(argv=None):
         exe.run(startup, scope=scope)
         out_var = topo.outputs[-1]
         out_name = out_var.var.name if hasattr(out_var, "var") else out_var
-        feeds = [d.name for d in topo.data_layers if not d.is_pending]
+        # feeds = only the data layers the pruned output slice reads; an
+        # inference config (is_infer outputs(net), the reference MergeModel
+        # use) needs no label feed — a cost output honestly still does
+        from paddle_tpu.fluid.io import _prune_program
+        from paddle_tpu.core.block_walk import free_reads
+        declared = [d.name for d in topo.data_layers if not d.is_pending]
+        pruned = _prune_program(main_prog, declared, [out_name])
+        free = free_reads(pruned, 0)
+        feeds = [n for n in declared if n in free]
+        if set(declared) - set(feeds):
+            print("note: data layers not reachable from the merged output "
+                  f"were dropped from the feed list: "
+                  f"{sorted(set(declared) - set(feeds))}")
         aot.export_inference_artifact(args.model_dir or "merged_model",
                                       feeds, [out_name], exe,
                                       main_program=main_prog, scope=scope)
-        print(f"merged model -> {args.model_dir or 'merged_model'}")
+        print(f"merged model -> {args.model_dir or 'merged_model'} "
+              f"(output {out_name!r}, feeds {feeds})")
         return 0
 
     import paddle_tpu.fluid as fluid
@@ -230,11 +243,8 @@ def main(argv=None):
     reader = _make_reader(topo, args)
 
     if args.job == "train":
-        costs = []
-
         def handler(evt):
             if isinstance(evt, v2.event.EndPass):
-                costs.append(evt.metrics["cost"])
                 print(f"Pass {evt.pass_id}: cost={evt.metrics['cost']:.6f}")
 
         trainer.train(reader, num_passes=args.num_passes,

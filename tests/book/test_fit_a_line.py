@@ -2,22 +2,25 @@
 
 Reference: /root/reference/python/paddle/fluid/tests/book/test_fit_a_line.py —
 train a linear model until avg loss drops under a threshold, then round-trip
-save/load_inference_model. Here synthetic data stands in for the UCI housing
-reader (the dataset module arrives with the input-pipeline milestone).
+save/load_inference_model — fed from the uci_housing dataset module
+(paddle_tpu.dataset.uci_housing mirrors python/paddle/v2/dataset/
+uci_housing.py; real file when cached, linear-structure synthetic
+otherwise).
 """
 
 import numpy as np
 import pytest
 
 import paddle_tpu.fluid as fluid
+import paddle_tpu.dataset as dataset
 
 
-def _synthetic_housing(n=512, dim=13, seed=0):
-    rng = np.random.RandomState(seed)
-    x = rng.uniform(-1, 1, (n, dim)).astype("float32")
-    w = rng.uniform(-2, 2, (dim, 1)).astype("float32")
-    y = x @ w + 0.5 + rng.normal(0, 0.01, (n, 1)).astype("float32")
-    return x, y.astype("float32")
+def _housing_arrays():
+    rows = list(dataset.uci_housing.train()())
+    x = np.stack([np.asarray(f, "float32") for f, _ in rows])
+    y = np.asarray([[float(np.asarray(p).reshape(-1)[0])] for _, p in rows],
+                   "float32")
+    return x, y
 
 
 def test_fit_a_line_converges(tmp_path):
@@ -35,15 +38,22 @@ def test_fit_a_line_converges(tmp_path):
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
 
-    xs, ys = _synthetic_housing()
+    xs, ys = _housing_arrays()
     batch = 64
     loss = None
-    for epoch in range(30):
+    for epoch in range(40):
         for i in range(0, len(xs), batch):
             loss, = exe.run(main,
                             feed={"x": xs[i:i + batch], "y": ys[i:i + batch]},
                             fetch_list=[avg_cost])
-    assert loss is not None and float(loss) < 0.05, float(loss)
+    # full-data MSE against the DATASET'S OWN least-squares noise floor —
+    # valid for both the synthetic fallback (floor ~0.23) and the real
+    # Boston file (unnormalized prices, floor ~22)
+    Xa = np.hstack([xs, np.ones((len(xs), 1), "float32")])
+    w_lsq, *_ = np.linalg.lstsq(Xa, ys, rcond=None)
+    floor = float(np.mean((Xa @ w_lsq - ys) ** 2))
+    mse, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[avg_cost])
+    assert float(mse) < max(1.3 * floor, 0.3), (float(mse), floor)
 
     # save / load inference model round trip (reference book test does this)
     model_dir = str(tmp_path / "fit_a_line.model")
@@ -52,4 +62,5 @@ def test_fit_a_line_converges(tmp_path):
         model_dir, exe)
     assert feed_names == ["x"]
     pred, = exe.run(infer_prog, feed={"x": xs[:8]}, fetch_list=fetch_vars)
-    np.testing.assert_allclose(pred, ys[:8], atol=0.2)
+    tol = max(1.5, 4.0 * np.sqrt(floor))
+    np.testing.assert_allclose(pred, ys[:8], atol=tol)

@@ -4,24 +4,41 @@ Reference: /root/reference/python/paddle/fluid/tests/book/
 test_image_classification_train.py — vgg16_bn_drop (img_conv_group stacks
 with batch-norm + dropout) and resnet_cifar10 (conv_bn_layer /
 shortcut / basicblock composition), trained until the loss drops.
-Synthetic CIFAR-shaped data keeps CI hermetic; shapes/depths are scaled
-down so the convergence contract runs in seconds while exercising the
-same op graph (conv2d, batch_norm, pool2d, dropout, elementwise_add).
+Fed from the cifar dataset module (paddle_tpu.dataset.cifar: real
+pickled batches when cached, class-templated 32x32 synthetic otherwise);
+net depths are scaled down so the convergence contract runs in CI seconds
+while exercising the same op graph (conv2d, batch_norm, pool2d, dropout,
+elementwise_add).
 """
+
+import itertools
 
 import numpy as np
 import pytest
 
 import paddle_tpu.fluid as fluid
+import paddle_tpu.dataset as dataset
+from paddle_tpu.dataset import common as _dcommon
+from paddle_tpu.dataset.cifar import CIFAR10_URL
+
+# the synthetic fallback is templated (separable in a few epochs); real
+# CIFAR-10 under this test's deliberately tiny budget (256 samples, <=6
+# epochs, scaled-down nets) only clears a beats-chance bar
+_REAL_DATA = _dcommon.have_file(CIFAR10_URL, "cifar")
+_ACC_GATE = 0.25 if _REAL_DATA else 0.7
+
+_CACHE = {}
 
 
-def _synthetic_images(n=256, c=3, hw=16, classes=4, seed=5):
-    """Class-dependent blob patterns, learnable by a small convnet."""
-    rng = np.random.RandomState(seed)
-    base = rng.normal(0, 1.0, (classes, c, hw, hw)).astype("float32")
-    labels = rng.randint(0, classes, n)
-    x = base[labels] + rng.normal(0, 0.6, (n, c, hw, hw)).astype("float32")
-    return x, labels.reshape(-1, 1).astype("int64")
+def _cifar_arrays(n=256):
+    """First n cifar10 train samples as NCHW arrays + int64 labels."""
+    if n not in _CACHE:
+        rows = list(itertools.islice(dataset.cifar.train10()(), n))
+        x = np.stack([np.asarray(r[0], "float32").reshape(3, 32, 32)
+                      for r in rows])
+        y = np.asarray([[int(r[1])] for r in rows], "int64")
+        _CACHE[n] = (x, y)
+    return _CACHE[n]
 
 
 def vgg_bn_drop(input, classes):
@@ -80,7 +97,7 @@ def resnet_cifar10(input, classes, depth=8):
 
 @pytest.mark.parametrize("net", ["resnet", "vgg"])
 def test_image_classification_converges(net):
-    classes, hw = 4, 16
+    classes, hw = 10, 32
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
@@ -99,7 +116,7 @@ def test_image_classification_converges(net):
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
 
-    xs, ys = _synthetic_images(classes=classes, hw=hw)
+    xs, ys = _cifar_arrays()
     batch = 64
     first_loss, last_acc = None, 0.0
     for epoch in range(6):
@@ -115,12 +132,12 @@ def test_image_classification_converges(net):
         last_acc = float(np.mean(accs))
         if last_acc > 0.9:
             break
-    assert last_acc > 0.7, (
+    assert last_acc > _ACC_GATE, (
         f"{net} failed to converge: acc={last_acc}, first loss={first_loss}")
 
 
 def test_image_classification_inference_roundtrip(tmp_path):
-    classes, hw = 4, 16
+    classes, hw = 10, 32
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
@@ -134,7 +151,7 @@ def test_image_classification_inference_roundtrip(tmp_path):
 
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
-    xs, ys = _synthetic_images(n=128, classes=classes, hw=hw)
+    xs, ys = _cifar_arrays(128)
     for _ in range(3):
         exe.run(main, feed={"pixel": xs[:64], "label": ys[:64]},
                 fetch_list=[avg_cost])

@@ -3,21 +3,28 @@
 Reference: /root/reference/python/paddle/fluid/tests/book/
 test_recognize_digits.py:45-127 — an MLP (two hidden fc layers + softmax),
 trained with Adam until accuracy crosses a threshold, with inference-model
-round trip. Synthetic separable data stands in for the MNIST reader until the
-dataset milestone; the convergence assertion contract is the same.
+round trip — fed from the mnist dataset module (paddle_tpu.dataset.mnist:
+real idx files when cached, class-templated synthetic otherwise); the
+convergence assertion contract is the reference's.
 """
+
+import itertools
 
 import numpy as np
 
 import paddle_tpu.fluid as fluid
+import paddle_tpu.dataset as dataset
+
+_CACHE = {}
 
 
-def _synthetic_digits(n=2048, dim=64, classes=10, seed=1):
-    rng = np.random.RandomState(seed)
-    centers = rng.normal(0, 2.0, (classes, dim)).astype("float32")
-    labels = rng.randint(0, classes, n)
-    x = centers[labels] + rng.normal(0, 0.8, (n, dim)).astype("float32")
-    return x.astype("float32"), labels.reshape(-1, 1).astype("int64")
+def _digit_arrays(n=2048):
+    if "xy" not in _CACHE:
+        rows = list(itertools.islice(dataset.mnist.train()(), n))
+        x = np.stack([np.asarray(r[0], "float32") for r in rows])
+        y = np.asarray([[int(r[1])] for r in rows], "int64")
+        _CACHE["xy"] = (x, y)
+    return _CACHE["xy"]
 
 
 def mlp(img, label):
@@ -34,7 +41,7 @@ def test_recognize_digits_mlp_converges(tmp_path):
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
-        img = fluid.layers.data("img", shape=[64])
+        img = fluid.layers.data("img", shape=[784])
         label = fluid.layers.data("label", shape=[1], dtype="int64")
         prediction, avg_loss, acc = mlp(img, label)
         opt = fluid.optimizer.Adam(learning_rate=0.002)
@@ -43,7 +50,7 @@ def test_recognize_digits_mlp_converges(tmp_path):
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
 
-    xs, ys = _synthetic_digits()
+    xs, ys = _digit_arrays()
     batch = 128
     acc_val = 0.0
     for epoch in range(10):
@@ -78,7 +85,7 @@ def test_recognize_digits_parallel_matches_reference_variant():
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = startup.random_seed = 2
     with fluid.program_guard(main, startup):
-        img = fluid.layers.data("img", shape=[64])
+        img = fluid.layers.data("img", shape=[784])
         label = fluid.layers.data("label", shape=[1], dtype="int64")
         prediction, avg_loss, acc = mlp(img, label)
         fluid.optimizer.Adam(learning_rate=0.002).minimize(avg_loss,
@@ -89,7 +96,7 @@ def test_recognize_digits_parallel_matches_reference_variant():
     mesh = make_mesh(8, axes=("dp",))
     plan = ShardingPlan(mesh)
 
-    xs, ys = _synthetic_digits()
+    xs, ys = _digit_arrays()
     batch = 128
     block = main.global_block()
     feed0 = {"img": xs[:batch], "label": ys[:batch]}
@@ -125,7 +132,7 @@ def test_recognize_digits_pserver_variant():
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = startup.random_seed = 3
     with fluid.program_guard(main, startup):
-        img = fluid.layers.data("img", shape=[64])
+        img = fluid.layers.data("img", shape=[784])
         label = fluid.layers.data("label", shape=[1], dtype="int64")
         prediction, avg_loss, acc = mlp(img, label)
         params_grads = fluid.append_backward(avg_loss)
@@ -137,7 +144,7 @@ def test_recognize_digits_pserver_variant():
     client = ParamClient([rpc.address])
     client.init_params({n: np.asarray(scope.find_var(n)) for n in pnames})
 
-    xs, ys = _synthetic_digits()
+    xs, ys = _digit_arrays()
     batch = 128
     grad_names = [g.name for _, g in params_grads]
     acc_val = 0.0

@@ -3,16 +3,24 @@
 Reference: /root/reference/python/paddle/fluid/tests/book/
 test_understand_sentiment.py — convolution_net (two parallel
 sequence_conv_pool towers) and stacked_lstm_net (fc+lstm stacked with
-max-pool heads), over ragged token sequences. Synthetic token-class data
-stands in for the IMDB reader.
+max-pool heads), over ragged token sequences — fed from the imdb dataset
+module (paddle_tpu.dataset.imdb: real aclImdb tarball when cached,
+marker-token synthetic corpus otherwise, same reader schema).
 """
 
 import numpy as np
 import pytest
 
 import paddle_tpu.fluid as fluid
+import paddle_tpu.dataset as dataset
+from paddle_tpu.dataset import common as _dcommon
 
-DICT_DIM = 60
+# LAZY corpus/dict (a cached real aclImdb tarball takes seconds to scan —
+# never at module import); real data under this test's tiny budget only
+# clears a beats-chance-by-margin bar, the synthetic corpus separates fast
+_REAL_DATA = _dcommon.have_file(dataset.imdb.URL, "imdb")
+_ACC_GATE = 0.6 if _REAL_DATA else 0.85
+VOCAB_CAP = 5000          # cap real-vocab ids so the test embedding stays small
 CLASS_DIM = 2
 EMB_DIM = 16
 
@@ -49,16 +57,34 @@ def stacked_lstm_net(data, dict_dim, class_dim=2, emb_dim=16, hid_dim=32,
                            act="softmax")
 
 
+_SAMPLES = None
+_DICT_DIM = None
+
+
+def _dict_dim():
+    global _DICT_DIM
+    if _DICT_DIM is None:
+        _DICT_DIM = min(len(dataset.imdb.word_dict()), VOCAB_CAP)
+    return _DICT_DIM
+
+
+def _imdb_samples():
+    global _SAMPLES
+    if _SAMPLES is None:
+        wd = dataset.imdb.word_dict()
+        cap = _dict_dim()
+        _SAMPLES = [(np.minimum(
+            np.asarray(ids, "int64").reshape(-1, 1)[:64], cap - 1), int(l))
+            for ids, l in dataset.imdb.train(wd)()]
+    return _SAMPLES
+
+
 def _make_batch(rng, n=32):
-    seqs, ys = [], []
-    for _ in range(n):
-        y = rng.randint(0, CLASS_DIM)
-        ln = rng.randint(4, 10)
-        # class-dependent vocabulary halves
-        seqs.append((rng.randint(0, DICT_DIM // 2, (ln, 1))
-                     + (DICT_DIM // 2) * y).astype("int64"))
-        ys.append([y])
-    return seqs, np.array(ys, dtype="int64")
+    samples = _imdb_samples()
+    idx = rng.randint(0, len(samples), n)
+    seqs = [samples[i][0] for i in idx]
+    ys = np.array([[samples[i][1]] for i in idx], dtype="int64")
+    return seqs, ys
 
 
 @pytest.mark.parametrize("net", ["conv", "stacked_lstm"])
@@ -69,9 +95,9 @@ def test_understand_sentiment_converges(net):
                                  lod_level=1)
         label = fluid.layers.data("label", shape=[1], dtype="int64")
         if net == "conv":
-            prediction = convolution_net(data, DICT_DIM, CLASS_DIM)
+            prediction = convolution_net(data, _dict_dim(), CLASS_DIM)
         else:
-            prediction = stacked_lstm_net(data, DICT_DIM, CLASS_DIM)
+            prediction = stacked_lstm_net(data, _dict_dim(), CLASS_DIM)
         cost = fluid.layers.cross_entropy(input=prediction, label=label)
         avg_cost = fluid.layers.mean(cost)
         acc = fluid.layers.accuracy(input=prediction, label=label)
@@ -82,12 +108,12 @@ def test_understand_sentiment_converges(net):
 
     rng = np.random.RandomState(0)
     accs = []
-    for it in range(50):
+    for it in range(80):
         seqs, ys = _make_batch(rng)
         loss, a = exe.run(main, feed={"words": seqs, "label": ys},
                           fetch_list=[avg_cost, acc])
         accs.append(float(a))
-        if it > 10 and np.mean(accs[-5:]) > 0.95:
+        if it > 10 and np.mean(accs[-5:]) > max(0.95, _ACC_GATE):
             break
-    assert np.mean(accs[-5:]) > 0.85, (
+    assert np.mean(accs[-5:]) > _ACC_GATE, (
         f"{net} sentiment net failed to learn: acc={np.mean(accs[-5:])}")

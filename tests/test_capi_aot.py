@@ -123,3 +123,43 @@ def test_capi_dense_example_end_to_end():
     # softmax row sums to 1
     sum_line = [l for l in r.stdout.splitlines() if l.startswith("sum:")][0]
     assert abs(float(sum_line.split()[1]) - 1.0) < 1e-4, r.stdout
+
+
+def test_aot_export_lod_model():
+    """A sequence model (embedding -> LSTM -> last step -> softmax) exports
+    with symbolic batch AND padded-length dims; the artifact serves ragged
+    feeds of any shape."""
+    import paddle_tpu.dataset  # noqa: F401  (module import sanity)
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 4
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data("words", shape=[1], dtype="int64",
+                                  lod_level=1)
+        emb = fluid.layers.embedding(words, size=(50, 8))
+        proj = fluid.layers.fc(emb, 16 * 4)
+        h, _ = fluid.layers.dynamic_lstm(proj, size=16 * 4)
+        last = fluid.layers.sequence_last_step(h)
+        probs = fluid.layers.fc(last, 3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(0)
+    seqs = [rng.randint(0, 50, (int(n), 1)).astype("int64")
+            for n in (4, 7, 3)]
+    ref = exe.run(main, feed={"words": seqs}, fetch_list=[probs],
+                  scope=scope)[0]
+
+    d = tempfile.mkdtemp()
+    aot.export_inference_artifact(d, ["words"], [probs], exe,
+                                  main_program=main, scope=scope)
+    art = aot.load_inference_artifact(d)
+    out = art.run({"words": seqs})[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    # different batch AND different max_len through the same artifact
+    seqs2 = [rng.randint(0, 50, (9, 1)).astype("int64")]
+    out2 = art.run({"words": seqs2})[0]
+    assert out2.shape == (1, 3)
+    np.testing.assert_allclose(out2.sum(1), 1.0, atol=1e-5)

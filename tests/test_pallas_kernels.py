@@ -143,3 +143,37 @@ def test_gru_op_parity_with_pallas_flag():
     pallas = run(True)
     np.testing.assert_allclose(pallas, base, rtol=1e-5, atol=1e-6)
     assert base[-1] < base[0]
+
+
+def test_pallas_ctc_matches_scan_path():
+    """The Pallas whole-recurrence CTC forward is numerically pinned to the
+    lax.scan path (losses AND gradients), ragged x/y lengths included."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.ops.ctc_ops import _ctc_loss
+
+    rng = np.random.RandomState(0)
+    b, T, C, U = 4, 11, 7, 4
+    logits = jnp.asarray(rng.normal(0, 1, (b, T, C)).astype("float32"))
+    x_lens = jnp.asarray([11, 7, 9, 5], jnp.int32)
+    labels = jnp.asarray(rng.randint(1, C, (b, U)), jnp.int32)
+    # repeated labels exercise the can_skip mask
+    labels = labels.at[0, 1].set(labels[0, 0])
+    y_lens = jnp.asarray([4, 2, 3, 1], jnp.int32)
+
+    ref, ref_grad = jax.value_and_grad(
+        lambda lg: jnp.sum(_ctc_loss(lg, x_lens, labels, y_lens, 0)))(logits)
+
+    set_flags({"use_pallas_ctc": True})
+    try:
+        got, got_grad = jax.value_and_grad(
+            lambda lg: jnp.sum(_ctc_loss(lg, x_lens, labels, y_lens, 0)))(
+                logits)
+    finally:
+        set_flags({"use_pallas_ctc": False})
+
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_grad), np.asarray(ref_grad),
+                               rtol=1e-4, atol=1e-5)

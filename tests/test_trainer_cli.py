@@ -61,3 +61,38 @@ def test_job_time():
     r = _run_cli("--job=time", "--batches_per_pass=3")
     assert r.returncode == 0, r.stdout + r.stderr
     assert "ms/batch" in r.stdout
+
+
+INFER_CONFIG = """
+from paddle_tpu.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=0.01,
+         learning_method=MomentumOptimizer(0.9))
+net = data_layer('data', size=12)
+net = fc_layer(input=net, size=4, act=SoftmaxActivation())
+outputs(net)
+"""
+
+
+def test_job_merge_inference_config():
+    """merge (the MergeModel analog) on an inference config produces a
+    self-contained artifact with only the real input as a feed."""
+    import numpy as np
+    cfg = os.path.join(tempfile.mkdtemp(), "icfg.py")
+    with open(cfg, "w") as f:
+        f.write(INFER_CONFIG)
+    md = os.path.join(tempfile.mkdtemp(), "merged")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.v2.trainer_cli",
+         f"--config={cfg}", "--job=merge", f"--model_dir={md}"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.fluid import aot
+    art = aot.load_inference_artifact(md)
+    assert art.feed_names == ["data"]
+    out = art.run({"data": np.random.rand(3, 12).astype("float32")})[0]
+    assert out.shape == (3, 4)
+    np.testing.assert_allclose(out.sum(1), 1.0, atol=1e-5)
