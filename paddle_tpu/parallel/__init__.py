@@ -12,7 +12,13 @@ with jax.sharding shardings over a Mesh and lets GSPMD insert ICI collectives.
 from .sharding import (ShardingPlan, make_mesh, shard_program_step,
                        place_feed)
 from .ring_attention import ring_attention
+from .moe import moe_ffn, init_moe_params, shard_moe_params
+from .pipeline import (pipeline_apply, shard_pipeline_params,
+                       pipeline_stack_reference)
 from .multihost import init_multihost, global_mesh
 
 __all__ = ["ShardingPlan", "make_mesh", "shard_program_step", "place_feed",
-           "ring_attention", "init_multihost", "global_mesh"]
+           "ring_attention", "init_multihost", "global_mesh",
+           "moe_ffn", "init_moe_params", "shard_moe_params",
+           "pipeline_apply", "shard_pipeline_params",
+           "pipeline_stack_reference"]
