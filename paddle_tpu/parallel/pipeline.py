@@ -22,8 +22,6 @@ on zeros), exactly like hardware pipelines.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P

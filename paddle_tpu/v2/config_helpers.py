@@ -754,3 +754,364 @@ def parse_config(source, config_args=None, main_program=None,
         exec(compile(source, "<v2-config>", "exec"), glb)
         topo = get_topology()
     return topo, main, startup
+
+
+# ---------------------------------------------------------------------------
+# round-4 DSL breadth: the layers that map 1:1 onto registered ops
+# (reference trainer_config_helpers/layers.py; validated by running the
+# reference's own tests/configs through parse_config)
+# ---------------------------------------------------------------------------
+
+class ExpActivation(_Activation):
+    act = "exp"
+
+
+class AbsActivation(_Activation):
+    act = "abs"
+
+
+class SquareActivation(_Activation):
+    act = "square"
+
+
+class BReluActivation(_Activation):
+    act = "brelu"
+
+
+class SoftReluActivation(_Activation):
+    act = "soft_relu"
+
+
+class STanhActivation(_Activation):
+    act = "stanh"
+
+
+class AggregateLevel:
+    TO_NO_SEQUENCE = "non-seq"
+    TO_SEQUENCE = "seq"
+
+
+class ExpandLevel:
+    FROM_NO_SEQUENCE = AggregateLevel.TO_NO_SEQUENCE
+    FROM_SEQUENCE = AggregateLevel.TO_SEQUENCE
+
+
+def _unary_layer(op_type, input, name=None, attrs=None, **meta):
+    helper_var = _unwrap(input)
+    from ..fluid.layer_helper import LayerHelper
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_tmp_variable(
+        helper_var.dtype, shape=helper_var.shape,
+        lod_level=helper_var.lod_level)
+    helper.append_op(op_type, inputs={"X": [helper_var.name]},
+                     outputs={"Out": [out.name]}, attrs=attrs or {})
+    return LayerOutput(out, size=getattr(input, "size", None),
+                       hwc=getattr(input, "hwc", None),
+                       is_seq=getattr(input, "is_seq", False), name=name)
+
+
+def clip_layer(input, min, max, name=None, **kw):
+    return _unary_layer("clip", input, name=name,
+                        attrs={"min": float(min), "max": float(max)})
+
+
+def scaling_layer(input, weight, name=None, **kw):
+    """Row-wise scale by a [N, 1] weight layer (layers.py scaling_layer)."""
+    import paddle_tpu.fluid as fluid
+    out = fluid.layers.elementwise_mul(_unwrap(input), _unwrap(weight),
+                                       axis=0)
+    return LayerOutput(out, size=getattr(input, "size", None), name=name,
+                       is_seq=getattr(input, "is_seq", False))
+
+
+def slope_intercept_layer(input, slope=1.0, intercept=0.0, name=None, **kw):
+    return _unary_layer("scale", input, name=name,
+                        attrs={"scale": float(slope),
+                               "bias": float(intercept)})
+
+
+def power_layer(input, power, name=None, **kw):
+    return _unary_layer("pow", input, name=name,
+                        attrs={"factor": float(power)})
+
+
+def trans_layer(input, name=None, **kw):
+    """2-D transpose (layers.py trans_layer over TransLayer)."""
+    import paddle_tpu.fluid as fluid
+    out = fluid.layers.transpose(_unwrap(input), perm=[1, 0])
+    return LayerOutput(out, size=getattr(input, "size", None), name=name)
+
+
+def interpolation_layer(input, weight, name=None, **kw):
+    """w * in0 + (1-w) * in1 with a [N, 1] weight (layers.py
+    interpolation_layer)."""
+    import paddle_tpu.fluid as fluid
+    a, b = input
+    w = _unwrap(weight)
+    av = fluid.layers.elementwise_mul(_unwrap(a), w, axis=0)
+    one_minus = fluid.layers.scale(w, scale=-1.0, bias=1.0)
+    bv = fluid.layers.elementwise_mul(_unwrap(b), one_minus, axis=0)
+    out = fluid.layers.elementwise_add(av, bv)
+    return LayerOutput(out, size=getattr(a, "size", None), name=name)
+
+
+def dotmul_operator(a, b, scale=1.0, **kw):
+    import paddle_tpu.fluid as fluid
+    out = fluid.layers.elementwise_mul(_unwrap(a), _unwrap(b))
+    if scale != 1.0:
+        out = fluid.layers.scale(out, scale=float(scale))
+    return LayerOutput(out, size=getattr(a, "size", None))
+
+
+def cos_sim(a, b, scale=1.0, name=None, **kw):
+    import paddle_tpu.fluid as fluid
+    out = fluid.layers.cos_sim(_unwrap(a), _unwrap(b))
+    if scale != 1.0:
+        out = fluid.layers.scale(out, scale=float(scale))
+    return LayerOutput(out, size=1, name=name)
+
+
+def maxout_layer(input, groups, num_channels=None, name=None, **kw):
+    import paddle_tpu.fluid as fluid
+    var, (c, h, w) = _as_image_var(input, num_channels)
+    out = fluid.layers.maxout(var, groups=groups)
+    oc = c // groups
+    return LayerOutput(out, size=oc * h * w, hwc=(oc, h, w), name=name)
+
+
+def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, name=None, **kw):
+    """Zero-pad the C/H/W dims of an image layer (layers.py pad_layer)."""
+    import paddle_tpu.fluid as fluid
+    var, (c, h, w) = _as_image_var(input, None)
+    pc = list(pad_c or [0, 0])
+    ph = list(pad_h or [0, 0])
+    pw = list(pad_w or [0, 0])
+    out = fluid.layers.pad(var, [0, 0] + pc + ph + pw)
+    nc, nh, nw = c + sum(pc), h + sum(ph), w + sum(pw)
+    return LayerOutput(out, size=nc * nh * nw, hwc=(nc, nh, nw), name=name)
+
+
+def expand_layer(input, expand_as, expand_level=None, name=None, **kw):
+    """Tile each row of ``input`` along the matching sequence of
+    ``expand_as`` (layers.py expand_layer -> sequence_expand)."""
+    import paddle_tpu.fluid as fluid
+    if expand_level == ExpandLevel.FROM_SEQUENCE:
+        raise NotImplementedError(
+            "expand_layer FROM_SEQUENCE (sub-sequence granularity) is not "
+            "supported; FROM_NO_SEQUENCE covers the dense->sequence case")
+    out = fluid.layers.sequence_expand(_unwrap(input), _unwrap(expand_as))
+    return LayerOutput(out, size=getattr(input, "size", None), is_seq=True,
+                       name=name)
+
+
+def ctc_layer(input, label, size=None, blank=None, norm_by_times=False,
+              name=None, **kw):
+    """Mean CTC cost (layers.py ctc_layer; the fluid warpctc op implements
+    both the legacy ctc and warp-ctc contracts — delegate)."""
+    return warp_ctc_layer(input, label, blank=blank if blank is not None
+                          else (size - 1 if size else 0),
+                          norm_by_times=norm_by_times, name=name)
+
+
+def warp_ctc_layer(input, label, size=None, blank=0, norm_by_times=False,
+                   name=None, **kw):
+    import paddle_tpu.fluid as fluid
+    out = fluid.layers.mean(fluid.layers.warpctc(
+        _unwrap(input), _unwrap(label, kind="seq_ids"), blank=blank,
+        norm_by_times=norm_by_times))
+    return LayerOutput(out, size=1, name=name)
+
+
+def crf_layer(input, label, size=None, param_attr=None, name=None, **kw):
+    import paddle_tpu.fluid as fluid
+    out = fluid.layers.mean(fluid.layers.linear_chain_crf(
+        _unwrap(input), _unwrap(label, kind="seq_ids"),
+        param_attr=_fluid_param_attr(param_attr)))
+    return LayerOutput(out, size=1, name=name)
+
+
+def rank_cost(left, right, label, name=None, **kw):
+    import paddle_tpu.fluid as fluid
+    out = fluid.layers.mean(fluid.layers.rank_loss(
+        _unwrap(label), _unwrap(left), _unwrap(right)))
+    return LayerOutput(out, size=1, name=name)
+
+
+def huber_regression_cost(input, label, delta=1.0, name=None, **kw):
+    import paddle_tpu.fluid as fluid
+    from ..fluid.layer_helper import LayerHelper
+    helper = LayerHelper("huber_loss", name=name)
+    residual = helper.create_tmp_variable("float32")
+    out = helper.create_tmp_variable("float32")
+    helper.append_op("huber_loss",
+                     inputs={"X": [_unwrap(input).name],
+                             "Y": [_unwrap(label).name]},
+                     outputs={"Out": [out.name],
+                              "Residual": [residual.name]},
+                     attrs={"delta": float(delta)})
+    import paddle_tpu.fluid as fluid
+    return LayerOutput(fluid.layers.mean(out), size=1, name=name)
+
+
+def multi_binary_label_cross_entropy(input, label, name=None, **kw):
+    import paddle_tpu.fluid as fluid
+    out = fluid.layers.mean(fluid.layers.sigmoid_cross_entropy_with_logits(
+        _unwrap(input), _unwrap(label)))
+    return LayerOutput(out, size=1, name=name)
+
+
+def sum_cost(input, name=None, **kw):
+    import paddle_tpu.fluid as fluid
+    from ..fluid.layer_helper import LayerHelper
+    helper = LayerHelper("reduce_sum", name=name)
+    out = helper.create_tmp_variable("float32", shape=())
+    helper.append_op("reduce_sum", inputs={"X": [_unwrap(input).name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"reduce_all": True, "dim": 0, "keep_dim": False})
+    return LayerOutput(out, size=1, name=name)
+
+
+def mse_cost(input, label, name=None, **kw):
+    return regression_cost(input, label, name=name)
+
+
+def bidirectional_gru(input, size, return_seq=True, name=None, **kw):
+    """fwd + reverse grumemory concatenated (networks.py
+    bidirectional_gru)."""
+    fwd = simple_gru(input, size)
+    bwd = simple_gru(input, size, reverse=True)
+    if return_seq:
+        return concat_layer([fwd, bwd])
+    return concat_layer([last_seq(fwd), first_seq(bwd)])
+
+
+def bidirectional_lstm(input, size, return_seq=True, name=None, **kw):
+    fwd = simple_lstm(input, size)
+    bwd = simple_lstm(input, size, reverse=True)
+    if return_seq:
+        return concat_layer([fwd, bwd])
+    return concat_layer([last_seq(fwd), first_seq(bwd)])
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride=1, num_channel=None, act=None,
+                         pool_type=None, name=None, **kw):
+    conv = img_conv_layer(input, filter_size=filter_size,
+                          num_filters=num_filters,
+                          num_channels=num_channel, padding=0, act=act)
+    return img_pool_layer(conv, pool_size=pool_size, stride=pool_stride,
+                          pool_type=pool_type)
+
+
+# LayerOutput arithmetic + layer_math (reference trainer_config_helpers/
+# math.py: `1 + x`, `x * y`, elementwise chains in config scripts)
+def _lo_binary(self, other, op_type, reverse=False):
+    import paddle_tpu.fluid as fluid
+    if isinstance(other, LayerOutput):
+        fn = getattr(fluid.layers, op_type)
+        a, b = (other, self) if reverse else (self, other)
+        # the fluid out var inherits X's static shape, so the LARGER
+        # operand must be X (the reference math.py special-cases the
+        # size-1 operand the same way); a - b with a smaller becomes
+        # -(b - a)
+        sa = a.size or 0
+        sb = b.size or 0
+        negate = False
+        if sb > sa:
+            if op_type == "elementwise_sub":
+                negate = True
+            a, b = b, a
+        out = fn(_unwrap(a), _unwrap(b))
+        if negate:
+            out = fluid.layers.scale(out, scale=-1.0)
+        return LayerOutput(out, size=a.size, is_seq=a.is_seq or b.is_seq,
+                           hwc=a.hwc)
+    scalar = float(other)
+    if op_type == "elementwise_add":
+        return slope_intercept_layer(self, 1.0, scalar)
+    if op_type == "elementwise_sub":
+        return slope_intercept_layer(self, -1.0 if reverse else 1.0,
+                                     scalar if reverse else -scalar)
+    if op_type == "elementwise_mul":
+        return slope_intercept_layer(self, scalar, 0.0)
+    raise TypeError(op_type)
+
+
+LayerOutput.__add__ = lambda s, o: _lo_binary(s, o, "elementwise_add")
+LayerOutput.__radd__ = LayerOutput.__add__
+LayerOutput.__sub__ = lambda s, o: _lo_binary(s, o, "elementwise_sub")
+LayerOutput.__rsub__ = lambda s, o: _lo_binary(s, o, "elementwise_sub",
+                                               reverse=True)
+LayerOutput.__mul__ = lambda s, o: _lo_binary(s, o, "elementwise_mul")
+LayerOutput.__rmul__ = LayerOutput.__mul__
+
+
+__all__ += [
+    "ExpActivation", "AbsActivation", "SquareActivation", "BReluActivation",
+    "SoftReluActivation", "STanhActivation", "AggregateLevel", "ExpandLevel",
+    "clip_layer", "scaling_layer", "slope_intercept_layer", "power_layer",
+    "trans_layer", "interpolation_layer", "dotmul_operator", "cos_sim",
+    "maxout_layer", "pad_layer", "expand_layer", "ctc_layer",
+    "warp_ctc_layer", "crf_layer", "rank_cost", "huber_regression_cost",
+    "multi_binary_label_cross_entropy", "sum_cost", "mse_cost",
+    "bidirectional_gru", "bidirectional_lstm", "simple_img_conv_pool",
+]
+
+
+class _LayerMath:
+    """The config-script math namespace (reference trainer_config_helpers/
+    math.py, exported as ``layer_math``): elementwise functions over
+    LayerOutput."""
+
+    @staticmethod
+    def _u(op, x, attrs=None):
+        return _unary_layer(op, x, attrs=attrs)
+
+    def exp(self, x):
+        return self._u("exp", x)
+
+    def sqrt(self, x):
+        return self._u("sqrt", x)
+
+    def reciprocal(self, x):
+        return self._u("reciprocal", x)
+
+    def log(self, x):
+        return self._u("log", x)
+
+    def abs(self, x):
+        return self._u("abs", x)
+
+    def sigmoid(self, x):
+        return self._u("sigmoid", x)
+
+    def tanh(self, x):
+        return self._u("tanh", x)
+
+    def square(self, x):
+        return self._u("square", x)
+
+    def relu(self, x):
+        return self._u("relu", x)
+
+
+layer_math = _LayerMath()
+
+__all__ += ["layer_math"]
+
+
+def block_expand_layer(input, num_channels=None, block_x=1, block_y=1,
+                       stride_x=1, stride_y=1, padding_x=0, padding_y=0,
+                       name=None, **kw):
+    """Image -> sequence of flattened blocks (layers.py block_expand_layer;
+    the fluid im2sequence op owns the patch walk)."""
+    import paddle_tpu.fluid as fluid
+    var, (c, h, w) = _as_image_var(input, num_channels)
+    out = fluid.layers.im2sequence(var, filter_size=[block_y, block_x],
+                                   stride=[stride_y, stride_x],
+                                   padding=[padding_y, padding_x])
+    return LayerOutput(out, size=c * block_x * block_y, is_seq=True,
+                       name=name)
+
+
+__all__ += ["block_expand_layer"]
