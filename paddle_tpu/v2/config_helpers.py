@@ -1014,8 +1014,9 @@ def _lo_binary(self, other, op_type, reverse=False):
         # operand must be X (the reference math.py special-cases the
         # size-1 operand the same way); a - b with a smaller becomes
         # -(b - a)
-        sa = a.size or 0
-        sb = b.size or 0
+        # pending data layers carry their size in _data_size
+        sa = a.size or a._data_size or 0
+        sb = b.size or b._data_size or 0
         negate = False
         if sb > sa:
             if op_type == "elementwise_sub":

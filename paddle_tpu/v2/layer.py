@@ -64,9 +64,40 @@ regression_cost = _ch.regression_cost
 simple_lstm = _ch.simple_lstm
 simple_gru = _ch.simple_gru
 img_conv_group = _ch.img_conv_group
+bidirectional_gru = _ch.bidirectional_gru
+bidirectional_lstm = _ch.bidirectional_lstm
+simple_img_conv_pool = _ch.simple_img_conv_pool
+
+# round-4 breadth aliases
+clip = _ch.clip_layer
+scaling = _ch.scaling_layer
+slope_intercept = _ch.slope_intercept_layer
+power = _ch.power_layer
+trans = _ch.trans_layer
+interpolation = _ch.interpolation_layer
+cos_sim = _ch.cos_sim
+maxout = _ch.maxout_layer
+pad = _ch.pad_layer
+block_expand = _ch.block_expand_layer
+expand = _ch.expand_layer
+ctc = _ch.ctc_layer
+warp_ctc = _ch.warp_ctc_layer
+crf = _ch.crf_layer
+rank_cost = _ch.rank_cost
+huber_regression_cost = _ch.huber_regression_cost
+multi_binary_label_cross_entropy_cost = _ch.multi_binary_label_cross_entropy
+sum_cost = _ch.sum_cost
+mse_cost = _ch.mse_cost
 
 __all__ = ["data", "fc", "img_conv", "img_pool", "img_cmrnorm",
            "batch_norm", "addto", "concat", "dropout", "embedding",
            "lstmemory", "grumemory", "last_seq", "first_seq", "pooling",
            "cross_entropy_cost", "classification_cost", "regression_cost",
-           "simple_lstm", "simple_gru", "img_conv_group", "LayerOutput"]
+           "simple_lstm", "simple_gru", "img_conv_group",
+           "bidirectional_gru", "bidirectional_lstm",
+           "simple_img_conv_pool", "clip", "scaling", "slope_intercept",
+           "power", "trans", "interpolation", "cos_sim", "maxout", "pad",
+           "block_expand", "expand", "ctc", "warp_ctc", "crf", "rank_cost",
+           "huber_regression_cost",
+           "multi_binary_label_cross_entropy_cost", "sum_cost", "mse_cost",
+           "LayerOutput"]
