@@ -14,12 +14,12 @@ test() over a held-out reader — used exactly like
 from . import event
 from .trainer import SGD
 from . import (activation, attr, config_helpers, data_type, layer,
-               optimizer, pooling)
+               optimizer, parameters, pooling)
 from .config_helpers import parse_config
 
 # paddle.v2.trainer.SGD spelling (reference v2/trainer.py)
 from . import trainer
 
 __all__ = ["event", "SGD", "trainer", "layer", "activation", "pooling",
-           "attr", "data_type", "optimizer", "config_helpers",
+           "attr", "data_type", "optimizer", "parameters", "config_helpers",
            "parse_config"]

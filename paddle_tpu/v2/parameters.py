@@ -1,0 +1,82 @@
+"""v2 Parameters API (reference python/paddle/v2/parameters.py:
+``parameters = paddle.parameters.create(cost)``; names/shapes/get/set and
+the to_tar/from_tar checkpoint form).
+
+Here parameter storage is the fluid Scope (the reference wraps the C++
+GradientMachine's parameter buffers); ``create`` returns a view bound to
+the cost's program + a scope, initialized by the startup program on first
+use by the trainer. to_tar/from_tar reuse the trainer's tar codec so
+reference-style v2 checkpoints round-trip.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Parameters:
+    def __init__(self, program, scope=None):
+        self._program = program
+        self._scope = scope
+
+    def _bind(self, scope):
+        self._scope = scope
+
+    def names(self):
+        return [p.name for p in self._program.all_parameters()]
+
+    def keys(self):
+        return self.names()
+
+    def shape(self, name):
+        return tuple(self._program.global_block().var(name).shape)
+
+    def get(self, name):
+        if self._scope is None:
+            raise RuntimeError("parameters not initialized yet (bind via "
+                               "the trainer or pass a scope)")
+        return np.asarray(self._scope.find_var(name))
+
+    def set(self, name, value):
+        self._scope.set(name, np.asarray(value))
+
+    def __iter__(self):
+        return iter(self.names())
+
+    def to_tar(self, f):
+        """Write every parameter as an .npy tar member (the reference's
+        parameters.to_tar wire shape: one member per parameter)."""
+        import tarfile
+        import io
+        with tarfile.open(fileobj=f, mode="w") as tf:
+            for name in self.names():
+                buf = io.BytesIO()
+                np.save(buf, self.get(name), allow_pickle=False)
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name=name + ".npy")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+
+    def from_tar(self, f):
+        import tarfile
+        import io
+        with tarfile.open(fileobj=f, mode="r") as tf:
+            for m in tf.getmembers():
+                if not m.name.endswith(".npy"):
+                    continue
+                arr = np.load(io.BytesIO(tf.extractfile(m).read()),
+                              allow_pickle=False)
+                self._scope.set(m.name[:-4], arr)
+        return self
+
+
+def create(cost):
+    """Parameters view over the program that computes ``cost`` (reference
+    parameters.create walks the topology the same way)."""
+    from .config_helpers import LayerOutput
+
+    var = cost.var if isinstance(cost, LayerOutput) else cost
+    return Parameters(var.block.program)
+
+
+__all__ = ["Parameters", "create"]

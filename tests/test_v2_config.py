@@ -269,3 +269,38 @@ outputs(w)
     got, = exe2.run(main2, feed={"y": yv, "z": zv},
                     fetch_list=[topo2.cost])
     np.testing.assert_allclose(got, w_expect, rtol=1e-5)
+
+
+def test_v2_parameters_create_and_tar_roundtrip():
+    """paddle.v2.parameters.create(cost): names/shape/get/set + tar
+    round-trip (reference v2/parameters.py)."""
+    import io
+    paddle = v2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(6))
+        pred = paddle.layer.fc(x, size=3,
+                               act=paddle.activation.Softmax())
+        label = paddle.layer.data("label",
+                                  paddle.data_type.integer_value(3))
+        cost = paddle.layer.classification_cost(input=pred, label=label)
+        params = paddle.parameters.create(cost)
+
+        trainer = paddle.SGD(cost=cost,
+                             update_equation=paddle.optimizer.Momentum(
+                                 momentum=0.9, learning_rate=0.1),
+                             main_program=main, startup_program=startup)
+    params._bind(trainer.scope)
+    assert params.names() and all(params.shape(n) for n in params)
+
+    before = {n: params.get(n).copy() for n in params}
+    buf = io.BytesIO()
+    params.to_tar(buf)
+
+    # perturb, then restore from the tar
+    for n in params:
+        params.set(n, params.get(n) + 1.0)
+    buf.seek(0)
+    params.from_tar(buf)
+    for n in params:
+        np.testing.assert_allclose(params.get(n), before[n])
