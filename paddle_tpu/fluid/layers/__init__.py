@@ -26,7 +26,7 @@ from .tensor import *  # noqa: F401,F403
 from .io import data  # noqa: F401
 from .ops import *  # noqa: F401,F403
 from .sequence import (dynamic_lstm, dynamic_gru,  # noqa: F401
-                       dynamic_lstmp, sequence_conv,
+                       dynamic_lstmp, dynamic_vanilla_rnn, sequence_conv,
                        sequence_pool, sequence_first_step,
                        sequence_last_step, sequence_softmax, sequence_expand,
                        sequence_reshape, sequence_concat, sequence_slice,

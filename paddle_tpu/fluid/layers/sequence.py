@@ -270,3 +270,28 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
                "proj_activation": proj_activation,
                "is_reverse": is_reverse})
     return proj, cell
+
+
+def dynamic_vanilla_rnn(input, size=None, param_attr=None, bias_attr=None,
+                        act="tanh", is_reverse=False, dtype="float32",
+                        name=None):
+    """Vanilla recurrence h_t = act(x_t + h_{t-1} W + b) over a LoD input
+    (the legacy RecurrentLayer the v2 DSL's recurrent_layer maps to; no
+    fluid-reference analog — the fluid generation built it from StaticRNN
+    blocks)."""
+    helper = LayerHelper("simple_rnn", name=name)
+    size = size or input.shape[-1]
+    weight = helper.create_parameter(param_attr, shape=(size, size),
+                                     dtype=dtype)
+    inputs = {"Input": [input.name], "Weight": [weight.name]}
+    if bias_attr is not False:
+        bias = helper.create_parameter(ParamAttr.to_attr(bias_attr),
+                                       shape=(1, size), dtype=dtype,
+                                       is_bias=True)
+        inputs["Bias"] = [bias.name]
+    out = helper.create_tmp_variable(dtype, lod_level=input.lod_level)
+    helper.append_op(
+        "simple_rnn", inputs=inputs,
+        outputs={"Out": [out.name]},
+        attrs={"activation": act, "is_reverse": is_reverse})
+    return out

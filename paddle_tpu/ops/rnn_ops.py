@@ -598,3 +598,92 @@ def lstmp_grad(ctx):
             ctx.set_output("Bias@GRAD", g.reshape(1, -1))
         else:
             ctx.set_output(n + "@GRAD", g)
+
+
+# ---------------------------------------------------------------------------
+# simple_rnn — the vanilla recurrence of the legacy recurrent_layer
+# (reference gserver/layers/RecurrentLayer.cpp: h_t = act(x_t + h_{t-1} W
+# + b); there is no standalone fluid op for it — the fluid generation
+# reached it through StaticRNN blocks — so this TPU-native op gives the
+# v2 DSL's recurrent_layer a direct scan lowering)
+# ---------------------------------------------------------------------------
+
+def _simple_rnn_compute(x, lens, w, bias, h0, attrs):
+    b, L, H = x.shape
+    act = _act(attrs.get("activation", "tanh"))
+    rev = bool(attrs.get("is_reverse", False))
+    if bias is not None:
+        x = x + bias[None, None, :]
+    if h0 is None:
+        h0 = jnp.zeros((b, H), x.dtype)
+    if rev:
+        # reversed recurrence over ragged rows: flip the VALID prefix of
+        # each row (the reference runs the layer backwards per sequence)
+        x = _reverse_padded(x, lens)
+    xt = jnp.swapaxes(x, 0, 1)                        # [L, b, H]
+
+    def step(carry, inp):
+        h_prev, t = carry
+        h = act(inp + h_prev @ w)
+        alive = (t < lens)[:, None].astype(x.dtype)
+        h = alive * h + (1 - alive) * h_prev
+        return (h, t + 1), h * alive
+
+    (_, _), hs = jax.lax.scan(step, (h0, jnp.zeros((), jnp.int32)), xt)
+    hs = jnp.swapaxes(hs, 0, 1)
+    if rev:
+        hs = _reverse_padded(hs, lens)
+    return hs
+
+
+def _simple_rnn_grad_maker(op):
+    inputs = {"Input": op.input("Input"), "Weight": op.input("Weight"),
+              "Out@GRAD": G(op.output("Out"))}
+    outputs = {"Input@GRAD": G(op.input("Input")),
+               "Weight@GRAD": G(op.input("Weight"))}
+    if op.input("Bias"):
+        inputs["Bias"] = op.input("Bias")
+        outputs["Bias@GRAD"] = G(op.input("Bias"))
+    return [OpSpec("simple_rnn_grad", inputs, outputs, dict(op.attrs))]
+
+
+@register_op("simple_rnn", infer_shape=_rnn_infer(("Out",)),
+             grad=_simple_rnn_grad_maker)
+def simple_rnn(ctx):
+    xv = ctx.input("Input")
+    x = xv.data if isinstance(xv, LoDArray) else data_of(xv)
+    lens = xv.lens if isinstance(xv, LoDArray) else \
+        jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    w = data_of(ctx.input("Weight"))
+    bias = data_of(ctx.input("Bias")).reshape(-1) \
+        if ctx.has_input("Bias") else None
+    hs = _simple_rnn_compute(x, lens, w, bias, None, ctx.op.attrs)
+    ctx.set_output("Out", LoDArray(hs, lens))
+
+
+@register_op("simple_rnn_grad")
+def simple_rnn_grad(ctx):
+    xv = ctx.input("Input")
+    x = xv.data if isinstance(xv, LoDArray) else data_of(xv)
+    lens = xv.lens if isinstance(xv, LoDArray) else \
+        jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    attrs = dict(ctx.op.attrs)
+    operands = {"Input": x, "Weight": data_of(ctx.input("Weight"))}
+    if ctx.has_input("Bias"):
+        operands["Bias"] = data_of(ctx.input("Bias")).reshape(-1)
+    names = list(operands)
+
+    def f(*args):
+        kw = dict(zip(names, args))
+        return _simple_rnn_compute(kw["Input"], lens, kw["Weight"],
+                                   kw.get("Bias"), None, attrs)
+
+    dyv = ctx.input("Out@GRAD")
+    dy = dyv.data if isinstance(dyv, LoDArray) else data_of(dyv)
+    _, vjp = jax.vjp(f, *operands.values())
+    grads = dict(zip(names, vjp(dy)))
+    ctx.set_output("Input@GRAD", LoDArray(grads["Input"], lens))
+    ctx.set_output("Weight@GRAD", grads["Weight"])
+    if "Bias" in grads:
+        # restore the (1, H) parameter shape
+        ctx.set_output("Bias@GRAD", grads["Bias"].reshape(1, -1))

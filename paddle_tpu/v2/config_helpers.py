@@ -67,6 +67,7 @@ _CONFIG_ARGS: dict = {}
 _OUTPUTS: list = []
 _DATA_LAYERS: list = []
 _DATA_SOURCES: dict = {}
+_SEQUENCE_HINTS: set = set()
 
 
 def _reset_config():
@@ -75,6 +76,7 @@ def _reset_config():
     del _OUTPUTS[:]
     del _DATA_LAYERS[:]
     _DATA_SOURCES.clear()
+    _SEQUENCE_HINTS.clear()
 
 
 def set_config_args(**kwargs):
@@ -324,9 +326,14 @@ class LayerOutput:
 
     def materialize(self, kind="dense"):
         """kind: dense [-1, size] float | label [-1, 1] int64 |
-        seq_ids [-1, 1] int64 lod 1 | seq_dense [-1, size] float lod 1."""
+        seq_ids [-1, 1] int64 lod 1 | seq_dense [-1, size] float lod 1.
+        A sequence hint (parse_config(sequence_inputs=...)) upgrades the
+        dense/label guesses — the reference learns sequence-ness from the
+        data provider at runtime, which an eager lowering cannot see."""
         if self._var is not None:
             return self._var
+        if self.name in _SEQUENCE_HINTS:
+            kind = {"dense": "seq_dense", "label": "seq_ids"}.get(kind, kind)
         import paddle_tpu.fluid as fluid
         if kind == "label":
             self._var = fluid.layers.data(self.name, shape=[1],
@@ -726,14 +733,19 @@ def get_topology():
 
 
 def parse_config(source, config_args=None, main_program=None,
-                 startup_program=None):
+                 startup_program=None, sequence_inputs=()):
     """Run a v2 config script (source text or file path) against fresh (or
     given) fluid programs — the ``paddle train --config=X.py
-    --config_args=...`` entry point. Returns (topology, main, startup)."""
+    --config_args=...`` entry point. Returns (topology, main, startup).
+
+    ``sequence_inputs``: data-layer names whose feeds are token/feature
+    SEQUENCES (the information the reference's data provider supplies at
+    runtime)."""
     import paddle_tpu.fluid as fluid
     import os
 
     _reset_config()
+    _SEQUENCE_HINTS.update(sequence_inputs)
     if config_args:
         set_config_args(**config_args)
     if os.path.exists(source):
@@ -1101,6 +1113,23 @@ layer_math = _LayerMath()
 __all__ += ["layer_math"]
 
 
+def recurrent_layer(input, act=None, reverse=False, bias_attr=True,
+                    param_attr=None, name=None, **kw):
+    """Vanilla full-matrix recurrence over the input sequence (reference
+    layers.py recurrent_layer -> gserver RecurrentLayer; size equals the
+    input size)."""
+    import paddle_tpu.fluid as fluid
+    out = fluid.layers.dynamic_vanilla_rnn(
+        _unwrap(input, kind="seq_dense"),
+        size=(input.size or input._data_size),
+        act=_act_str(act) or "tanh", is_reverse=reverse,
+        param_attr=_fluid_param_attr(param_attr),
+        bias_attr=False if bias_attr is False
+        else (None if bias_attr is True else _fluid_param_attr(bias_attr)))
+    return LayerOutput(out, size=(input.size or input._data_size),
+                       is_seq=True, name=name)
+
+
 def block_expand_layer(input, num_channels=None, block_x=1, block_y=1,
                        stride_x=1, stride_y=1, padding_x=0, padding_y=0,
                        name=None, **kw):
@@ -1115,4 +1144,4 @@ def block_expand_layer(input, num_channels=None, block_x=1, block_y=1,
                        name=name)
 
 
-__all__ += ["block_expand_layer"]
+__all__ += ["block_expand_layer", "recurrent_layer"]

@@ -186,6 +186,10 @@ def main(argv=None):
     ap.add_argument("--reader", default=None,
                     help="module:reader_creator for real data")
     ap.add_argument("--checkgrad_eps", type=float, default=1e-4)
+    ap.add_argument("--sequence_inputs", default="",
+                    help="comma-separated data-layer names fed as "
+                         "sequences (the data-provider knowledge the "
+                         "reference supplies at runtime)")
     args = ap.parse_args(argv)
 
     import jax
@@ -196,7 +200,9 @@ def main(argv=None):
 
     from .config_helpers import parse_config
     topo, main_prog, startup = parse_config(
-        args.config, config_args=_parse_config_args(args.config_args))
+        args.config, config_args=_parse_config_args(args.config_args),
+        sequence_inputs=tuple(n for n in args.sequence_inputs.split(",")
+                              if n))
 
     if args.job == "checkgrad":
         return job_checkgrad(topo, main_prog, startup, args)

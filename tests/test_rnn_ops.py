@@ -267,3 +267,80 @@ def test_lstm_peepholes_train_and_differ_from_plain():
     # and the trajectory DIFFERS from the plain LSTM once peepholes move
     _, _, plain_losses = train(False)
     assert not np.allclose(losses[5:], plain_losses[5:], rtol=1e-4)
+
+
+def test_simple_rnn_matches_numpy_and_trains():
+    """Vanilla recurrence (v2 recurrent_layer): numpy-pinned forward over
+    ragged lens, reversed variant, and gradient flow."""
+    import paddle_tpu.fluid as fluid
+    rng = np.random.RandomState(0)
+    b, L, H = 3, 5, 4
+    lens = np.array([5, 3, 4], "int32")
+    seqs = [rng.normal(0, 1, (int(l), H)).astype("float32") for l in lens]
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[H], lod_level=1)
+        out = fluid.layers.dynamic_vanilla_rnn(
+            x, size=H, act="tanh",
+            param_attr=fluid.ParamAttr(name="rw"),
+            bias_attr=fluid.ParamAttr(name="rb"))
+        rev = fluid.layers.dynamic_vanilla_rnn(
+            x, size=H, act="tanh", is_reverse=True,
+            param_attr=fluid.ParamAttr(name="rw"),
+            bias_attr=fluid.ParamAttr(name="rb"))
+        loss = fluid.layers.mean(fluid.layers.sequence_pool(out, "sum"))
+        fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    got, got_rev, gw = exe.run(
+        main, feed={"x": seqs}, fetch_list=[out, rev, "rw@GRAD"],
+        scope=scope)
+
+    w = np.asarray(scope.find_var("rw"))
+    bias = np.asarray(scope.find_var("rb")).reshape(-1)
+
+    def ref_run(seq):
+        h = np.zeros(H, "float32")
+        outs = []
+        for t in range(len(seq)):
+            h = np.tanh(seq[t] + bias + h @ w)
+            outs.append(h)
+        return np.stack(outs)
+
+    from paddle_tpu.core.lod import lodarray_to_flat
+    flat, _ = lodarray_to_flat(got)
+    expect = np.concatenate([ref_run(s) for s in seqs])
+    np.testing.assert_allclose(flat, expect, rtol=1e-5, atol=1e-6)
+
+    # reversed recurrence = run on the flipped sequence, flip back
+    flat_rev, _ = lodarray_to_flat(got_rev)
+    expect_rev = np.concatenate([ref_run(s[::-1])[::-1] for s in seqs])
+    np.testing.assert_allclose(flat_rev, expect_rev, rtol=1e-5, atol=1e-6)
+
+    assert np.abs(np.asarray(gw)).sum() > 0  # gradient reaches the weight
+
+
+def test_simple_rnn_without_bias():
+    """bias_attr=False builds a bias-free recurrence (the reference
+    recurrent_layer contract) and its parameter list has no bias."""
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], lod_level=1)
+        out = fluid.layers.dynamic_vanilla_rnn(x, size=4, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.sequence_pool(out, "sum"))
+        fluid.append_backward(loss)
+    names = [p.name for p in main.all_parameters()]
+    assert len(names) == 1 and not any("b_0" in n for n in names), names
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    seqs = [np.ones((3, 4), "float32")]
+    got, gb = exe.run(main, feed={"x": seqs},
+                      fetch_list=[loss, names[0] + "@GRAD"], scope=scope)
+    assert np.isfinite(float(got))
+    # grad restores the (size, size) parameter shape
+    assert np.asarray(gb).shape == (4, 4)

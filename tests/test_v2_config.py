@@ -184,12 +184,16 @@ REF_CFG = "/root/reference/python/paddle/trainer_config_helpers/tests/configs"
     ("test_pad.py", {"pad": 1}),
     ("test_maxout.py", {"maxout": 2}),
     ("test_bi_grumemory.py", {"gru": 2, "concat": 1}),
+    ("simple_rnn_layers.py", {"simple_rnn": 2, "lstm": 2, "gru": 2}),
 ])
 def test_reference_dsl_config_builds(config, expect_ops):
     """The reference's OWN trainer_config_helpers test configs build through
     parse_config (python/paddle/trainer_config_helpers/tests/configs/)."""
     from collections import Counter
-    topo, main, startup = parse_config(os.path.join(REF_CFG, config))
+    seq_hint = {"simple_rnn_layers.py": ("data",),
+                "test_bi_grumemory.py": ("data",)}.get(config, ())
+    topo, main, startup = parse_config(os.path.join(REF_CFG, config),
+                                       sequence_inputs=seq_hint)
     counts = Counter(op.type for b in main.blocks for op in b.ops)
     for op_type, n in expect_ops.items():
         matched = sum(v for k, v in counts.items() if k.startswith(op_type))
@@ -304,3 +308,25 @@ def test_v2_parameters_create_and_tar_roundtrip():
     params.from_tar(buf)
     for n in params:
         np.testing.assert_allclose(params.get(n), before[n])
+
+
+@needs_ref
+def test_simple_rnn_layers_config_runs_forward():
+    """simple_rnn_layers.py (recurrent/lstm/gru memories, fwd + reverse)
+    executes a real forward pass over ragged sequence feeds."""
+    topo, main, startup = parse_config(
+        os.path.join(REF_CFG, "simple_rnn_layers.py"),
+        sequence_inputs=("data",))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    seqs = [rng.normal(0, 1, (int(n), 200)).astype("float32")
+            for n in (3, 5, 2)]
+    fetches = [o.var.name for o in topo.outputs]
+    outs = exe.run(main, feed={"data": seqs}, fetch_list=fetches,
+                   scope=scope)
+    assert len(outs) == 6
+    for o in outs:
+        arr = np.asarray(o)
+        assert arr.shape == (3, 200) and np.isfinite(arr).all()
