@@ -105,13 +105,78 @@ def _sp_infer(op, block):
     out.lod_level = 0
 
 
+def _windowed_pool(data, lens, k, pooltype):
+    """Strided sequence pooling (reference seq pooling with stride: one
+    result PER WINDOW of k steps, so the output is itself a sequence of
+    ceil(len/k) entries)."""
+    b, L = data.shape[:2]
+    feat = data.shape[2:]
+    nw = -(-L // k)
+    pad = nw * k - L
+    dp = jnp.pad(data, ((0, 0), (0, pad)) + ((0, 0),) * len(feat))
+    w = dp.reshape((b, nw, k) + feat)
+    tok = (jnp.arange(nw * k).reshape(nw, k))[None]          # [1, nw, k]
+    valid = tok < lens[:, None, None]                        # [b, nw, k]
+    vm = valid.reshape(valid.shape + (1,) * len(feat)).astype(data.dtype)
+    counts = valid.sum(axis=2)                               # [b, nw]
+    cm = jnp.maximum(counts, 1).reshape(
+        (b, nw) + (1,) * len(feat)).astype(data.dtype)
+    if pooltype == "SUM":
+        out = (w * vm).sum(axis=2)
+    elif pooltype == "AVERAGE":
+        out = (w * vm).sum(axis=2) / cm
+    elif pooltype == "SQRT":
+        out = (w * vm).sum(axis=2) / jnp.sqrt(cm)
+    elif pooltype == "MAX":
+        out = jnp.where(vm > 0, w, -jnp.inf).max(axis=2)
+        out = jnp.where(counts.reshape(cm.shape) > 0, out, 0.0)
+    elif pooltype == "FIRST":
+        out = w[:, :, 0]
+    elif pooltype == "LAST":
+        last = jnp.clip(counts - 1, 0, k - 1)                # [b, nw]
+        idx = last.reshape((b, nw, 1) + (1,) * len(feat)).astype(jnp.int32)
+        idx = jnp.broadcast_to(idx, (b, nw, 1) + feat)
+        out = jnp.take_along_axis(w, idx, axis=2)[:, :, 0]
+    else:
+        raise ValueError(f"unknown pooltype {pooltype!r}")
+    out_lens = -(-lens // k)
+    out = out * _feat_mask(out, out_lens)
+    return LoDArray(out, out_lens)
+
+
+def _regroup_rows(rows, outer_lens):
+    """[n_inner, *feat] rows -> padded LoDArray [n_outer, max_inner, *feat]
+    grouped by outer_lens (the TO_SEQUENCE pooling output form)."""
+    n = rows.shape[0]
+    starts = jnp.cumsum(outer_lens) - outer_lens
+    owner = jnp.searchsorted(jnp.cumsum(outer_lens), jnp.arange(n),
+                             side="right").astype(jnp.int32)
+    pos = jnp.arange(n) - starts[owner]
+    # static padded bound: at most n_inner rows can land in one group
+    out = jnp.zeros((outer_lens.shape[0], rows.shape[0]) + rows.shape[1:],
+                    rows.dtype)
+    out = out.at[owner, pos].set(rows)
+    return LoDArray(out, outer_lens.astype(jnp.int32))
+
+
 @register_op("sequence_pool", infer_shape=_sp_infer,
              grad=_vjp_grad("sequence_pool"))
 def sequence_pool(ctx):
     x = _seq(ctx.input("X"))
-    ctx.set_output("Out",
-                   _sequence_pool_compute(x.data, x.lens,
-                                          ctx.attr("pooltype", "AVERAGE")))
+    pooltype = ctx.attr("pooltype", "AVERAGE")
+    stride = int(ctx.attr("stride", 0) or 0)
+    if stride > 0:
+        ctx.set_output("Out", _windowed_pool(x.data, x.lens, stride,
+                                             pooltype))
+        return
+    pooled = _sequence_pool_compute(x.data, x.lens, pooltype)
+    if x.outer_levels and ctx.attr("agg_level", "non-seq") == "seq":
+        # nested input, pool INNER sequences -> a level-1 sequence of
+        # per-inner results grouped by the outer level (reference
+        # AggregateLevel.TO_SEQUENCE)
+        ctx.set_output("Out", _regroup_rows(pooled, x.outer_levels[-1]))
+        return
+    ctx.set_output("Out", pooled)
 
 
 @register_op("sequence_pool_grad")

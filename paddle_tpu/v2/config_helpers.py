@@ -165,6 +165,11 @@ class _Pooling:
 class MaxPooling(_Pooling):
     pool_type = "max"
 
+    def __init__(self, output_max_index=False):
+        # output_max_index is accepted for config parity (reference
+        # poolings.py); index emission is served by max_pool*_with_index
+        self.output_max_index = output_max_index
+
 
 class AvgPooling(_Pooling):
     pool_type = "avg"
@@ -608,23 +613,43 @@ def simple_gru(input, size, reverse=False, act=None, gate_act=None,
                      gate_act=gate_act, name=name)
 
 
-def last_seq(input, name=None, **kw):
-    import paddle_tpu.fluid as fluid
-    out = fluid.layers.sequence_last_step(_unwrap(input))
-    return LayerOutput(out, size=getattr(input, "size", None), name=name)
+def _seq_select(input, which, agg_level=None, stride=-1, name=None):
+    """first_seq/last_seq with the reference's agg_level/stride axes
+    (layers.py first_seq:1395/last_seq:1353: stride>0 emits one result per
+    stride-window — a sequence; TO_SEQUENCE pools inner sequences of a
+    nested input)."""
+    from ..fluid.layer_helper import LayerHelper
+    var = _unwrap(input, kind="seq_dense")
+    helper = LayerHelper(f"{which.lower()}_seq", name=name)
+    attrs = {"pooltype": which}
+    is_seq_out = False
+    if stride and stride > 0:
+        attrs["stride"] = int(stride)
+        is_seq_out = True
+    if agg_level == AggregateLevel.TO_SEQUENCE:
+        attrs["agg_level"] = "seq"
+        is_seq_out = True
+    out = helper.create_tmp_variable(var.dtype,
+                                     lod_level=1 if is_seq_out else 0)
+    helper.append_op("sequence_pool", inputs={"X": [var.name]},
+                     outputs={"Out": [out.name]}, attrs=attrs)
+    return LayerOutput(out, size=getattr(input, "size", None), name=name,
+                       is_seq=is_seq_out)
 
 
-def first_seq(input, name=None, **kw):
-    import paddle_tpu.fluid as fluid
-    out = fluid.layers.sequence_first_step(_unwrap(input))
-    return LayerOutput(out, size=getattr(input, "size", None), name=name)
+def last_seq(input, agg_level=None, stride=-1, name=None, **kw):
+    return _seq_select(input, "LAST", agg_level, stride, name)
 
 
-def pooling_layer(input, pooling_type=None, name=None, **kw):
-    import paddle_tpu.fluid as fluid
-    ptype = (pooling_type or MaxPooling()).pool_type
-    out = fluid.layers.sequence_pool(_unwrap(input), ptype)
-    return LayerOutput(out, size=getattr(input, "size", None), name=name)
+def first_seq(input, agg_level=None, stride=-1, name=None, **kw):
+    return _seq_select(input, "FIRST", agg_level, stride, name)
+
+
+def pooling_layer(input, pooling_type=None, agg_level=None, stride=-1,
+                  name=None, **kw):
+    ptype = {"max": "MAX", "avg": "AVERAGE",
+             "sum": "SUM"}[(pooling_type or MaxPooling()).pool_type]
+    return _seq_select(input, ptype, agg_level, stride, name)
 
 
 def cross_entropy(input, label, name=None, coeff=1.0, **kw):

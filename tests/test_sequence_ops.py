@@ -285,3 +285,67 @@ def test_max_sequence_len():
                                      np.zeros((2, 1), "float32")]},
                    fetch_list=["mx"])
     assert int(np.asarray(got)[0]) == 7
+
+
+def test_sequence_pool_stride_windows():
+    """stride=k pooling emits one result per k-window — a sequence of
+    ceil(len/k) entries (reference pooling with stride)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.lod import lodarray_to_flat
+
+    seqs = [np.arange(7, dtype="float32").reshape(7, 1) + 1,
+            np.arange(4, dtype="float32").reshape(4, 1) + 10]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1], lod_level=1)
+        b = main.global_block()
+        outs = {}
+        for pt in ("SUM", "MAX", "LAST", "FIRST", "AVERAGE"):
+            b.create_var(name=f"o_{pt}", lod_level=1)
+            b.append_op("sequence_pool", {"X": ["x"]},
+                        {"Out": [f"o_{pt}"]},
+                        {"pooltype": pt, "stride": 3})
+            outs[pt] = f"o_{pt}"
+    exe = fluid.Executor(fluid.CPUPlace())
+    got = dict(zip(outs, exe.run(main, feed={"x": seqs},
+                                 fetch_list=list(outs.values()))))
+
+    def win(seq, k=3):
+        return [seq[i:i + k] for i in range(0, len(seq), k)]
+
+    for pt, fn in (("SUM", np.sum), ("MAX", np.max),
+                   ("LAST", lambda w: w[-1]), ("FIRST", lambda w: w[0]),
+                   ("AVERAGE", np.mean)):
+        flat, lod = lodarray_to_flat(got[pt])
+        expect = np.concatenate(
+            [[np.atleast_1d(fn(w.reshape(-1)))] for s in seqs
+             for w in win(s)]).reshape(-1)
+        np.testing.assert_allclose(flat.reshape(-1), expect, rtol=1e-6,
+                                   err_msg=pt)
+        assert lod[0] == [0, 3, 5], (pt, lod)
+
+
+def test_sequence_pool_to_sequence_over_nested():
+    """agg_level=seq over a 2-level input pools INNER sequences into a
+    level-1 sequence grouped by the outer level (reference
+    AggregateLevel.TO_SEQUENCE)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.lod import lodarray_to_flat
+
+    # 2 outer groups: [2, 1] inner seqs; inner token lens 2,3,2
+    flat = np.arange(14, dtype="float32").reshape(7, 2)
+    lod = [[0, 2, 3], [0, 2, 5, 7]]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], lod_level=2)
+        b = main.global_block()
+        b.create_var(name="o", lod_level=1)
+        b.append_op("sequence_pool", {"X": ["x"]}, {"Out": ["o"]},
+                    {"pooltype": "SUM", "agg_level": "seq"})
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(main, feed={"x": (flat, lod)}, fetch_list=["o"])
+    out_flat, out_lod = lodarray_to_flat(got)
+    expect = np.stack([flat[0:2].sum(0), flat[2:5].sum(0),
+                       flat[5:7].sum(0)])
+    np.testing.assert_allclose(out_flat, expect, rtol=1e-6)
+    assert out_lod[0] == [0, 2, 3]

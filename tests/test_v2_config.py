@@ -185,13 +185,17 @@ REF_CFG = "/root/reference/python/paddle/trainer_config_helpers/tests/configs"
     ("test_maxout.py", {"maxout": 2}),
     ("test_bi_grumemory.py", {"gru": 2, "concat": 1}),
     ("simple_rnn_layers.py", {"simple_rnn": 2, "lstm": 2, "gru": 2}),
+    ("last_first_seq.py", {"sequence_pool": 6}),
+    ("test_sequence_pooling.py", {"sequence_pool": 10}),
 ])
 def test_reference_dsl_config_builds(config, expect_ops):
     """The reference's OWN trainer_config_helpers test configs build through
     parse_config (python/paddle/trainer_config_helpers/tests/configs/)."""
     from collections import Counter
     seq_hint = {"simple_rnn_layers.py": ("data",),
-                "test_bi_grumemory.py": ("data",)}.get(config, ())
+                "test_bi_grumemory.py": ("data",),
+                "last_first_seq.py": ("data",),
+                "test_sequence_pooling.py": ("data",)}.get(config, ())
     topo, main, startup = parse_config(os.path.join(REF_CFG, config),
                                        sequence_inputs=seq_hint)
     counts = Counter(op.type for b in main.blocks for op in b.ops)
