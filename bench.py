@@ -7,19 +7,43 @@ images/sec/chip. The whole training step (forward + IR-autodiff backward +
 momentum update) compiles to one XLA computation; matmuls/convs run through
 the MXU in bfloat16 (mixed precision: fp32 params, bf16 compute).
 
-Roofline status (v5e single chip, re-measured round 4): ~2545 img/s at
-bs256 = ~100.5 ms/step. Round-4 decomposition (tools/bench_variants.py,
-tools/hlo_report.py): fwd-only 33.4 ms, BN-frozen 88.0 ms, BN-removed
-75.6 ms — batch statistics cost ~17 ms/step and BN ~29 ms total. The
-optimized HLO shows XLA already fuses BOTH BN stat reductions AND the
-previous layer's normalize+relu INTO the conv kernels (one
-convert_reduce_fusion per layer reads the conv input once, emits conv
-output + two f32 moments), so the dataflow is structurally near-minimal
-for train-mode BN; the ~79 GB cost-analysis figure overcounts conv
-operand bytes vs actual post-fusion traffic (static sum over the fusion
-graph is ~37 GB), meaning the step sits between the bandwidth floor
-(~45 ms) and measured 100 ms mostly on conv/VPU efficiency at these
-shapes, not on removable passes. Measured and REJECTED in round 4:
+Roofline status (v5e single chip, re-measured round 5): 2552.8 img/s at
+bs256 = ~100.3 ms/step with the space-to-depth stem (2519.9 without it
+in the same session — the rewrite is worth ~+1.3%). Round-5 brought
+real per-kernel device timing (tools/profile_step.py reads jax.profiler
+TPU events): device-busy is 98.4 ms/step of the 100.3 ms wall, i.e. the
+step is kernel-bound, not host-bound. Itemized (us/step, 8-step trace):
+    45.9 ms  convert_reduce_fusion.*  fwd convs w/ fused BN stats and
+                                      bwd data-grad convs w/ fused
+                                      relu-grad + BN-grad reduces
+    23.7 ms  fusion.*                 remaining conv + elementwise
+                                      chains (residual/relu backward
+                                      fusions measured AT HBM peak:
+                                      fusion.98 1.6 GB in 1.8 ms)
+    16.7 ms  multiply_subtract_fusion filter-grad convs + momentum
+     6.0 ms  copy_subtract_fusion     filter-grad convs (stem/shortcut)
+     4.0 ms  copy/copy-done           async relayout DMA
+     1.5 ms  select_and_scatter       maxpool backward
+Floors: the big bwd mega-fusions (e.g. convert_reduce_fusion.3: 1x1
+data-grad conv + relu-grad select + BN-grad mul/sub + 2 reduces over
+~1.6 GB of operands) measure 2.9 ms vs a ~2.0 ms pure-HBM floor (~70%
+efficiency); elementwise fusions run at peak; XLA's standalone
+filter-grad dot measures 755 GB/s (at peak) but in-graph the same
+contraction is emitted as a conv against N-in-sublane layouts at ~55%.
+The residual per-kernel gap is the v5e conv emitter's at these shapes
+(window_config estimated_cycles in the HLO backend_config confirms the
+emitter's own estimate is ~2x the clean-layout equivalent for the
+transpose(jvp) convs — 'EmitAllBatchInSublanes' vs the forward's
+'EmitAllInputFeatureInSublanesOutputBatchInSublanesXposeReuse').
+Round-5 probes, all REJECTED: bwd-only BN fusion barrier (2320.7 —
+the fused epilogue beats the better emitter it unlocks), fwd-only
+barrier (2368.9), bs192 (2341.6), Pallas tall-K filter-grad kernel
+(473 GB/s standalone vs XLA's 755). With the 2x2 barrier quadrant,
+batch sweep 128..512, layout probes, and the round-4 compiler-flag
+sweep all negative, the achievable ceiling with the current XLA conv
+emitters on this chip sits at ~2600 img/s (~87% of the 3000 north
+star); closing the rest needs a custom conv stack, not graph surgery.
+Measured and REJECTED in round 4:
 auto_layout state entry layouts (kills ~8 GB/step of filter relayout
 copies in the HLO, wall-clock NEUTRAL — the async copies already
 overlap; kept as an Executor option), bs288/320 (2284 img/s, worse),
@@ -287,6 +311,8 @@ def main():
                          "already overlap with compute; kept for A/B runs)")
     ap.add_argument("--skip-lstm", action="store_true",
                     help="only run the flagship ResNet-50 lane")
+    ap.add_argument("--no-s2d", action="store_true",
+                    help="A/B probe: disable the space-to-depth stem rewrite")
     ap.add_argument("--bn-barrier", action="store_true",
                     help="A/B probe: optimization barrier between convs "
                          "and BN stat reduces (flags.bn_fusion_barrier)")
@@ -351,9 +377,12 @@ def main():
             "bucketed_ms_sample": round(bucketed_ms, 4),
         }))
 
+    from paddle_tpu.core.flags import set_flags
     if args.bn_barrier:
-        from paddle_tpu.core.flags import set_flags
         set_flags({"bn_fusion_barrier": True})
+    # space-to-depth stem: exact rewrite of the 7x7/s2 C=3 stem conv as a
+    # 4x4/s1 conv over 112x112x12 (parity-tested in tests/test_conv_s2d.py)
+    set_flags({"conv_space_to_depth": not args.no_s2d})
     main_prog, startup, avg_loss = build(batch, image_size, class_dim)
 
     # Pre-stage a rotating pool of device-resident batches: the benchmark

@@ -433,6 +433,9 @@ class Executor:
                fetch_names, carry_keys, K, B, self.donate, self.amp,
                get_flag("xla_compiler_options"),
                get_flag("use_pallas_rnn"), get_flag("bn_fusion_barrier"),
+               get_flag("bn_fusion_barrier_fwd"),
+               get_flag("bn_fusion_barrier_bwd"),
+               get_flag("conv_space_to_depth"),
                get_flag("use_pallas_ctc"))
         fn = self._cache.get(key)
         if fn is not None:
@@ -473,6 +476,9 @@ class Executor:
                state_in, state_out, self.donate, self.amp, self.auto_layout,
                get_flag("xla_compiler_options"),
                get_flag("use_pallas_rnn"), get_flag("bn_fusion_barrier"),
+               get_flag("bn_fusion_barrier_fwd"),
+               get_flag("bn_fusion_barrier_bwd"),
+               get_flag("conv_space_to_depth"),
                get_flag("use_pallas_ctc"))
         fn = self._cache.get(key)
         if fn is not None:

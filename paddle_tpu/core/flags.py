@@ -82,13 +82,35 @@ DEFINE_flag("use_pallas_ctc", False,
             "pattern) inside warpctc; default off — numerics pinned "
             "against the lax.scan path")
 
+DEFINE_flag("conv_space_to_depth", False,
+            "rewrite eligible stem convs (NHWC, stride 2, C_in<=4, k>1 — "
+            "the ResNet/VGG 7x7/s2 stem over HxWx3 images) as a stride-1 "
+            "conv over the 2x2 space-to-depth transform of the input. "
+            "Mathematically exact (filter stays OIHW 7x7 in checkpoints; "
+            "the rearrangement happens inside the compiled step) and "
+            "quadruples MXU lane occupancy at C_in=3 — the standard TPU "
+            "ResNet stem transform (MLPerf). Off by default so reference "
+            "numeric parity tests see the untransformed summation order")
+
 DEFINE_flag("bn_fusion_barrier", False,
             "A/B probe (default off): optimization barrier between a conv "
             "output and batch_norm's statistics reductions so XLA cannot "
             "fuse the reduces INTO the conv kernel. MEASURED 13% WORSE on "
             "the v5e ResNet-50 bench (2216 vs 2545 img/s, bench.py round-4 "
             "notes) — the conv+stats fusion XLA picks is net positive; the "
-            "flag remains for future-hardware A/B runs only")
+            "flag remains for future-hardware A/B runs only. The op checks "
+            "OR this flag together with the one-sided flags below (this "
+            "flag does not write them; read all three to know the state)")
+
+DEFINE_flag("bn_fusion_barrier_fwd", False,
+            "barrier only in batch_norm forward (conv -> stat reduces)")
+
+DEFINE_flag("bn_fusion_barrier_bwd", False,
+            "barrier only in batch_norm_grad (dy -> dbias/dscale reduces): "
+            "round-5 probe motivated by the profile showing backward "
+            "data-grad convs with fused BN-grad reductions picking a ~2x "
+            "slower conv emitter (EmitAllBatchInSublanes) than the "
+            "unencumbered forward convs")
 
 # PDTPU_FLAGS=check_nan_inf=1,benchmark=0 — unknown names warn and are
 # ignored (a typo'd env var must not make the package unimportable)

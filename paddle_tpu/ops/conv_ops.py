@@ -32,6 +32,63 @@ def _pair(v):
     return (int(v), int(v))
 
 
+def _s2d_stem_eligible(x, w, strides, paddings, dilations, groups, df):
+    """True when the space-to-depth stem rewrite applies exactly: an NHWC
+    stride-2 ungrouped undilated conv over few input channels (the ResNet/VGG
+    stem: 7x7/s2 over HxWx3) whose spatial dims are even. At C_in=3 the MXU
+    contraction tile is nearly empty; folding the 2x2 pixel blocks into
+    channels (C=12, kernel 4x4, stride 1) quadruples lane occupancy for the
+    same FLOPs — the standard TPU ResNet stem transform."""
+    return (df == "NHWC" and strides == (2, 2) and dilations == (1, 1)
+            and groups == 1 and x.ndim == 4 and x.shape[3] <= 4
+            and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0
+            and w.shape[2] > 1 and w.shape[3] > 1)
+
+
+def _s2d_stem_conv(x, w, paddings):
+    """Exact rewrite of conv2d(k, stride=2, pad) over NHWC x as a stride-1
+    conv over the space-to-depth transform of x.
+
+    Derivation: y[i,j] = sum_{p,q,c} x[2i+p-ph, 2j+q-pw, c] * W[o,c,p,q].
+    Writing each input row as u = 2(i+m) + a (block row i+m, parity a) gives
+    p = 2m + a + ph with m in [-(ph+1)//2, (kh-1-ph)//2]; the filter embeds
+    into a zero-padded (2Kh, 2Kw) grid whose (parity, block) regrouping is
+    the rearranged stride-1 kernel over the (a,b,c)-packed channels.
+    """
+    n, h, wd, c = x.shape
+    o, _, kh, kw = w.shape
+    ph, pw = paddings
+
+    def geom(k, p, size):
+        m_min = -((p + 1) // 2)
+        m_max = (k - 1 - p) // 2
+        kk = m_max - m_min + 1
+        out = (size + 2 * p - k) // 2 + 1
+        pad_l = -m_min
+        pad_r = out - 1 + m_max - (size // 2 - 1)
+        off = 2 * (-m_min) - p  # 1 when p is odd, 0 when even
+        return kk, pad_l, pad_r, off, out
+
+    kh2, pl_h, pr_h, off_h, _ = geom(kh, ph, h)
+    kw2, pl_w, pr_w, off_w, _ = geom(kw, pw, wd)
+    if min(pl_h, pr_h, pl_w, pr_w) < 0:
+        return None
+    # x: [N,H,W,C] -> blocks [N,H/2,2,W/2,2,C] -> [N,H/2,W/2, a*2C+b*C+c]
+    x2 = x.reshape(n, h // 2, 2, wd // 2, 2, c)
+    x2 = x2.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, wd // 2, 4 * c)
+    # filter: embed at (off_h, off_w) inside the (2Kh, 2Kw) grid, regroup
+    # [o,c, m,a, n,b] -> [o, (a,b,c), m, n]
+    wp = jnp.zeros((o, c, 2 * kh2, 2 * kw2), w.dtype)
+    wp = wp.at[:, :, off_h:off_h + kh, off_w:off_w + kw].set(w)
+    w2 = wp.reshape(o, c, kh2, 2, kw2, 2)
+    w2 = w2.transpose(0, 3, 5, 1, 2, 4).reshape(o, 4 * c, kh2, kw2)
+    return lax.conv_general_dilated(
+        x2, w2,
+        window_strides=(1, 1),
+        padding=[(pl_h, pr_h), (pl_w, pr_w)],
+        dimension_numbers=("NHWC", "OIHW", "NHWC"))
+
+
 def _conv2d_compute(x, w, strides, paddings, dilations, groups, df="NCHW"):
     # under AMP both operands become bf16; the TPU MXU still accumulates in
     # float32 internally, so no explicit preferred_element_type is needed
@@ -40,6 +97,13 @@ def _conv2d_compute(x, w, strides, paddings, dilations, groups, df="NCHW"):
     # dimension — BN reductions and elementwise tiles align); the filter
     # stays OIHW for reference checkpoint parity and XLA relayouts it once.
     x, w = cast_compute(x, w)
+    from ..core.flags import get_flag
+    if (get_flag("conv_space_to_depth")
+            and _s2d_stem_eligible(x, w, strides, paddings, dilations, groups,
+                                   df)):
+        y = _s2d_stem_conv(x, w, paddings)
+        if y is not None:
+            return y
     return lax.conv_general_dilated(
         x, w,
         window_strides=strides,

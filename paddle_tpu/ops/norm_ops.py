@@ -82,7 +82,7 @@ def batch_norm(ctx):
     bshape = _bn_bshape(x, layout)
 
     from ..core.flags import get_flag
-    if get_flag("bn_fusion_barrier"):
+    if get_flag("bn_fusion_barrier") or get_flag("bn_fusion_barrier_fwd"):
         # sever the producer conv from the stat reduces (see flags.py)
         x = jax.lax.optimization_barrier(x)
 
@@ -134,7 +134,7 @@ def batch_norm_grad(ctx):
     eps = ctx.attr("epsilon", 1e-5)
     layout = ctx.attr("data_layout", "NCHW")
     from ..core.flags import get_flag
-    if get_flag("bn_fusion_barrier"):
+    if get_flag("bn_fusion_barrier") or get_flag("bn_fusion_barrier_bwd"):
         x, dy = jax.lax.optimization_barrier((x, dy))
     axes = _bn_axes(x, layout)
     bshape = _bn_bshape(x, layout)
