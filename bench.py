@@ -60,11 +60,14 @@ every candidate at or below baseline — the compiler defaults stand.
 Banked: 96-step readback amortization, NHWC end-to-end, AMP, donation,
 device-resident bf16 feeds.
 
-Round-4 final numbers (v5e single chip, shared dev machine):
-  resnet50_train_throughput   2541.7 img/s (84.7% of the 3000 north star)
+Round-5 numbers (v5e single chip, shared dev machine):
+  resnet50_train_throughput   2552.8 img/s (85.1% of the 3000 north star,
+                              space-to-depth stem on)
   lstm_textcls ms/batch       5.6-8.7 across runs (23-33x the K40m 184 ms
-                              reference row; best path reported)
-  ragged bucketing speedup    1.38-1.65x (bimodal corpus)
+                              reference row; best path reported); absolute
+                              gate: <= 12 ms/batch on a v5e-class chip
+  ragged bucketing speedup    1.60x driver-visible (scanned per-bucket
+                              dispatch; see run_lstm_ragged_lane docstring)
 
 Prints one json line per lane, the flagship ResNet line LAST:
 {"metric", "value", "unit", "vs_baseline"} (+ jnp/pallas detail for the
@@ -216,16 +219,25 @@ def run_lstm_lane(batch=64, seq_len=100, hidden=512, steps=32, warmup=3,
     return elapsed / steps * 1e3
 
 
-def run_lstm_ragged_lane(batch=64, hidden=512, n_seqs=1536, steps_cap=None,
+def run_lstm_ragged_lane(batch=64, hidden=512, n_seqs=4608, steps_cap=None,
                          warmup_epochs=1, vocab=30000):
     """The ragged-corpus win of length bucketing (reader.bucket_by_length,
     the static-shape answer to the reference's shrink_rnn_memory batch
     shrinking): one epoch over a bimodal-length corpus (half 10..12, half
     96..100 — short chat turns mixed with long documents), (a) every batch
     padded to the corpus bound of 100 vs (b) batches bucketed to [12, 100]
-    and padded to their own bucket. Returns per-SAMPLE ms for each path
-    (measured 1.65x on v5e; a uniform 10..100 corpus with 3 buckets gave
-    only ~1.3x theoretical, within shared-chip noise)."""
+    and padded to their own bucket. Returns per-SAMPLE ms for each path.
+
+    Round-5 redesign after the round-4 driver capture measured 0.98x against
+    a prose claim of 1.38-1.65x: the old per-batch exe.run() loop paid a
+    host dispatch round-trip per batch through the tunneled chip (~12 ms
+    wall vs ~1.7 ms device-busy for a len-12 batch), which dominated BOTH
+    paths and erased the compute difference. The epoch now runs as one
+    scanned dispatch per bucket shape via Executor.prepare_steps/
+    run_prepared (stage feeds once, lax.scan over the group), and the
+    corpus is sized so the 1-vs-2-dispatch asymmetry amortizes. Measured
+    on v5e with this exact entry point: 1.60x (flat 0.0958 -> bucketed
+    0.0599 ms/sample, n_seqs=4608)."""
     import jax
     import numpy as np
     import paddle_tpu.fluid as fluid
@@ -257,27 +269,34 @@ def run_lstm_ragged_lane(batch=64, hidden=512, n_seqs=1536, steps_cap=None,
                 bounds, max(len(s[0]) for s in chunk))
 
     def run_epoch(batches, scope, exe):
-        # pre-stage every batch on device OUTSIDE the timed region: packing
-        # + host->device transfer is the input pipeline's job (and through
-        # the tunneled dev chip a per-step device_put costs more than the
-        # step itself, which would swamp the compute difference being
-        # measured)
-        staged = []
+        # Group the epoch's batches by their padded bound and run each group
+        # as ONE scanned dispatch: prepare_steps stages each group's stacked
+        # feeds on device ONCE (outside the timed region — staging is the
+        # input pipeline's job), run_prepared dispatches the whole group as
+        # a lax.scan. Round 4's per-batch exe.run() loop measured 0.98x
+        # because 24 per-batch dispatch round-trips through the tunneled
+        # chip dominated BOTH paths — the device was busy ~1.7 ms of every
+        # ~12 ms batch — so halving the compute didn't move the epoch. With
+        # the epoch device-resident, only the padding differs between paths.
+        groups = {}
         n_samples = 0
         for chunk, bound in batches:
             toks = pack_sequences([s for s, _ in chunk], max_len=bound)
-            staged.append({"words": jax.device_put(toks),
-                           "label": jax.device_put(np.asarray(
-                               [[l] for _, l in chunk], "int64"))})
+            feed = {"words": toks,
+                    "label": np.asarray([[l] for _, l in chunk], "int64")}
+            groups.setdefault(bound, []).append(feed)
             n_samples += len(chunk)
-        jax.block_until_ready([f["words"].data for f in staged])
+        handles = [exe.prepare_steps(main, feeds=groups[bound],
+                                     fetch_list=[loss], scope=scope)
+                   for bound in sorted(groups)]
+        exe.run_prepared(handles[-1])  # compile + warm the largest bound
         best = float("inf")
-        for _ in range(2):       # best-of-2 epochs (shared-chip noise)
+        for _ in range(3):       # best-of-N epochs (shared-chip noise)
             t0 = time.perf_counter()
-            for feed in staged:
-                v = exe.run(main, feed=feed, fetch_list=[loss], scope=scope,
-                            return_numpy=False)
-            np.asarray(v[0])
+            last = None
+            for h in handles:
+                last = exe.run_prepared(h, return_numpy=False)
+            np.asarray(last[0])  # forces the chained epoch
             best = min(best, time.perf_counter() - t0)
         # ms per SAMPLE: the two paths cover slightly different sample
         # counts (bucketed drop_last), so per-batch time would be unfair
@@ -362,6 +381,11 @@ def main():
             "vs_baseline": round(lstm_baseline / best, 4),
             "jnp_ms": round(jnp_ms, 3),
             "pallas_ms": None if pallas_ms is None else round(pallas_ms, 3),
+            # absolute gate (VERDICT r4 #6): the K40m ratio says nothing
+            # about TPU quality; 12 ms/batch is ~2x the best observed v5e
+            # time, a regression-detection bound rather than an aspiration
+            "abs_gate_ms": 12.0,
+            "abs_gate_ok": bool(args.smoke or best <= 12.0),
         }))
         ragged_kw = dict(batch=8, hidden=16, n_seqs=64, vocab=200) \
             if args.smoke else {}

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.lod import LoDArray
 from ..core.registry import register_op, OpSpec, same_shape
@@ -251,17 +252,48 @@ def sequence_expand(ctx):
     ref_level = int(ctx.attr("ref_level", -1))
     if ref_level == 0 and y.outer_lens is not None:
         if isinstance(xv, LoDArray):
-            raise NotImplementedError(
-                "sequence_expand ref_level=0 with a LoD-carrying X (ragged "
-                "rows) is not supported; expand dense per-sequence rows")
+            # sequence_expand_op.cc nested case: x's i-th SEQUENCE repeated
+            # once per inner sequence of y's i-th outer group, sub-lod
+            # preserved — a row gather in the padded representation. Output
+            # sequence count == y's inner-sequence count (static).
+            x = _seq(xv)
+            n_outer = y.outer_levels[0].shape[0]
+            if x.data.shape[0] != n_outer:
+                raise ValueError(
+                    f"sequence_expand ref_level=0: x has {x.data.shape[0]} "
+                    f"sequences but y has {n_outer} outer groups")
+            idx = _rows_to_level0(y)          # [y_batch] -> outer group
+            ctx.set_output("Out", LoDArray(x.data[idx], x.lens[idx]))
+            return
         x = data_of(xv)                       # [n_level0, *feat]
         out = x[_rows_to_level0(y)]           # [batch_rows, *feat]
         ctx.set_output("Out", out)
         return
     if isinstance(xv, LoDArray):
-        raise NotImplementedError(
-            "sequence_expand with LoD-carrying X is served by the lod-level-2 "
-            "beam machinery (beam_search ops), not this op")
+        # innermost-level reference with ragged X (sequence_expand_op.cc
+        # "Case 2": x.lod=[[0,2,4]], y.lod=[...,[0,3,6,7,8]] -> x's i-th
+        # sequence repeated y_lens[i] times). Output sequence count is
+        # sum(y_lens) — data-dependent — so the padded form emits the static
+        # bound n_y*max_len rows with jnp.repeat(total_repeat_length=...);
+        # rows past the true total carry length 0 (empty trailing sequences
+        # at the fetch boundary when y is ragged under jit; exact when
+        # sum(y_lens) == bound or when running eagerly with concrete lens).
+        x = _seq(xv)
+        if x.data.shape[0] != y.lens.shape[0]:
+            raise ValueError(
+                f"sequence_expand: x has {x.data.shape[0]} sequences but y "
+                f"has {y.lens.shape[0]} reference segments")
+        total = int(y.lens.shape[0]) * int(y.max_len)
+        concrete = not isinstance(y.lens, jax.core.Tracer)
+        if concrete:
+            total = int(jnp.sum(y.lens))
+        idx = jnp.repeat(jnp.arange(y.lens.shape[0]), y.lens,
+                         total_repeat_length=total)
+        n_valid = jnp.sum(y.lens)
+        valid = jnp.arange(total) < n_valid
+        ctx.set_output("Out", LoDArray(
+            x.data[idx], jnp.where(valid, x.lens[idx], 0)))
+        return
     x = data_of(xv)  # [batch, feat]
     tiled = jnp.broadcast_to(x[:, None], (x.shape[0], y.max_len) + x.shape[1:])
     fm = _feat_mask(tiled, y.lens)
@@ -270,14 +302,38 @@ def sequence_expand(ctx):
 
 @register_op("sequence_expand_grad")
 def sequence_expand_grad(ctx):
+    xv = ctx.input("X")
     y = _seq(ctx.input("Y"))
     dy_v = ctx.input("Out@GRAD")
     ref_level = int(ctx.attr("ref_level", -1))
     if ref_level == 0 and y.outer_lens is not None:
-        d = data_of(dy_v)                     # [batch_rows, *feat]
+        idx = _rows_to_level0(y)
         n_outer = y.outer_levels[0].shape[0]
+        if isinstance(xv, LoDArray):
+            # ragged-X expansion was a row gather; grad is the segment-sum
+            # of the repeated padded rows back onto x's sequences
+            dy = _seq(dy_v)
+            x = _seq(xv)
+            d = dy.data * _feat_mask(dy.data, x.lens[idx])
+            ctx.set_output("X@GRAD", LoDArray(
+                jax.ops.segment_sum(d, idx, num_segments=n_outer), x.lens))
+            return
+        d = data_of(dy_v)                     # [batch_rows, *feat]
         ctx.set_output("X@GRAD", jax.ops.segment_sum(
-            d, _rows_to_level0(y), num_segments=n_outer))
+            d, idx, num_segments=n_outer))
+        return
+    if isinstance(xv, LoDArray):
+        x = _seq(xv)
+        dy = _seq(dy_v)
+        total = dy.data.shape[0]
+        idx = jnp.repeat(jnp.arange(y.lens.shape[0]), y.lens,
+                         total_repeat_length=total)
+        valid = (jnp.arange(total) < jnp.sum(y.lens)).reshape(
+            (total,) + (1,) * (dy.data.ndim - 1))
+        d = dy.data * _feat_mask(dy.data, dy.lens) * valid.astype(dy.data.dtype)
+        ctx.set_output("X@GRAD", LoDArray(
+            jax.ops.segment_sum(d, idx, num_segments=x.data.shape[0]),
+            x.lens))
         return
     dy = _seq(dy_v)
     d = dy.data * _feat_mask(dy.data, y.lens)
@@ -417,18 +473,60 @@ def sequence_erase(ctx):
                                    lens))
 
 
+def _lod_repack(data, old_lens, new_lens, new_max):
+    """Re-segment the flat rows of a padded LoD tensor under new lengths
+    (the whole point of lod_reset_op.cc: same rows, new offsets — including
+    a different number of sequences). Traced-safe: only ``new_max`` (the new
+    padded bound) must be static; row/col lookups are gathers."""
+    b, L = data.shape[0], data.shape[1]
+    old_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(old_lens.astype(jnp.int32))])
+    new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(new_lens.astype(jnp.int32))])
+    pos = jnp.arange(new_max, dtype=jnp.int32)
+    flat_idx = new_off[:-1, None] + pos[None, :]          # [n_new, new_max]
+    valid = pos[None, :] < new_lens[:, None]
+    flat_idx = jnp.clip(flat_idx, 0, b * L - 1)
+    row = jnp.clip(jnp.searchsorted(old_off[1:], flat_idx, side="right"),
+                   0, b - 1)
+    col = jnp.clip(flat_idx - old_off[row], 0, L - 1)
+    gathered = data[row, col]
+    mask = valid.reshape(valid.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, gathered, 0)
+
+
 @register_op("lod_reset")
 def lod_reset(ctx):
-    x = _seq(ctx.input("X")) if isinstance(ctx.input("X"), LoDArray) else None
-    data = x.data if x is not None else data_of(ctx.input("X"))
+    xv = ctx.input("X")
+    x = _seq(xv) if isinstance(xv, LoDArray) else None
+    data = x.data if x is not None else data_of(xv)
     if ctx.has_input("Y"):
         y = ctx.input("Y")
-        lens = y.lens if isinstance(y, LoDArray) else \
-            jnp.diff(data_of(y).astype(jnp.int32))
+        # fallback static bound on any one new sequence's length: the total
+        # flat element count (rows for a plain tensor, rows*padded-len for a
+        # LoD input) — a new segment can never exceed it
+        cap = data.shape[0] if x is None else data.shape[0] * data.shape[1]
+        if isinstance(y, LoDArray):
+            lens = y.lens
+            # Y's own padded bound caps its max length (static)
+            new_max = y.data.shape[1] if y.data.ndim >= 2 else cap
+        else:
+            lens = jnp.diff(data_of(y).astype(jnp.int32))
+            concrete = not isinstance(lens, jax.core.Tracer)
+            new_max = int(jnp.max(lens)) if concrete and lens.size else cap
     else:
-        target = jnp.asarray(ctx.attr("target_lod"), jnp.int32)
-        lens = jnp.diff(target)
-    ctx.set_output("Out", LoDArray(data, lens))
+        target = np.asarray(ctx.attr("target_lod"), np.int64)
+        lens = jnp.asarray(np.diff(target), jnp.int32)
+        new_max = int(np.diff(target).max()) if target.size > 1 else 0
+    if x is None:
+        # plain tensor input (lod_reset_op.cc accepts a bare tensor): each
+        # row is one element; segment rows by the new lengths
+        old_lens = jnp.ones((data.shape[0],), jnp.int32)
+        packed = _lod_repack(data[:, None], old_lens, lens, new_max)
+        ctx.set_output("Out", LoDArray(packed, lens))
+        return
+    packed = _lod_repack(data, x.lens, lens, new_max)
+    ctx.set_output("Out", LoDArray(packed, lens))
 
 
 # ---------------------------------------------------------------------------

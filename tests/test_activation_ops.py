@@ -28,9 +28,31 @@ CASES = {
     "softplus": (lambda x: np.log(1 + np.exp(x)), (-1, 1)),
     "softsign": (lambda x: x / (1 + np.abs(x)), (-1, 1)),
     "soft_relu": (lambda x: np.log(1 + np.exp(np.clip(x, -40, 40))), (-1, 1)),
+    # reference test_activation_op.py TestRelu6/TestSwish/TestHardShrink/
+    # TestSoftShrink/TestThresholdedRelu (default attrs)
+    "relu6": (lambda x: np.clip(x, 0.0, 6.0), (-2, 8)),
+    "swish": (lambda x: x / (1 + np.exp(-x)), (-1, 1)),
+    "hard_shrink": (lambda x: np.where(np.abs(x) > 0.5, x, 0.0), (-2, 2)),
+    "softshrink": (lambda x: np.where(x > 0.5, x - 0.5,
+                                      np.where(x < -0.5, x + 0.5, 0.0)),
+                   (-2, 2)),
+    "thresholded_relu": (lambda x: np.where(x > 1.0, x, 0.0), (-2, 3)),
 }
 
 GRAD_SKIP = {"ceil", "floor", "round"}  # zero-gradient ops
+
+# non-differentiable points per op: inputs are nudged off them before the
+# finite-difference grad check (reference op_tests do the same via x[...]= )
+KINKS = {"abs": [0.0], "relu": [0.0], "relu6": [0.0, 6.0],
+         "hard_shrink": [-0.5, 0.5], "softshrink": [-0.5, 0.5],
+         "thresholded_relu": [1.0]}
+
+
+def _nudge(x, op_name, margin=0.05):
+    for k in KINKS.get(op_name, ()):
+        near = np.abs(x - k) < margin
+        x[near] = k + 4 * margin
+    return x
 
 
 @pytest.mark.parametrize("op_name", sorted(CASES))
@@ -39,9 +61,6 @@ def test_activation_output(op_name):
     t = OpTest()
     t.op_type = op_name
     x = np.random.uniform(lo, hi, (4, 6)).astype("float32")
-    # keep away from non-differentiable points
-    if op_name == "abs":
-        x[np.abs(x) < 0.1] = 0.5
     t.inputs = {"X": x}
     t.attrs = {}
     t.outputs = {"Out": fn(x)}
@@ -55,9 +74,8 @@ def test_activation_grad(op_name):
     fn, (lo, hi) = CASES[op_name]
     t = OpTest()
     t.op_type = op_name
-    x = np.random.uniform(lo, hi, (3, 4)).astype("float32")
-    if op_name == "abs":
-        x[np.abs(x) < 0.2] = 0.5
+    x = _nudge(np.random.uniform(lo, hi, (3, 4)).astype("float32"), op_name,
+               margin=0.1)
     t.inputs = {"X": x}
     t.attrs = {}
     t.outputs = {"Out": fn(x)}

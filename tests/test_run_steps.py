@@ -54,6 +54,74 @@ def test_run_steps_matches_sequential_runs():
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_prepare_steps_run_prepared_split():
+    """prepare_steps stages feeds once; run_prepared dispatches many times,
+    each continuing from the scope's current state (the reference's
+    Prepare / RunPreparedContext split, framework/executor.cc:271)."""
+    rng = np.random.RandomState(7)
+    feeds = _feeds(3, rng)
+    main, startup, loss = _build()
+    main.random_seed = startup.random_seed = 13
+
+    scope_a = fluid.Scope()
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope_a)
+    ref = [exe.run_steps(main, feeds, fetch_list=[loss], scope=scope_a)[0]
+           for _ in range(2)]
+
+    scope_b = fluid.Scope()
+    exe.run(startup, scope=scope_b)
+    h = exe.prepare_steps(main, feeds, fetch_list=[loss], scope=scope_b)
+    got = [exe.run_prepared(h)[0] for _ in range(2)]
+
+    np.testing.assert_allclose(got[0], ref[0], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got[1], ref[1], rtol=1e-5, atol=1e-6)
+
+
+def test_run_steps_lod_feeds():
+    """LoD (ragged) feeds ride the scan: a lod_level=1 sequence model trained
+    via run_steps matches per-batch exe.run — the scanned path the ragged
+    bucketing benchmark lane uses."""
+    from paddle_tpu.core.lod import pack_sequences
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            words = fluid.layers.data("words", shape=[1], dtype="int64",
+                                      lod_level=1)
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            emb = fluid.layers.embedding(words, size=(50, 8))
+            pooled = fluid.layers.sequence_pool(emb, pool_type="average")
+            logits = fluid.layers.fc(pooled, size=3)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            fluid.optimizer.SGD(0.1).minimize(loss, startup)
+        return main, startup, loss
+
+    rng = np.random.RandomState(11)
+    feeds = []
+    for _ in range(3):
+        seqs = [rng.randint(0, 50, (int(rng.randint(2, 7)), 1)).astype("int64")
+                for _ in range(4)]
+        # one scanned group must share a padded bound (the ragged lane
+        # groups batches by bucket bound for exactly this reason)
+        feeds.append({"words": pack_sequences(seqs, max_len=8),
+                      "label": rng.randint(0, 3, (4, 1)).astype("int64")})
+
+    main, startup, loss = build()
+    main.random_seed = startup.random_seed = 17
+    scope_a = fluid.Scope()
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope_a)
+    seq_losses = [float(exe.run(main, feed=f, fetch_list=[loss],
+                                scope=scope_a)[0]) for f in feeds]
+
+    scope_b = fluid.Scope()
+    exe.run(startup, scope=scope_b)
+    multi = exe.run_steps(main, feeds, fetch_list=[loss], scope=scope_b)[0]
+    np.testing.assert_allclose(multi, seq_losses, rtol=1e-5, atol=1e-6)
+
+
 def test_run_steps_repeated_invocation_continues_training():
     rng = np.random.RandomState(5)
     feeds = _feeds(2, rng)

@@ -136,6 +136,57 @@ class TestSequenceExpand(OpTest):
         self.check_grad(["X"], "Out", max_relative_error=0.03)
 
 
+class TestSequenceExpandLoDX(OpTest):
+    """LoD-carrying X, ref_level=0 over a 2-level Y — the reference
+    sequence_expand_op.cc nested case: x's i-th SEQUENCE is repeated once
+    per inner sequence of y's i-th outer group, sub-lod preserved
+    (x.lod=[[0,2,4]], y.lod=[[0,2,4],[0,3,6,7,8]] ->
+    out flat = [x0, x1, x0, x1, x2, x3, x2, x3], out.lod=[[0,2,4,6,8]])."""
+    op_type = "sequence_expand"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(6)
+        x = rng.uniform(0.1, 1, (4, 3)).astype("float32")
+        x_lod = [[0, 2, 4]]
+        y = rng.uniform(0.1, 1, (8, 3)).astype("float32")
+        y_lod = [[0, 2, 4], [0, 3, 6, 7, 8]]
+        out = np.concatenate([x[0:2], x[0:2], x[2:4], x[2:4]])
+        self.inputs = {"X": (x, x_lod), "Y": (y, y_lod)}
+        self.attrs = {"ref_level": 0}
+        self.outputs = {"Out": (out, [[0, 2, 4, 6, 8]])}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+class TestSequenceExpandLoDXInnermost(OpTest):
+    """LoD-carrying X against a level-1 Y (sequence_expand_op.cc Case 2):
+    x's i-th sequence repeated y_lens[i] times. Uniform y lens keep the
+    static output bound exact under jit (ragged y under jit yields empty
+    trailing sequences — recorded in the op docstring)."""
+    op_type = "sequence_expand"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(7)
+        x = rng.uniform(0.1, 1, (5, 2)).astype("float32")
+        x_lod = [[0, 2, 5]]
+        y = rng.uniform(0.1, 1, (4, 2)).astype("float32")
+        y_lod = [[0, 2, 4]]
+        out = np.concatenate([x[0:2], x[0:2], x[2:5], x[2:5]])
+        self.inputs = {"X": (x, x_lod), "Y": (y, y_lod)}
+        self.attrs = {}
+        self.outputs = {"Out": (out, [[0, 2, 4, 7, 10]])}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
 class TestSequenceReshape(OpTest):
     op_type = "sequence_reshape"
 
