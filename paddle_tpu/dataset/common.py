@@ -49,11 +49,31 @@ def have_file(url, module_name, md5sum=None):
         return False
 
 
-def convert(output_dir, reader, name, max_records=1000):
-    """reader → recordio shards (reference common.convert)."""
-    from ..reader.creator import convert_reader_to_recordio_file
+def convert(output_path, reader, line_count=1000, name_prefix="dataset"):
+    """reader → sharded recordio files ``output_path/name_prefix-00000``…
+    with ``line_count`` pickled samples per shard (reference
+    common.convert's layout; every dataset module's ``convert(path)``
+    delegates here)."""
+    import pickle
 
-    path = os.path.join(output_dir, name + ".recordio")
-    os.makedirs(output_dir, exist_ok=True)
-    convert_reader_to_recordio_file(path, reader, max_records=max_records)
-    return path
+    from ..recordio import Writer
+
+    os.makedirs(output_path, exist_ok=True)
+    shard_paths = []
+    writer, n_in_shard = None, 0
+
+    def _shard_path(idx):
+        return os.path.join(output_path, f"{name_prefix}-{idx:05d}")
+
+    for sample in reader():
+        if writer is None:
+            shard_paths.append(_shard_path(len(shard_paths)))
+            writer = Writer(shard_paths[-1])
+        writer.write(pickle.dumps(sample))
+        n_in_shard += 1
+        if n_in_shard >= line_count:
+            writer.close()
+            writer, n_in_shard = None, 0
+    if writer is not None:
+        writer.close()
+    return shard_paths

@@ -224,3 +224,11 @@ def train():
     if _have_real():
         corpus = _real_corpus
     return reader_creator(corpus, word_dict, verb_dict, label_dict)
+
+
+def convert(path):
+    """Converts dataset to sharded recordio format (reference
+    conll05.py:252 — which converts the test split for both names; the
+    train corpus is license-gated there and here)."""
+    common.convert(path, test(), 1000, "conl105_train")
+    common.convert(path, test(), 1000, "conl105_test")

@@ -63,17 +63,21 @@ def _real_reader(split):
         # more than train data") and TEST_FLAG='trnid'
         ids = setid[{"train": "tstid", "test": "trnid",
                      "valid": "valid"}[split]].ravel()
+        from ..v2 import image as v2_image
+
+        del Image  # decoding goes through v2.image (same Pillow backend)
         with tarfile.open(os.path.join(base, DATA_URL.split("/")[-1])) as tf:
             members = {m.name: m for m in tf.getmembers()}
             for i in ids:
                 name = f"jpg/image_{int(i):05d}.jpg"
-                img = Image.open(io.BytesIO(
-                    tf.extractfile(members[name]).read())).convert("RGB")
-                img = img.resize((256, 256))
-                left = (256 - 224) // 2
-                img = img.crop((left, left, left + 224, left + 224))
-                arr = np.asarray(img, np.float32).transpose(2, 0, 1) / 255.0
-                yield arr, int(labels[int(i) - 1]) - 1
+                # the reference pipeline: decode -> simple_transform
+                # (resize_short 256, center-crop 224, CHW float32) — then
+                # scaled to [0,1], this module's pinned schema
+                im = v2_image.load_image_bytes(
+                    tf.extractfile(members[name]).read())
+                arr = v2_image.simple_transform(im, 256, 224,
+                                                is_train=False) / 255.0
+                yield arr.astype(np.float32), int(labels[int(i) - 1]) - 1
 
     return reader
 

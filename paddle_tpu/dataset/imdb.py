@@ -97,3 +97,11 @@ def train(word_idx):
 def test(word_idx):
     return _reader(r"aclImdb/test/(pos|neg)/.*\.txt$", SYNTH_TEST, 17,
                    word_idx)
+
+
+def convert(path):
+    """Converts dataset to sharded recordio format (reference
+    imdb.py:142)."""
+    w = word_dict()
+    common.convert(path, lambda: train(w)(), 1000, "imdb_train")
+    common.convert(path, lambda: test(w)(), 1000, "imdb_test")

@@ -117,3 +117,12 @@ def train(word_idx, n, data_type=DataType.NGRAM):
 def test(word_idx, n, data_type=DataType.NGRAM):
     return reader_creator(TEST_MEMBER, word_idx, n, data_type,
                           SYNTH_TEST, 9)
+
+
+def convert(path):
+    """Converts dataset to sharded recordio format (reference
+    imikolov.py:151)."""
+    n = 5
+    word_idx = build_dict()
+    common.convert(path, train(word_idx, n), 1000, "imikolov_train")
+    common.convert(path, test(word_idx, n), 1000, "imikolov_test")

@@ -78,3 +78,10 @@ def train():
 
 def test():
     return _reader(TEST_IMAGE, TEST_LABEL, SYNTH_TEST, 11)
+
+
+def convert(path):
+    """Converts dataset to sharded recordio format (reference
+    mnist.py:118)."""
+    common.convert(path, train(), 1000, "minist_train")
+    common.convert(path, test(), 1000, "minist_test")

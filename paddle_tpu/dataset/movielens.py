@@ -194,3 +194,10 @@ def movie_info():
 
 def age_table():
     return list(AGES)
+
+
+def convert(path):
+    """Converts dataset to sharded recordio format (reference
+    movielens.py:253)."""
+    common.convert(path, train(), 1000, "movielens_train")
+    common.convert(path, test(), 1000, "movielens_test")

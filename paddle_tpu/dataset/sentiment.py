@@ -111,3 +111,10 @@ def train():
 
 def test():
     return reader_creator(_corpus()[NUM_TRAINING_INSTANCES:])
+
+
+def convert(path):
+    """Converts dataset to sharded recordio format (reference
+    sentiment.py:136)."""
+    common.convert(path, train(), 1000, "sentiment_train")
+    common.convert(path, test(), 1000, "sentiment_test")

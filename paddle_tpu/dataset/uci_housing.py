@@ -57,3 +57,10 @@ def train():
 
 def test():
     return _reader(0.8, 1.0, SYNTH_TEST, 23)
+
+
+def convert(path):
+    """Converts dataset to sharded recordio format (reference
+    uci_housing.py:129)."""
+    common.convert(path, train(), 1000, "uci_housing_train")
+    common.convert(path, test(), 1000, "uci_housing_test")

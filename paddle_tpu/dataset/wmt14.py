@@ -35,22 +35,18 @@ def _tar_path():
     return os.path.join(common.DATA_HOME, "wmt14", URL_TRAIN.split("/")[-1])
 
 
-def __read_to_dict(tar_file, dict_size):
-    def __to_dict(fd, size):
-        out_dict = dict()
-        for line_count, line in enumerate(fd):
-            if line_count < size:
-                out_dict[line.decode().strip()] = line_count
-            else:
-                break
-        return out_dict
+def _load_tar_dicts(tar_file, dict_size):
+    """Vocabulary tables from the wmt14 tarball: the archive ships
+    ``*src.dict`` / ``*trg.dict`` members, one token per line already in
+    frequency order, so token -> line number caps the vocabulary at
+    ``dict_size`` (reference wmt14.py __read_to_dict)."""
+    def vocab_of(tf, suffix):
+        member = next(n for n in tf.getnames() if n.endswith(suffix))
+        lines = tf.extractfile(member).read().decode().splitlines()
+        return {tok.strip(): i for i, tok in enumerate(lines[:dict_size])}
 
-    with tarfile.open(tar_file, mode="r") as f:
-        names = [n for n in f.getnames() if n.endswith("src.dict")]
-        src_dict = __to_dict(f.extractfile(names[0]), dict_size)
-        names = [n for n in f.getnames() if n.endswith("trg.dict")]
-        trg_dict = __to_dict(f.extractfile(names[0]), dict_size)
-    return src_dict, trg_dict
+    with tarfile.open(tar_file, mode="r") as tf:
+        return vocab_of(tf, "src.dict"), vocab_of(tf, "trg.dict")
 
 
 def _synth_dicts(dict_size):
@@ -81,7 +77,7 @@ def _synth_samples(n, seed, dict_size):
 def reader_creator(file_name, dict_size, synth_n, synth_seed):
     def reader():
         if common.have_file(URL_TRAIN, "wmt14"):
-            src_dict, trg_dict = __read_to_dict(_tar_path(), dict_size)
+            src_dict, trg_dict = _load_tar_dicts(_tar_path(), dict_size)
             with tarfile.open(_tar_path(), mode="r") as f:
                 names = [n for n in f.getnames() if n.endswith(file_name)]
                 for name in names:
@@ -121,10 +117,18 @@ def get_dict(dict_size, reverse=True):
     """(src_dict, trg_dict); reverse=True returns id->word (the reference's
     default orientation for decoding printouts)."""
     if common.have_file(URL_TRAIN, "wmt14"):
-        src_dict, trg_dict = __read_to_dict(_tar_path(), dict_size)
+        src_dict, trg_dict = _load_tar_dicts(_tar_path(), dict_size)
     else:
         src_dict, trg_dict = _synth_dicts(dict_size)
     if reverse:
         src_dict = {v: k for k, v in src_dict.items()}
         trg_dict = {v: k for k, v in trg_dict.items()}
     return src_dict, trg_dict
+
+
+def convert(path):
+    """Converts dataset to sharded recordio format (reference
+    wmt14.py:175)."""
+    dict_size = 30000
+    common.convert(path, train(dict_size), 1000, "wmt14_train")
+    common.convert(path, test(dict_size), 1000, "wmt14_test")

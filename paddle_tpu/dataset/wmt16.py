@@ -124,3 +124,16 @@ def test(src_dict_size, trg_dict_size, src_lang="en"):
 def validation(src_dict_size, trg_dict_size, src_lang="en"):
     return reader_creator("val", src_dict_size, trg_dict_size, src_lang,
                           SYNTH_TEST, 13)
+
+
+def convert(path, src_dict_size, trg_dict_size, src_lang):
+    """Converts dataset to sharded recordio format (reference
+    wmt16.py:322)."""
+    common.convert(path,
+                   train(src_dict_size=src_dict_size,
+                         trg_dict_size=trg_dict_size, src_lang=src_lang),
+                   1000, "wmt16_train")
+    common.convert(path,
+                   test(src_dict_size=src_dict_size,
+                        trg_dict_size=trg_dict_size, src_lang=src_lang),
+                   1000, "wmt16_test")

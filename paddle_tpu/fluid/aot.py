@@ -90,6 +90,15 @@ def export_inference_artifact(dirname, feeded_var_names, target_vars,
         lod_level = int(v.lod_level or 0)
         feed_meta[name] = {"shape": shape, "dtype": str(dtype),
                            "lod_level": lod_level}
+        if lod_level >= 2:
+            # the traced (data, lens) spec below carries only the innermost
+            # level; silently dropping outer levels would export an artifact
+            # that rejects (or misreads) nested-LoD feeds
+            raise NotImplementedError(
+                f"AOT export: feed {name!r} has lod_level={lod_level}; the "
+                "artifact feed spec carries one LoD level (data + lens). "
+                "Flatten the outer levels at the feed boundary or export "
+                "via save_inference_model + the executor path instead")
         if lod_level > 0:
             feat = tuple(int(s) for s in shape[1:] if s not in (-1, None))
             data_spec = jax.ShapeDtypeStruct((sym, sym_len) + feat, dtype)

@@ -45,6 +45,8 @@ class Channel:
         self._closed = threading.Event()
         self._taken = threading.Condition()
         self._outstanding = 0
+        self._waiting_receivers = 0
+        self._recv_interest = False
 
     def send(self, value, timeout=None):
         """True on success; raises ChannelClosed if the channel is closed
@@ -63,21 +65,27 @@ class Channel:
     def recv(self, timeout=None):
         """(value, ok): ok False iff closed and drained
         (channel_impl.h Receive)."""
-        while True:
-            try:
-                v = self._q.get(timeout=0.05)
-                if self._unbuffered:
-                    with self._taken:
-                        self._outstanding -= 1
-                        self._taken.notify_all()
-                return v, True
-            except queue.Empty:
-                if self._closed.is_set() and self._q.empty():
-                    return None, False
-                if timeout is not None:
-                    timeout -= 0.05
-                    if timeout <= 0:
-                        raise TimeoutError("channel recv timed out")
+        with self._taken:
+            self._waiting_receivers += 1
+        try:
+            while True:
+                try:
+                    v = self._q.get(timeout=0.05)
+                    if self._unbuffered:
+                        with self._taken:
+                            self._outstanding -= 1
+                            self._taken.notify_all()
+                    return v, True
+                except queue.Empty:
+                    if self._closed.is_set() and self._q.empty():
+                        return None, False
+                    if timeout is not None:
+                        timeout -= 0.05
+                        if timeout <= 0:
+                            raise TimeoutError("channel recv timed out")
+        finally:
+            with self._taken:
+                self._waiting_receivers -= 1
 
     def close(self):
         self._closed.set()
@@ -95,6 +103,14 @@ class Channel:
         except queue.Empty:
             if self._closed.is_set():
                 return None, False, True
+            if self._unbuffered:
+                # a polling Select recv case IS a momentarily-ready
+                # receiver: advertise it so a peer Select's send case can
+                # rendezvous (without this, send-Select and recv-Select on
+                # one unbuffered channel would livelock — each side polling,
+                # neither ever "waiting")
+                with self._taken:
+                    self._recv_interest = True
             return None, False, False
         if self._unbuffered:
             with self._taken:
@@ -103,19 +119,30 @@ class Channel:
         return v, True, True
 
     def try_send(self, value):
-        """True if the value was accepted without blocking. On an unbuffered
-        channel the value is parked in the rendezvous slot (the host-side
-        approximation of "a receiver is ready"); a closed channel raises,
+        """True if the value was accepted without blocking. An unbuffered
+        channel only accepts when a receiver is actually waiting (the
+        reference select_op keeps the send case not-ready otherwise —
+        parking a value with no receiver would let Select fire a case the
+        rendezvous semantics say must block); a closed channel raises,
         like send."""
         if self._closed.is_set():
             raise ChannelClosed("send on closed channel")
+        if self._unbuffered:
+            with self._taken:
+                if self._waiting_receivers <= self._outstanding \
+                        and not self._recv_interest:
+                    return False
+                try:
+                    self._q.put_nowait(value)
+                except queue.Full:
+                    return False
+                self._outstanding += 1
+                self._recv_interest = False
+            return True
         try:
             self._q.put_nowait(value)
         except queue.Full:
             return False
-        if self._unbuffered:
-            with self._taken:
-                self._outstanding += 1
         return True
 
 

@@ -397,19 +397,28 @@ def conv3d_transpose_grad(ctx):
 def max_pool3d_with_index(ctx):
     """pool_with_index_op.cc 3-D form (math/pooling.cc
     MaxPool3dWithIndexFunctor): mask holds the flat argmax offset within the
-    [D, H, W] volume."""
+    UNPADDED [D, H, W] volume. paddings pad with -inf (the max can never land
+    on padding) and global_pooling swallows ksize/paddings, both per the
+    reference op."""
     x = data_of(ctx.input("X"))
-    ks = _triple(ctx.attr("ksize"))
-    st = _triple(ctx.attr("strides", ks))
     n, c, dd, h, w = x.shape
-    od = (dd - ks[0]) // st[0] + 1
-    oh = (h - ks[1]) // st[1] + 1
-    ow = (w - ks[2]) // st[2] + 1
+    ks = _triple(ctx.attr("ksize"))
+    pd = _triple(ctx.attr("paddings", [0, 0, 0]))
+    if ctx.attr("global_pooling", False):
+        ks, pd = (dd, h, w), (0, 0, 0)
+    st = _triple(ctx.attr("strides", ks))
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1]),
+                     (pd[2], pd[2])), constant_values=neg)
+    od = (dd + 2 * pd[0] - ks[0]) // st[0] + 1
+    oh = (h + 2 * pd[1] - ks[1]) // st[1] + 1
+    ow = (w + 2 * pd[2] - ks[2]) // st[2] + 1
     patches = jnp.stack([
-        x[:, :,
-          a:a + st[0] * od:st[0],
-          b:b + st[1] * oh:st[1],
-          e:e + st[2] * ow:st[2]]
+        xp[:, :,
+           a:a + st[0] * od:st[0],
+           b:b + st[1] * oh:st[1],
+           e:e + st[2] * ow:st[2]]
         for a in range(ks[0]) for b in range(ks[1]) for e in range(ks[2])],
         axis=-1)
     arg = jnp.argmax(patches, axis=-1)
@@ -417,9 +426,10 @@ def max_pool3d_with_index(ctx):
     ka = arg // (ks[1] * ks[2])
     kb = (arg // ks[2]) % ks[1]
     ke = arg % ks[2]
-    ds = jnp.arange(od)[None, None, :, None, None] * st[0] + ka
-    hs = jnp.arange(oh)[None, None, None, :, None] * st[1] + kb
-    ws = jnp.arange(ow)[None, None, None, None, :] * st[2] + ke
+    # argmax coordinates back in UNPADDED input space (mask contract)
+    ds = jnp.arange(od)[None, None, :, None, None] * st[0] + ka - pd[0]
+    hs = jnp.arange(oh)[None, None, None, :, None] * st[1] + kb - pd[1]
+    ws = jnp.arange(ow)[None, None, None, None, :] * st[2] + ke - pd[2]
     ctx.set_output("Out", out)
     ctx.set_output("Mask", ((ds * h + hs) * w + ws).astype(jnp.int32))
 

@@ -518,10 +518,20 @@ def lod_reset(ctx):
         target = np.asarray(ctx.attr("target_lod"), np.int64)
         lens = jnp.asarray(np.diff(target), jnp.int32)
         new_max = int(np.diff(target).max()) if target.size > 1 else 0
+    old_lens = jnp.ones((data.shape[0],), jnp.int32) if x is None else x.lens
+    # malformed target lod (covers a different element count than X holds)
+    # corrupts the repack; reject when both sides are concrete (the eager /
+    # attr path — traced lens can't be validated at trace time)
+    if not isinstance(lens, jax.core.Tracer) \
+            and not isinstance(old_lens, jax.core.Tracer):
+        n_new, n_old = int(jnp.sum(lens)), int(jnp.sum(old_lens))
+        if n_new != n_old:
+            raise ValueError(
+                f"lod_reset: target lod covers {n_new} elements but X "
+                f"holds {n_old}")
     if x is None:
         # plain tensor input (lod_reset_op.cc accepts a bare tensor): each
         # row is one element; segment rows by the new lengths
-        old_lens = jnp.ones((data.shape[0],), jnp.int32)
         packed = _lod_repack(data[:, None], old_lens, lens, new_max)
         ctx.set_output("Out", LoDArray(packed, lens))
         return

@@ -13,13 +13,17 @@ test() over a held-out reader — used exactly like
 
 from . import event
 from .trainer import SGD
-from . import (activation, attr, config_helpers, data_type, layer,
-               optimizer, parameters, pooling)
+from . import (activation, attr, config_helpers, data_type, image, layer,
+               optimizer, parameters, pooling, topology)
 from .config_helpers import parse_config
+from .inference import infer, Inference
+from .topology import Topology
 
 # paddle.v2.trainer.SGD spelling (reference v2/trainer.py)
 from . import trainer
+from . import inference
 
 __all__ = ["event", "SGD", "trainer", "layer", "activation", "pooling",
            "attr", "data_type", "optimizer", "parameters", "config_helpers",
-           "parse_config"]
+           "parse_config", "infer", "Inference", "topology", "Topology",
+           "inference", "image"]

@@ -1,0 +1,134 @@
+"""paddle.infer / Inference — the v2 generation's inference entry point.
+
+Reference: python/paddle/v2/inference.py:24-125 — ``Inference(parameters,
+output_layer=...)`` builds a testing GradientMachine, copies the trained
+parameter buffers in, and ``infer(input, field=...)`` feeds a batch of
+samples and returns the (concatenated) forward outputs. Every reference v2
+example ends with ``paddle.infer(output_layer=prediction, parameters=params,
+input=data)``.
+
+Here the testing machine is the pruned for-test fluid Program (via
+v2.topology.Topology) run by the jit Executor against the Parameters'
+scope; ``fileobj=`` loads a Topology.serialize_for_inference bundle instead,
+so a model trained elsewhere round-trips through a stream.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import topology as v2_topology
+
+
+def build_feed(block, feed_order, data_batch, feeding=None):
+    """Sample tuples -> executor feed dict. ``feeding`` maps data-layer name
+    to the sample tuple position (the reference DataFeeder's feeding dict);
+    default is declaration order."""
+    feed = {}
+    for pos, name in enumerate(feed_order):
+        idx = feeding[name] if feeding else pos
+        vals = [row[idx] if isinstance(row, (list, tuple)) else row
+                for row in data_batch]
+        v = block.var(name)
+        if v.lod_level and v.lod_level > 0:
+            seqs = []
+            for s in vals:
+                a = np.asarray(s)
+                if a.ndim == 1:
+                    a = a.reshape(-1, 1)
+                seqs.append(a)
+            feed[name] = seqs
+        else:
+            arrs = [np.asarray(s) for s in vals]
+            if arrs and arrs[0].ndim == 0:
+                arrs = [a.reshape(1) for a in arrs]
+            feed[name] = np.stack(arrs)
+    return feed
+
+
+class Inference:
+    """Inference(parameters, output_layer=...) or
+    Inference(parameters, fileobj=serialized_topology_stream)."""
+
+    def __init__(self, parameters, output_layer=None, fileobj=None):
+        import paddle_tpu.fluid as fluid
+
+        if output_layer is not None:
+            topo = v2_topology.Topology(output_layer)
+            self._program = topo.program
+            self._feed_names = topo.feed_names
+            self._fetch_names = topo.fetch_names
+        elif fileobj is not None:
+            (self._program, self._feed_names,
+             self._fetch_names) = v2_topology.load_serialized(fileobj)
+        else:
+            raise ValueError("Either output_layer or fileobj must be set")
+
+        # bind the trained parameter values (the reference copies each
+        # buffer into the testing machine; here the executor reads the
+        # Parameters' scope directly)
+        scope = getattr(parameters, "_scope", None)
+        if scope is None:
+            raise RuntimeError(
+                "parameters are not initialized: train them (v2.SGD binds "
+                "its scope) or load values via Parameters.from_tar")
+        self._scope = scope
+        self._exe = fluid.Executor()
+
+    def iter_infer(self, input, feeding=None):
+        """Yield per-batch fetch lists (reference iter_infer forwards the
+        whole ``input`` as one batch)."""
+        block = self._program.global_block()
+        feed = build_feed(block, self._feed_names, list(input), feeding)
+        yield self._exe.run(self._program, feed=feed,
+                            fetch_list=list(self._fetch_names),
+                            scope=self._scope)
+
+    def iter_infer_field(self, field, **kwargs):
+        from paddle_tpu.core.lod import LoDArray, lodarray_to_flat
+
+        if not isinstance(field, (list, tuple)):
+            field = [field]
+        for result in self.iter_infer(**kwargs):
+            item = []
+            for f in field:
+                for r in result:
+                    if isinstance(r, LoDArray):
+                        r = lodarray_to_flat(r)[0]
+                    r = np.asarray(r)
+                    if f == "id":
+                        # reference: prediction labels (max_id); for a
+                        # probability output take the argmax, for an
+                        # integer output pass it through
+                        if np.issubdtype(r.dtype, np.floating) and r.ndim > 1:
+                            r = np.argmax(r, axis=-1)
+                    item.append(r)
+            yield item
+
+    def infer(self, input, field="value", flatten_result=True, **kwargs):
+        kwargs["input"] = input
+        retv = None
+        for item in self.iter_infer_field(field=field, **kwargs):
+            if retv is None:
+                retv = [[] for _ in item]
+            for i, r in enumerate(item):
+                retv[i].append(r)
+        if retv is None:
+            return []
+        if flatten_result:
+            retv = [np.concatenate(out) for out in retv]
+        if len(retv) == 1:
+            return retv[0]
+        return retv
+
+
+def infer(output_layer, parameters, input, feeding=None, field="value"):
+    """paddle.infer(output_layer=prediction, parameters=params, input=batch)
+    (reference inference.py:125-172). ``input`` is a list of sample tuples
+    ordered like the network's data layers (or per ``feeding``); returns the
+    prediction array(s)."""
+    inferer = Inference(output_layer=output_layer, parameters=parameters)
+    return inferer.infer(field=field, input=input, feeding=feeding)
+
+
+__all__ = ["infer", "Inference"]

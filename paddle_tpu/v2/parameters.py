@@ -15,7 +15,7 @@ import numpy as np
 
 
 class Parameters:
-    def __init__(self, program, scope=None):
+    def __init__(self, program=None, scope=None):
         self._program = program
         self._scope = scope
 
@@ -23,6 +23,10 @@ class Parameters:
         self._scope = scope
 
     def names(self):
+        if self._program is None:
+            # standalone (Parameters.from_tar in a fresh process): the scope
+            # IS the parameter set
+            return sorted(self._scope._vars)
         return [p.name for p in self._program.all_parameters()]
 
     def keys(self):
@@ -57,9 +61,18 @@ class Parameters:
                 info.size = len(data)
                 tf.addfile(info, io.BytesIO(data))
 
-    def from_tar(self, f):
+    def from_tar(self, f=None):
+        """Works both as the instance method ``params.from_tar(f)`` and as
+        the reference's class-level spelling ``Parameters.from_tar(f)``
+        (reference v2/parameters.py declares it static) — the latter builds
+        a standalone Parameters around a fresh scope."""
         import tarfile
         import io
+        if f is None:
+            f, self = self, Parameters()
+        if self._scope is None:
+            from ..core.scope import Scope
+            self._scope = Scope()
         with tarfile.open(fileobj=f, mode="r") as tf:
             for m in tf.getmembers():
                 if not m.name.endswith(".npy"):
@@ -68,6 +81,13 @@ class Parameters:
                               allow_pickle=False)
                 self._scope.set(m.name[:-4], arr)
         return self
+
+    @classmethod
+    def from_tar_file(cls, f):
+        """Reference classmethod spelling ``Parameters.from_tar(f)`` used by
+        every v2 example to load a trained model in a fresh process — builds
+        a standalone scope holding the values."""
+        return cls().from_tar(f)
 
 
 def create(cost):

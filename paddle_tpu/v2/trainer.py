@@ -55,7 +55,6 @@ class SGD:
                 if hasattr(update_equation, "to_fluid") else update_equation
         if optimizer is None:
             raise ValueError("SGD needs optimizer= or update_equation=")
-        del parameters  # parity arg; fluid scope owns parameter storage
 
         self._cost = cost
         self._main = main_program or default_main_program()
@@ -75,26 +74,37 @@ class SGD:
         self._metrics = dict(metrics or {})
         self._exe = fluid.Executor(place)
         self._scope = fluid.Scope()
-        optimizer.minimize(cost, self._startup)
-        # test program: forward-only clone (reference creates a separate
-        # test evaluator over the same machine)
+        # test program: forward-only clone, taken BEFORE minimize appends
+        # backward + optimizer ops — the reference's forwardTest never
+        # updates parameters (cloning after would make test() train!)
         self._test_program = self._main.clone(for_test=True)
+        optimizer.minimize(cost, self._startup)
         self._exe.run(self._startup, scope=self._scope)
+        if parameters is not None:
+            # pre-trained values (Parameters.from_tar in a fresh process)
+            # seed the trainer's freshly-initialized scope first
+            if parameters._scope is not None \
+                    and parameters._scope is not self._scope:
+                for name in list(parameters._scope._vars):
+                    if self._scope.has_var(name):
+                        self._scope.set(name,
+                                        parameters._scope.find_var(name))
+            # bind the v2 Parameters view (paddle.parameters.create) to this
+            # trainer's scope so paddle.infer(parameters=...) and
+            # parameters.to_tar see the trained values — the reference's
+            # Parameters wraps the same GradientMachine the trainer updates
+            parameters._bind(self._scope)
+            if parameters._program is None:
+                parameters._program = self._main
 
     @property
     def scope(self):
         return self._scope
 
     def _feed(self, data_batch):
-        feed = {}
-        for idx, name in enumerate(self._feed_order):
-            vals = [row[idx] for row in data_batch]
-            v = self._main.global_block().var(name)
-            if v.lod_level > 0:
-                feed[name] = [np.asarray(s) for s in vals]
-            else:
-                feed[name] = np.stack([np.asarray(s) for s in vals])
-        return feed
+        from .inference import build_feed
+        return build_feed(self._main.global_block(), self._feed_order,
+                          data_batch)
 
     def _run(self, program, data_batch):
         fetch = [self._cost] + list(self._metrics.values())

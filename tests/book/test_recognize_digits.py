@@ -164,3 +164,41 @@ def test_recognize_digits_pserver_variant():
             break
     rpc.shutdown()
     assert acc_val > 0.9, f"pserver MLP failed to converge, acc={acc_val}"
+
+
+def test_recognize_digits_v2_style_with_infer():
+    """The same chapter written the v2 way (reference book/
+    recognize_digits trains via paddle.v2.SGD and ends with
+    ``paddle.infer(output_layer=prediction, parameters=parameters,
+    input=test_data)`` — python/paddle/v2/inference.py:125)."""
+    import paddle_tpu.v2 as paddle
+    import paddle_tpu.reader as reader_pkg
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        images = paddle.layer.data("pixel_rd_v2",
+                                   paddle.data_type.dense_vector(784))
+        label = paddle.layer.data("label_rd_v2",
+                                  paddle.data_type.integer_value(10))
+        h1 = paddle.layer.fc(images, size=64, act=paddle.activation.Relu())
+        prediction = paddle.layer.fc(h1, size=10,
+                                     act=paddle.activation.Softmax())
+        cost = paddle.layer.classification_cost(input=prediction, label=label)
+        parameters = paddle.parameters.create(cost)
+        trainer = paddle.SGD(cost=cost, parameters=parameters,
+                             update_equation=paddle.optimizer.Adam(
+                                 learning_rate=0.002),
+                             feed_order=["pixel_rd_v2", "label_rd_v2"],
+                             main_program=main, startup_program=startup)
+
+    xs, ys = _digit_arrays(1024)
+    data = [(xs[i], ys[i]) for i in range(len(xs))]
+    trainer.train(reader=reader_pkg.batch(lambda: iter(data), batch_size=128),
+                  num_passes=5)
+
+    probs = paddle.infer(output_layer=prediction, parameters=parameters,
+                         input=[(x,) for x in xs[:64]])
+    assert probs.shape == (64, 10)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
+    acc = float((np.argmax(probs, axis=1) == ys[:64, 0]).mean())
+    assert acc > 0.85, f"v2 infer path accuracy {acc}"

@@ -1,0 +1,136 @@
+"""v2 image preprocessing utilities (reference python/paddle/v2/image.py).
+
+Numerics pinned on synthetic images: crop windows, flip symmetry,
+resize_short aspect-ratio preservation, simple_transform layout + mean
+subtraction, encoded-bytes decode round-trip, and batch_images_from_tar's
+{label, data} batch-file shape.
+"""
+
+import io
+import os
+import pickle
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.v2 import image as v2_image
+
+
+def _img(h=32, w=48, c=3, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (h, w, c) if c else (h, w)).astype(np.uint8)
+
+
+def test_to_chw_and_flip():
+    im = _img()
+    chw = v2_image.to_chw(im)
+    assert chw.shape == (3, 32, 48)
+    np.testing.assert_array_equal(chw[1], im[:, :, 1])
+    flipped = v2_image.left_right_flip(im)
+    np.testing.assert_array_equal(flipped[:, 0, :], im[:, -1, :])
+    gray = _img(c=0)
+    np.testing.assert_array_equal(
+        v2_image.left_right_flip(gray, is_color=False)[:, 0], gray[:, -1])
+
+
+def test_center_crop_window():
+    im = _img(h=40, w=60)
+    out = v2_image.center_crop(im, 20)
+    assert out.shape == (20, 20, 3)
+    np.testing.assert_array_equal(out, im[10:30, 20:40, :])
+
+
+def test_random_crop_is_a_window():
+    im = _img(h=40, w=60)
+    rng = np.random.RandomState(3)
+    out = v2_image.random_crop(im, 24, rng=rng)
+    assert out.shape == (24, 24, 3)
+    # the crop must be an exact sub-window of the source
+    found = any(
+        np.array_equal(out, im[i:i + 24, j:j + 24])
+        for i in range(40 - 24 + 1) for j in range(60 - 24 + 1))
+    assert found
+
+
+def test_resize_short_keeps_aspect():
+    im = _img(h=100, w=50)
+    out = v2_image.resize_short(im, 25)
+    assert out.shape == (50, 25, 3)   # shorter edge (w) -> 25, h scales 2x
+    im2 = _img(h=30, w=90)
+    out2 = v2_image.resize_short(im2, 15)
+    assert out2.shape == (15, 45, 3)
+
+
+def test_simple_transform_eval_path():
+    im = _img(h=64, w=80)
+    mean = [10.0, 20.0, 30.0]
+    out = v2_image.simple_transform(im, 48, 32, is_train=False, mean=mean)
+    assert out.shape == (3, 32, 32) and out.dtype == np.float32
+    # mean subtraction is per-channel
+    ref = v2_image.simple_transform(im, 48, 32, is_train=False)
+    np.testing.assert_allclose(out[0], ref[0] - 10.0, atol=1e-5)
+    np.testing.assert_allclose(out[2], ref[2] - 30.0, atol=1e-5)
+
+
+def test_simple_transform_train_path_deterministic_rng():
+    im = _img(h=64, w=80, seed=5)
+    a = v2_image.simple_transform(im, 48, 32, is_train=True,
+                                  rng=np.random.RandomState(7))
+    b = v2_image.simple_transform(im, 48, 32, is_train=True,
+                                  rng=np.random.RandomState(7))
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (3, 32, 32)
+
+
+def test_load_image_bytes_roundtrip(tmp_path):
+    from PIL import Image
+
+    im = _img(h=20, w=24)
+    buf = io.BytesIO()
+    Image.fromarray(im).save(buf, format="PNG")   # lossless
+    got = v2_image.load_image_bytes(buf.getvalue())
+    np.testing.assert_array_equal(got, im)
+    gray = v2_image.load_image_bytes(buf.getvalue(), is_color=False)
+    assert gray.ndim == 2
+
+    p = tmp_path / "img.png"
+    p.write_bytes(buf.getvalue())
+    np.testing.assert_array_equal(v2_image.load_image(str(p)), im)
+
+
+def test_batch_images_from_tar(tmp_path):
+    from PIL import Image
+
+    tar_path = str(tmp_path / "imgs.tar")
+    img2label = {}
+    with tarfile.open(tar_path, "w") as tf:
+        for i in range(5):
+            buf = io.BytesIO()
+            Image.fromarray(_img(h=8, w=8, seed=i)).save(buf, format="PNG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(name=f"img_{i}.png")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+            img2label[f"img_{i}.png"] = i % 2
+    meta = v2_image.batch_images_from_tar(tar_path, "train", img2label,
+                                          num_per_batch=2)
+    files = [l.strip() for l in open(meta)]
+    assert len(files) == 3            # 2 + 2 + 1
+    rec = pickle.load(open(files[0], "rb"))
+    assert set(rec) == {"label", "data"} and len(rec["data"]) == 2
+    got = v2_image.load_image_bytes(rec["data"][0])
+    assert got.shape == (8, 8, 3)
+
+
+def test_flowers_pipeline_uses_simple_transform(monkeypatch):
+    """The flowers real-path reader routes every JPEG through
+    v2.image.load_image_bytes + simple_transform (resize 256, crop 224) —
+    schema: float32 CHW [3,224,224] in [0,1]."""
+    import paddle_tpu.dataset.flowers as flowers
+    src = open(flowers.__file__).read()
+    assert "simple_transform" in src and "load_image_bytes" in src
+    # synthetic fallback (no cached tarball in CI) keeps the same schema
+    img, label = next(flowers.train()())
+    assert img.shape == (3, 224, 224) and img.dtype == np.float32
+    assert 0 <= label < flowers.N_CLASSES
