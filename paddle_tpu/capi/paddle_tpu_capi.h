@@ -43,16 +43,33 @@ pd_tpu_error pd_tpu_model_load(const char* artifact_dir, pd_tpu_model* out);
 /* Run the model on one dense float32 input [batch, feature_dim] and copy
  * the FIRST fetch into out_data (caller-allocated, out_capacity floats).
  * out_rows/out_cols receive the fetch shape. Mirrors the dense example's
- * forward (capi/examples/model_inference/dense/main.c). */
+ * forward (capi/examples/model_inference/dense/main.c).
+ *
+ * Thread safety: after pd_tpu_init, every entry point acquires the Python
+ * GIL internally — any number of threads may run concurrently against
+ * shared or distinct models (the reference's multi_thread example
+ * contract); Python-side work serializes on the GIL. */
 pd_tpu_error pd_tpu_model_run(pd_tpu_model model, const float* in_data,
                               int64_t batch, int64_t feature_dim,
                               float* out_data, int64_t out_capacity,
                               int64_t* out_rows, int64_t* out_cols);
 
+/* Run a SEQUENCE model: ids is the concatenation of n_seqs int64 token
+ * sequences, seq_lens their lengths (the reference capi's
+ * paddle_ivector sequence feed, examples/model_inference/sequence/
+ * main.c). The model's (single) feed must be a lod_level=1 var; the
+ * FIRST fetch is copied to out_data as with pd_tpu_model_run. */
+pd_tpu_error pd_tpu_model_run_seq(pd_tpu_model model, const int64_t* ids,
+                                  const int64_t* seq_lens, int64_t n_seqs,
+                                  float* out_data, int64_t out_capacity,
+                                  int64_t* out_rows, int64_t* out_cols);
+
 /* Destroy a loaded model. */
 pd_tpu_error pd_tpu_model_destroy(pd_tpu_model model);
 
-/* Tear down the embedded runtime. */
+/* Tear down the embedded runtime. MUST be called from the thread that
+ * called pd_tpu_init (Py_Finalize needs the interpreter's main thread
+ * state); all other entry points are thread-agnostic. */
 pd_tpu_error pd_tpu_shutdown(void);
 
 #ifdef __cplusplus

@@ -35,11 +35,20 @@ def shard_pipeline_params(stacked_params, mesh, axis="pp"):
                                   stacked_params)
 
 
-def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis="pp"):
+def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis="pp",
+                   data_spec=None, param_specs=None):
     """Run ``microbatches [M, mb, ...]`` through S pipelined stages.
 
     stacked_params: pytree of [S, ...] arrays (stage-major, sharded or not);
-    returns [M, mb, ...] outputs (replicated)."""
+    returns [M, mb, ...] outputs.
+
+    Composition hooks (dp×pp×tp on one 3-axis mesh): ``data_spec`` shards
+    the microbatch dims over other mesh axes (e.g. P(None, "dp") — each dp
+    group pipelines its own batch shard; outputs come back with the same
+    spec), and ``param_specs`` overrides the per-leaf parameter specs so
+    stage weights can ALSO be tensor-sharded (e.g. P("pp", None, "tp") with
+    the stage_fn psum-ing its partial matmul over "tp" — the Megatron
+    pattern inside each pipeline stage)."""
     n_stages = mesh.shape[axis]
     m = microbatches.shape[0]
     for leaf in jax.tree_util.tree_leaves(stacked_params):
@@ -82,9 +91,17 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis="pp"):
         keep = (stage == n_stages - 1).astype(xs.dtype)
         return jax.lax.psum(outs * keep, axis)
 
-    spec_params = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    spec_params = param_specs if param_specs is not None else \
+        jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    dspec = data_spec if data_spec is not None else P()
+    if len(dspec) >= 1 and dspec[0] is not None:
+        # per_device closes over the GLOBAL microbatch count; sharding the
+        # M dim would silently re-feed clamped local microbatches
+        raise ValueError(
+            f"data_spec {dspec} must not partition the leading microbatch "
+            "dim; shard the per-microbatch batch dim (e.g. P(None, 'dp'))")
     fn = shard_map(per_device, mesh=mesh,
-                   in_specs=(spec_params, P()), out_specs=P(),
+                   in_specs=(spec_params, dspec), out_specs=dspec,
                    check_rep=False)
     return fn(stacked_params, microbatches)
 

@@ -40,7 +40,21 @@ def make_mesh(n_devices=None, axes=("dp",), shape=None, devices=None):
                     tp = cand
             shape = (n // tp, tp)
         else:
-            raise ValueError("provide shape for >2 mesh axes")
+            # balanced k-axis mesh (dp×pp×tp composition): greedily feed
+            # prime factors (largest first) to the currently-smallest axis;
+            # n=8, 3 axes -> (2, 2, 2)
+            sizes = [1] * len(axes)
+            rem, f, factors = n, 2, []
+            while f * f <= rem:
+                while rem % f == 0:
+                    factors.append(f)
+                    rem //= f
+                f += 1
+            if rem > 1:
+                factors.append(rem)
+            for fac in sorted(factors, reverse=True):
+                sizes[sizes.index(min(sizes))] *= fac
+            shape = tuple(sizes)
     mesh_devs = np.array(devs).reshape(shape)
     return Mesh(mesh_devs, axes)
 

@@ -90,6 +90,27 @@ def test_aot_export_roundtrip_and_batch_polymorphism():
                                rtol=1e-5, atol=1e-6)
 
 
+def _compile_capi_example(example, binname, extra=()):
+    import shutil
+    if shutil.which("gcc") is None:
+        pytest.skip("no C compiler")
+    capi = os.path.join(REPO, "paddle_tpu", "capi")
+    bindir = tempfile.mkdtemp()
+    binpath = os.path.join(bindir, binname)
+    cflags = subprocess.check_output(
+        ["python3-config", "--includes"], text=True).split()
+    ldflags = subprocess.check_output(
+        ["python3-config", "--embed", "--ldflags"], text=True).split()
+    cmd = (["gcc", "-O1", "-o", binpath,
+            os.path.join(capi, "examples/model_inference", example, "main.c"),
+            os.path.join(capi, "paddle_tpu_capi.c")]
+           + cflags + ldflags + list(extra))
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    return binpath
+
+
+
 def test_capi_dense_example_end_to_end():
     """Compile paddle_tpu/capi (gcc + embedded CPython) and run the dense
     example binary against a freshly exported artifact."""
@@ -102,18 +123,7 @@ def test_capi_dense_example_end_to_end():
     aot.export_inference_artifact(d, ["x"], [probs], exe,
                                   main_program=main, scope=scope)
 
-    capi = os.path.join(REPO, "paddle_tpu", "capi")
-    bindir = tempfile.mkdtemp()
-    binpath = os.path.join(bindir, "dense_infer")
-    cflags = subprocess.check_output(
-        ["python3-config", "--includes"], text=True).split()
-    ldflags = subprocess.check_output(
-        ["python3-config", "--embed", "--ldflags"], text=True).split()
-    cmd = (["gcc", "-O1", "-o", binpath,
-            os.path.join(capi, "examples/model_inference/dense/main.c"),
-            os.path.join(capi, "paddle_tpu_capi.c")] + cflags + ldflags)
-    r = subprocess.run(cmd, capture_output=True, text=True)
-    assert r.returncode == 0, r.stderr
+    binpath = _compile_capi_example("dense", "dense_infer")
 
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
     r = subprocess.run([binpath, d, "8"], env=env, capture_output=True,
@@ -163,3 +173,63 @@ def test_aot_export_lod_model():
     out2 = art.run({"words": seqs2})[0]
     assert out2.shape == (1, 3)
     np.testing.assert_allclose(out2.sum(1), 1.0, atol=1e-5)
+
+
+def test_capi_sequence_example_end_to_end():
+    """The sequence C example (reference capi/examples/model_inference/
+    sequence/main.c): ragged int64 token sequences through
+    pd_tpu_model_run_seq, checked against the in-process artifact run."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 6
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data("words", shape=[1], dtype="int64",
+                                  lod_level=1)
+        emb = fluid.layers.embedding(words, size=(20, 8))
+        pooled = fluid.layers.sequence_pool(emb, pool_type="average")
+        probs = fluid.layers.fc(pooled, 3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+
+    d = tempfile.mkdtemp()
+    aot.export_inference_artifact(d, ["words"], [probs], exe,
+                                  main_program=main, scope=scope)
+
+    # in-process expectation for the example's hard-coded sequences
+    art = aot.load_inference_artifact(d)
+    seqs = [np.array(s, "int64").reshape(-1, 1)
+            for s in ([1, 2, 3, 4], [5, 6], [7, 8, 9])]
+    want = art.run({"words": seqs})[0]
+
+    binpath = _compile_capi_example("sequence", "seq_infer")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run([binpath, d], env=env, capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "SEQ_INFER_OK" in r.stdout, r.stdout + r.stderr
+    rows = [l for l in r.stdout.splitlines() if l.startswith("seq ")]
+    assert len(rows) == 3
+    got = np.array([[float(v) for v in l.split(":")[1].split("(")[0].split()]
+                    for l in rows])
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_capi_multi_thread_example_end_to_end():
+    """The multi-thread C example (reference capi/examples/model_inference/
+    multi_thread/main.c:29-35): 4 pthreads forwarding concurrently on ONE
+    loaded model; the GIL contract is documented in paddle_tpu_capi.h and
+    each thread asserts its own runs are valid + deterministic."""
+    main, exe, scope, probs = _train_small_model(seed=2)
+    d = tempfile.mkdtemp()
+    aot.export_inference_artifact(d, ["x"], [probs], exe,
+                                  main_program=main, scope=scope)
+
+    binpath = _compile_capi_example("multi_thread", "multi_thread_infer",
+                                    extra=["-lpthread"])
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run([binpath, d, "8"], env=env, capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "MULTI_THREAD_INFER_OK" in r.stdout, r.stdout + r.stderr
+    ok_lines = [l for l in r.stdout.splitlines() if "ok=1" in l]
+    assert len(ok_lines) == 4, r.stdout
