@@ -65,7 +65,14 @@ Round-5 numbers (v5e single chip, shared dev machine):
                               space-to-depth stem on)
   lstm_textcls ms/batch       5.6-8.7 across runs (23-33x the K40m 184 ms
                               reference row; best path reported); absolute
-                              gate: <= 12 ms/batch on a v5e-class chip
+                              gate: <= 12 ms/batch on a v5e-class chip.
+                              Round 5: the Pallas whole-recurrence kernel
+                              (weight VMEM-resident across the scan, one
+                              launch per sequence instead of seq_len
+                              matmul+fusion pairs) now BEATS the lax.scan
+                              path: 5.91 vs 7.21 ms measured same-session
+                              (1.22x) — the hand-tuned set finally wins
+                              its lane (VERDICT r4 #7)
   ragged bucketing speedup    1.60x driver-visible (scanned per-bucket
                               dispatch; see run_lstm_ragged_lane docstring)
 

@@ -66,10 +66,14 @@ DEFINE_flag("benchmark", False,
             "log per-op timing in eager mode — reference --benchmark "
             "(executor.cc:321-324)")
 DEFINE_flag("use_pallas_rnn", False,
-            "use the Pallas fused LSTM/GRU cell kernels (the hand-scheduled "
-            "hl_cuda_lstm.cu analogs) inside recurrent scans; default off — "
-            "XLA's fusion handles the elementwise chain well, so this is a "
-            "tuning/demonstration surface with pinned numeric parity")
+            "use the Pallas recurrent kernels (the hand-scheduled "
+            "hl_cuda_lstm.cu analogs): the LSTM path runs the WHOLE "
+            "sequence as one kernel with the recurrent weight VMEM-"
+            "resident across steps — measured 1.22x vs the lax.scan path "
+            "on the v5e training lane (5.91 vs 7.21 ms/batch, round 5); "
+            "GRU keeps the fused-cell form. Default off so CPU test runs "
+            "avoid interpret-mode kernels; bench.py measures both paths "
+            "and reports the winner")
 DEFINE_flag("xla_compiler_options", "",
             "comma-separated k=v TPU compiler options forwarded to "
             "jit(compiler_options=...), e.g. "

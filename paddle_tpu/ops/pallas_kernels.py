@@ -28,26 +28,6 @@ def _on_cpu():
     return jax.default_backend() == "cpu"
 
 
-def _lstm_cell_kernel(gates_ref, c_prev_ref, h_prev_ref, alive_ref,
-                      h_ref, c_ref):
-    """One fused pass: gates [b, 4H] -> (h, c) [b, H], masked by alive.
-    Gate column order [i, f, c, o] (this framework's documented layout)."""
-    gates = gates_ref[...]
-    h4 = gates.shape[-1]
-    hdim = h4 // 4
-    c_prev = c_prev_ref[...]
-    h_prev = h_prev_ref[...]
-    alive = alive_ref[...]
-    i = jax.nn.sigmoid(gates[:, :hdim])
-    f = jax.nn.sigmoid(gates[:, hdim:2 * hdim])
-    cand = jnp.tanh(gates[:, 2 * hdim:3 * hdim])
-    o = jax.nn.sigmoid(gates[:, 3 * hdim:])
-    c = f * c_prev + i * cand
-    h = o * jnp.tanh(c)
-    h_ref[...] = alive * h + (1 - alive) * h_prev
-    c_ref[...] = alive * c + (1 - alive) * c_prev
-
-
 def _lstm_cell_jnp(gates, c_prev, h_prev, alive):
     hdim = gates.shape[-1] // 4
     i = jax.nn.sigmoid(gates[:, :hdim])
@@ -58,34 +38,6 @@ def _lstm_cell_jnp(gates, c_prev, h_prev, alive):
     h = o * jnp.tanh(c)
     return (alive * h + (1 - alive) * h_prev,
             alive * c + (1 - alive) * c_prev)
-
-
-@jax.custom_vjp
-def fused_lstm_cell(gates, c_prev, h_prev, alive):
-    """Fused LSTM cell (standard sigmoid/tanh activations): pallas forward,
-    jnp custom-vjp backward. All operands [b, ·]; alive [b, 1]."""
-    b, h4 = gates.shape
-    hdim = h4 // 4
-    return pl.pallas_call(
-        _lstm_cell_kernel,
-        out_shape=(jax.ShapeDtypeStruct((b, hdim), gates.dtype),
-                   jax.ShapeDtypeStruct((b, hdim), gates.dtype)),
-        interpret=_on_cpu(),
-    )(gates, c_prev, h_prev, alive)
-
-
-def _fused_fwd(gates, c_prev, h_prev, alive):
-    out = fused_lstm_cell(gates, c_prev, h_prev, alive)
-    return out, (gates, c_prev, h_prev, alive)
-
-
-def _fused_bwd(res, cts):
-    gates, c_prev, h_prev, alive = res
-    _, vjp = jax.vjp(_lstm_cell_jnp, gates, c_prev, h_prev, alive)
-    return vjp(cts)
-
-
-fused_lstm_cell.defvjp(_fused_fwd, _fused_bwd)
 
 
 def _gru_cell_kernel(u_in_ref, c_in_ref, h_prev_ref, w_c_ref, alive_ref,
@@ -205,3 +157,122 @@ def ctc_alpha_pallas(e, alpha0, final0, can_skip, s_valid, x_lens, y_lens):
         out_shape=jax.ShapeDtypeStruct((b, 1), f32),
         interpret=_on_cpu(),
     )(e, alpha0, final0, can_skip, s_valid, x_lens, y_lens)
+
+
+# ---------------------------------------------------------------------------
+# Whole-recurrence LSTM: one kernel for the ENTIRE sequence
+# ---------------------------------------------------------------------------
+
+def _lstm_seq_kernel(x_ref, alive_ref, w_ref, h0_ref, c0_ref,
+                     hs_ref, cs_ref, h_s, c_s):
+    """Grid over time. The recurrent weight w stays VMEM-resident across
+    every grid step (XLA's lax.scan body re-reads it from HBM each
+    iteration — for hid 512 that is ~4 MB x seq_len per layer) and the h/c
+    carries live in VMEM scratch, so the whole recurrence is ONE kernel
+    launch instead of seq_len (matmul + fusion) pairs. The per-step matmul
+    runs on the MXU in bf16 with f32 accumulation (the lane's
+    default_matmul_precision contract)."""
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_s[...] = h0_ref[...]
+        c_s[...] = c0_ref[...]
+
+    h_prev = h_s[...]
+    c_prev = c_s[...]
+    gates = x_ref[0] + jax.lax.dot(
+        h_prev.astype(w_ref.dtype), w_ref[...],
+        preferred_element_type=jnp.float32).astype(h_prev.dtype)
+    hdim = h_prev.shape[-1]
+    alive = alive_ref[0]
+    i = jax.nn.sigmoid(gates[:, :hdim])
+    f = jax.nn.sigmoid(gates[:, hdim:2 * hdim])
+    cand = jnp.tanh(gates[:, 2 * hdim:3 * hdim])
+    o = jax.nn.sigmoid(gates[:, 3 * hdim:])
+    c = f * c_prev + i * cand
+    h = o * jnp.tanh(c)
+    h = alive * h + (1 - alive) * h_prev
+    c = alive * c + (1 - alive) * c_prev
+    h_s[...] = h
+    c_s[...] = c
+    hs_ref[0] = h
+    cs_ref[0] = c
+
+
+def _lstm_seq_fwd_pallas(x, alive, w, h0, c0):
+    """x [L, b, 4H] (projected inputs + bias), alive [L, b, 1] float,
+    w [H, 4H]; returns CARRY sequences hs/cs [L, b, H] (unmasked — the
+    caller applies the output mask)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    L, b, H4 = x.shape
+    H = H4 // 4
+    wb = w.astype(jnp.bfloat16)   # MXU operand; bf16 halves its VMEM stay
+    return pl.pallas_call(
+        _lstm_seq_kernel,
+        grid=(L,),
+        in_specs=[
+            pl.BlockSpec((1, b, H4), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, b, 1), lambda t: (t, 0, 0)),
+            pl.BlockSpec((H, H4), lambda t: (0, 0)),
+            pl.BlockSpec((b, H), lambda t: (0, 0)),
+            pl.BlockSpec((b, H), lambda t: (0, 0)),
+        ],
+        out_specs=[pl.BlockSpec((1, b, H), lambda t: (t, 0, 0)),
+                   pl.BlockSpec((1, b, H), lambda t: (t, 0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((L, b, H), x.dtype),
+                   jax.ShapeDtypeStruct((L, b, H), x.dtype)],
+        scratch_shapes=[pltpu.VMEM((b, H), x.dtype),
+                        pltpu.VMEM((b, H), x.dtype)],
+        interpret=_on_cpu(),
+    )(x, alive, wb, h0, c0)
+
+
+def _lstm_step_jnp(xt, h_prev, c_prev, w, alive):
+    """One reference step on CARRIES (the jnp twin the backward
+    differentiates): the bf16-MXU gate matmul + the shared cell math.
+    Returns (h_carry, c_carry)."""
+    gates = xt + jax.lax.dot(
+        h_prev.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32).astype(h_prev.dtype)
+    return _lstm_cell_jnp(gates, c_prev, h_prev, alive)
+
+
+@jax.custom_vjp
+def lstm_seq_pallas(x, alive, w, h0, c0):
+    return _lstm_seq_fwd_pallas(x, alive, w, h0, c0)
+
+
+def _lstm_seq_fwd(x, alive, w, h0, c0):
+    hs, cs = _lstm_seq_fwd_pallas(x, alive, w, h0, c0)
+    return (hs, cs), (x, alive, w, h0, c0, hs, cs)
+
+
+def _lstm_seq_bwd(res, cts):
+    """Reverse scan of per-step jax.vjp over the SAVED carries: gates are
+    recomputed from x[t] + h[t-1] @ w (one extra matmul per step — the
+    trade XLA's scan makes by saving gates instead; recompute keeps the
+    saved-residual HBM footprint at 2 arrays)."""
+    x, alive, w, h0, c0, hs, cs = res
+    dhs, dcs = cts
+    h_prevs = jnp.concatenate([h0[None], hs[:-1]], axis=0)
+    c_prevs = jnp.concatenate([c0[None], cs[:-1]], axis=0)
+
+    def bstep(carry, inp):
+        dh_next, dc_next, dw = carry
+        xt, at, hp, cp, dh_out, dc_out = inp
+        _, vjp = jax.vjp(
+            lambda xv, hv, cv, wv: _lstm_step_jnp(xv, hv, cv, wv, at),
+            xt, hp, cp, w)
+        dxt, dhp, dcp, dwt = vjp((dh_next + dh_out, dc_next + dc_out))
+        return (dhp, dcp, dw + dwt), dxt
+
+    zero = jnp.zeros_like(h0)
+    (dh0, dc0, dw), dx = jax.lax.scan(
+        bstep, (zero, jnp.zeros_like(c0), jnp.zeros_like(w)),
+        (x, alive, h_prevs, c_prevs, dhs, dcs), reverse=True)
+    return dx, None, dw, dh0, dc0
+
+
+lstm_seq_pallas.defvjp(_lstm_seq_fwd, _lstm_seq_bwd)

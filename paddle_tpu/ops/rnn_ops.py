@@ -75,32 +75,41 @@ def _lstm_scan(x, lens, w, h0, c0, gate_act, cell_act, cand_act,
                   and (gate_act, cell_act, cand_act)
                   == ("sigmoid", "tanh", "tanh"))
 
+    if use_pallas:
+        # whole-recurrence kernel: ONE launch for the full sequence with
+        # the recurrent weight VMEM-resident across steps (see
+        # pallas_kernels.lstm_seq_pallas)
+        from .pallas_kernels import lstm_seq_pallas
+        xt = jnp.swapaxes(x, 0, 1)                   # [L, b, 4H]
+        alive = (jnp.arange(L)[:, None] < lens[None, :]) \
+            .astype(x.dtype)[..., None]              # [L, b, 1]
+        hs, cs = lstm_seq_pallas(xt, alive, w, h0, c0)
+        hs = hs * alive
+        cs = cs * alive
+        return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
+
     def step(carry, inp):
         h_prev, c_prev, t = carry
         xt = inp                                     # [b, 4H]
         gates = xt + h_prev @ w                      # MXU matmul
         alive = (t < lens)[:, None].astype(x.dtype)
-        if use_pallas:
-            from .pallas_kernels import fused_lstm_cell
-            h, c = fused_lstm_cell(gates, c_prev, h_prev, alive)
-        else:
-            gi = gates[:, :H]
-            gf = gates[:, H:2 * H]
-            go = gates[:, 3 * H:]
-            if peepholes is not None:
-                w_ic, w_fc, w_oc = peepholes
-                gi = gi + c_prev * w_ic[None, :]
-                gf = gf + c_prev * w_fc[None, :]
-            i = ga(gi)
-            f = ga(gf)
-            cand = cda(gates[:, 2 * H:3 * H])
-            c = f * c_prev + i * cand
-            if peepholes is not None:
-                go = go + c * w_oc[None, :]
-            o = ga(go)
-            h = o * ca(c)
-            h = alive * h + (1 - alive) * h_prev
-            c = alive * c + (1 - alive) * c_prev
+        gi = gates[:, :H]
+        gf = gates[:, H:2 * H]
+        go = gates[:, 3 * H:]
+        if peepholes is not None:
+            w_ic, w_fc, w_oc = peepholes
+            gi = gi + c_prev * w_ic[None, :]
+            gf = gf + c_prev * w_fc[None, :]
+        i = ga(gi)
+        f = ga(gf)
+        cand = cda(gates[:, 2 * H:3 * H])
+        c = f * c_prev + i * cand
+        if peepholes is not None:
+            go = go + c * w_oc[None, :]
+        o = ga(go)
+        h = o * ca(c)
+        h = alive * h + (1 - alive) * h_prev
+        c = alive * c + (1 - alive) * c_prev
         return (h, c, t + 1), (h * alive, c * alive)
 
     xt = jnp.swapaxes(x, 0, 1)                       # [L, b, 4H]

@@ -14,7 +14,7 @@ test() over a held-out reader — used exactly like
 from . import event
 from .trainer import SGD
 from . import (activation, attr, config_helpers, data_type, image, layer,
-               optimizer, parameters, pooling, topology)
+               optimizer, parameters, plot, pooling, topology)
 from .config_helpers import parse_config
 from .inference import infer, Inference
 from .topology import Topology
@@ -26,4 +26,4 @@ from . import inference
 __all__ = ["event", "SGD", "trainer", "layer", "activation", "pooling",
            "attr", "data_type", "optimizer", "parameters", "config_helpers",
            "parse_config", "infer", "Inference", "topology", "Topology",
-           "inference", "image"]
+           "inference", "image", "plot"]

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 
 import paddle_tpu.fluid as fluid
-from paddle_tpu.ops.pallas_kernels import (fused_lstm_cell, _lstm_cell_jnp,
+from paddle_tpu.ops.pallas_kernels import (_lstm_cell_jnp,
                                            fused_gru_cell, _gru_cell_jnp)
 
 
@@ -23,40 +23,6 @@ from paddle_tpu.ops.pallas_kernels import (fused_lstm_cell, _lstm_cell_jnp,
 def _reset_flags():
     yield
     fluid.set_flags({"use_pallas_rnn": False})
-
-
-def test_fused_lstm_cell_matches_jnp():
-    rng = np.random.RandomState(0)
-    b, h = 8, 16
-    gates = jnp.asarray(rng.normal(0, 1, (b, 4 * h)).astype("float32"))
-    c_prev = jnp.asarray(rng.normal(0, 1, (b, h)).astype("float32"))
-    h_prev = jnp.asarray(rng.normal(0, 1, (b, h)).astype("float32"))
-    alive = jnp.asarray((rng.rand(b, 1) > 0.3).astype("float32"))
-    got_h, got_c = fused_lstm_cell(gates, c_prev, h_prev, alive)
-    exp_h, exp_c = _lstm_cell_jnp(gates, c_prev, h_prev, alive)
-    np.testing.assert_allclose(got_h, exp_h, rtol=1e-6, atol=1e-6)
-    np.testing.assert_allclose(got_c, exp_c, rtol=1e-6, atol=1e-6)
-
-
-def test_fused_lstm_cell_grads_match():
-    rng = np.random.RandomState(1)
-    b, h = 4, 8
-    gates = jnp.asarray(rng.normal(0, 1, (b, 4 * h)).astype("float32"))
-    c_prev = jnp.asarray(rng.normal(0, 1, (b, h)).astype("float32"))
-    h_prev = jnp.asarray(rng.normal(0, 1, (b, h)).astype("float32"))
-    alive = jnp.ones((b, 1), jnp.float32)
-
-    def loss_fused(g):
-        hh, cc = fused_lstm_cell(g, c_prev, h_prev, alive)
-        return jnp.sum(hh ** 2 + cc ** 2)
-
-    def loss_jnp(g):
-        hh, cc = _lstm_cell_jnp(g, c_prev, h_prev, alive)
-        return jnp.sum(hh ** 2 + cc ** 2)
-
-    np.testing.assert_allclose(jax.grad(loss_fused)(gates),
-                               jax.grad(loss_jnp)(gates),
-                               rtol=1e-5, atol=1e-6)
 
 
 def test_fused_gru_cell_matches_jnp():
@@ -107,8 +73,57 @@ def test_lstm_op_parity_with_pallas_flag():
 
     base = run(False)
     pallas = run(True)
-    np.testing.assert_allclose(pallas, base, rtol=1e-5, atol=1e-6)
+    # the whole-recurrence kernel computes its MXU matmuls in bf16 with f32
+    # accumulation (the TPU lane contract) while the jnp scan on CPU runs
+    # f32 — parity to bf16 resolution; exact parity vs the bf16 jnp twin is
+    # pinned in test_lstm_seq_kernel_matches_jnp_twin
+    np.testing.assert_allclose(pallas, base, rtol=5e-4, atol=1e-5)
     assert base[-1] < base[0]
+
+
+def test_lstm_seq_kernel_matches_jnp_twin():
+    """Whole-recurrence kernel vs its jnp twin (same bf16-matmul recipe):
+    carries AND gradients (dx, dw, dh0, dc0) must match tightly."""
+    from paddle_tpu.ops.pallas_kernels import (lstm_seq_pallas,
+                                               _lstm_step_jnp)
+
+    rng = np.random.RandomState(4)
+    L, b, H = 6, 4, 8
+    x = jnp.asarray(rng.normal(0, 1, (L, b, 4 * H)).astype("float32"))
+    lens = jnp.asarray([6, 3, 5, 1], jnp.int32)
+    alive = (jnp.arange(L)[:, None] < lens[None, :]) \
+        .astype(jnp.float32)[..., None]
+    w = jnp.asarray(rng.normal(0, 0.5, (H, 4 * H)).astype("float32"))
+    h0 = jnp.asarray(rng.normal(0, 1, (b, H)).astype("float32"))
+    c0 = jnp.asarray(rng.normal(0, 1, (b, H)).astype("float32"))
+
+    def jnp_seq(x, alive, w, h0, c0):
+        def step(carry, inp):
+            h, c = carry
+            xt, at = inp
+            h, c = _lstm_step_jnp(xt, h, c, w, at)
+            return (h, c), (h, c)
+        _, (hs, cs) = jax.lax.scan(step, (h0, c0), (x, alive))
+        return hs, cs
+
+    got_h, got_c = lstm_seq_pallas(x, alive, w, h0, c0)
+    exp_h, exp_c = jnp_seq(x, alive, w, h0, c0)
+    np.testing.assert_allclose(got_h, exp_h, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got_c, exp_c, rtol=1e-5, atol=1e-6)
+
+    def loss_pallas(x, w, h0, c0):
+        hs, cs = lstm_seq_pallas(x, alive, w, h0, c0)
+        return jnp.sum(hs ** 2) + jnp.sum(cs * alive)
+
+    def loss_jnp(x, w, h0, c0):
+        hs, cs = jnp_seq(x, alive, w, h0, c0)
+        return jnp.sum(hs ** 2) + jnp.sum(cs * alive)
+
+    g_got = jax.grad(loss_pallas, argnums=(0, 1, 2, 3))(x, w, h0, c0)
+    g_exp = jax.grad(loss_jnp, argnums=(0, 1, 2, 3))(x, w, h0, c0)
+    for a, b_, name in zip(g_got, g_exp, ("dx", "dw", "dh0", "dc0")):
+        np.testing.assert_allclose(a, b_, rtol=2e-4, atol=1e-5,
+                                   err_msg=name)
 
 def test_gru_op_parity_with_pallas_flag():
     layers = fluid.layers
