@@ -38,7 +38,11 @@ transpose(jvp) convs — 'EmitAllBatchInSublanes' vs the forward's
 Round-5 probes, all REJECTED: bwd-only BN fusion barrier (2320.7 —
 the fused epilogue beats the better emitter it unlocks), fwd-only
 barrier (2368.9), bs192 (2341.6), Pallas tall-K filter-grad kernel
-(473 GB/s standalone vs XLA's 755). With the 2x2 barrier quadrant,
+(473 GB/s standalone vs XLA's 755), conv_1x1_grad_as_dot (1x1 conv
+grads emitted as dot_general channel matmuls: 2537.7 vs 2552.8 —
+in-graph, XLA re-lays the N-in-sublane conv activations out for the
+dots and the relayouts eat the emitter win the standalone measurement
+promised; flag kept with exact-parity test). With the 2x2 barrier quadrant,
 batch sweep 128..512, layout probes, and the round-4 compiler-flag
 sweep all negative, the achievable ceiling with the current XLA conv
 emitters on this chip sits at ~2600 img/s (~87% of the 3000 north

@@ -487,6 +487,7 @@ class Executor:
                get_flag("bn_fusion_barrier_fwd"),
                get_flag("bn_fusion_barrier_bwd"),
                get_flag("conv_space_to_depth"),
+               get_flag("conv_1x1_grad_as_dot"),
                get_flag("use_pallas_ctc"))
         fn = self._cache.get(key)
         if fn is not None:
@@ -531,6 +532,7 @@ class Executor:
                get_flag("bn_fusion_barrier_fwd"),
                get_flag("bn_fusion_barrier_bwd"),
                get_flag("conv_space_to_depth"),
+               get_flag("conv_1x1_grad_as_dot"),
                get_flag("use_pallas_ctc"))
         fn = self._cache.get(key)
         if fn is not None:

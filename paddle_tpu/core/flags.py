@@ -116,6 +116,11 @@ DEFINE_flag("bn_fusion_barrier_bwd", False,
             "slower conv emitter (EmitAllBatchInSublanes) than the "
             "unencumbered forward convs")
 
+DEFINE_flag("conv_1x1_grad_as_dot", False,
+            "A/B probe: emit 1x1-conv input/filter gradients as dot_general "
+            "channel matmuls instead of jax's transposed convolutions (see "
+            "conv2d_grad)")
+
 # PDTPU_FLAGS=check_nan_inf=1,benchmark=0 — unknown names warn and are
 # ignored (a typo'd env var must not make the package unimportable)
 _env = os.environ.get("PDTPU_FLAGS", "")

@@ -179,6 +179,25 @@ def conv2d_grad(ctx):
     dy = data_of(ctx.input("Output@GRAD"))
     strides, paddings, dilations, groups = _conv_attrs(ctx, ctx.attr)
     df = _conv_df(ctx.attr)
+    from ..core.flags import get_flag
+    if (get_flag("conv_1x1_grad_as_dot") and df == "NHWC"
+            and w.shape[2:] == (1, 1) and strides == (1, 1)
+            and paddings == (0, 0) and dilations == (1, 1) and groups == 1):
+        # A/B probe: a 1x1 conv IS a channel matmul, so emit its grads as
+        # dot_general instead of jax's transposed convs — the standalone
+        # filter-grad dot measured at HBM peak while the in-graph conv
+        # emitter ran at ~55% (round-5 profile). Whether XLA's layout
+        # assignment cooperates in-graph is what the flag measures.
+        xc, wc = cast_compute(x, w)
+        dyc = dy.astype(xc.dtype)
+        w2 = wc.reshape(wc.shape[0], wc.shape[1])          # [O, I]
+        dx = jax.lax.dot_general(dyc, w2, (((3,), (0,)), ((), ())))
+        dw = jax.lax.dot_general(dyc, xc, (((0, 1, 2), (0, 1, 2)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ctx.set_output("Input@GRAD", cast_compute(dx))
+        ctx.set_output("Filter@GRAD",
+                       dw.reshape(w.shape).astype(jnp.float32))
+        return
     out, vjp = jax.vjp(
         lambda a, b: _conv2d_compute(a, b, strides, paddings, dilations,
                                      groups, df), x, w)

@@ -15,8 +15,7 @@ import jax
 import jax.numpy as jnp
 
 import paddle_tpu.fluid as fluid
-from paddle_tpu.ops.pallas_kernels import (_lstm_cell_jnp,
-                                           fused_gru_cell, _gru_cell_jnp)
+from paddle_tpu.ops.pallas_kernels import fused_gru_cell, _gru_cell_jnp
 
 
 @pytest.fixture(autouse=True)
