@@ -66,14 +66,14 @@ DEFINE_flag("benchmark", False,
             "log per-op timing in eager mode — reference --benchmark "
             "(executor.cc:321-324)")
 DEFINE_flag("use_pallas_rnn", False,
-            "use the Pallas recurrent kernels (the hand-scheduled "
-            "hl_cuda_lstm.cu analogs): the LSTM path runs the WHOLE "
+            "use the Pallas whole-recurrence kernels (the hand-scheduled "
+            "hl_cuda_lstm.cu analogs): LSTM and GRU each run their WHOLE "
             "sequence as one kernel with the recurrent weight VMEM-"
-            "resident across steps — measured 1.22x vs the lax.scan path "
-            "on the v5e training lane (5.91 vs 7.21 ms/batch, round 5); "
-            "GRU keeps the fused-cell form. Default off so CPU test runs "
-            "avoid interpret-mode kernels; bench.py measures both paths "
-            "and reports the winner")
+            "resident across steps — measured on the v5e training lanes "
+            "(round 5): LSTM 1.22x (5.91 vs 7.21 ms/batch), GRU 1.08x "
+            "(8.16 vs 8.76). Default off so CPU test runs avoid "
+            "interpret-mode kernels; bench.py measures both paths and "
+            "reports the winner")
 DEFINE_flag("xla_compiler_options", "",
             "comma-separated k=v TPU compiler options forwarded to "
             "jit(compiler_options=...), e.g. "

@@ -260,21 +260,28 @@ def _gru_compute(x, lens, w, bias, h0, attrs):
                   and attrs.get("gate_activation", "sigmoid") == "sigmoid"
                   and attrs.get("activation", "tanh") == "tanh")
 
+    if use_pallas:
+        # whole-recurrence kernel (see pallas_kernels.gru_seq_pallas)
+        from .pallas_kernels import gru_seq_pallas
+        xs = jnp.swapaxes(x, 0, 1)                   # [L, b, 3H]
+        alive = (jnp.arange(L)[:, None] < lens[None, :]) \
+            .astype(x.dtype)[..., None]              # [L, b, 1]
+        hs = gru_seq_pallas(xs, alive, w, h0) * alive
+        hs = jnp.swapaxes(hs, 0, 1)
+        if rev:
+            hs = _reverse_padded(hs, lens)
+        return hs
+
     def step(carry, inp):
         h_prev, t = carry
         xt = inp
         alive = (t < lens)[:, None].astype(x.dtype)
         r = ga(xt[:, H:2 * H] + h_prev @ wr)
         rc = (r * h_prev) @ wc                       # MXU matmul
-        if use_pallas:
-            from .pallas_kernels import fused_gru_cell
-            h = fused_gru_cell(xt[:, :H] + h_prev @ wu, xt[:, 2 * H:],
-                               h_prev, rc, alive)
-        else:
-            u = ga(xt[:, :H] + h_prev @ wu)
-            c = ca(xt[:, 2 * H:] + rc)
-            h = u * c + (1.0 - u) * h_prev
-            h = alive * h + (1 - alive) * h_prev
+        u = ga(xt[:, :H] + h_prev @ wu)
+        c = ca(xt[:, 2 * H:] + rc)
+        h = u * c + (1.0 - u) * h_prev
+        h = alive * h + (1 - alive) * h_prev
         return (h, t + 1), h * alive
 
     xt = jnp.swapaxes(x, 0, 1)
