@@ -63,18 +63,17 @@ class Ploter:
     def plot(self, path=None):
         if self.__plot_is_disabled__():
             return
-        titles = []
-        for title in self.__args__:
-            data = self.__plot_data__[title]
-            if data.step:
-                titles.append(title)
-                self.plt.plot(data.step, data.value)
-        self.plt.legend(titles, loc="upper left")
-        if path is None and self.display is not None:
+        # draw the non-empty series in declaration order
+        drawn = [t for t in self.__args__ if self.__plot_data__[t].step]
+        for t in drawn:
+            series = self.__plot_data__[t]
+            self.plt.plot(series.step, series.value)
+        self.plt.legend(drawn, loc="upper left")
+        if path is not None:
+            self.plt.savefig(path)
+        elif self.display is not None:
             self.display.clear_output(wait=True)
             self.display.display(self.plt.gcf())
-        elif path is not None:
-            self.plt.savefig(path)
         self.plt.gcf().clear()
 
     def reset(self):
