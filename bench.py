@@ -182,52 +182,92 @@ def build_lstm_textcls(batch, seq_len, hidden, vocab=30000, emb=128,
     return main, startup, loss
 
 
-def run_lstm_lane(batch=64, seq_len=100, hidden=512, steps=32, warmup=3,
-                  use_pallas=False, vocab=30000):
-    """ms/batch for the LSTM text-classification lane, mirroring the
-    reference protocol (benchmark/README.md:115-127: 2xlstm+fc, bs64,
-    fixed len 100; K40m hid512 = 184 ms/batch)."""
+def _run_rnn_lane(build_fn, batch, seq_len, hidden, steps, warmup,
+                  use_pallas, vocab):
+    """Shared RNN-lane protocol: build, pre-stage 2 device feeds, warm up,
+    time `steps` dispatches under bf16 matmul precision with the pallas
+    flag saved/restored. Used by both the LSTM and GRU lanes."""
     import jax
-    import numpy as np
     import paddle_tpu.fluid as fluid
-    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.core.flags import set_flags, get_flag
     from paddle_tpu.core.lod import pack_sequences
 
-    main, startup, loss = build_lstm_textcls(batch, seq_len, hidden,
-                                             vocab=vocab)
+    main, startup, loss = build_fn(batch, seq_len, hidden, vocab=vocab)
     rng = np.random.RandomState(0)
-    n_bufs = 2
     feeds = []
-    for _ in range(n_bufs):
+    for _ in range(2):
         toks = [rng.randint(0, vocab, (seq_len, 1)).astype("int64")
                 for _ in range(batch)]
-        arr = pack_sequences(toks)
         feeds.append({
-            "words": jax.device_put(arr),
+            "words": jax.device_put(pack_sequences(toks)),
             "label": jax.device_put(
                 rng.randint(0, 2, (batch, 1)).astype("int64")),
         })
 
     scope = fluid.Scope()
     exe = fluid.Executor(mode="jit", donate=True)
+    prev = get_flag("use_pallas_rnn")
     set_flags({"use_pallas_rnn": bool(use_pallas)})
     try:
         with jax.default_matmul_precision("bfloat16"):
             exe.run(startup, scope=scope)
+            v = None
             for i in range(warmup):
-                v = exe.run(main, feed=feeds[i % n_bufs], fetch_list=[loss],
+                v = exe.run(main, feed=feeds[i % 2], fetch_list=[loss],
                             scope=scope)
-            assert np.isfinite(v[0]), f"non-finite lstm loss {v[0]}"
+            if v is not None:
+                assert np.isfinite(v[0]), f"non-finite rnn loss {v[0]}"
             t0 = time.perf_counter()
             for i in range(steps):
-                v = exe.run(main, feed=feeds[i % n_bufs], fetch_list=[loss],
+                v = exe.run(main, feed=feeds[i % 2], fetch_list=[loss],
                             scope=scope, return_numpy=False)
             loss_v = np.asarray(v[0])
             elapsed = time.perf_counter() - t0
     finally:
-        set_flags({"use_pallas_rnn": False})
-    assert np.isfinite(loss_v), f"non-finite lstm loss {loss_v}"
+        set_flags({"use_pallas_rnn": prev})
+    assert np.isfinite(loss_v), f"non-finite rnn loss {loss_v}"
     return elapsed / steps * 1e3
+
+
+def run_lstm_lane(batch=64, seq_len=100, hidden=512, steps=32, warmup=3,
+                  use_pallas=False, vocab=30000):
+    """ms/batch for the LSTM text-classification lane, mirroring the
+    reference protocol (benchmark/README.md:115-127: 2xlstm+fc, bs64,
+    fixed len 100; K40m hid512 = 184 ms/batch)."""
+    return _run_rnn_lane(build_lstm_textcls, batch, seq_len, hidden, steps,
+                         warmup, use_pallas, vocab)
+
+
+def build_gru_textcls(batch, seq_len, hidden, vocab=30000, emb=128,
+                      gru_num=2, class_dim=2):
+    """GRU twin of the RNN benchmark model (reference benchmark/paddle/rnn/
+    rnn.py --rnn_type gru: embedding -> gru_num x simple_gru(hidden) ->
+    last_seq -> fc softmax, Adam)."""
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data("words", shape=[1], dtype="int64",
+                                  lod_level=1)
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        net = fluid.layers.embedding(words, size=(vocab, emb))
+        for _ in range(gru_num):
+            proj = fluid.layers.fc(net, hidden * 3)
+            net = fluid.layers.dynamic_gru(proj, size=hidden)
+        last = fluid.layers.sequence_last_step(net)
+        logits = fluid.layers.fc(last, class_dim, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(logits, label))
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss, startup)
+    return main, startup, loss
+
+
+def run_gru_lane(batch=64, seq_len=100, hidden=512, steps=48, warmup=4,
+                 use_pallas=False, vocab=30000):
+    """ms/batch for the GRU text-classification lane (--with-gru): the
+    whole-recurrence Pallas kernel's A/B surface (0.98-1.08x vs the scan
+    path across sessions on the shared v5e — see flags.use_pallas_rnn)."""
+    return _run_rnn_lane(build_gru_textcls, batch, seq_len, hidden, steps,
+                         warmup, use_pallas, vocab)
 
 
 def run_lstm_ragged_lane(batch=64, hidden=512, n_seqs=4608, steps_cap=None,
@@ -343,6 +383,9 @@ def main():
                     help="only run the flagship ResNet-50 lane")
     ap.add_argument("--no-s2d", action="store_true",
                     help="A/B probe: disable the space-to-depth stem rewrite")
+    ap.add_argument("--with-gru", action="store_true",
+                    help="also run the GRU text-cls lane (jnp vs the "
+                         "whole-recurrence Pallas kernel)")
     ap.add_argument("--bn-barrier", action="store_true",
                     help="A/B probe: optimization barrier between convs "
                          "and BN stat reduces (flags.bn_fusion_barrier)")
@@ -413,6 +456,36 @@ def main():
         }))
 
     from paddle_tpu.core.flags import set_flags
+    if args.with_gru:
+        gru_kw = dict(batch=8, seq_len=12, hidden=16, steps=2, warmup=1) \
+            if args.smoke else dict(batch=64, seq_len=100, hidden=512,
+                                    steps=48, warmup=4)
+        repeats = 1 if args.smoke else 2   # best-of-N on the shared chip
+        gru_jnp = min(run_gru_lane(use_pallas=False, **gru_kw)
+                      for _ in range(repeats))
+        try:
+            gru_pallas = min(run_gru_lane(use_pallas=True, **gru_kw)
+                             for _ in range(repeats))
+        except Exception as e:  # pallas lowering unavailable on backend
+            print(f"pallas gru lane failed ({type(e).__name__}: {e}); "
+                  "reporting jnp path", file=sys.stderr)
+            gru_pallas = None
+        print(json.dumps({
+            "metric": "gru_textcls_train_ms_batch"
+                      + ("_smoke" if args.smoke else ""),
+            "value": round(gru_jnp if gru_pallas is None
+                           else min(gru_jnp, gru_pallas), 3),
+            "unit": "ms/batch (bs64 hid512 len100, lower is better)",
+            # A/B lane: no recorded external baseline; vs_baseline keeps the
+            # schema's "higher is better vs the reference row" meaning by
+            # reusing the K40m-class LSTM row is WRONG here, so report the
+            # jnp/pallas ratio under its own key and omit vs_baseline
+            "pallas_speedup": None if gru_pallas is None
+                              else round(gru_jnp / gru_pallas, 4),
+            "jnp_ms": round(gru_jnp, 3),
+            "pallas_ms": None if gru_pallas is None else round(gru_pallas, 3),
+        }))
+
     if args.bn_barrier:
         set_flags({"bn_fusion_barrier": True})
     # space-to-depth stem: exact rewrite of the 7x7/s2 C=3 stem conv as a

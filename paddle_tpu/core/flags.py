@@ -70,10 +70,12 @@ DEFINE_flag("use_pallas_rnn", False,
             "hl_cuda_lstm.cu analogs): LSTM and GRU each run their WHOLE "
             "sequence as one kernel with the recurrent weight VMEM-"
             "resident across steps — measured on the v5e training lanes "
-            "(round 5): LSTM 1.22x (5.91 vs 7.21 ms/batch), GRU 1.08x "
-            "(8.16 vs 8.76). Default off so CPU test runs avoid "
-            "interpret-mode kernels; bench.py measures both paths and "
-            "reports the winner")
+            "(round 5): LSTM 1.22x (5.91 vs 7.21 ms/batch); GRU ranges "
+            "0.98-1.08x across sessions on the shared chip (the reset-"
+            "gated candidate forces two dependent matmuls per step, so "
+            "the VMEM-residency win is thinner). Default off so CPU test "
+            "runs avoid interpret-mode kernels; bench.py measures both "
+            "paths and reports the winner")
 DEFINE_flag("xla_compiler_options", "",
             "comma-separated k=v TPU compiler options forwarded to "
             "jit(compiler_options=...), e.g. "

@@ -11,7 +11,6 @@ synthetic corpus with grammar-like BIO role structure around each verb."""
 from __future__ import annotations
 
 import gzip
-import itertools
 import os
 import tarfile
 

@@ -9,7 +9,6 @@ low-frequency images upsampled to 224 (conv classifiers separate them)."""
 
 from __future__ import annotations
 
-import io
 import os
 import tarfile
 
