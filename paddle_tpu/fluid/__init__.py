@@ -32,6 +32,7 @@ from .concurrency import (Go, Select, make_channel, channel_send,
 from .backward import append_backward
 from .param_attr import ParamAttr
 from .data_feeder import DataFeeder
+from .memory_optimization_transpiler import memory_optimize, release_memory
 
 # CUDAPlace alias: reference scripts say CUDAPlace(0); on this framework that
 # means "the accelerator", i.e. the TPU chip.
@@ -45,5 +46,5 @@ __all__ = [
     "ParamAttr", "DataFeeder", "LoDArray", "profiler", "amp_guard", "clip",
     "set_flags", "get_flag", "flags", "init_flags", "evaluator",
     "concurrency", "Go", "Select", "make_channel", "channel_send",
-    "channel_recv", "channel_close",
+    "channel_recv", "channel_close", "memory_optimize", "release_memory",
 ]

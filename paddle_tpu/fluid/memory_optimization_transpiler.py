@@ -1,0 +1,195 @@
+"""Memory-optimization transpiler — liveness-based var reuse + early release.
+
+Reference capability: python/paddle/fluid/memory_optimization_transpiler.py
+(`memory_optimize` :189, `ControlFlowGraph._dataflow_analyze` :97,
+`release_memory` :149) — a straight-line liveness analysis over a block's ops
+that (a) renames a freshly-defined temporary onto a dead one so the two share
+one allocation, and (b) inserts `delete_var` ops at each variable's death
+point so the runtime frees buffers before the block ends.
+
+TPU-native stance: under ``Executor(mode="jit")`` XLA's buffer assignment
+already performs exactly this liveness-based reuse on the compiled
+computation, so the pass is a no-op there by design (recorded in README —
+"memory-optimization transpiler"). It matters for the **eager interpreter**
+path (the reference Executor analog, used for OpTests and debugging): the
+interpreter's environment dict would otherwise pin every intermediate of a
+big program until the block finishes. Both passes are pure program→program
+rewrites, mirroring the reference surface:
+
+    memory_optimize(program, print_log=False, level=0,
+                    skip_opt_set=None, fetch_list=None)
+    release_memory(program, skip_opt_set=None, fetch_list=None)
+
+(the first two ``memory_optimize`` parameters keep the reference's positional
+order, memory_optimization_transpiler.py:189; ``skip_opt_set``/``fetch_list``
+are this framework's fetch-protection surface).
+
+Differences from the reference, by design:
+  * Reuse is at the *name* level: the interpreter env maps names to jax
+    arrays, so renaming x onto a dead cache var makes the old buffer
+    refcount-free at overwrite time (no aliasing of live data is possible —
+    the cache var is provably dead and never redefined later).
+  * Renames require an EXACT declared shape + dtype match at every level.
+    The reference's level-1 "size fit" reuses a larger dead allocation for a
+    smaller tensor — an allocation-level concept with no benefit under
+    name-level reuse (a fresh array is bound to the name either way; XLA
+    buffer assignment does the allocation-level version on the jit path),
+    and accepting it would desync declared var metadata from runtime values
+    for shape-consulting consumers (e.g. broadcast-sensitive grad ops).
+    ``level`` is accepted for reference API parity and changes nothing.
+  * Ops carrying control-flow sub-blocks are barriers: every name their
+    sub-blocks read or write is excluded from optimization (the reference
+    skips `sub_block_ops` the same way, :32).
+  * Fetch targets must stay addressable; pass them via ``fetch_list`` (or
+    ``skip_opt_set``), as with the reference's post-transpile fetch contract.
+"""
+
+from __future__ import annotations
+
+from ..core.block_walk import SUB_BLOCK_ATTRS, free_reads, written_names
+
+def _liveness(ops):
+    """uses/defs per op + straight-line backward liveness fixpoint
+    (reference _dataflow_analyze, memory_optimization_transpiler.py:97)."""
+    n = len(ops)
+    uses = [set(op.input_arg_names()) for op in ops]
+    defs = [set(op.output_arg_names()) for op in ops]
+    live_in = [set() for _ in range(n)]
+    live_out = [set() for _ in range(n)]
+    changed = True
+    while changed:
+        changed = False
+        for i in range(n - 1, -1, -1):
+            lo = set(live_in[i + 1]) if i + 1 < n else set()
+            li = uses[i] | (lo - defs[i])
+            if lo != live_out[i] or li != live_in[i]:
+                live_out[i], live_in[i] = lo, li
+                changed = True
+    return uses, defs, live_in, live_out
+
+
+def _build_skip_set(program, block, skip_opt_set, fetch_list):
+    skip = set(skip_opt_set or ())
+    for f in fetch_list or ():
+        skip.add(f if isinstance(f, str) else f.name)
+    for op in block.ops:
+        if any(op.has_attr(a) for a in SUB_BLOCK_ATTRS):
+            # control-flow barrier: its args and everything its sub-blocks
+            # touch stay untouched (reference sub_block_ops skip)
+            skip.update(op.input_arg_names())
+            skip.update(op.output_arg_names())
+            for a in SUB_BLOCK_ATTRS:
+                if op.has_attr(a):
+                    sub = op.attr(a)
+                    skip.update(free_reads(program, sub))
+                    skip.update(written_names(program, sub))
+    return skip
+
+
+def _optimizable(block, name, skip):
+    """reference _check_var_validity (:128): data vars only — declared in the
+    block, non-persistable, known shape, not ragged, not skipped."""
+    if name in skip or not block.has_var(name):
+        return False
+    v = block.var(name)
+    if v.persistable or (v.lod_level or 0) > 0:
+        return False
+    if v.shape is None:
+        return False
+    return True
+
+
+def _shapes_compatible(x, cache, level):
+    """Exact declared-shape match at every level (see module docstring: the
+    reference's level-1 size-fit is an allocation-level concept that does not
+    apply to name-level reuse and would desync declared metadata). ``level``
+    is accepted for reference API parity."""
+    del level
+    return tuple(x.shape) == tuple(cache.shape)
+
+
+def memory_optimize(program, print_log=False, level=0, skip_opt_set=None,
+                    fetch_list=None):
+    """Rename each freshly-defined temporary onto a dead, shape/dtype
+    compatible one (reference memory_optimize :189). Mutates ``program`` in
+    place and returns the number of reuses performed."""
+    block = program.global_block()
+    ops = block.ops
+    skip = _build_skip_set(program, block, skip_opt_set, fetch_list)
+    uses, defs, live_in, live_out = _liveness(ops)
+
+    # names defined/used at-or-after each index, to guarantee a cache var is
+    # never touched again before we alias onto it
+    n = len(ops)
+    touched_after = [set() for _ in range(n + 1)]
+    for i in range(n - 1, -1, -1):
+        touched_after[i] = touched_after[i + 1] | uses[i] | defs[i]
+
+    pool = []  # [(name, Variable)] dead vars available for reuse, FIFO
+    renames = 0
+    for i, op in enumerate(ops):
+        if any(op.has_attr(a) for a in SUB_BLOCK_ATTRS):
+            continue
+        if pool:
+            for x in sorted(defs[i]):
+                if x in uses[i] or not _optimizable(block, x, skip):
+                    continue
+                xv = block.var(x)
+                for j, (cname, cv) in enumerate(pool):
+                    if str(cv.dtype) != str(xv.dtype):
+                        continue
+                    if not _shapes_compatible(xv, cv, level):
+                        continue
+                    if cname in touched_after[i]:
+                        # covers redefinitions of cname, including cname == x
+                        continue
+                    pool.pop(j)
+                    if print_log:
+                        print(f"memory_optimize: reuse {cname} <- {x} "
+                              f"(op {i} {op.type})")
+                    _rename_from(ops, i, x, cname)
+                    for k in range(i, n):
+                        for s in (uses[k], defs[k], live_in[k], live_out[k],
+                                  touched_after[k]):
+                            if x in s:
+                                s.discard(x)
+                                s.add(cname)
+                    renames += 1
+                    break
+        # vars dying at this op join the pool (reference in_diff append :248)
+        for name in sorted(live_in[i] - live_out[i] - defs[i]):
+            if _optimizable(block, name, skip):
+                pool.append((name, block.var(name)))
+    program._bump_version()
+    return renames
+
+
+def _rename_from(ops, begin, old, new):
+    for op in ops[begin:]:
+        for slots in (op.inputs, op.outputs):
+            for k, names in slots.items():
+                slots[k] = [new if nm == old else nm for nm in names]
+
+
+def release_memory(program, skip_opt_set=None, fetch_list=None):
+    """Insert ``delete_var`` ops at each temporary's death point (reference
+    release_memory :149) so the eager interpreter frees buffers mid-block.
+    Mutates ``program`` in place; returns the number of delete ops added."""
+    block = program.global_block()
+    ops = list(block.ops)
+    skip = _build_skip_set(program, block, skip_opt_set, fetch_list)
+    _, defs, live_in, live_out = _liveness(ops)
+
+    inserted = 0
+    for i in range(len(ops) - 1, -1, -1):
+        if any(ops[i].has_attr(a) for a in SUB_BLOCK_ATTRS):
+            continue
+        dead = sorted(
+            name for name in (live_in[i] | defs[i]) - live_out[i]
+            if _optimizable(block, name, skip))
+        if dead:
+            block.insert_op(i + 1, "delete_var", inputs={"X": dead},
+                            outputs={})
+            inserted += 1
+    program._bump_version()
+    return inserted
