@@ -42,7 +42,13 @@ barrier (2368.9), bs192 (2341.6), Pallas tall-K filter-grad kernel
 grads emitted as dot_general channel matmuls: 2537.7 vs 2552.8 —
 in-graph, XLA re-lays the N-in-sublane conv activations out for the
 dots and the relayouts eat the emitter win the standalone measurement
-promised; flag kept with exact-parity test). With the 2x2 barrier quadrant,
+promised; flag kept with exact-parity test), bn_bf16_stats (bf16
+accumulators for the BN batch moments, VERDICT r4 lever (b): 2583.3 vs
+2570.3 same-session baseline = +0.5%, inside shared-chip run variance,
+AND the loss overflows to NaN by step ~4 — accumulator width is not on
+the critical path of the conv+stat reduce fusions, which are bound by
+the conv emitter itself; flag kept as a timing probe only). With the
+2x2 barrier quadrant,
 batch sweep 128..512, layout probes, and the round-4 compiler-flag
 sweep all negative, the achievable ceiling with the current XLA conv
 emitters on this chip sits at ~2600 img/s (~87% of the 3000 north
@@ -365,6 +371,23 @@ def run_lstm_ragged_lane(batch=64, hidden=512, n_seqs=4608, steps_cap=None,
     return results[0], results[1]
 
 
+def _best_of(run_fn, label, repeats, **kw):
+    """Best-of-N jnp and Pallas timings for one RNN lane; the shared dev
+    chip shows large run-to-run variance (8.7..14.4 ms for the identical
+    program), so min is the standard contended-machine protocol. Pallas
+    failures (lowering unavailable on a backend) degrade to jnp-only."""
+    jnp_ms = min(run_fn(use_pallas=False, **kw) for _ in range(repeats))
+    try:
+        pallas_ms = min(run_fn(use_pallas=True, **kw)
+                        for _ in range(repeats))
+    except Exception as e:
+        print(f"pallas {label} lane failed ({type(e).__name__}: {e}); "
+              "reporting jnp path", file=sys.stderr)
+        pallas_ms = None
+    best = jnp_ms if pallas_ms is None else min(jnp_ms, pallas_ms)
+    return best, jnp_ms, pallas_ms
+
+
 def main():
     ap = argparse.ArgumentParser()
     # 96 steps: the end-of-chain readback and per-run staging amortize to
@@ -389,6 +412,9 @@ def main():
     ap.add_argument("--bn-barrier", action="store_true",
                     help="A/B probe: optimization barrier between convs "
                          "and BN stat reduces (flags.bn_fusion_barrier)")
+    ap.add_argument("--bn-bf16-stats", action="store_true",
+                    help="A/B probe: bf16 accumulators for BN batch "
+                         "statistics (flags.bn_bf16_stats)")
     args = ap.parse_args()
 
     if args.smoke:
@@ -413,19 +439,8 @@ def main():
             if args.smoke else dict(batch=64, seq_len=100, hidden=512,
                                     steps=64, warmup=4)
         repeats = 1 if args.smoke else 2
-        # best-of-N repeats: the shared dev chip shows large run-to-run
-        # variance (8.7..14.4 ms measured for the identical program);
-        # min is the standard contended-machine protocol
-        jnp_ms = min(run_lstm_lane(use_pallas=False, **lstm_kw)
-                     for _ in range(repeats))
-        try:
-            pallas_ms = min(run_lstm_lane(use_pallas=True, **lstm_kw)
-                            for _ in range(repeats))
-        except Exception as e:  # pallas lowering unavailable on this backend
-            print(f"pallas lstm lane failed ({type(e).__name__}: {e}); "
-                  "reporting jnp path", file=sys.stderr)
-            pallas_ms = None
-        best = min(jnp_ms, pallas_ms) if pallas_ms is not None else jnp_ms
+        best, jnp_ms, pallas_ms = _best_of(run_lstm_lane, "lstm", repeats,
+                                           **lstm_kw)
         lstm_baseline = 184.0  # K40m ms/batch, bs64 hid512 (BASELINE.md)
         print(json.dumps({
             "metric": "lstm_textcls_train_ms_batch"
@@ -460,21 +475,13 @@ def main():
         gru_kw = dict(batch=8, seq_len=12, hidden=16, steps=2, warmup=1) \
             if args.smoke else dict(batch=64, seq_len=100, hidden=512,
                                     steps=48, warmup=4)
-        repeats = 1 if args.smoke else 2   # best-of-N on the shared chip
-        gru_jnp = min(run_gru_lane(use_pallas=False, **gru_kw)
-                      for _ in range(repeats))
-        try:
-            gru_pallas = min(run_gru_lane(use_pallas=True, **gru_kw)
-                             for _ in range(repeats))
-        except Exception as e:  # pallas lowering unavailable on backend
-            print(f"pallas gru lane failed ({type(e).__name__}: {e}); "
-                  "reporting jnp path", file=sys.stderr)
-            gru_pallas = None
+        repeats = 1 if args.smoke else 2
+        gru_best, gru_jnp, gru_pallas = _best_of(run_gru_lane, "gru",
+                                                 repeats, **gru_kw)
         print(json.dumps({
             "metric": "gru_textcls_train_ms_batch"
                       + ("_smoke" if args.smoke else ""),
-            "value": round(gru_jnp if gru_pallas is None
-                           else min(gru_jnp, gru_pallas), 3),
+            "value": round(gru_best, 3),
             "unit": "ms/batch (bs64 hid512 len100, lower is better)",
             # A/B lane: no recorded external baseline; vs_baseline keeps the
             # schema's "higher is better vs the reference row" meaning by
@@ -488,6 +495,8 @@ def main():
 
     if args.bn_barrier:
         set_flags({"bn_fusion_barrier": True})
+    if args.bn_bf16_stats:
+        set_flags({"bn_bf16_stats": True})
     # space-to-depth stem: exact rewrite of the 7x7/s2 C=3 stem conv as a
     # 4x4/s1 conv over 112x112x12 (parity-tested in tests/test_conv_s2d.py)
     set_flags({"conv_space_to_depth": not args.no_s2d})
@@ -525,7 +534,10 @@ def main():
         for i in range(warmup):
             v = exe.run(main_prog, feed=feeds[i % n_bufs],
                         fetch_list=[avg_loss], scope=scope)
-        assert np.isfinite(v[0]), f"non-finite loss {v[0]}"
+        # bn_bf16_stats is a timing-only probe whose numerics are known-bad
+        # (see flags.py); keep timing even when the loss overflows
+        if warmup and not args.bn_bf16_stats:
+            assert np.isfinite(v[0]), f"non-finite loss {v[0]}"
 
         t0 = time.perf_counter()
         for i in range(steps):
@@ -535,7 +547,8 @@ def main():
         loss_v = np.asarray(v[0])
         elapsed = time.perf_counter() - t0
 
-    assert np.isfinite(loss_v), f"non-finite loss {loss_v}"
+    if not args.bn_bf16_stats:
+        assert np.isfinite(loss_v), f"non-finite loss {loss_v}"
     images_per_sec = steps * batch / elapsed
     baseline = 3000.0  # BASELINE.json: ResNet-50 >= 3000 images/sec/chip
     print(json.dumps({

@@ -118,6 +118,14 @@ DEFINE_flag("bn_fusion_barrier_bwd", False,
             "slower conv emitter (EmitAllBatchInSublanes) than the "
             "unencumbered forward convs")
 
+DEFINE_flag("bn_bf16_stats", False,
+            "A/B probe: accumulate batch_norm batch statistics in bfloat16 "
+            "instead of the default fp32 stability island (VERDICT r4 "
+            "lever (b)). Numerically inadvisable for real training "
+            "(E[x^2]-E[x]^2 in 8-bit mantissa); exists to measure whether "
+            "accumulator width is on the critical path of the conv+stat "
+            "reduce fusions")
+
 DEFINE_flag("conv_1x1_grad_as_dot", False,
             "A/B probe: emit 1x1-conv input/filter gradients as dot_general "
             "channel matmuls instead of jax's transposed convolutions (see "

@@ -1,0 +1,111 @@
+"""Book chapters re-run under the memory-optimization transpiler.
+
+Reference: python/paddle/fluid/tests/book_memory_optimization/
+(test_memopt_fit_a_line.py, test_memopt_image_classification_train.py) —
+the same book models must converge identically after fluid.memory_optimize /
+fluid.release_memory rewrite the program (random seed pinned so the
+optimized and unoptimized runs are comparable).
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _fit_a_line_program(seed=111):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[13])
+        y = fluid.layers.data("y", shape=[1])
+        y_predict = fluid.layers.fc(input=x, size=1, act=None)
+        cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(avg_cost, startup)
+    return main, startup, avg_cost
+
+
+def _synthetic_housing(n=256):
+    rng = np.random.RandomState(17)
+    xs = rng.randn(n, 13).astype("float32")
+    w = rng.randn(13, 1).astype("float32")
+    ys = xs @ w + 0.01 * rng.randn(n, 1).astype("float32")
+    return xs, ys
+
+
+def _train(main, startup, loss, mode="eager", epochs=12):
+    xs, ys = _synthetic_housing()
+    exe = fluid.Executor(fluid.CPUPlace(), mode=mode)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    out = []
+    for _ in range(epochs):
+        for i in range(0, len(xs), 64):
+            v, = exe.run(main, feed={"x": xs[i:i + 64], "y": ys[i:i + 64]},
+                         fetch_list=[loss], scope=scope)
+            out.append(float(np.asarray(v)))
+    return out
+
+
+def test_memopt_fit_a_line_matches_unoptimized():
+    """reference test_memopt_fit_a_line.py contract: pinned seed, the
+    optimized program's losses equal the plain program's."""
+    plain_main, plain_start, plain_loss = _fit_a_line_program()
+    want = _train(plain_main, plain_start, plain_loss)
+
+    opt_main, opt_start, opt_loss = _fit_a_line_program()
+    nr = fluid.memory_optimize(opt_main, fetch_list=[opt_loss])
+    nd = fluid.release_memory(opt_main, fetch_list=[opt_loss])
+    assert nr > 0 and nd > 0
+    got = _train(opt_main, opt_start, opt_loss)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    assert got[-1] < got[0] * 0.5  # converges
+
+
+def test_memopt_conv_classifier_converges():
+    """reference test_memopt_image_classification_train.py contract scaled
+    to suite budget: a conv+BN classifier trains under the optimized program
+    (jit path) to the same losses as the plain one."""
+    def build(seed=7):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", shape=[3, 16, 16])
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            conv = fluid.layers.conv2d(input=img, num_filters=8,
+                                       filter_size=3, padding=1, act=None)
+            bn = fluid.layers.batch_norm(input=conv, act="relu")
+            pool = fluid.layers.pool2d(input=bn, pool_size=2, pool_stride=2,
+                                       pool_type="max")
+            logits = fluid.layers.fc(input=pool, size=10, act="softmax")
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(input=logits, label=label))
+            fluid.optimizer.Momentum(learning_rate=0.05,
+                                     momentum=0.9).minimize(loss, startup)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(128, 3, 16, 16).astype("float32")
+    ys = rng.randint(0, 10, (128, 1)).astype("int64")
+
+    def run(main, startup, loss):
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        vals = []
+        for _ in range(6):
+            for i in range(0, 128, 64):
+                v, = exe.run(main, feed={"img": xs[i:i + 64],
+                                         "label": ys[i:i + 64]},
+                             fetch_list=[loss], scope=scope)
+                vals.append(float(np.asarray(v)))
+        return vals
+
+    plain = build()
+    want = run(*plain)
+    opt_main, opt_start, opt_loss = build()
+    fluid.memory_optimize(opt_main, fetch_list=[opt_loss])
+    fluid.release_memory(opt_main, fetch_list=[opt_loss])
+    got = run(opt_main, opt_start, opt_loss)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    assert got[-1] < got[0]
