@@ -128,6 +128,19 @@ class Master:
             return {"todo": len(self._todo), "doing": len(self._doing),
                     "done": len(self._done), "pass_id": self._pass_id}
 
+    def request_save_model(self, trainer_id, block_ms):
+        """Save-model arbitration (reference go/master/service.go
+        RequestSaveModel): grant exactly one trainer the save slot; other
+        requests within ``block_ms`` are rejected — any trainer may save
+        (the conventional 0-th trainer can die in elastic training)."""
+        with self._lock:
+            now = time.monotonic()
+            holder, until = getattr(self, "_save_lease", (None, 0.0))
+            if until > now and holder != trainer_id:
+                return 0
+            self._save_lease = (trainer_id, now + block_ms / 1000.0)
+            return 1
+
     # ---- internals ----
     def _requeue_expired_locked(self):
         now = time.monotonic()
@@ -211,6 +224,10 @@ class MasterClient:
 
     def progress(self):
         return self._rpc.call("pass_progress")
+
+    def request_save_model(self, trainer_id, block_ms):
+        return self._rpc.call("request_save_model", trainer_id=trainer_id,
+                              block_ms=block_ms)
 
     def close(self):
         self._rpc.close()

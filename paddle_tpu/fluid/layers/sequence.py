@@ -67,19 +67,25 @@ def dynamic_gru(input, size, param_attr=None, bias_attr=None,
 
 
 def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
-                  padding=None, bias_attr=None, param_attr=None, act=None):
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  context_start=None):
+    """context_start: first row of the context window relative to the
+    current step (reference sequence_conv_op.cc contextStart); None centers
+    the window, 0 makes it causal/left-aligned."""
     helper = LayerHelper("sequence_conv", act=act, bias_attr=bias_attr)
     filter_shape = (filter_size * input.shape[-1], num_filters)
     filter_param = helper.create_parameter(param_attr, shape=filter_shape,
                                            dtype=input.dtype)
     pre_bias = helper.create_tmp_variable(input.dtype,
                                           lod_level=input.lod_level)
+    if context_start is None:
+        context_start = -int(filter_size // 2)
     helper.append_op(
         "sequence_conv",
         inputs={"X": [input.name], "Filter": [filter_param.name]},
         outputs={"Out": [pre_bias.name]},
         attrs={"contextStride": filter_stride,
-               "contextStart": -int(filter_size // 2),
+               "contextStart": int(context_start),
                "contextLength": filter_size})
     pre_act = helper.append_bias_op(pre_bias, dim_start=1)
     return helper.append_activation(pre_act)

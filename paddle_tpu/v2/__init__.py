@@ -13,8 +13,9 @@ test() over a held-out reader — used exactly like
 
 from . import event
 from .trainer import SGD
-from . import (activation, attr, config_helpers, data_type, image, layer,
-               optimizer, parameters, plot, pooling, topology)
+from . import (activation, attr, config_helpers, data_type, evaluator,
+               image, layer, master, networks, op, optimizer, parameters,
+               plot, pooling, topology)
 from .config_helpers import parse_config
 from .inference import infer, Inference
 from .topology import Topology
@@ -23,7 +24,39 @@ from .topology import Topology
 from . import trainer
 from . import inference
 
+# reference v2/__init__.py re-exports: paddle.batch, paddle.reader,
+# paddle.dataset (minibatch.py, reader/, dataset/ live at package level
+# here — one implementation, two spellings)
+from ..reader.minibatch import batch
+from .. import reader
+from .. import dataset
+minibatch = reader.minibatch
+
 __all__ = ["event", "SGD", "trainer", "layer", "activation", "pooling",
            "attr", "data_type", "optimizer", "parameters", "config_helpers",
            "parse_config", "infer", "Inference", "topology", "Topology",
-           "inference", "image", "plot"]
+           "inference", "image", "plot", "networks", "evaluator", "op",
+           "master", "batch", "minibatch", "reader", "dataset", "init"]
+
+
+def init(**kwargs):
+    """paddle.init(use_gpu=..., trainer_count=...) (reference
+    v2/__init__.py:127): fold PADDLE_INIT_* environment variables and
+    kwargs into the flags registry. Device selection maps to this
+    framework's Places — ``use_gpu`` means "use the accelerator" and is
+    accepted for script parity (the Executor defaults to the accelerator
+    when one exists); unknown reference flags are recorded without error so
+    unedited reference scripts run."""
+    import os as _os
+
+    from ..core.flags import _FLAGS, set_flags
+
+    args = {}
+    for ek, ev in _os.environ.items():
+        if ek.startswith("PADDLE_INIT_"):
+            args[ek[len("PADDLE_INIT_"):].lower()] = ev
+    args.update(kwargs)
+    known = {k: v for k, v in args.items() if k in _FLAGS}
+    if known:
+        set_flags(known)
+    return args

@@ -1,0 +1,63 @@
+"""``paddle.v2.master`` — the trainer-side client of the elastic master.
+
+Reference: python/paddle/v2/master/client.py — a ctypes client over the Go
+master (go/master/service.go) that leases RecordIO-chunk tasks and yields
+records; trainers are stateless consumers, which is the elastic-training
+design. Here the service is ``paddle_tpu.distributed.master.Master`` (same
+task-queue contract: leases, timeouts, retry limits, snapshots) and this
+module provides the reference client surface over its RPC."""
+
+from __future__ import annotations
+
+from ...distributed.master import MasterClient
+
+__all__ = ["client"]
+
+
+class client:
+    """Reference client.py surface: ``set_dataset(paths)`` registers the
+    RecordIO files as this pass's chunks, ``next_record()`` returns one
+    record (None at pass end), ``request_save_model`` arbitrates which
+    trainer saves, ``paddle_start_get_records``/``release`` mirror the
+    reference's lifecycle calls."""
+
+    def __init__(self, addr, timeout_sec=3.0, buf_size=0):
+        # addr: the master service address ("host:port" or (host, port));
+        # the reference takes etcd endpoints for discovery — discovery is
+        # out of scope for the in-process service, the address is direct.
+        if isinstance(addr, str):
+            host, _, port = addr.rpartition(":")
+            addr = (host or "127.0.0.1", int(port))
+        self._client = MasterClient(addr)
+        self._records = iter(())
+        del timeout_sec, buf_size  # server-side / C-buffer concerns
+
+    def set_dataset(self, paths):
+        self._client.set_dataset(list(paths), chunks_per_task=1)
+
+    def paddle_start_get_records(self, pass_id=0):
+        self._records = self._record_stream()
+
+    def _record_stream(self):
+        from ...recordio import Scanner
+        for task_id, epoch, chunks in self._client.tasks():
+            try:
+                for path in chunks:
+                    for rec in Scanner(path):
+                        yield rec
+                self._client.finished(task_id, epoch)
+            except Exception:
+                self._client.failed(task_id, epoch)
+                raise
+
+    def next_record(self):
+        """One record, or None when the pass is exhausted (the reference
+        returns size -2 at pass end)."""
+        return next(self._records, None)
+
+    def request_save_model(self, trainer_id, block_ms):
+        return self._client.request_save_model(trainer_id, block_ms)
+
+    def release(self):
+        self._client.close()
+        self._client = None

@@ -1,0 +1,246 @@
+"""v2 module-surface parity: networks, evaluator, op, init, batch, master.
+
+Reference: python/paddle/v2/__init__.py:14-35 (module exports + init),
+trainer_config_helpers/networks.py (sequence_conv_pool :40, vgg towers,
+simple_attention :1400), v2/evaluator.py (auto-converted *_evaluator
+names), v2/op.py (module-level unary math), v2/master/client.py.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.v2 as paddle
+from paddle_tpu.v2.config_helpers import LayerOutput
+
+
+def _fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    return main, startup
+
+
+def test_v2_exports_match_reference_surface():
+    # every module the reference v2/__init__.py imports must exist here
+    for name in ("optimizer", "layer", "activation", "parameters", "trainer",
+                 "event", "data_type", "topology", "networks", "evaluator",
+                 "dataset", "reader", "plot", "attr", "op", "pooling",
+                 "inference", "minibatch", "image", "master"):
+        assert hasattr(paddle, name), name
+    assert callable(paddle.init)
+    assert callable(paddle.batch)
+    assert paddle.infer is paddle.inference.infer
+
+
+def test_init_folds_env_and_kwargs(monkeypatch):
+    monkeypatch.setenv("PADDLE_INIT_CHECK_NAN_INF", "0")
+    args = paddle.init(use_gpu=False, trainer_count=4)
+    assert args["use_gpu"] is False and args["trainer_count"] == 4
+    assert args["check_nan_inf"] == "0"  # env folded in
+
+
+def test_networks_sequence_conv_pool_trains():
+    from paddle_tpu.v2.networks import sequence_conv_pool
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], lod_level=1)
+        lo = LayerOutput(x, size=8, is_seq=True)
+        pooled = sequence_conv_pool(lo, context_len=3, hidden_size=16)
+        assert pooled.size == 16
+        label = fluid.layers.data("y", shape=[1], dtype="int64")
+        logits = fluid.layers.fc(input=pooled.var, size=3, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=logits, label=label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss, startup)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    feeder = fluid.DataFeeder([x, label], main)
+    rng = np.random.RandomState(0)
+    seqs = [rng.randn(rng.randint(3, 7), 8).astype("float32")
+            for _ in range(8)]
+    labels = [np.array([i % 3], "int64") for i in range(8)]
+    feed = feeder.feed(list(zip(seqs, labels)))
+    first = last = None
+    for _ in range(15):
+        v, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        last = float(np.asarray(v))
+        first = last if first is None else first
+    assert last < first
+
+
+def test_networks_simple_attention_context():
+    from paddle_tpu.v2.networks import simple_attention
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        enc = fluid.layers.data("enc", shape=[6], lod_level=1)
+        proj = fluid.layers.data("proj", shape=[4], lod_level=1)
+        state = fluid.layers.data("state", shape=[4])
+        ctx = simple_attention(
+            LayerOutput(enc, size=6, is_seq=True),
+            LayerOutput(proj, size=4, is_seq=True),
+            LayerOutput(state, size=4), name="att")
+        assert ctx.size == 6
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    feeder = fluid.DataFeeder([enc, proj, state], main)
+    rng = np.random.RandomState(1)
+    lens = [3, 5]
+    rows = [(rng.randn(n, 6).astype("float32"),
+             rng.randn(n, 4).astype("float32"),
+             rng.randn(4).astype("float32")) for n in lens]
+    feed = feeder.feed(rows)
+    out, = exe.run(main, feed=feed, fetch_list=[ctx.var], scope=scope,
+                   return_numpy=True)
+    out = np.asarray(out)
+    # one context row per input sequence, in the encoded space
+    assert out.shape == (2, 6)
+    # attention weights are a softmax: each context is a convex combination
+    # of that sequence's encoder rows -> inside their min/max envelope
+    start = 0
+    for i, n in enumerate(lens):
+        seq = rows[i][0]
+        assert np.all(out[i] <= seq.max(axis=0) + 1e-5)
+        assert np.all(out[i] >= seq.min(axis=0) - 1e-5)
+        start += n
+
+
+def test_evaluator_classification_error_complements_accuracy():
+    from paddle_tpu.v2 import evaluator as ev
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        probs = fluid.layers.data("p", shape=[4])
+        label = fluid.layers.data("l", shape=[1], dtype="int64")
+        err = ev.classification_error(LayerOutput(probs, size=4),
+                                      LayerOutput(label, size=1))
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    p = np.eye(4, dtype="float32")[[0, 1, 2, 3]]
+    lab = np.array([[0], [1], [0], [3]], "int64")  # 3 of 4 correct
+    e, = exe.run(main, feed={"p": p, "l": lab}, fetch_list=[err.var])
+    np.testing.assert_allclose(np.asarray(e), [0.25], atol=1e-6)
+
+
+def test_evaluator_ctc_error_is_normalized_edit_distance():
+    from paddle_tpu.v2 import evaluator as ev
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        hyp = fluid.layers.data("hyp", shape=[1], dtype="int64", lod_level=1)
+        ref = fluid.layers.data("ref", shape=[1], dtype="int64", lod_level=1)
+        dist = ev.ctc_error(LayerOutput(hyp, size=1, is_seq=True),
+                            LayerOutput(ref, size=1, is_seq=True))
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    feeder = fluid.DataFeeder([hyp, ref], main)
+    feed = feeder.feed([
+        (np.array([[1], [2], [3]], "int64"), np.array([[1], [2]], "int64")),
+    ])
+    d, = exe.run(main, feed=feed, fetch_list=[dist.var])
+    # edit distance 1 (one insertion) normalized by ref len 2
+    np.testing.assert_allclose(np.asarray(d).reshape(-1), [0.5], atol=1e-6)
+
+
+def test_op_module_unary_math():
+    from paddle_tpu.v2 import op as vop
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[5])
+        y = vop.exp(LayerOutput(x, size=5))
+        z = vop.sigmoid(LayerOutput(x, size=5))
+        assert isinstance(y, LayerOutput) and y.size == 5
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    xv = np.linspace(-1, 1, 5, dtype="float32").reshape(1, 5)
+    yv, zv = exe.run(main, feed={"x": xv}, fetch_list=[y.var, z.var])
+    np.testing.assert_allclose(np.asarray(yv), np.exp(xv), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(zv), 1 / (1 + np.exp(-xv)),
+                               rtol=1e-5)
+
+
+def test_v2_master_client_roundtrip(tmp_path):
+    from paddle_tpu.distributed.master import Master
+    from paddle_tpu.distributed.rpc import RpcServer
+    from paddle_tpu.recordio import write_records
+    import paddle_tpu.v2.master as vmaster
+
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"chunk{i}.recordio")
+        write_records(p, [f"rec-{i}-{j}".encode() for j in range(4)])
+        paths.append(p)
+
+    rpc = RpcServer(Master(timeout_s=5.0))
+    rpc.serve_in_thread()
+    try:
+        c = vmaster.client(f"127.0.0.1:{rpc.address[1]}")
+        c.set_dataset(paths)
+        c.paddle_start_get_records()
+        got = []
+        while True:
+            r = c.next_record()
+            if r is None:
+                break
+            got.append(bytes(r))
+        assert sorted(got) == sorted(
+            f"rec-{i}-{j}".encode() for i in range(3) for j in range(4))
+        # save-model arbitration: first trainer wins, second is blocked,
+        # after the block window anyone may take the lease again
+        assert c.request_save_model("t0", 200) == 1
+        assert c.request_save_model("t1", 200) == 0
+        assert c.request_save_model("t0", 200) == 1  # holder may renew
+        c.release()
+    finally:
+        rpc.shutdown()
+
+
+def test_networks_vgg_towers_have_bn_relu_dropout():
+    """Regression for the conv_with_batchnorm kwarg: the vgg builders must
+    emit batch_norm + relu-activated groups and the dropout schedule
+    (reference networks.py small_vgg/vgg_16_network)."""
+    from paddle_tpu.v2.networks import small_vgg
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 32, 32])
+        out = small_vgg(LayerOutput(img, size=3 * 32 * 32, hwc=(3, 32, 32)),
+                        num_channels=3, num_classes=10)
+        assert out.size == 10
+    types = [op.type for op in main.global_block().ops]
+    assert types.count("batch_norm") >= 11   # 10 convs + 1 fc-side BN
+    assert types.count("dropout") >= 5       # 4 group drops + head drop
+    relu_bns = [op for op in main.global_block().ops
+                if op.type == "batch_norm"]
+    assert len(relu_bns) >= 10
+
+
+def test_sequence_conv_context_start_changes_window():
+    """context_start=0 (causal) must differ from the centered default and
+    match a hand-rolled causal window."""
+    rng = np.random.RandomState(5)
+    seq = rng.randn(4, 2).astype("float32")
+
+    from paddle_tpu.core.lod import lodarray_to_flat
+
+    def run(context_start):
+        main, startup = _fresh_programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[2], lod_level=1)
+            y = fluid.layers.sequence_conv(
+                input=x, num_filters=3, filter_size=2, bias_attr=False,
+                context_start=context_start)
+        exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        feeder = fluid.DataFeeder([x], main)
+        out, = exe.run(main, feed=feeder.feed([(seq,)]),
+                       fetch_list=[y], scope=scope, return_numpy=False)
+        flat, _ = lodarray_to_flat(out)
+        pname = main.global_block().all_parameters()[0].name
+        w = np.asarray(scope.find_var(pname))
+        return np.asarray(flat), w
+
+    causal, w = run(0)
+    centered, _ = run(None)
+    # causal window at step t: rows [t, t+1] of x (start 0, length 2)
+    ctx0 = np.concatenate([seq, np.vstack([seq[1:], np.zeros((1, 2))])],
+                          axis=1).astype("float32")
+    np.testing.assert_allclose(causal, ctx0 @ w, rtol=1e-4, atol=1e-5)
+    assert not np.allclose(causal, centered)
