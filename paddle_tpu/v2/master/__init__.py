@@ -42,13 +42,17 @@ class client:
         from ...recordio import Scanner
         for task_id, epoch, chunks in self._client.tasks():
             try:
-                for path in chunks:
-                    for rec in Scanner(path):
-                        yield rec
-                self._client.finished(task_id, epoch)
+                records = [rec for path in chunks for rec in Scanner(path)]
             except Exception:
+                # report the failure and keep consuming: the master requeues
+                # the task (retry-limited) and some other lease — possibly
+                # ours — will re-read it (reference Go client taskFailed
+                # keeps fetching; a dead generator would turn one bad chunk
+                # into a silent early pass-end)
                 self._client.failed(task_id, epoch)
-                raise
+                continue
+            yield from records
+            self._client.finished(task_id, epoch)
 
     def next_record(self):
         """One record, or None when the pass is exhausted (the reference

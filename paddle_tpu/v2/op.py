@@ -16,11 +16,9 @@ from .config_helpers import _unary_layer
 __all__ = []
 
 
-def _register(op_name, fluid_op=None):
-    fl = fluid_op or op_name
-
+def _register(op_name):
     def op(input, name=None):
-        return _unary_layer(fl, input)
+        return _unary_layer(op_name, input, name=name)
 
     op.__name__ = op_name
     globals()[op_name] = op

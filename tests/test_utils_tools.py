@@ -1,0 +1,113 @@
+"""paddle.utils tool parity: dump_config, diagram, merge_model, plotcurve,
+show_pb.
+
+Reference: python/paddle/utils/{dump_config,make_model_diagram,merge_model,
+plotcurve,show_pb}.py — each a small CLI over the config/param formats.
+"""
+
+import io
+import json
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+_CONF = """
+from paddle_tpu.v2.config_helpers import *
+
+settings(batch_size=16, learning_rate=0.01)
+img = data_layer(name="img", size=64)
+hidden = fc_layer(input=img, size=32, act=ReluActivation())
+prob = fc_layer(input=hidden, size=10, act=SoftmaxActivation())
+outputs(prob)
+"""
+
+
+def _write_conf(tmp_path):
+    p = tmp_path / "conf.py"
+    p.write_text(_CONF)
+    return str(p)
+
+
+def test_dump_config_prints_program(tmp_path):
+    from paddle_tpu.utils.dump_config import dump_config
+    out = io.StringIO()
+    dump_config(_write_conf(tmp_path), whole=True, out=out)
+    text = out.getvalue()
+    assert "fc" in text or "mul" in text
+    assert "batch_size" in text  # --whole prints settings
+
+
+def test_dump_config_binary_is_program_json(tmp_path):
+    from paddle_tpu.utils.dump_config import dump_config
+    buf = io.BytesIO()
+    dump_config(_write_conf(tmp_path), binary=True, out=buf)
+    doc = json.loads(buf.getvalue().decode())
+    assert any(b["ops"] for b in doc["blocks"])
+
+
+def test_make_model_diagram(tmp_path):
+    from paddle_tpu.utils.make_model_diagram import make_diagram
+    dot_path = str(tmp_path / "model.dot")
+    dot = make_diagram(_write_conf(tmp_path), dot_path)
+    assert dot.startswith("digraph")
+    assert open(dot_path).read() == dot
+
+
+def test_merge_model_roundtrip(tmp_path):
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.utils.merge_model import (merge_v2_model,
+                                              load_merged_model)
+    from paddle_tpu.v2.config_helpers import parse_config
+    from paddle_tpu.v2.parameters import Parameters
+
+    topo, main, startup = parse_config(_write_conf(tmp_path))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    params = Parameters(main, scope)
+    tar_path = str(tmp_path / "params.tar")
+    with open(tar_path, "wb") as f:
+        params.to_tar(f)
+
+    merged = str(tmp_path / "merged.paddle")
+    merge_v2_model(topo, tar_path, merged)
+
+    topo_doc, param_bytes = load_merged_model(merged)
+    assert topo_doc["fetch_var_names"]
+    restored = Parameters.from_tar_file(io.BytesIO(param_bytes))
+    for name in params.names():
+        np.testing.assert_array_equal(np.asarray(restored.get(name)),
+                                      np.asarray(params.get(name)))
+
+
+def test_plotcurve_parses_both_log_formats(tmp_path):
+    from paddle_tpu.utils.plotcurve import parse_log, plotcurve
+    lines = [
+        "I0101 trainer.cpp:100] Pass=0 Batch=20 Cost=2.5 AvgCost=2.31",
+        "Pass 1, Batch 10, Cost 1.75",
+        "noise line",
+        "I0101 trainer.cpp:100] Pass=2 Batch=20 Cost=1.2 AvgCost=1.10",
+    ]
+    pts = parse_log(lines)
+    assert pts == [(0, 2.31), (1, 1.75), (2, 1.10)]
+    out = str(tmp_path / "curve.png")
+    got = plotcurve(lines, out)
+    assert got == pts
+
+
+def test_show_pb_pretty_prints_saved_model(tmp_path):
+    from paddle_tpu.utils.show_pb import show
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.fc(input=x, size=2, act=None)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    model_dir = str(tmp_path / "m")
+    fluid.io.save_inference_model(model_dir, ["x"], [y], exe, main)
+    out = io.StringIO()
+    doc = show(model_dir, out)
+    assert "blocks" in doc
+    assert json.loads(out.getvalue()) == doc

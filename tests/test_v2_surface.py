@@ -203,12 +203,20 @@ def test_networks_vgg_towers_have_bn_relu_dropout():
         out = small_vgg(LayerOutput(img, size=3 * 32 * 32, hwc=(3, 32, 32)),
                         num_channels=3, num_classes=10)
         assert out.size == 10
-    types = [op.type for op in main.global_block().ops]
+    block = main.global_block()
+    types = [op.type for op in block.ops]
     assert types.count("batch_norm") >= 11   # 10 convs + 1 fc-side BN
     assert types.count("dropout") >= 5       # 4 group drops + head drop
-    relu_bns = [op for op in main.global_block().ops
-                if op.type == "batch_norm"]
-    assert len(relu_bns) >= 10
+    # the group BNs must carry the relu activation (either as the BN's own
+    # act attr or an immediately-following relu op on the BN output)
+    bn_outs = {op.output("Y")[0] for op in block.ops
+               if op.type == "batch_norm"}
+    relu_inputs = {n for op in block.ops if op.type == "relu"
+                   for n in op.input_arg_names()}
+    relu_activated = len(bn_outs & relu_inputs) + sum(
+        1 for op in block.ops
+        if op.type == "batch_norm" and op.attr("act") == "relu")
+    assert relu_activated >= 10, (len(bn_outs), len(relu_inputs))
 
 
 def test_sequence_conv_context_start_changes_window():
