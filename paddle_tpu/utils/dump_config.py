@@ -18,14 +18,11 @@ __all__ = ["dump_config"]
 
 def dump_config(conf_path, config_args="", whole=False, binary=False,
                 out=None):
-    from ..v2.config_helpers import parse_config, _SETTINGS
+    from ..v2.config_helpers import parse_config, parse_config_args, \
+        _SETTINGS
 
     out = out or sys.stdout
-    args = {}
-    for kv in (config_args or "").split(","):
-        if "=" in kv:
-            k, v = kv.split("=", 1)
-            args[k] = v
+    args = parse_config_args(config_args)
     topo, main, _startup = parse_config(conf_path, config_args=args or None)
     if binary:
         data = main.to_json().encode("utf-8")

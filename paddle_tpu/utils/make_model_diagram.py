@@ -21,13 +21,9 @@ def make_diagram_from_program(program, dot_path):
 
 
 def make_diagram(config_file, dot_path, config_args=""):
-    from ..v2.config_helpers import parse_config
+    from ..v2.config_helpers import parse_config, parse_config_args
 
-    args = {}
-    for kv in (config_args or "").split(","):
-        if "=" in kv:
-            k, v = kv.split("=", 1)
-            args[k] = v
+    args = parse_config_args(config_args)
     _topo, main, _startup = parse_config(config_file,
                                          config_args=args or None)
     return make_diagram_from_program(main, dot_path)

@@ -13,9 +13,9 @@ test() over a held-out reader — used exactly like
 
 from . import event
 from .trainer import SGD
-from . import (activation, attr, config_helpers, data_type, evaluator,
-               image, layer, master, networks, op, optimizer, parameters,
-               plot, pooling, topology)
+from . import (activation, attr, config_helpers, data_feeder, data_type,
+               evaluator, image, layer, master, networks, op, optimizer,
+               parameters, plot, pooling, topology)
 from .config_helpers import parse_config
 from .inference import infer, Inference
 from .topology import Topology

@@ -79,6 +79,17 @@ def _reset_config():
     _SEQUENCE_HINTS.clear()
 
 
+def parse_config_args(s):
+    """'k1=v1,k2=v2' -> dict, whitespace-tolerant (the --config_args CLI
+    format shared by the trainer CLI and the utils tools)."""
+    out = {}
+    for kv in (s or "").split(","):
+        if "=" in kv:
+            k, _, v = kv.partition("=")
+            out[k.strip()] = v.strip()
+    return out
+
+
 def set_config_args(**kwargs):
     """Provide the values get_config_arg reads (the reference passes them on
     the paddle_trainer command line: --config_args=batch_size=64,...)."""

@@ -1,0 +1,54 @@
+"""``paddle.v2.data_feeder`` — minibatch rows -> feed dict by data types.
+
+Reference: python/paddle/v2/data_feeder.py (DataFeeder over
+DataProviderConverter: ``feeder(minibatch)`` converts reader rows into
+Arguments keyed by the topology's data layers, with an optional ``feeding``
+map when row columns and data layers aren't one-to-one). Here the produced
+structure is the executor feed dict (dense arrays / packed LoDArrays), via
+the fluid DataFeeder's packing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.lod import pack_sequences
+
+__all__ = ["DataFeeder", "default_feeding_map"]
+
+
+def default_feeding_map(data_types):
+    return {name: i for i, (name, _tp) in enumerate(data_types)}
+
+
+class DataFeeder:
+    def __init__(self, data_types, feeding=None):
+        """data_types: [(name, InputType)] (e.g. from Topology.data_type());
+        feeding: list of names or {name: column-index} when reader rows
+        carry extra/reordered columns."""
+        self.data_types = list(data_types)
+        if feeding is None:
+            feeding = default_feeding_map(self.data_types)
+        elif not isinstance(feeding, dict):
+            feeding = {name: i for i, name in enumerate(feeding)}
+        self.feeding = feeding
+
+    def __call__(self, minibatch):
+        return self.feed(minibatch)
+
+    def feed(self, minibatch):
+        out = {}
+        for name, tp in self.data_types:
+            col = self.feeding[name]
+            column = [row[col] for row in minibatch]
+            if tp.lod_level > 0:
+                seqs = [np.asarray(c, dtype=tp.dtype) for c in column]
+                seqs = [s[:, None] if s.ndim == 1 else s for s in seqs]
+                out[name] = pack_sequences(seqs, dtype=tp.dtype)
+            elif tp.dtype == "int64":
+                out[name] = np.asarray(column, "int64").reshape(
+                    len(column), -1)
+            else:
+                out[name] = np.asarray(column, tp.dtype).reshape(
+                    [len(column)] + list(tp.shape))
+        return out

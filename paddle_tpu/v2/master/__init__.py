@@ -41,18 +41,30 @@ class client:
     def _record_stream(self):
         from ...recordio import Scanner
         for task_id, epoch, chunks in self._client.tasks():
-            try:
-                records = [rec for path in chunks for rec in Scanner(path)]
-            except Exception:
-                # report the failure and keep consuming: the master requeues
-                # the task (retry-limited) and some other lease — possibly
-                # ours — will re-read it (reference Go client taskFailed
-                # keeps fetching; a dead generator would turn one bad chunk
-                # into a silent early pass-end)
+            ok = True
+            for path in chunks:
+                try:
+                    scanner = iter(Scanner(path))
+                except Exception:
+                    ok = False
+                    break
+                # stream record-by-record (chunks can be multi-GB shards);
+                # a mid-chunk read error fails the task AFTER some records
+                # were delivered — the master requeues it and redelivery
+                # duplicates them, the at-least-once elastic contract
+                # (reference Go client taskFailed keeps fetching too; a
+                # dead generator here would turn one bad chunk into a
+                # silent early pass-end)
+                try:
+                    for rec in scanner:
+                        yield rec
+                except Exception:
+                    ok = False
+                    break
+            if ok:
+                self._client.finished(task_id, epoch)
+            else:
                 self._client.failed(task_id, epoch)
-                continue
-            yield from records
-            self._client.finished(task_id, epoch)
 
     def next_record(self):
         """One record, or None when the pass is exhausted (the reference

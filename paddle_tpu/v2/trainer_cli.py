@@ -24,19 +24,14 @@ from __future__ import annotations
 
 import argparse
 import importlib
+import os
 import sys
 import time
 
 import numpy as np
 
 
-def _parse_config_args(s):
-    out = {}
-    for kv in (s or "").split(","):
-        if "=" in kv:
-            k, _, v = kv.partition("=")
-            out[k.strip()] = v.strip()
-    return out
+from .config_helpers import parse_config_args as _parse_config_args
 
 
 def _synthetic_reader(topo, batch_size, batches, seed=7):
@@ -139,10 +134,42 @@ def job_checkgrad(topo, main, startup, args):
     return 1 if failed else 0
 
 
-def _make_reader(topo, args, batches=None):
+def _provider_reader(topo, is_train=True):
+    """When the config declared define_py_data_sources2(module=..., obj=...),
+    load the @provider-decorated function and bind it as the reader
+    (reference PyDataProvider2 path: the C++ trainer pulled batches through
+    the provider; here it IS the reader)."""
+    src = topo.data_sources or {}
+    module, obj = src.get("module"), src.get("obj")
+    if not (module and obj):
+        return None
+    file_list = src.get("train_list" if is_train else "test_list")
+    if file_list is None:
+        return None
+    if isinstance(file_list, str):
+        # the reference contract: train_list/test_list name a LIST FILE of
+        # data filenames (trainer config_parser); a missing list file is a
+        # config error, not a data file
+        if not os.path.exists(file_list):
+            raise FileNotFoundError(
+                f"data source list file not found: {file_list!r}")
+        with open(file_list) as f:
+            file_list = [ln.strip() for ln in f if ln.strip()]
+    provider_cls = getattr(importlib.import_module(module), obj)
+    return provider_cls(file_list, input_order=topo.feed_order,
+                        is_train=is_train, **(src.get("args") or {}))
+
+
+def _make_reader(topo, args, batches=None, is_train=True):
     if args.reader:
         mod, _, fn = args.reader.partition(":")
         return getattr(importlib.import_module(mod), fn)()
+    from_provider = _provider_reader(topo, is_train=is_train)
+    if from_provider is not None:
+        # providers yield samples; the CLI reader contract is batch-level
+        from ..reader.minibatch import batch
+        return batch(from_provider,
+                     int(topo.settings.get("batch_size") or 16))
     bs = topo.settings.get("batch_size") or 16
     return _synthetic_reader(topo, int(bs),
                              batches or args.batches_per_pass)
@@ -246,7 +273,7 @@ def main(argv=None):
         trainer = v2.SGD(cost=topo.cost, optimizer=topo.create_optimizer(),
                          feed_order=topo.feed_order,
                          main_program=main_prog, startup_program=startup)
-    reader = _make_reader(topo, args)
+    reader = _make_reader(topo, args, is_train=args.job != "test")
 
     if args.job == "train":
         def handler(evt):
