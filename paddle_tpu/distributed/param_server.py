@@ -192,6 +192,20 @@ class ParameterServer:
                     "trainer_steps": dict(self._trainer_steps)}
 
 
+def parse_endpoint(endpoint, default_port=0):
+    """'host:port' -> (host, port); bare host or trailing ':' take
+    default_port, bare ':port'/'port-less' hosts default to loopback. The
+    one parser for every consumer of endpoint strings (transpiler, master
+    client)."""
+    if isinstance(endpoint, (tuple, list)):
+        return tuple(endpoint)
+    host, _, port = str(endpoint).rpartition(":")
+    if not host:            # no ':' at all -> whole string is the host
+        host, port = port, ""
+    return (host or "127.0.0.1",
+            int(port) if port.strip() else int(default_port))
+
+
 def shard_names(names, n_shards):
     """Round-robin placement (reference distributed_spliter.py:16
     round_robin)."""

@@ -33,6 +33,7 @@ from .backward import append_backward
 from .param_attr import ParamAttr
 from .data_feeder import DataFeeder
 from .memory_optimization_transpiler import memory_optimize, release_memory
+from .distribute_transpiler import DistributeTranspiler
 
 # CUDAPlace alias: reference scripts say CUDAPlace(0); on this framework that
 # means "the accelerator", i.e. the TPU chip.
@@ -47,4 +48,5 @@ __all__ = [
     "set_flags", "get_flag", "flags", "init_flags", "evaluator",
     "concurrency", "Go", "Select", "make_channel", "channel_send",
     "channel_recv", "channel_close", "memory_optimize", "release_memory",
+    "DistributeTranspiler",
 ]

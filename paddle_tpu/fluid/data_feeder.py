@@ -14,6 +14,23 @@ from ..core.lod import pack_sequences
 from ..core.types import np_dtype
 
 
+def pack_column(column, dtype, lod_level, shape=None, pad_multiple=8):
+    """One feed column -> dense array or packed LoDArray. The single
+    conversion shared by the fluid DataFeeder and the v2 data_feeder;
+    pad_multiple buckets ragged max-lengths to bound XLA recompiles."""
+    dtype = np_dtype(dtype)
+    if lod_level > 0:
+        seqs = [np.asarray(c, dtype=dtype) for c in column]
+        if seqs and seqs[0].ndim == 1:
+            seqs = [s[:, None] for s in seqs]
+        return pack_sequences(seqs, dtype=dtype, pad_multiple=pad_multiple)
+    arr = np.asarray(column, dtype=dtype)
+    want = [s for s in (shape or ()) if s != -1]
+    if want and list(arr.shape[1:]) != want:
+        arr = arr.reshape([arr.shape[0]] + want)
+    return arr
+
+
 class DataFeeder:
     def __init__(self, feed_list, place=None, program=None, pad_multiple=8):
         self.feed_vars = feed_list
@@ -25,17 +42,7 @@ class DataFeeder:
         feed = {}
         for i, var in enumerate(self.feed_vars):
             column = [row[i] for row in minibatch]
-            dtype = np_dtype(var.dtype)
-            if var.lod_level > 0:
-                seqs = [np.asarray(c, dtype=dtype) for c in column]
-                if seqs and seqs[0].ndim == 1:
-                    seqs = [s[:, None] for s in seqs]
-                feed[var.name] = pack_sequences(seqs, dtype=dtype,
-                                                pad_multiple=self.pad_multiple)
-            else:
-                arr = np.asarray(column, dtype=dtype)
-                want = [s for s in (var.shape or ()) if s != -1]
-                if want and list(arr.shape[1:]) != want:
-                    arr = arr.reshape([arr.shape[0]] + want)
-                feed[var.name] = arr
+            feed[var.name] = pack_column(column, var.dtype, var.lod_level,
+                                         var.shape,
+                                         pad_multiple=self.pad_multiple)
         return feed

@@ -10,9 +10,7 @@ the fluid DataFeeder's packing.
 
 from __future__ import annotations
 
-import numpy as np
-
-from ..core.lod import pack_sequences
+from ..fluid.data_feeder import pack_column
 
 __all__ = ["DataFeeder", "default_feeding_map"]
 
@@ -22,7 +20,7 @@ def default_feeding_map(data_types):
 
 
 class DataFeeder:
-    def __init__(self, data_types, feeding=None):
+    def __init__(self, data_types, feeding=None, pad_multiple=8):
         """data_types: [(name, InputType)] (e.g. from Topology.data_type());
         feeding: list of names or {name: column-index} when reader rows
         carry extra/reordered columns."""
@@ -32,6 +30,7 @@ class DataFeeder:
         elif not isinstance(feeding, dict):
             feeding = {name: i for i, name in enumerate(feeding)}
         self.feeding = feeding
+        self.pad_multiple = pad_multiple
 
     def __call__(self, minibatch):
         return self.feed(minibatch)
@@ -41,14 +40,6 @@ class DataFeeder:
         for name, tp in self.data_types:
             col = self.feeding[name]
             column = [row[col] for row in minibatch]
-            if tp.lod_level > 0:
-                seqs = [np.asarray(c, dtype=tp.dtype) for c in column]
-                seqs = [s[:, None] if s.ndim == 1 else s for s in seqs]
-                out[name] = pack_sequences(seqs, dtype=tp.dtype)
-            elif tp.dtype == "int64":
-                out[name] = np.asarray(column, "int64").reshape(
-                    len(column), -1)
-            else:
-                out[name] = np.asarray(column, tp.dtype).reshape(
-                    [len(column)] + list(tp.shape))
+            out[name] = pack_column(column, tp.dtype, tp.lod_level,
+                                    tp.shape, pad_multiple=self.pad_multiple)
         return out

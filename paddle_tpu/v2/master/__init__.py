@@ -25,10 +25,8 @@ class client:
         # addr: the master service address ("host:port" or (host, port));
         # the reference takes etcd endpoints for discovery — discovery is
         # out of scope for the in-process service, the address is direct.
-        if isinstance(addr, str):
-            host, _, port = addr.rpartition(":")
-            addr = (host or "127.0.0.1", int(port))
-        self._client = MasterClient(addr)
+        from ...distributed.param_server import parse_endpoint
+        self._client = MasterClient(parse_endpoint(addr))
         self._records = iter(())
         del timeout_sec, buf_size  # server-side / C-buffer concerns
 
