@@ -192,18 +192,23 @@ class ParameterServer:
                     "trainer_steps": dict(self._trainer_steps)}
 
 
-def parse_endpoint(endpoint, default_port=0):
-    """'host:port' -> (host, port); bare host or trailing ':' take
-    default_port, bare ':port'/'port-less' hosts default to loopback. The
-    one parser for every consumer of endpoint strings (transpiler, master
-    client)."""
+def parse_endpoint(endpoint, default_port=None):
+    """'host:port' -> (host, port); ':port' defaults the host to loopback.
+    A missing port is a loud ValueError unless default_port is given — a
+    port-less pservers entry must fail at parse time, not as an obscure
+    connect error later. The one parser for every consumer of endpoint
+    strings (transpiler, master client)."""
     if isinstance(endpoint, (tuple, list)):
         return tuple(endpoint)
     host, _, port = str(endpoint).rpartition(":")
     if not host:            # no ':' at all -> whole string is the host
         host, port = port, ""
-    return (host or "127.0.0.1",
-            int(port) if port.strip() else int(default_port))
+    if not port.strip():
+        if default_port is None:
+            raise ValueError(
+                f"endpoint {endpoint!r} has no port (want 'host:port')")
+        port = str(default_port)
+    return (host or "127.0.0.1", int(port))
 
 
 def shard_names(names, n_shards):

@@ -14,7 +14,8 @@ derived round-robin placement this transpiler mirrors). A "pserver
 program" is a ``PServerProgram`` service spec: ``serve_in_thread()`` /
 ``serve_forever()`` run the shard's ParameterServer with the optimizer
 rule lifted out of the original program's optimize ops. Sync mode maps to
-the fan-in batch-barrier server; async to bounded-staleness.
+the fan-in batch-barrier server; async mode applies pushes immediately,
+bounded-staleness when ``transpile(..., max_staleness=k)`` is set.
 """
 
 from __future__ import annotations
@@ -41,13 +42,14 @@ class PServerProgram:
     service (the reference's listen_and_serv program)."""
 
     def __init__(self, endpoint, param_names, optimizer, opt_kwargs, mode,
-                 fan_in):
+                 fan_in, max_staleness=None):
         self.endpoint = endpoint
         self.param_names = list(param_names)
         self.optimizer = optimizer
         self.opt_kwargs = dict(opt_kwargs)
         self.mode = mode
         self.fan_in = fan_in
+        self.max_staleness = max_staleness
         self._rpc = None
 
     def _address(self):
@@ -58,7 +60,9 @@ class PServerProgram:
         from ..distributed.param_server import serve
         ps, rpc = serve(optimizer=self.optimizer,
                         opt_kwargs=self.opt_kwargs, mode=self.mode,
-                        fan_in=self.fan_in, address=self._address())
+                        fan_in=self.fan_in,
+                        max_staleness=self.max_staleness,
+                        address=self._address())
         self._rpc = rpc
         return ps, rpc
 
@@ -78,7 +82,7 @@ class PServerProgram:
 
 class DistributeTranspiler:
     def transpile(self, trainer_id, program=None, pservers="", trainers=1,
-                  startup_program=None, sync_mode=True):
+                  startup_program=None, sync_mode=True, max_staleness=None):
         """Split ``program`` (which must already carry optimize ops via
         ``optimizer.minimize``) into the trainer side (optimize ops and
         accumulator updates stripped) and per-endpoint pserver specs."""
@@ -89,6 +93,7 @@ class DistributeTranspiler:
         self.trainer_id = int(trainer_id)
         self.trainers = int(trainers)
         self.sync_mode = bool(sync_mode)
+        self.max_staleness = max_staleness
         self.endpoints = [e.strip() for e in pservers.split(",")
                           if e.strip()]
         if not self.endpoints:
@@ -117,9 +122,8 @@ class DistributeTranspiler:
         # identified by the optimizer's own registry metadata, then any op
         # writing only accumulators (e.g. adam's beta-pow scale updates)
         # is stripped with the optimize ops
-        accum = {n for n in (v.name for v in block.vars.values()
-                             if getattr(v, "optimizer_accumulator_for",
-                                        None))}
+        accum = {v.name for v in block.vars.values()
+                 if getattr(v, "optimizer_accumulator_for", None)}
         self._trainer_program = program.clone()
         tblock = self._trainer_program.global_block()
         keep = []
@@ -174,7 +178,8 @@ class DistributeTranspiler:
         return PServerProgram(endpoint, shard, self.optimizer,
                               self.opt_kwargs,
                               mode="sync" if self.sync_mode else "async",
-                              fan_in=self.trainers)
+                              fan_in=self.trainers,
+                              max_staleness=self.max_staleness)
 
     def get_startup_program(self, endpoint, pserver_program=None):
         """The user startup pruned to this endpoint's shard (reference

@@ -1,0 +1,93 @@
+"""The reference's RNN benchmark config runs UNEDITED end-to-end.
+
+Reference: benchmark/paddle/rnn/rnn.py (the LSTM text-classification
+benchmark protocol behind benchmark/README.md:115-127) + its data-provider
+contract (benchmark/paddle/rnn/provider.py: init_hook sets
+settings.input_types, CACHE_PASS_IN_MEM). Round 4 built this config with
+its data-provider lines removed; with the @provider protocol and
+define_py_data_sources2 now honored, the config file is consumed verbatim
+from the reference tree — only the site-local modules it imports (imdb
+data creation, the provider) are ours.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF_RNN = "/root/reference/benchmark/paddle/rnn/rnn.py"
+needs_ref = pytest.mark.skipif(not os.path.exists(REF_RNN),
+                               reason="reference tree not available")
+
+# site-local module the config imports to create its dataset: a small
+# synthetic imdb.pkl with class-separable id sequences (the reference's
+# imdb.py downloads the real pickle; zero-egress environments synthesize)
+_IMDB_STUB = '''
+import pickle
+
+import numpy as np
+
+
+def create_data(path):
+    rng = np.random.RandomState(11)
+    xs, ys = [], []
+    for i in range(96):
+        label = i % 2
+        length = int(rng.randint(5, 12))
+        base = 10 if label else 200
+        xs.append([int(w) for w in rng.randint(base, base + 50, length)])
+        ys.append(label)
+    with open(path, "wb") as f:
+        pickle.dump((xs, ys), f)
+'''
+
+# site-local data provider honoring the reference provider contract
+# (provider.py: init_hook receives the config args and sets
+# settings.input_types; process yields (word-id sequence, label))
+_PROVIDER = '''
+import pickle
+
+from paddle_tpu.trainer.PyDataProvider2 import (
+    CacheType, integer_value, integer_value_sequence, provider)
+
+
+def initHook(settings, vocab_size, pad_seq, maxlen, **kwargs):
+    settings.vocab_size = vocab_size
+    settings.input_types = [integer_value_sequence(vocab_size),
+                            integer_value(2)]
+
+
+@provider(init_hook=initHook, cache=CacheType.CACHE_PASS_IN_MEM,
+          should_shuffle=False)
+def process(settings, file):
+    with open(file, "rb") as f:
+        xs, ys = pickle.load(f)
+    for x, y in zip(xs, ys):
+        yield [min(w, settings.vocab_size - 1) for w in x], int(y)
+'''
+
+
+@needs_ref
+def test_reference_rnn_benchmark_config_trains_unedited(tmp_path):
+    shutil.copyfile(REF_RNN, tmp_path / "rnn.py")   # verbatim
+    (tmp_path / "imdb.py").write_text(_IMDB_STUB)
+    (tmp_path / "provider.py").write_text(_PROVIDER)
+    (tmp_path / "train.list").write_text("imdb.pkl\n")
+
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.v2.trainer_cli",
+         "--config=rnn.py",
+         "--config_args=batch_size=16,hidden_size=32,lstm_num=1",
+         "--job=train", "--num_passes=4"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=str(tmp_path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("Pass")]
+    assert len(lines) == 4, r.stdout
+    costs = [float(ln.split("cost=")[1]) for ln in lines]
+    # separable synthetic classes: the unedited benchmark config must learn
+    assert costs[-1] < 0.7 * costs[0], costs
