@@ -200,8 +200,8 @@ def parse_endpoint(endpoint, default_port=None):
     strings (transpiler, master client)."""
     if isinstance(endpoint, (tuple, list)):
         return tuple(endpoint)
-    host, _, port = str(endpoint).rpartition(":")
-    if not host:            # no ':' at all -> whole string is the host
+    host, sep, port = str(endpoint).rpartition(":")
+    if not sep:             # no ':' at all -> whole string is the host
         host, port = port, ""
     if not port.strip():
         if default_port is None:

@@ -35,3 +35,8 @@ def _fresh_programs():
     config_helpers._reset_config()
     np.random.seed(123)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test (wheel builds, big configs)")

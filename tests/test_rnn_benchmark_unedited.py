@@ -71,6 +71,7 @@ def process(settings, file):
 
 
 @needs_ref
+@pytest.mark.slow
 def test_reference_rnn_benchmark_config_trains_unedited(tmp_path):
     shutil.copyfile(REF_RNN, tmp_path / "rnn.py")   # verbatim
     (tmp_path / "imdb.py").write_text(_IMDB_STUB)
