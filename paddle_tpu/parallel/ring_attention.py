@@ -11,9 +11,12 @@ per-step traffic is one K/V block per hop — the standard ring-attention
 recipe (shard_map + collective-permute) rather than an all-gather of the
 full sequence.
 
-API: ``ring_attention(q, k, v, mesh, axis="sp", causal=False)`` with
-[batch, seq, heads, head_dim] inputs sharded on seq; numerics match full
-softmax attention (pinned by tests on the 8-virtual-device mesh).
+API: ``ring_attention(q, k, v, mesh, axis="sp", causal=False,
+batch_axis=None)`` with [batch, seq, heads, head_dim] inputs sharded on
+seq; ``batch_axis`` composes dp×sp (batch rows sharded over a
+data-parallel mesh axis while the ring runs over sp). Numerics match full
+softmax attention (pinned by tests on the 8-virtual-device mesh and the
+dryrun's composed dp×sp training-step equality).
 """
 
 from __future__ import annotations
@@ -54,12 +57,14 @@ def _block_attention(q, k, v, m_prev, l_prev, acc_prev, mask=None):
 
 
 @functools.lru_cache(maxsize=64)
-def _build_ring_fn(mesh, axis, causal):
-    """Compiled ring step, cached per (mesh, axis, causal) so a training
-    loop calling ring_attention every step hits the jit cache instead of
-    retracing (jit keys on the function object)."""
+def _build_ring_fn(mesh, axis, causal, batch_axis=None):
+    """Compiled ring step, cached per (mesh, axis, causal, batch_axis) so a
+    training loop calling ring_attention every step hits the jit cache
+    instead of retracing (jit keys on the function object). ``batch_axis``
+    composes sequence parallelism with data parallelism: batch rows shard
+    over that mesh axis while the ring runs per-dp-slice over ``axis``."""
     sp = mesh.shape[axis]
-    spec = P(None, axis, None, None)
+    spec = P(batch_axis, axis, None, None)
 
     def local(qb, kb, vb):
         rank = lax.axis_index(axis)
@@ -108,14 +113,20 @@ def _build_ring_fn(mesh, axis, causal):
     return jax.jit(fn), NamedSharding(mesh, spec)
 
 
-def ring_attention(q, k, v, mesh, axis="sp", causal=False):
+def ring_attention(q, k, v, mesh, axis="sp", causal=False,
+                   batch_axis=None):
     """Multi-head attention with the SEQUENCE axis sharded over
     ``mesh[axis]``. Inputs [batch, seq, heads, head_dim]; seq must divide
-    the axis size. Returns the attention output with the same sharding."""
+    the axis size. ``batch_axis`` additionally shards batch rows over a
+    data-parallel mesh axis (dp×sp composition). Returns the attention
+    output with the same sharding."""
     sp = mesh.shape[axis]
     seq = q.shape[1]
     assert seq % sp == 0, (seq, sp)
-    fn, sharding = _build_ring_fn(mesh, axis, bool(causal))
+    if batch_axis is not None:
+        assert q.shape[0] % mesh.shape[batch_axis] == 0, \
+            (q.shape[0], mesh.shape[batch_axis])
+    fn, sharding = _build_ring_fn(mesh, axis, bool(causal), batch_axis)
     q = jax.device_put(q, sharding)
     k = jax.device_put(k, sharding)
     v = jax.device_put(v, sharding)
