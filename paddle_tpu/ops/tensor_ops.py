@@ -394,11 +394,14 @@ def logical_not(ctx):
 
 @register_op("top_k")
 def top_k(ctx):
-    x = data_of(ctx.input("X"))
+    xin = ctx.input("X")
+    x = data_of(xin)
     k = ctx.attr("k", 1)
     vals, idx = jax.lax.top_k(x, k)
-    ctx.set_output("Out", vals)
-    ctx.set_output("Indices", idx.astype(jnp.int64))
+    # LoD propagates (reference top_k_op.cc: Out/Indices share X's lod —
+    # the ctc_greedy_decoder path argmaxes ragged logits)
+    ctx.set_output("Out", like(xin, vals))
+    ctx.set_output("Indices", like(xin, idx.astype(jnp.int64)))
 
 
 @register_op("one_hot")

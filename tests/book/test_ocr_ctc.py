@@ -1,0 +1,96 @@
+"""CTC sequence-labeling slice: a DeepSpeech-style model trains to
+convergence through warpctc and decodes with ctc_greedy_decoder.
+
+Reference capability: SURVEY.md §7.7 — "warpctc-equivalent CTC ... gets
+OCR-CTC / DeepSpeech2 configs running" (the reference trains CTC models
+via operators/warpctc_op.cc + ctc_align + edit_distance; model shape per
+the DeepSpeech2 design doc, fc -> recurrent -> fc -> CTC). The book-test
+contract: train until the evaluation metric (normalized edit distance)
+crosses a threshold, then decode and compare sequences.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+NUM_CLASSES = 5          # labels 1..5; 0 is the CTC blank
+FEAT = 12
+HIDDEN = 24
+
+
+def _synth_sample(rng, min_len=3, max_len=6):
+    """Label sequence -> frame sequence: each label emits 2-3 frames of a
+    class-distinct pattern + noise (the CTC alignment problem: more frames
+    than labels, repeated emissions, unknown segmentation)."""
+    n = int(rng.randint(min_len, max_len + 1))
+    labels = rng.randint(1, NUM_CLASSES + 1, n)
+    frames = []
+    for lab in labels:
+        pattern = np.zeros(FEAT, "float32")
+        pattern[2 * (lab - 1):2 * (lab - 1) + 2] = 1.0
+        for _ in range(int(rng.randint(2, 4))):
+            frames.append(pattern + 0.1 * rng.randn(FEAT))
+    return (np.asarray(frames, "float32"),
+            labels.reshape(-1, 1).astype("int64"))
+
+
+def _build():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 4
+    with fluid.program_guard(main, startup):
+        feat = fluid.layers.data("feat", shape=[FEAT], lod_level=1)
+        label = fluid.layers.data("label", shape=[1], dtype="int64",
+                                  lod_level=1)
+        # fc -> GRU -> fc logits: the DeepSpeech2 stack at suite scale
+        proj = fluid.layers.fc(input=feat, size=HIDDEN * 3, act=None)
+        rnn = fluid.layers.dynamic_gru(input=proj, size=HIDDEN)
+        logits = fluid.layers.fc(input=rnn, size=NUM_CLASSES + 1, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.warpctc(input=logits, label=label, blank=0))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss, startup)
+    return main, startup, feat, label, logits, loss
+
+
+def test_ctc_model_converges_and_decodes():
+    main, startup, feat, label, logits, loss = _build()
+    infer = main.clone(for_test=True)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(0)
+    samples = [_synth_sample(rng) for _ in range(48)]
+    feeder = fluid.DataFeeder([feat, label], main)
+
+    first = last = None
+    for epoch in range(60):
+        rng.shuffle(samples)
+        for i in range(0, len(samples), 16):
+            feed = feeder.feed(samples[i:i + 16])
+            v, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+            last = float(np.asarray(v))
+            first = last if first is None else first
+        if last < 0.15:
+            break
+    assert last < 0.5 * first, (first, last)
+
+    # decode a batch and score it with the edit-distance metric op
+    test_batch = samples[:16]
+    eval_prog, eval_start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(eval_prog, eval_start):
+        lg = fluid.layers.data("lg", shape=[NUM_CLASSES + 1], lod_level=1)
+        lb = fluid.layers.data("lb", shape=[1], dtype="int64", lod_level=1)
+        decoded = fluid.layers.ctc_greedy_decoder(input=lg, blank=0)
+        dist = fluid.layers.edit_distance(input=decoded, label=lb,
+                                          normalized=True)
+        dist_var = dist[0] if isinstance(dist, (tuple, list)) else dist
+
+    feed = feeder.feed(test_batch)
+    lg_out, = exe.run(infer, feed=feed, fetch_list=[logits], scope=scope,
+                      return_numpy=False)
+    d, = exe.run(eval_prog, feed={"lg": lg_out, "lb": feed["label"]},
+                 fetch_list=[dist_var], scope=scope)
+    mean_norm_dist = float(np.mean(np.asarray(d)))
+    # trained model: decoded sequences nearly match the labels
+    assert mean_norm_dist < 0.2, mean_norm_dist
