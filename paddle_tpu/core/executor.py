@@ -180,6 +180,11 @@ def _run_ops(block, env, exec_state):
     """Run/trace every op of a block over ``env`` in order. This is both the
     eager interpreter and the function traced by jit."""
     from .flags import get_flag
+    # dispatch-coverage recording happens per-op AFTER each forward below
+    # (an op that raises must not mark the block's remaining ops as
+    # dispatched); no-op lambda when disabled keeps the loops branch-free
+    record = registry.record_dispatch \
+        if registry.dispatch_coverage_enabled() else (lambda t: None)
     if not getattr(exec_state, "_tracing", False) and \
             (get_flag("check_nan_inf") or get_flag("benchmark")):
         # eager-path debug modes: per-op NaN/Inf host sweep (jit covers
@@ -192,6 +197,7 @@ def _run_ops(block, env, exec_state):
             t0 = _time.perf_counter() if bench else 0.0
             info = registry.get_op_info(op.type)
             info.forward(ExecContext(op, block, env, exec_state))
+            record(op.type)
             if check:
                 _check_op_outputs_finite(op, env)
             if bench:
@@ -213,11 +219,13 @@ def _run_ops(block, env, exec_state):
                 info = registry.get_op_info(op.type)
                 ctx = ExecContext(op, block, env, exec_state)
                 info.forward(ctx)
+                record(op.type)
         return
     for op in block.ops:
         info = registry.get_op_info(op.type)
         ctx = ExecContext(op, block, env, exec_state)
         info.forward(ctx)
+        record(op.type)
 
 
 def _collect_free_inputs(program, block_idx):

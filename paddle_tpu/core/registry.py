@@ -89,6 +89,36 @@ def get_op_info(type) -> OpInfo:
     return info
 
 
+# ---------------------------------------------------------------------------
+# runtime dispatch coverage (PDTPU_OP_COVERAGE=/path): op types that reach
+# EXECUTION (the executor's op loop — eager run or jit trace), appended one
+# name per line, merged across processes by append mode. The executor calls
+# record_dispatch at its dispatch sites; recording here in get_op_info would
+# overstate coverage (graph construction and backward graph traversal also
+# look ops up). Audited by tools/op_inventory.py --runtime — "a test file
+# mentions the op" is word-match evidence; "the op dispatched" is proof.
+# ---------------------------------------------------------------------------
+import os as _os
+
+_COVERAGE_PATH = _os.environ.get("PDTPU_OP_COVERAGE")
+_SEEN: set = set()
+
+
+def dispatch_coverage_enabled():
+    return bool(_COVERAGE_PATH)
+
+
+def record_dispatch(type):
+    if type in _SEEN:
+        return
+    try:
+        with open(_COVERAGE_PATH, "a") as f:
+            f.write(type + "\n")
+    except OSError:
+        return  # retried on the next dispatch: _SEEN only after success
+    _SEEN.add(type)
+
+
 def has_op(type) -> bool:
     return type in _REGISTRY
 

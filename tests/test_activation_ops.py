@@ -37,6 +37,11 @@ CASES = {
                                       np.where(x < -0.5, x + 0.5, 0.0)),
                    (-2, 2)),
     "thresholded_relu": (lambda x: np.where(x > 1.0, x, 0.0), (-2, 3)),
+    # round-5 runtime-dispatch audit: these three registered grads never
+    # executed (reference TestBRelu/TestSTanh/TestHardSigmoid, default attrs)
+    "brelu": (lambda x: np.clip(x, 0.0, 24.0), (-4, 30)),
+    "stanh": (lambda x: 1.7159 * np.tanh(0.67 * x), (-2, 2)),
+    "hard_sigmoid": (lambda x: np.clip(0.2 * x + 0.5, 0.0, 1.0), (-4, 4)),
 }
 
 GRAD_SKIP = {"ceil", "floor", "round"}  # zero-gradient ops
@@ -45,7 +50,8 @@ GRAD_SKIP = {"ceil", "floor", "round"}  # zero-gradient ops
 # finite-difference grad check (reference op_tests do the same via x[...]= )
 KINKS = {"abs": [0.0], "relu": [0.0], "relu6": [0.0, 6.0],
          "hard_shrink": [-0.5, 0.5], "softshrink": [-0.5, 0.5],
-         "thresholded_relu": [1.0]}
+         "thresholded_relu": [1.0], "brelu": [0.0, 24.0],
+         "hard_sigmoid": [-2.5, 2.5]}
 
 
 def _nudge(x, op_name, margin=0.05):

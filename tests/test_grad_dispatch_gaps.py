@@ -1,0 +1,275 @@
+"""Close the runtime-dispatch audit gaps: ops registered and word-matched
+but never actually executed by the suite.
+
+Found by `PDTPU_OP_COVERAGE=... pytest` + `tools/op_inventory.py --runtime`
+(round 5): 5 forward ops and 25 grad ops never dispatched. Reference: the
+per-op unittests exercise forward AND backward for every one
+(python/paddle/fluid/tests/unittests/test_*_op.py check_grad).
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from op_test import OpTest
+
+
+def _t(op_type, inputs, outputs, attrs=None):
+    t = OpTest()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.outputs = outputs
+    t.attrs = attrs or {}
+    return t
+
+
+# ---------------------------------------------------------------------------
+# never-dispatched FORWARD ops
+# ---------------------------------------------------------------------------
+
+def test_argmax_op():
+    x = np.random.RandomState(0).randn(3, 5).astype("float32")
+    _t("argmax", {"X": x}, {"Out": np.argmax(x, axis=-1)}).check_output()
+
+
+def test_equal_op():
+    x = np.array([[1, 2], [3, 4]], "float32")
+    y = np.array([[1, 0], [3, 9]], "float32")
+    _t("equal", {"X": x, "Y": y}, {"Out": x == y}).check_output()
+
+
+def test_fill_constant_batch_size_like_op():
+    ref = np.zeros((7, 3), "float32")
+    t = _t("fill_constant_batch_size_like", {"Input": ref},
+           {"Out": np.full((7, 5), 2.5, "float32")},
+           {"shape": [-1, 5], "value": 2.5, "input_dim_idx": 0,
+            "output_dim_idx": 0, "dtype": "float32"})
+    t.check_output()
+
+
+def test_scatter_op():
+    rng = np.random.RandomState(1)
+    x = rng.randn(5, 4).astype("float32")
+    ids = np.array([1, 3], "int64")
+    upd = rng.randn(2, 4).astype("float32")
+    want = x.copy()
+    want[ids] = upd
+    _t("scatter", {"X": x, "Ids": ids, "Updates": upd},
+       {"Out": want}).check_output()
+
+
+def test_shape_op():
+    x = np.zeros((3, 4, 2), "float32")
+    _t("shape", {"Input": x}, {"Out": np.array([3, 4, 2])}).check_output()
+
+
+# ---------------------------------------------------------------------------
+# never-dispatched GRAD ops — check_grad drives forward + backward and
+# compares against central finite differences (the reference contract)
+# ---------------------------------------------------------------------------
+
+def test_bilinear_tensor_product_grad():
+    rng = np.random.RandomState(2)
+    x = rng.uniform(-1, 1, (3, 4)).astype("float32")
+    y = rng.uniform(-1, 1, (3, 5)).astype("float32")
+    w = rng.uniform(-1, 1, (2, 4, 5)).astype("float32")
+    b = rng.uniform(-1, 1, (1, 2)).astype("float32")
+    out = np.einsum("bi,kij,bj->bk", x, w, y) + b
+    t = _t("bilinear_tensor_product",
+           {"X": x, "Y": y, "Weight": w, "Bias": b}, {"Out": out})
+    t.check_output()
+    t.check_grad(["X", "Y", "Weight"], "Out", max_relative_error=0.03)
+
+
+def test_conv3d_grad():
+    rng = np.random.RandomState(3)
+    x = rng.uniform(-1, 1, (1, 2, 3, 4, 4)).astype("float32")
+    w = rng.uniform(-0.5, 0.5, (3, 2, 2, 2, 2)).astype("float32")
+    t = _t("conv3d", {"Input": x, "Filter": w},
+           {"Output": np.zeros((1, 3, 2, 3, 3), "float32")},
+           {"strides": [1, 1, 1], "paddings": [0, 0, 0]})
+    t.check_grad(["Input", "Filter"], "Output", max_relative_error=0.03)
+
+
+def test_depthwise_conv2d_grad():
+    rng = np.random.RandomState(4)
+    x = rng.uniform(-1, 1, (1, 3, 5, 5)).astype("float32")
+    w = rng.uniform(-0.5, 0.5, (3, 1, 3, 3)).astype("float32")
+    t = _t("depthwise_conv2d", {"Input": x, "Filter": w},
+           {"Output": np.zeros((1, 3, 3, 3), "float32")},
+           {"strides": [1, 1], "paddings": [0, 0], "groups": 3})
+    t.check_grad(["Input", "Filter"], "Output", max_relative_error=0.03)
+
+
+def test_pool3d_grad_avg_and_max():
+    rng = np.random.RandomState(5)
+    # distinct values keep the max-pool argmax stable under FD nudges
+    x = (np.arange(2 * 4 * 4 * 4).reshape(1, 2, 4, 4, 4) * 0.01
+         + rng.uniform(0, 0.001, (1, 2, 4, 4, 4))).astype("float32")
+    for ptype in ("avg", "max"):
+        t = _t("pool3d", {"X": x},
+               {"Out": np.zeros((1, 2, 2, 2, 2), "float32")},
+               {"pooling_type": ptype, "ksize": [2, 2, 2],
+                "strides": [2, 2, 2], "paddings": [0, 0, 0]})
+        t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+def test_maxout_grad():
+    rng = np.random.RandomState(6)
+    x = rng.permutation(4 * 4 * 9).reshape(4, 4, 3, 3).astype("float32")
+    x = x * 0.05
+    t = _t("maxout", {"X": x}, {"Out": np.zeros((4, 2, 3, 3), "float32")},
+           {"groups": 2})
+    t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+def test_spp_grad():
+    rng = np.random.RandomState(7)
+    x = rng.uniform(-1, 1, (1, 2, 4, 4)).astype("float32")
+    t = _t("spp", {"X": x}, {"Out": np.zeros((1, 2 * 5), "float32")},
+           {"pyramid_height": 2, "pooling_type": "avg"})
+    t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+def test_unpool_grad():
+    rng = np.random.RandomState(8)
+    x = rng.uniform(0.5, 1.5, (1, 1, 2, 2)).astype("float32")
+    # distinct argmax positions inside the 4x4 plane
+    idx = np.array([[[[0, 6], [9, 15]]]], "int64")
+    t = _t("unpool", {"X": x, "Indices": idx},
+           {"Out": np.zeros((1, 1, 4, 4), "float32")},
+           {"unpooled_size": [4, 4]})
+    t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+def test_im2sequence_grad():
+    rng = np.random.RandomState(9)
+    x = rng.uniform(-1, 1, (2, 1, 4, 4)).astype("float32")
+    out_dummy = (np.zeros((8, 4), "float32"), [[0, 4, 8]])
+    t = _t("im2sequence", {"X": x}, {"Out": out_dummy},
+           {"kernels": [2, 2], "strides": [2, 2]})
+    t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+def test_norm_grad():
+    rng = np.random.RandomState(10)
+    x = rng.uniform(0.5, 1.5, (2, 3, 2, 2)).astype("float32")
+    scale = rng.uniform(0.5, 1.5, (3,)).astype("float32")
+    t = _t("norm", {"X": x, "Scale": scale},
+           {"Out": np.zeros_like(x)}, {"epsilon": 1e-6})
+    t.check_grad(["X", "Scale"], "Out", max_relative_error=0.03)
+
+
+def test_elementwise_max_grad():
+    rng = np.random.RandomState(11)
+    x = rng.uniform(-1, 1, (4, 5)).astype("float32")
+    y = x + np.where(rng.rand(4, 5) > 0.5, 0.5, -0.5).astype("float32")
+    t = _t("elementwise_max", {"X": x, "Y": y},
+           {"Out": np.maximum(x, y)})
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.03)
+
+
+def test_elementwise_pow_grad():
+    rng = np.random.RandomState(12)
+    x = rng.uniform(0.5, 2.0, (3, 4)).astype("float32")
+    y = rng.uniform(1.0, 2.0, (3, 4)).astype("float32")
+    t = _t("elementwise_pow", {"X": x, "Y": y}, {"Out": x ** y})
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.03)
+
+
+def test_gather_grad():
+    rng = np.random.RandomState(13)
+    x = rng.uniform(-1, 1, (6, 3)).astype("float32")
+    idx = np.array([0, 2, 4], "int64")
+    t = _t("gather", {"X": x, "Index": idx}, {"Out": x[idx]})
+    t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+def test_huber_loss_grad():
+    rng = np.random.RandomState(14)
+    delta = 0.5
+    x = rng.uniform(0, 1, (8, 1)).astype("float32")
+    # keep |residual| away from the delta kink
+    r = np.where(rng.rand(8, 1) > 0.5, 0.2, 0.9).astype("float32")
+    y = x + r
+    loss = np.where(np.abs(r) <= delta, 0.5 * r * r,
+                    delta * (np.abs(r) - 0.5 * delta))
+    t = _t("huber_loss", {"X": x, "Y": y},
+           {"Residual": r, "Out": loss}, {"delta": delta})
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.03)
+
+
+def test_margin_rank_loss_grad():
+    rng = np.random.RandomState(15)
+    margin = 0.1
+    x1 = rng.uniform(-1, 1, (6, 1)).astype("float32")
+    # keep -label*(x1-x2)+margin away from the hinge point
+    x2 = x1 + np.where(rng.rand(6, 1) > 0.5, 0.5, -0.5).astype("float32")
+    label = np.where(rng.rand(6, 1) > 0.5, 1.0, -1.0).astype("float32")
+    out = np.maximum(0.0, -label * (x1 - x2) + margin)
+    t = _t("margin_rank_loss", {"Label": label, "X1": x1, "X2": x2},
+           {"Out": out}, {"margin": margin})
+    t.check_grad(["X1", "X2"], "Out", max_relative_error=0.03)
+
+
+def test_reduce_max_min_grad():
+    rng = np.random.RandomState(16)
+    x = (rng.permutation(12).reshape(3, 4) * 0.1).astype("float32")
+    for op, fn in (("reduce_max", np.max), ("reduce_min", np.min)):
+        t = _t(op, {"X": x}, {"Out": fn(x, axis=1)},
+               {"dim": 1, "keep_dim": False, "reduce_all": False})
+        t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+def test_sequence_reshape_grad():
+    rng = np.random.RandomState(17)
+    x = rng.uniform(0.1, 1, (6, 4)).astype("float32")
+    t = _t("sequence_reshape", {"X": (x, [[0, 2, 6]])},
+           {"Out": (x.reshape(-1, 2), [[0, 4, 12]])}, {"new_dim": 2})
+    t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+def test_sequence_slice_grad():
+    rng = np.random.RandomState(18)
+    x = rng.uniform(0.1, 1, (10, 2)).astype("float32")
+    offset = np.array([[1], [2]], "int64")
+    length = np.array([[2], [3]], "int64")
+    out = np.concatenate([x[1:3], x[6:9]])
+    t = _t("sequence_slice",
+           {"X": (x, [[0, 4, 10]]), "Offset": offset, "Length": length},
+           {"Out": (out, [[0, 2, 5]])})
+    t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+def test_read_from_array_grad():
+    """Array read participates in backward: write x to a tensor array,
+    read it back, take a loss — dX must be exactly 1/numel."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        arr = fluid.layers.array_write(x, i)
+        back = fluid.layers.array_read(arr, i)
+        loss = fluid.layers.mean(back)
+        fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.random.RandomState(19).randn(3, 4).astype("float32")
+    g, = exe.run(main, feed={"x": xv}, fetch_list=["x@GRAD"])
+    np.testing.assert_allclose(np.asarray(g),
+                               np.full_like(xv, 1.0 / xv.size), rtol=1e-6)
+
+
+def test_ceil_floor_round_zero_grads_dispatch():
+    """The zero-gradient activations still register grad ops; backward must
+    DISPATCH them and produce exact zeros (reference registers
+    ZeroGradFunctor kernels for these)."""
+    for op_name in ("ceil", "floor", "round"):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[5])
+            y = getattr(fluid.layers, op_name)(x)
+            loss = fluid.layers.mean(y)
+            fluid.append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+        xv = np.random.RandomState(20).randn(2, 5).astype("float32") + 0.3
+        g, = exe.run(main, feed={"x": xv}, fetch_list=["x@GRAD"])
+        assert np.all(np.asarray(g) == 0.0), op_name

@@ -53,6 +53,10 @@ def reference_ops(root):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--reference", default="/root/reference")
+    ap.add_argument("--runtime", default=None, metavar="COVERAGE_FILE",
+                    help="a PDTPU_OP_COVERAGE dispatch log from a suite "
+                         "run: additionally report registered ops that "
+                         "NEVER DISPATCHED (stronger than word-match)")
     args = ap.parse_args()
 
     import jax
@@ -99,6 +103,26 @@ def main():
     if undocumented:
         print(f"ERROR: undocumented missing ops: {undocumented}")
         rc = 1
+
+    if args.runtime:
+        with open(args.runtime) as f:
+            dispatched = {ln.strip() for ln in f if ln.strip()}
+        all_registered = set(registered_ops())
+        never_fwd = sorted(o for o in all_registered
+                           if not o.endswith("_grad")
+                           and o not in dispatched)
+        never_grad = sorted(o for o in all_registered
+                            if o.endswith("_grad") and o not in dispatched)
+        print(f"runtime dispatch    : {len(dispatched & all_registered)}"
+              f"/{len(all_registered)} registered ops dispatched")
+        print(f"never-dispatched fwd : {len(never_fwd)}")
+        for n in never_fwd:
+            print(f"  NEVER-RUN {n}")
+        print(f"never-dispatched grad: {len(never_grad)}")
+        for n in never_grad:
+            print(f"  NEVER-RUN {n}")
+        if never_fwd or never_grad:
+            rc = 1
     return rc
 
 
