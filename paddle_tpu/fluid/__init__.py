@@ -33,7 +33,13 @@ from .backward import append_backward
 from .param_attr import ParamAttr
 from .data_feeder import DataFeeder
 from .memory_optimization_transpiler import memory_optimize, release_memory
-from .distribute_transpiler import DistributeTranspiler
+from .distribute_transpiler import (DistributeTranspiler,
+                                    SimpleDistributeTranspiler)
+from .param_attr import WeightNormParamAttr
+from . import average
+from . import recordio_writer
+from ..core import executor
+from ..core.lod import LoDArray as LoDTensor  # reference core.LoDTensor
 
 # CUDAPlace alias: reference scripts say CUDAPlace(0); on this framework that
 # means "the accelerator", i.e. the TPU chip.
@@ -48,5 +54,7 @@ __all__ = [
     "set_flags", "get_flag", "flags", "init_flags", "evaluator",
     "concurrency", "Go", "Select", "make_channel", "channel_send",
     "channel_recv", "channel_close", "memory_optimize", "release_memory",
-    "DistributeTranspiler",
+    "DistributeTranspiler", "SimpleDistributeTranspiler",
+    "WeightNormParamAttr", "average", "recordio_writer", "executor",
+    "LoDTensor",
 ]

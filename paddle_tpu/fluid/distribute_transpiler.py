@@ -20,7 +20,8 @@ bounded-staleness when ``transpile(..., max_staleness=k)`` is set.
 
 from __future__ import annotations
 
-__all__ = ["DistributeTranspiler", "PServerProgram"]
+__all__ = ["DistributeTranspiler", "SimpleDistributeTranspiler",
+           "PServerProgram"]
 
 # optimize-op type -> how to lift its rule onto the server
 # (distributed/param_server.py OPTIMIZERS carries the same three rules the
@@ -210,3 +211,12 @@ class DistributeTranspiler:
         return ParamClient([parse_endpoint(e) for e in self.endpoints],
                            trainer_id=self.trainer_id,
                            param_names=[p for p, _ in self.params_grads])
+
+
+class SimpleDistributeTranspiler(DistributeTranspiler):
+    """Reference distribute_transpiler_simple.py: whole-parameter placement
+    with no block splitting. This framework's transpiler already places
+    whole parameters (round-robin over endpoints; the reference's 1 KiB /
+    1 MiB block splitting served gRPC message sizing, which the host-RPC
+    backend does not need), so the simple variant IS the base behavior —
+    the class exists for the reference API spelling."""

@@ -34,3 +34,19 @@ class ParamAttr:
             a.trainable = arg
             return a
         raise TypeError(f"cannot convert {arg!r} to ParamAttr")
+
+
+class WeightNormParamAttr(ParamAttr):
+    """Weight-normalization reparameterization (reference param_attr.py:90 +
+    layer_helper.py _create_weight_normalize): the layer's weight becomes
+    w = g * v / ||v||, with direction ``v`` and magnitude ``g`` the trainable
+    parameters. ``dim``: the output dimension KEPT by the norm (None
+    normalizes over the whole tensor); ``g`` is stored keep-dim shaped so
+    the w-recompute ops broadcast without reshapes. The reference's
+    ``params_with_weight_norm`` registry (for inference serialization) is
+    unnecessary here: v and g ARE the persistable params, w is an ordinary
+    recomputed temporary, so save/load needs no special-casing."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim

@@ -1,0 +1,34 @@
+"""Convert a reader into RecordIO files (reference python/paddle/fluid/
+recordio_writer.py convert_reader_to_recordio_file :30 over the C++
+RecordIOWriter). Records are pickled feed dicts (one per batch) — the
+framework's recordio format (`paddle_tpu.recordio`) with the same
+chunk/compress layout as the reference's."""
+
+from __future__ import annotations
+
+import pickle
+
+from ..recordio import Writer
+
+__all__ = ["convert_reader_to_recordio_file"]
+
+
+def convert_reader_to_recordio_file(filename, reader_creator, feeder=None,
+                                    compressor="deflate",
+                                    max_num_records=1000, feed_order=None):
+    """Each batch from ``reader_creator`` becomes one record: the feeder's
+    feed dict (ordered by ``feed_order``) pickled. Without a feeder, raw
+    batches are pickled. Returns the record count."""
+    counter = 0
+    with Writer(filename, compressor=compressor,
+                max_records=max_num_records) as writer:
+        for batch in reader_creator():
+            if feeder is not None:
+                res = feeder.feed(batch)
+                order = feed_order or [v.name for v in feeder.feed_vars]
+                payload = {name: res[name] for name in order}
+            else:
+                payload = batch
+            writer.write(pickle.dumps(payload))
+            counter += 1
+    return counter
