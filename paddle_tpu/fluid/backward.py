@@ -87,8 +87,16 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
     for i in reversed(sorted(path)):
         op = block.ops[i]
         info = registry.get_op_info(op.type)
+        outs = op.output_arg_names()
+        # ops whose EVERY output is an explicit stop_gradient var are
+        # pruned outright (the reference's no-grad-set pruning,
+        # backward.py _find_no_grad_vars): their upstream chain — e.g. the
+        # ssd_loss mining-weight path — must not demand grad makers
+        if outs and all(n in stop and not _is_param(block, n)
+                        for n in outs):
+            continue
         # skip if none of this op's outputs have a live upstream gradient
-        out_grads = [grad_var_name(n) for n in op.output_arg_names()]
+        out_grads = [grad_var_name(n) for n in outs]
         if not any(g in produced for g in out_grads):
             continue
         if info.grad is None:

@@ -10,7 +10,8 @@ from . import detection
 from . import metric
 from .detection import (prior_box, iou_similarity, box_coder,  # noqa: F401
                         bipartite_match, target_assign, mine_hard_examples,
-                        multiclass_nms, detection_output, roi_pool)
+                        multiclass_nms, detection_output, roi_pool,
+                        ssd_loss, multi_box_head, detection_map)
 from .metric import auc, precision_recall, chunk_eval  # noqa: F401
 from . import learning_rate_scheduler
 from .learning_rate_scheduler import (noam_decay, exponential_decay,  # noqa: F401
@@ -18,12 +19,15 @@ from .learning_rate_scheduler import (noam_decay, exponential_decay,  # noqa: F4
                                       polynomial_decay, piecewise_decay,
                                       autoincreased_step_counter)
 from .control_flow import (While, Switch, StaticRNN, DynamicRNN,  # noqa: F401
-                           increment, less_than, create_array, array_write,
-                           array_read, array_length, beam_search,
-                           beam_search_decode, batch_gather, Print, IfElse)
+                           increment, less_than, equal, create_array,
+                           array_write, array_read, array_length,
+                           beam_search, beam_search_decode, batch_gather,
+                           Print, IfElse)
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
-from .io import data  # noqa: F401
+from .io import (data, open_recordio_file, open_files,  # noqa: F401
+                 create_shuffle_reader, create_double_buffer_reader,
+                 create_multi_pass_reader, read_file)
 from .ops import *  # noqa: F401,F403
 from .sequence import (dynamic_lstm, dynamic_gru,  # noqa: F401
                        dynamic_lstmp, dynamic_vanilla_rnn, sequence_conv,
@@ -41,3 +45,29 @@ from .nn import (fc, embedding, dropout, softmax, cross_entropy,  # noqa: F401
 from .tensor import (cast, concat, sums, assign, fill_constant,  # noqa: F401
                      fill_constant_batch_size_like, ones, zeros, reshape,
                      transpose, split, argmax, create_tensor)
+
+
+sum = tensor.sums  # reference layers.ops re-exports the sum-op spelling
+
+
+def get_places(device_count=0, device_type="AUTO"):
+    """Reference layers/device.py get_places: a var holding the device list
+    (the parallel_do fan-out input; here informational — SPMD sharding owns
+    device fan-out, README recorded decision)."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("get_places")
+    out = helper.create_global_variable(shape=(1,), dtype="int64",
+                                        persistable=False)
+    helper.append_op("get_places", outputs={"Out": [out.name]},
+                     attrs={"device_count": device_count,
+                            "device_type": device_type})
+    return out
+
+
+def monkey_patch_variable():
+    """Reference layers/math_op_patch.py — installs +,-,*,/ operators on
+    Variable. Here the operators are built into Variable itself
+    (framework.py _binary); the function exists for API parity and is a
+    no-op."""
+    return None

@@ -557,3 +557,17 @@ class IfElse:
                              outputs={"Out": [out.name]})
             merged.append(out)
         return merged
+
+
+def equal(x, y, cond=None):
+    """layers/control_flow.py equal — elementwise x == y (bool), usable as a
+    While condition."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("equal")
+    if cond is None:
+        cond = helper.create_tmp_variable("bool", shape=x.shape,
+                                          stop_gradient=True)
+    helper.append_op("equal", inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [cond.name]})
+    return cond

@@ -67,3 +67,109 @@ for _t in _REDUCE_OPS:
     setattr(_mod, _t, _make_reduce(_t))
 
 __all__ = _UNARY_OPS + _REDUCE_OPS
+
+
+# ---------------------------------------------------------------------------
+# explicit-signature op layers the reference exposes via layers.ops
+# (clip/clip_by_norm/logicals/randoms/scatter; reference layers/ops.py
+# __all__ + layer_function_generator)
+# ---------------------------------------------------------------------------
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", name=name)
+    out = helper.create_tmp_variable(x.dtype, shape=x.shape,
+                                     lod_level=x.lod_level)
+    helper.append_op("clip", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"min": float(min), "max": float(max)})
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", name=name)
+    out = helper.create_tmp_variable(x.dtype, shape=x.shape)
+    helper.append_op("clip_by_norm", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"max_norm": float(max_norm)})
+    return out
+
+
+def _make_logical(op_type, binary=True):
+    def layer(x, y=None, out=None, name=None):
+        helper = LayerHelper(op_type, name=name)
+        if out is None:
+            out = helper.create_tmp_variable("bool", shape=x.shape,
+                                             stop_gradient=True)
+        inputs = {"X": [x.name]}
+        if binary:
+            inputs["Y"] = [y.name]
+        helper.append_op(op_type, inputs=inputs,
+                         outputs={"Out": [out.name]})
+        return out
+    layer.__name__ = op_type
+    return layer
+
+
+logical_and = _make_logical("logical_and")
+logical_or = _make_logical("logical_or")
+logical_xor = _make_logical("logical_xor")
+logical_not = _make_logical("logical_not", binary=False)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random")
+    out = helper.create_tmp_variable(dtype, shape=tuple(shape),
+                                     stop_gradient=True)
+    helper.append_op("uniform_random", outputs={"Out": [out.name]},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "min": float(min), "max": float(max),
+                            "seed": int(seed)})
+    return out
+
+
+def gaussian_random(shape, dtype="float32", mean=0.0, std=1.0, seed=0):
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_tmp_variable(dtype, shape=tuple(shape),
+                                     stop_gradient=True)
+    helper.append_op("gaussian_random", outputs={"Out": [out.name]},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "mean": float(mean), "std": float(std),
+                            "seed": int(seed)})
+    return out
+
+
+def _make_random_batch_size_like(op_type):
+    def layer(input, shape, dtype="float32", input_dim_idx=0,
+              output_dim_idx=0, **attrs):
+        helper = LayerHelper(op_type)
+        out = helper.create_tmp_variable(dtype, stop_gradient=True)
+        helper.append_op(op_type, inputs={"Input": [input.name]},
+                         outputs={"Out": [out.name]},
+                         attrs={"shape": list(shape), "dtype": dtype,
+                                "input_dim_idx": input_dim_idx,
+                                "output_dim_idx": output_dim_idx, **attrs})
+        return out
+    layer.__name__ = op_type
+    return layer
+
+
+uniform_random_batch_size_like = _make_random_batch_size_like(
+    "uniform_random_batch_size_like")
+gaussian_random_batch_size_like = _make_random_batch_size_like(
+    "gaussian_random_batch_size_like")
+
+
+def scatter(input, index, updates, name=None):
+    helper = LayerHelper("scatter", name=name)
+    out = helper.create_tmp_variable(input.dtype, shape=input.shape)
+    helper.append_op("scatter",
+                     inputs={"X": [input.name], "Ids": [index.name],
+                             "Updates": [updates.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+__all__ += ["clip", "clip_by_norm", "logical_and", "logical_or",
+            "logical_xor", "logical_not", "uniform_random",
+            "gaussian_random", "uniform_random_batch_size_like",
+            "gaussian_random_batch_size_like", "scatter"]

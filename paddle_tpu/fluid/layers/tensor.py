@@ -107,7 +107,11 @@ def zeros(shape, dtype):
 def reshape(x, shape, act=None):
     helper = LayerHelper("reshape", act=act)
     known = [s if s != 0 else x.shape[i] for i, s in enumerate(shape)]
-    if -1 in known and x.shape is not None:
+    # infer the -1 dim only when every input dim is static; with a dynamic
+    # batch (-1/None in x.shape) the -1 stays symbolic in the declared shape
+    # (the op resolves it from the runtime shape)
+    if -1 in known and x.shape is not None and \
+            all(s is not None and s > 0 for s in x.shape):
         total = 1
         for s in x.shape:
             total *= s
@@ -163,3 +167,34 @@ def argmax(x, axis=-1):
     helper.append_op("argmax", inputs={"X": [x.name]},
                      outputs={"Out": [out.name]}, attrs={"axis": axis})
     return out
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """layers/tensor.py:44 — a standalone trainable parameter outside any
+    layer (used for custom weights)."""
+    from ..param_attr import ParamAttr
+
+    helper = LayerHelper("create_parameter")
+    attr = ParamAttr.to_attr(attr)
+    if name is not None and attr.name is None:
+        attr.name = name
+    return helper.create_parameter(attr, shape, dtype, is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """layers/tensor.py create_global_var — a filled global variable."""
+    helper = LayerHelper("create_global_var")
+    var = helper.create_global_variable(shape=tuple(shape), dtype=dtype,
+                                        persistable=persistable, name=name)
+    helper.append_op("fill_constant", outputs={"Out": [var.name]},
+                     attrs={"shape": list(shape), "value": float(value),
+                            "dtype": dtype, "force_cpu": force_cpu})
+    return var
+
+
+# (the reference's layers.sum spelling is aliased to sums in
+# layers/__init__.py — assigning `sum` here would shadow the builtin for
+# this module's own helpers)

@@ -149,13 +149,30 @@ def box_coder(ctx):
     encode: T [N,4] targets x P [M,4] priors -> [N,M,4] offsets;
     decode: T [N,M,4] offsets + priors -> [N,M,4] corner boxes."""
     prior = data_of(ctx.input("PriorBox"))
-    pvar = data_of(ctx.input("PriorBoxVar"))
+    pvar = data_of(ctx.input("PriorBoxVar")) \
+        if ctx.has_input("PriorBoxVar") \
+        else jnp.ones((prior.shape[0], 4), jnp.float32)
     tv = ctx.input("TargetBox")
     target = data_of(tv)
     code_type = ctx.attr("code_type", "encode_center_size")
     pcx, pcy, pw, ph = _center_size(prior)            # [M]
 
     if code_type == "encode_center_size":
+        if target.ndim == 3:
+            # aligned encode (ssd_loss): target [b, M, 4] already gathered
+            # per prior -> elementwise offsets [b, M, 4] (the later
+            # reference's axis=0 box_coder semantics)
+            tcx, tcy, tw, th = _center_size(target)   # [b, M]
+            out = jnp.stack([
+                (tcx - pcx[None, :]) / pw[None, :] / pvar[None, :, 0],
+                (tcy - pcy[None, :]) / ph[None, :] / pvar[None, :, 1],
+                jnp.log(jnp.maximum(jnp.abs(tw / pw[None, :]), 1e-10))
+                / pvar[None, :, 2],
+                jnp.log(jnp.maximum(jnp.abs(th / ph[None, :]), 1e-10))
+                / pvar[None, :, 3],
+            ], axis=-1)
+            ctx.set_output("OutputBox", out)
+            return
         tcx, tcy, tw, th = _center_size(target)       # [N]
         out = jnp.stack([
             (tcx[:, None] - pcx[None, :]) / pw[None, :] / pvar[None, :, 0],

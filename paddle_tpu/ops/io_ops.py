@@ -162,6 +162,59 @@ def lod_array_length(ctx):
     ctx.set_output("Out", arr.length.astype(jnp.int64).reshape((1,)))
 
 
+# ---------------------------------------------------------------------------
+# reader creation/decoration ops (reference operators/reader/: the startup
+# program builds the reader chain into a persistable READER var; runtime
+# values are reader-creator CALLABLES from paddle_tpu.reader, promoted to
+# live iterators by the read op at first pop)
+# ---------------------------------------------------------------------------
+
+@register_op("create_recordio_file_reader")
+def create_recordio_file_reader(ctx):
+    """create_recordio_file_reader_op.cc / open_files: a creator over one or
+    more recordio files; dict records (fluid.recordio_writer batches) become
+    slot tuples in insertion (feed) order, tuple records pass through."""
+    from ..reader import creator as reader_creator
+
+    paths = list(ctx.attr("filenames"))
+
+    def _as_tuple(rec):
+        if isinstance(rec, dict):
+            return tuple(rec.values())
+        return rec
+
+    def make():
+        base = reader_creator.recordio(paths)
+        return (_as_tuple(r) for r in base())
+
+    ctx.set_output("Out", make)
+
+
+@register_op("create_shuffle_reader")
+def create_shuffle_reader_op(ctx):
+    from ..reader.decorator import shuffle
+    ctx.set_output("Out", shuffle(ctx.input("UnderlyingReader"),
+                                  int(ctx.attr("buffer_size", 1024))))
+
+
+@register_op("create_double_buffer_reader")
+def create_double_buffer_reader_op(ctx):
+    from ..reader.prefetch import double_buffer
+    ctx.set_output("Out", double_buffer(ctx.input("UnderlyingReader")))
+
+
+@register_op("create_multi_pass_reader")
+def create_multi_pass_reader_op(ctx):
+    underlying = ctx.input("UnderlyingReader")
+    pass_num = int(ctx.attr("pass_num", 1))
+
+    def make():
+        for _ in range(pass_num):
+            yield from underlying()
+
+    ctx.set_output("Out", make)
+
+
 @register_op("read")
 def read(ctx):
     """read_op.cc: pop the next sample batch from a READER variable (here a

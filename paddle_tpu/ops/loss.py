@@ -163,16 +163,27 @@ def squared_l2_distance_grad(ctx):
 
 @register_op("smooth_l1_loss", grad=lambda op: [OpSpec(
     "smooth_l1_loss_grad",
-    {"Diff": op.output("Diff"), "Out@GRAD": G(op.output("Out"))},
+    {"Diff": op.output("Diff"), "Out@GRAD": G(op.output("Out")),
+     **({"InsideWeight": op.input("InsideWeight")}
+        if op.input("InsideWeight") else {}),
+     **({"OutsideWeight": op.input("OutsideWeight")}
+        if op.input("OutsideWeight") else {})},
     {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
 def smooth_l1_loss(ctx):
+    """smooth_l1_loss_op.h: InsideWeight gates the diff, OutsideWeight
+    scales the per-element loss before the row sum (the SSD positive
+    mask)."""
     x = data_of(ctx.input("X"))
     y = data_of(ctx.input("Y"))
     sigma2 = ctx.attr("sigma", 1.0) ** 2
     diff = x - y
+    if ctx.has_input("InsideWeight"):
+        diff = diff * data_of(ctx.input("InsideWeight"))
     ad = jnp.abs(diff)
     val = jnp.where(ad < 1.0 / sigma2, 0.5 * sigma2 * diff * diff,
                     ad - 0.5 / sigma2)
+    if ctx.has_input("OutsideWeight"):
+        val = val * data_of(ctx.input("OutsideWeight"))
     ctx.set_output("Diff", diff)
     ctx.set_output("Out", jnp.sum(val, axis=tuple(range(1, x.ndim)),
                                   keepdims=False).reshape(-1, 1))
@@ -184,6 +195,10 @@ def smooth_l1_loss_grad(ctx):
     d = data_of(ctx.input("Out@GRAD")).reshape((-1,) + (1,) * (diff.ndim - 1))
     sigma2 = ctx.attr("sigma", 1.0) ** 2
     g = jnp.where(jnp.abs(diff) < 1.0 / sigma2, sigma2 * diff, jnp.sign(diff))
+    if ctx.has_input("OutsideWeight"):
+        g = g * data_of(ctx.input("OutsideWeight"))
+    if ctx.has_input("InsideWeight"):
+        g = g * data_of(ctx.input("InsideWeight"))
     ctx.set_output("X@GRAD", d * g)
 
 

@@ -1,0 +1,125 @@
+"""SSD composites: multi_box_head + ssd_loss + detection_map layer.
+
+Reference: python/paddle/fluid/layers/detection.py (multi_box_head :568,
+ssd_loss :350, detection_map :157) — the SSD training pipeline the
+reference book-era models use, composed from the detection op family.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def _ssd_program(num_classes=3, priors=None):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 12
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 32, 32])
+        feat = fluid.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                                   stride=4, padding=1, act="relu")
+        locs, confs, box, var = layers.multi_box_head(
+            inputs=[feat], image=img, base_size=32, num_classes=num_classes,
+            aspect_ratios=[[2.0]], min_sizes=[8.0], max_sizes=[16.0],
+            flip=True, clip=True)
+        gt_box = fluid.layers.data("gt_box", shape=[4], lod_level=1)
+        gt_label = fluid.layers.data("gt_label", shape=[1], dtype="int64",
+                                     lod_level=1)
+        loss_rows = layers.ssd_loss(locs, confs, gt_box, gt_label, box, var)
+        loss = fluid.layers.reduce_sum(loss_rows)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss, startup)
+    return main, startup, (img, gt_box, gt_label), (locs, confs, box, loss)
+
+
+def test_multi_box_head_shapes():
+    main, startup, _, (locs, confs, box, _loss) = _ssd_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    feeder_img = np.random.RandomState(0).rand(2, 3, 32, 32).astype(
+        "float32")
+    gt = [(np.array([[0.1, 0.1, 0.4, 0.4]], "float32"),
+           np.array([[1]], "int64")) for _ in range(2)]
+    feeder = fluid.DataFeeder([main.global_block().var("gt_box"),
+                               main.global_block().var("gt_label")], main)
+    feed = feeder.feed(gt)
+    feed["img"] = feeder_img
+    lv, cv, bv = exe.run(main, feed=feed,
+                         fetch_list=[locs, confs, box], scope=scope)
+    lv, cv, bv = map(np.asarray, (lv, cv, bv))
+    # 8x8 cells x 4 priors/cell (min, sqrt(min*max), ar=2 flipped pair)
+    assert bv.shape == (8 * 8 * 4, 4)
+    assert lv.shape == (2, bv.shape[0], 4)
+    assert cv.shape == (2, bv.shape[0], 3)
+    # clipped normalized boxes
+    assert bv.min() >= 0.0 and bv.max() <= 1.0
+
+
+def test_ssd_loss_trains():
+    """The SSD objective must be finite and decrease while fitting a fixed
+    ground-truth box (locs/confs convs moving toward the targets)."""
+    main, startup, (img, gt_box, gt_label), (_l, _c, _b, loss) = \
+        _ssd_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(3)
+    imgs = rng.rand(4, 3, 32, 32).astype("float32")
+    gt = [(np.array([[0.2, 0.2, 0.5, 0.5]], "float32"),
+           np.array([[2]], "int64")) for _ in range(4)]
+    feeder = fluid.DataFeeder([gt_box, gt_label], main)
+    feed = feeder.feed(gt)
+    feed["img"] = imgs
+
+    first = last = None
+    for _ in range(25):
+        v, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        last = float(np.asarray(v))
+        assert np.isfinite(last)
+        first = last if first is None else first
+    assert last < 0.7 * first, (first, last)
+
+
+def test_detection_map_layer():
+    """detection_map as a graph op: perfect detections -> mAP 1.0."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        det = fluid.layers.data("det", shape=[6], lod_level=1)
+        lab = fluid.layers.data("lab", shape=[5], lod_level=1)
+        m = layers.detection_map(det, lab, class_num=3,
+                                 overlap_threshold=0.5)
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    feeder = fluid.DataFeeder([main.global_block().var("det"),
+                               main.global_block().var("lab")], main)
+    box = [0.1, 0.1, 0.4, 0.4]
+    feed = feeder.feed([(
+        np.array([[1.0, 0.9] + box], "float32"),       # label,score,box
+        np.array([[1.0] + box], "float32"),            # label,box
+    )])
+    out, = exe.run(main, feed=feed, fetch_list=[m])
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), [1.0],
+                               atol=1e-6)
+
+
+def test_multi_box_head_flip_dedup_matches_prior_count():
+    """Regression (round-5 review): aspect_ratios [2.0, 0.5] with flip=True
+    must NOT double-count 0.5 (the op dedups it against 1/2.0) — conv
+    channels and emitted priors stay aligned."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 32, 32])
+        feat = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                                   stride=4, padding=1, act=None)
+        locs, confs, box, var = layers.multi_box_head(
+            inputs=[feat], image=img, base_size=32, num_classes=2,
+            aspect_ratios=[[2.0, 0.5]], min_sizes=[8.0], max_sizes=[16.0],
+            flip=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    imgs = np.random.RandomState(1).rand(1, 3, 32, 32).astype("float32")
+    lv, bv = exe.run(main, feed={"img": imgs}, fetch_list=[locs, box],
+                     scope=scope)
+    lv, bv = np.asarray(lv), np.asarray(bv)
+    assert lv.shape[1] == bv.shape[0], (lv.shape, bv.shape)
