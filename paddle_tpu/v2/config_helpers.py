@@ -190,6 +190,16 @@ class SumPooling(_Pooling):
     pool_type = "sum"
 
 
+# cudnn pooling spellings (reference poolings.py CudnnMaxPooling /
+# CudnnAvgPooling — kernel-choice hints; one XLA lowering here)
+class CudnnMaxPooling(MaxPooling):
+    pass
+
+
+class CudnnAvgPooling(AvgPooling):
+    pass
+
+
 class ExtraLayerAttribute:
     def __init__(self, error_clipping_threshold=None, drop_rate=None,
                  device=None):
@@ -399,12 +409,18 @@ def _img_meta(input, num_channels=None):
     if size is None:
         raise ValueError("cannot infer image height/width: input size "
                          "unknown")
-    hw = int(math.isqrt(size // num_channels))
-    if hw * hw * num_channels != size:
+    pixels = size // num_channels
+    # the reference's get_img_size rule (config_parser.py:1210-1215):
+    # width = floor(sqrt(pixels)), height = pixels // width, ASSERTING
+    # width * height == pixels — squares pass, 12 -> 4x3 passes, a typo'd
+    # size like 783 still errors at config time
+    w = int(math.isqrt(pixels))
+    h = pixels // max(w, 1)
+    if w <= 0 or w * h != pixels or pixels * num_channels != size:
         raise ValueError(
-            f"input size {size} is not a square image of {num_channels} "
-            "channels")
-    return (num_channels, hw, hw)
+            f"input size {size} does not factor into H x W x "
+            f"{num_channels} channels (reference get_img_size rule)")
+    return (num_channels, h, w)
 
 
 def _as_image_var(input, num_channels=None):
@@ -479,17 +495,23 @@ def img_conv_layer(input, filter_size, num_filters, name=None,
 
 
 def img_pool_layer(input, pool_size, name=None, num_channels=None, stride=1,
-                   padding=0, pool_type=None, layer_attr=None, **kw):
+                   padding=0, pool_type=None, layer_attr=None,
+                   pool_size_y=None, stride_y=None, padding_y=None, **kw):
+    """_y variants give asymmetric windows (reference img_pool_layer:
+    pool_size is the x/width extent, *_y the height)."""
     import paddle_tpu.fluid as fluid
     var, (c, h, w) = _as_image_var(input, num_channels)
     ptype = (pool_type or MaxPooling()).pool_type
-    out = fluid.layers.pool2d(var, pool_size=pool_size, pool_type=ptype,
-                              pool_stride=stride, pool_padding=padding,
+    ph, pw = (pool_size_y or pool_size), pool_size
+    sh, sw = (stride_y or stride), stride
+    pdh, pdw = (padding if padding_y is None else padding_y), padding
+    out = fluid.layers.pool2d(var, pool_size=[ph, pw], pool_type=ptype,
+                              pool_stride=[sh, sw], pool_padding=[pdh, pdw],
                               ceil_mode=True)
     # legacy pooling uses the ceil output size (config_parser.py
     # cnn_output_size with caffe_mode=False)
-    oh = _conv_out(h, pool_size, padding, stride, caffe_mode=False)
-    ow = _conv_out(w, pool_size, padding, stride, caffe_mode=False)
+    oh = _conv_out(h, ph, pdh, sh, caffe_mode=False)
+    ow = _conv_out(w, pw, pdw, sw, caffe_mode=False)
     return LayerOutput(out, size=c * oh * ow, hwc=(c, oh, ow), name=name)
 
 
@@ -663,20 +685,23 @@ def pooling_layer(input, pooling_type=None, agg_level=None, stride=-1,
     return _seq_select(input, ptype, agg_level, stride, name)
 
 
-def cross_entropy(input, label, name=None, coeff=1.0, **kw):
+def cross_entropy(input, label, name=None, coeff=1.0, weight=None, **kw):
     """Cost over an already-softmaxed input (the reference image configs
-    apply SoftmaxActivation on the last fc, then cross_entropy)."""
+    apply SoftmaxActivation on the last fc, then cross_entropy); ``weight``
+    scales each sample's cost (the reference's weight data layer)."""
     import paddle_tpu.fluid as fluid
     lab = _unwrap(label, kind="label")
     ce = fluid.layers.cross_entropy(_unwrap(input), lab)
+    if weight is not None:
+        ce = fluid.layers.elementwise_mul(ce, _unwrap(weight))
     cost = fluid.layers.mean(ce)
     if coeff != 1.0:
         cost = fluid.layers.scale(cost, scale=float(coeff))
     return LayerOutput(cost, size=1, name=name)
 
 
-def classification_cost(input, label, name=None, **kw):
-    return cross_entropy(input, label, name=name)
+def classification_cost(input, label, name=None, weight=None, **kw):
+    return cross_entropy(input, label, name=name, weight=weight)
 
 
 def regression_cost(input, label, name=None, **kw):
@@ -1181,3 +1206,200 @@ def block_expand_layer(input, num_channels=None, block_x=1, block_y=1,
 
 
 __all__ += ["block_expand_layer", "recurrent_layer"]
+
+
+# ---------------------------------------------------------------------------
+# mixed_layer + projections (reference trainer_config_helpers/layers.py:867
+# mixed_layer, :405+ projections) — the legacy DSL's composition primitive:
+# ``with mixed_layer(size=n, act=a) as m: m += projection(...)`` sums the
+# lowered projections, adds the optional bias, applies the activation.
+# ---------------------------------------------------------------------------
+
+class _Projection:
+    def __init__(self, kind, input, param_attr=None, size=None, offset=None):
+        self.kind = kind
+        self.input = input
+        self.param_attr = param_attr
+        self.size = size
+        self.offset = offset
+
+    def lower(self, out_size):
+        import paddle_tpu.fluid as fluid
+
+        x = _unwrap(self.input)
+        in_size = getattr(self.input, "size", None) or \
+            (x.shape[-1] if x.shape else None)
+        if self.kind == "full":
+            return fluid.layers.fc(input=x, size=out_size, act=None,
+                                   bias_attr=False,
+                                   param_attr=_fluid_param_attr(
+                                       self.param_attr))
+        if self.kind == "trans_full":
+            # out.row = in.row @ W^T with W [out_size, in_size] — shared
+            # against an fc whose weight is [in', out'] = [out_size, in_size]
+            # (layers.py:468 trans_full_matrix_projection, the sharew case)
+            from paddle_tpu.fluid.layer_helper import LayerHelper
+            helper = LayerHelper("trans_full_matrix_projection")
+            w = helper.create_parameter(
+                _fluid_param_attr(self.param_attr) or
+                fluid.ParamAttr(), shape=(out_size, in_size),
+                dtype="float32")
+            return fluid.layers.matmul(x, w, transpose_y=True)
+        if self.kind == "identity":
+            if self.offset in (None, 0) and (in_size in (None, out_size)):
+                return x
+            # layers.py:548 identity_projection with offset: columns
+            # [offset, offset+out_size)
+            return fluid.layers.crop(
+                x, shape=[-1, out_size], offsets=[0, int(self.offset or 0)])
+        if self.kind == "table":
+            ids = _unwrap(self.input, "seq_ids")   # int64 id sequence
+            return fluid.layers.embedding(
+                input=ids, size=[in_size, out_size],
+                param_attr=_fluid_param_attr(self.param_attr))
+        if self.kind == "dotmul":
+            from paddle_tpu.fluid.layer_helper import LayerHelper
+            helper = LayerHelper("dotmul_projection")
+            w = helper.create_parameter(
+                _fluid_param_attr(self.param_attr) or fluid.ParamAttr(),
+                shape=(1, out_size), dtype="float32")
+            return fluid.layers.elementwise_mul(x, w)
+        raise NotImplementedError(
+            f"projection kind {self.kind!r} inside mixed_layer (the "
+            "context/conv projections lower through sequence_conv_pool / "
+            "img_conv_layer instead)")
+
+
+def full_matrix_projection(input, size=0, param_attr=None):
+    return _Projection("full", input, param_attr, size)
+
+
+def trans_full_matrix_projection(input, size=0, param_attr=None):
+    return _Projection("trans_full", input, param_attr, size)
+
+
+def identity_projection(input, offset=None, size=None):
+    return _Projection("identity", input, None, size, offset)
+
+
+def table_projection(input, size=0, param_attr=None):
+    return _Projection("table", input, param_attr, size)
+
+
+def dotmul_projection(input, param_attr=None):
+    return _Projection("dotmul", input, param_attr)
+
+
+class MixedLayer(LayerOutput):
+    """The ``with mixed_layer(...) as m`` object: LayerOutput whose var is
+    produced at context exit from the accumulated projections."""
+
+    def __init__(self, size, act=None, bias_attr=False, name=None):
+        super().__init__(var=None, size=size, name=name)
+        self._mixed_act = act
+        self._mixed_bias = bias_attr
+        self._projs = []
+
+    def __iadd__(self, proj):
+        if not isinstance(proj, _Projection):
+            raise TypeError(f"mixed_layer += expects a projection, got "
+                            f"{type(proj).__name__}")
+        self._projs.append(proj)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            return False
+        self._lower()
+        return False
+
+    def _lower(self):
+        import paddle_tpu.fluid as fluid
+
+        if self._var is not None:   # already materialized (a consumer
+            return                  # inside the with-block forced it)
+        if not self._projs:
+            raise ValueError("mixed_layer exited with no projections")
+        terms = [p.lower(self.size) for p in self._projs]
+        total = terms[0]
+        for t in terms[1:]:
+            total = fluid.layers.elementwise_add(total, t)
+        if self._mixed_bias not in (False, None):
+            from paddle_tpu.fluid.layer_helper import LayerHelper
+            helper = LayerHelper("mixed_bias")
+            battr = None if self._mixed_bias is True else self._mixed_bias
+            b = helper.create_parameter(
+                _fluid_param_attr(battr) or fluid.ParamAttr(),
+                shape=(self.size,), dtype="float32", is_bias=True)
+            total = fluid.layers.elementwise_add(total, b)
+        act = _act_str(self._mixed_act)
+        if act and act != "linear":
+            total = getattr(fluid.layers, act)(total)
+        self._var = total
+
+    # pending-materialization guard: using the mixed layer before the with-
+    # block ends (or calling it bare) lowers on demand
+    def materialize(self, kind="dense"):
+        if self._var is None:
+            self._lower()
+        return self._var
+
+
+def mixed_layer(size=0, input=None, act=None, bias_attr=False, name=None,
+                **kw):
+    ml = MixedLayer(size=size, act=act, bias_attr=bias_attr, name=name)
+    if input:
+        for proj in (input if isinstance(input, (list, tuple)) else [input]):
+            ml += proj
+    return ml
+
+
+def TrainData(spec=None, **kw):
+    """Legacy proto data-source declaration (config_parser TrainData):
+    recorded for introspection; the trainer contract feeds readers."""
+    _DATA_SOURCES.update(train_data=spec)
+
+
+def TestData(spec=None, **kw):
+    _DATA_SOURCES.update(test_data=spec)
+
+
+def SimpleData(files=None, feat_dim=0, context_len=0, buffer_capacity=0,
+               **kw):
+    return dict(kind="simple", files=files, feat_dim=feat_dim,
+                context_len=context_len, buffer_capacity=buffer_capacity)
+
+
+__all__ += ["mixed_layer", "full_matrix_projection",
+            "trans_full_matrix_projection", "identity_projection",
+            "table_projection", "dotmul_projection", "TrainData",
+            "TestData", "SimpleData"]
+
+
+def nce_layer(input, label, num_classes=None, weight=None,
+              num_neg_samples=10, neg_distribution=None, param_attr=None,
+              bias_attr=None, name=None, **kw):
+    """NCE cost (reference layers.py nce_layer over NCELayer); the sampled
+    negative distribution is uniform here — ``neg_distribution`` is
+    accepted for config parity (the fluid nce op samples uniformly, like
+    the reference's default when no distribution is given)."""
+    import paddle_tpu.fluid as fluid
+    xs = input if isinstance(input, (list, tuple)) else [input]
+    x = _unwrap(xs[0])
+    if len(xs) > 1:
+        x = fluid.layers.concat([_unwrap(v) for v in xs], axis=1)
+    cost = fluid.layers.nce(
+        input=x, label=_unwrap(label, "label"),
+        num_total_classes=int(num_classes),
+        num_neg_samples=int(num_neg_samples),
+        sample_weight=None if weight is None else _unwrap(weight),
+        param_attr=_fluid_param_attr(param_attr),
+        bias_attr=_fluid_param_attr(bias_attr))
+    out = fluid.layers.mean(cost)
+    return LayerOutput(out, size=1, name=name)
+
+
+__all__ += ["nce_layer", "CudnnAvgPooling", "CudnnMaxPooling"]
