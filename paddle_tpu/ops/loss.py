@@ -268,3 +268,70 @@ def huber_loss_grad(ctx):
     g = jnp.where(jnp.abs(r) <= delta, r, delta * jnp.sign(r))
     ctx.set_output("X@GRAD", -d * g)
     ctx.set_output("Y@GRAD", d * g)
+
+
+# ---------------------------------------------------------------------------
+# hsigmoid (legacy gserver HierarchicalSigmoidLayer; math/MatrixBitCode.cpp
+# SimpleCode: c = label + num_classes, node(b) = (c >> (b+1)) - 1,
+# bit(b) = (c >> b) & 1, cost = sum_b softplus(z_b) - bit_b * z_b)
+# ---------------------------------------------------------------------------
+
+def _hsigmoid_compute(x, w, bias, label, num_classes):
+    c = label.reshape(-1).astype(jnp.int32) + num_classes
+    max_len = int(num_classes - 1).bit_length()
+    cost = jnp.zeros((x.shape[0],), jnp.float32)
+    xf = x.astype(jnp.float32)
+    for b in range(max_len):
+        parent = (c >> (b + 1))
+        valid = (parent >= 1).astype(jnp.float32)
+        idx = jnp.maximum(parent - 1, 0)
+        bit = ((c >> b) & 1).astype(jnp.float32)
+        z = jnp.sum(xf * w[idx].astype(jnp.float32), axis=-1)
+        if bias is not None:
+            z = z + bias.reshape(-1)[idx].astype(jnp.float32)
+        cost = cost + valid * (jax.nn.softplus(z) - bit * z)
+    return cost.reshape(-1, 1)
+
+
+@register_op("hsigmoid", grad=lambda op: [OpSpec(
+    "hsigmoid_grad",
+    {"X": op.input("X"), "W": op.input("W"), "Label": op.input("Label"),
+     **({"Bias": op.input("Bias")} if op.input("Bias") else {}),
+     "Out@GRAD": G(op.output("Out"))},
+    {"X@GRAD": G(op.input("X")), "W@GRAD": G(op.input("W")),
+     **({"Bias@GRAD": G(op.input("Bias"))} if op.input("Bias") else {})},
+    dict(op.attrs))])
+def hsigmoid(ctx):
+    """Hierarchical sigmoid cost over the complete-binary-tree SimpleCode
+    (reference HierarchicalSigmoidLayer.cpp:127 sumByBitCode +
+    MatrixBitCode.cpp)."""
+    x = data_of(ctx.input("X"))
+    w = data_of(ctx.input("W"))
+    label = data_of(ctx.input("Label"))
+    bias = data_of(ctx.input("Bias")) if ctx.has_input("Bias") else None
+    ctx.set_output("Out", _hsigmoid_compute(
+        x, w, bias, label, int(ctx.attr("num_classes"))))
+
+
+@register_op("hsigmoid_grad")
+def hsigmoid_grad(ctx):
+    x = data_of(ctx.input("X"))
+    w = data_of(ctx.input("W"))
+    label = data_of(ctx.input("Label"))
+    has_bias = ctx.has_input("Bias")
+    bias = data_of(ctx.input("Bias")) if has_bias else None
+    d = data_of(ctx.input("Out@GRAD"))
+    n = int(ctx.attr("num_classes"))
+    args = (x, w) + ((bias,) if has_bias else ())
+
+    def f(*a):
+        xx, ww = a[0], a[1]
+        bb = a[2] if has_bias else None
+        return _hsigmoid_compute(xx, ww, bb, label, n)
+
+    out, vjp = jax.vjp(f, *args)
+    grads = vjp(d.astype(out.dtype).reshape(out.shape))
+    ctx.set_output("X@GRAD", grads[0])
+    ctx.set_output("W@GRAD", grads[1])
+    if has_bias:
+        ctx.set_output("Bias@GRAD", grads[2])

@@ -1403,3 +1403,59 @@ def nce_layer(input, label, num_classes=None, weight=None,
 
 
 __all__ += ["nce_layer", "CudnnAvgPooling", "CudnnMaxPooling"]
+
+
+def hsigmoid(input, label, num_classes=None, name=None, param_attr=None,
+             bias_attr=True, **kw):
+    """Hierarchical sigmoid cost layer (reference layers.py hsigmoid over
+    gserver HierarchicalSigmoidLayer): inputs are concatenated (the
+    reference keeps one weight block per input; a single [num_classes-1,
+    sum(sizes)] block is the same linear map), cost averaged over the
+    batch. ``num_classes=None`` falls back to the label layer's size;
+    ``bias_attr=None`` means default bias (the reference's
+    wrap_bias_attr_default(has_bias=True) rule), False disables it."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.layer_helper import LayerHelper
+
+    xs = input if isinstance(input, (list, tuple)) else [input]
+    x = _unwrap(xs[0])
+    if len(xs) > 1:
+        x = fluid.layers.concat([_unwrap(v) for v in xs], axis=1)
+    dim = 0
+    for v in xs:
+        d = getattr(v, "size", None)
+        if not d:
+            uv = _unwrap(v)
+            d = uv.shape[-1] if uv.shape else None
+        if not d or d < 0:
+            raise ValueError(
+                "hsigmoid: cannot infer an input's feature size (declare "
+                "the layer size)")
+        dim += int(d)
+    if num_classes is None:
+        num_classes = getattr(label, "size", None) or             getattr(label, "_data_size", None)
+    if not num_classes or int(num_classes) <= 2:
+        raise ValueError(
+            "hsigmoid requires num_classes > 2 (reference layers.py "
+            "hsigmoid config_assert)")
+    helper = LayerHelper("hsigmoid", name=name)
+    w = helper.create_parameter(
+        _fluid_param_attr(param_attr) or fluid.ParamAttr(),
+        shape=(int(num_classes) - 1, dim), dtype="float32")
+    inputs = {"X": [x.name], "W": [w.name],
+              "Label": [_unwrap(label, "label").name]}
+    if bias_attr is not False:   # None == default bias, like the reference
+        battr = None if bias_attr in (True, None) else bias_attr
+        b = helper.create_parameter(
+            _fluid_param_attr(battr) or fluid.ParamAttr(),
+            shape=(1, int(num_classes) - 1), dtype="float32", is_bias=True)
+        inputs["Bias"] = [b.name]
+    cost = helper.create_tmp_variable("float32")
+    helper.append_op("hsigmoid", inputs=inputs,
+                     outputs={"Out": [cost.name]},
+                     attrs={"num_classes": int(num_classes)})
+    out = fluid.layers.mean(cost)
+    return LayerOutput(out, size=1, name=name)
+
+
+__all__ += ["hsigmoid"]
