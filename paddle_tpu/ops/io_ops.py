@@ -34,6 +34,12 @@ from ..core.lod import LoDArray
 from ..core.registry import register_op
 from ..core.types import np_dtype
 
+import weakref
+
+# promoted-iterator cache for reader creators without a settable __dict__
+# (see the read op)
+_PROMOTED: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
 
 def _require_concrete(op_type, *values):
     for v in values:
@@ -199,8 +205,26 @@ def create_shuffle_reader_op(ctx):
 
 @register_op("create_double_buffer_reader")
 def create_double_buffer_reader_op(ctx):
-    from ..reader.prefetch import double_buffer
-    ctx.set_output("Out", double_buffer(ctx.input("UnderlyingReader")))
+    """create_double_buffer_reader_op.cc: a background thread keeps the
+    next batches DEVICE-STAGED while the consumer computes (the shared
+    background_buffer helper; the feed-dict flavor in reader/prefetch.py
+    uses the same one). The layer's ``place`` attr picks the staging
+    device."""
+    from ..reader.prefetch import background_buffer
+
+    underlying = ctx.input("UnderlyingReader")
+    capacity = int(ctx.attr("capacity", 2) or 2)
+    place = str(ctx.attr("place", "") or "")
+    device = jax.devices("cpu")[0] if "CPU" in place.upper() \
+        else jax.devices()[0]
+
+    def stage(item):
+        if isinstance(item, (tuple, list)):
+            return tuple(jax.device_put(np.asarray(v), device)
+                         for v in item)
+        return jax.device_put(np.asarray(item), device)
+
+    ctx.set_output("Out", background_buffer(underlying, capacity, stage))
 
 
 @register_op("create_multi_pass_reader")
@@ -223,10 +247,34 @@ def read(ctx):
     (executor catches it to end the pass)."""
     reader = ctx.input("Reader")
     if callable(reader) and not hasattr(reader, "__next__"):
-        # a reader creator: instantiate once, keep the iterator in its place
-        reader = iter(reader())
-        ctx.env[ctx.op.input("Reader")[0]] = reader
-    batch = next(reader)
+        # a reader creator: promote to a live iterator ONCE and cache it ON
+        # the creator object — the creator is what persists in the scope
+        # (the read op only READS the reader var, so env rebinds don't
+        # survive state write-back), exactly the reference's
+        # ReaderHolder-in-scope contract (framework/reader.h:68). Creators
+        # without __dict__ (e.g. functools.partial) cache via weakref.
+        it = getattr(reader, "__promoted_iter__", None) \
+            or _PROMOTED.get(reader)
+        if it is None:
+            it = iter(reader())
+            try:
+                reader.__promoted_iter__ = it
+            except AttributeError:
+                _PROMOTED[reader] = it   # TypeError here = unweakrefable
+                # creator: a loud error beats silently re-reading batch 0
+        creator, reader = reader, it
+    else:
+        creator = None
+    try:
+        batch = next(reader)
+    except StopIteration:
+        # end of pass: clear the cached iterator so the next run starts a
+        # fresh pass (the reference's reader reset semantics)
+        if creator is not None:
+            if hasattr(creator, "__dict__"):
+                creator.__dict__.pop("__promoted_iter__", None)
+            _PROMOTED.pop(creator, None)
+        raise
     outs = ctx.op.output("Out")
     if len(outs) == 1 and not isinstance(batch, (tuple, list)):
         batch = (batch,)

@@ -17,9 +17,43 @@ double-buffer thread.
 from __future__ import annotations
 
 import queue as _queue
+import queue as _queue2
 import threading
 
 import jax
+
+
+def background_buffer(reader, capacity=2, stage=None):
+    """Record-agnostic bounded background prefetch: returns a creator whose
+    iterator is fed by a daemon thread (``stage`` runs per item IN the
+    feeder, e.g. jax.device_put). BaseException-safe: the end sentinel is
+    enqueued in a finally so the consumer can never hang, and feeder errors
+    re-raise consumer-side. One implementation for both the feed-dict
+    (DeviceFeedIterator) and slot-tuple (reader-graph op) flavors."""
+
+    def make():
+        q = _queue2.Queue(maxsize=max(1, int(capacity)))
+        end, err = object(), []
+
+        def feed():
+            try:
+                for item in reader():
+                    q.put(stage(item) if stage is not None else item)
+            except BaseException as e:   # surface in consumer
+                err.append(e)
+            finally:
+                q.put(end)
+
+        threading.Thread(target=feed, daemon=True).start()
+        while True:
+            item = q.get()
+            if item is end:
+                if err:
+                    raise err[0]
+                return
+            yield item
+
+    return make
 
 
 def double_buffer(reader, place=None, capacity=2, convert=None):
@@ -63,23 +97,5 @@ class DeviceFeedIterator:
         return staged
 
     def __iter__(self):
-        q = _queue.Queue(maxsize=self._capacity)
-        err = []
-
-        def feed():
-            try:
-                for batch in self._reader():
-                    q.put(self._stage(batch))
-            except BaseException as e:  # surface in consumer
-                err.append(e)
-            finally:
-                q.put(self._End)
-
-        threading.Thread(target=feed, daemon=True).start()
-        while True:
-            item = q.get()
-            if item is self._End:
-                if err:
-                    raise err[0]
-                return
-            yield item
+        return background_buffer(self._reader, self._capacity,
+                                 self._stage)()

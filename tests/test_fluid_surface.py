@@ -108,3 +108,46 @@ def test_convert_reader_to_recordio_file(tmp_path):
     assert set(recs[0]) == {"x", "y"}
     np.testing.assert_array_equal(np.asarray(recs[0]["y"]).reshape(-1),
                                   [0, 1, 2, 3])
+
+
+def test_program_level_reader_graph(tmp_path):
+    """The reference reader-op chain (layers/io.py:261-364): startup builds
+    open_recordio_file -> create_shuffle_reader -> create_multi_pass_reader
+    -> create_double_buffer_reader into a READER var; the main program's
+    read_file pops typed batches until the pass ends."""
+    import pickle
+
+    from paddle_tpu.recordio import write_records
+
+    path = str(tmp_path / "r.recordio")
+    batches = [(np.full((2, 3), i, "float32"),
+                np.full((2, 1), i, "int64")) for i in range(4)]
+    write_records(path, [pickle.dumps(b) for b in batches])
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.open_recordio_file(
+            path, shapes=[[-1, 3], [-1, 1]], lod_levels=[0, 0],
+            dtypes=["float32", "int64"])
+        reader = fluid.layers.create_shuffle_reader(reader, buffer_size=16)
+        reader = fluid.layers.create_multi_pass_reader(reader, pass_num=2)
+        reader = fluid.layers.create_double_buffer_reader(reader)
+        img, lbl = fluid.layers.read_file(reader)
+
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    seen = []
+    for _ in range(2 * len(batches)):   # two passes via multi_pass
+        iv, lv = exe.run(main, fetch_list=[img, lbl], scope=scope,
+                         use_program_cache=False)
+        assert np.asarray(iv).shape == (2, 3)
+        seen.append(int(np.asarray(lv).reshape(-1)[0]))
+    # every batch delivered twice (shuffled order)
+    assert sorted(seen) == sorted(list(range(4)) * 2), seen
+    try:
+        exe.run(main, fetch_list=[img], scope=scope,
+                use_program_cache=False)
+        raise AssertionError("expected StopIteration at end of data")
+    except StopIteration:
+        pass
