@@ -24,6 +24,8 @@ temporaries in a dropped local scope). The compiled step function is pure:
 
 from __future__ import annotations
 
+import weakref
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -228,17 +230,81 @@ def _run_ops(block, env, exec_state):
         record(op.type)
 
 
+class _ProgramAnalysis:
+    """Cached per-(program, version) block-walk results: the free-read and
+    written name lists plus the persistable subset of the writes. Computing
+    these walks every ``Executor.run`` made the steady-state dispatch path
+    re-traverse the whole block graph per step; with the cache a hot run()
+    does dict lookups only (the reference caches the analog Prepare work in
+    its ExecutorPrepareContext, framework/executor.cc:271)."""
+
+    __slots__ = ("version", "free", "written", "persistable_written")
+
+    def __init__(self, version, free, written, persistable_written):
+        self.version = version
+        self.free = free
+        self.written = written
+        self.persistable_written = persistable_written
+
+
+# program -> _ProgramAnalysis for block 0. Keyed by the program OBJECT via
+# weakref (with the version stored inside and revalidated on lookup): the
+# same identity contract as an (id(program), _version) key, minus the
+# id-reuse hazard after a program is garbage collected.
+_ANALYSIS_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _analyze_program(program):
+    cached = _ANALYSIS_CACHE.get(program)
+    if cached is not None and cached.version == program._version:
+        return cached
+    from . import block_walk
+    free = block_walk.free_reads(program, 0)
+    written = block_walk.written_names(program, 0)
+    block = program.global_block()
+    persistable = frozenset(
+        n for n in written if block.has_var(n) and block.var(n).persistable)
+    cached = _ProgramAnalysis(program._version, free, written, persistable)
+    _ANALYSIS_CACHE[program] = cached
+    return cached
+
+
 def _collect_free_inputs(program, block_idx):
     """Names a block (and its sub-blocks) reads before writing — the state +
     feed surface of the compiled function. Mirrors what the reference resolves
-    dynamically through Scope parent lookup (executor.cc:286-315)."""
+    dynamically through Scope parent lookup (executor.cc:286-315). Block 0
+    (every run()/prepare_steps call) hits the _ProgramAnalysis cache."""
+    if block_idx == 0:
+        return _analyze_program(program).free
     from .block_walk import free_reads
     return free_reads(program, block_idx)
 
 
 def _written_names(program, block_idx):
+    if block_idx == 0:
+        return _analyze_program(program).written
     from .block_walk import written_names
     return written_names(program, block_idx)
+
+
+# the flag-tuple portion of the jit-cache key: revalidated against the flag
+# registry's version counter so a steady-state run() costs one compare, not
+# eight registry lookups per dispatch
+_JIT_KEY_FLAGS = ("xla_compiler_options", "use_pallas_rnn",
+                  "bn_fusion_barrier", "bn_fusion_barrier_fwd",
+                  "bn_fusion_barrier_bwd", "conv_space_to_depth",
+                  "conv_1x1_grad_as_dot", "use_pallas_ctc")
+
+_JIT_FLAG_KEY = (None, ())
+
+
+def _jit_flag_key():
+    global _JIT_FLAG_KEY
+    from .flags import flags_version, get_flag
+    v = flags_version()
+    if _JIT_FLAG_KEY[0] != v:
+        _JIT_FLAG_KEY = (v, tuple(get_flag(n) for n in _JIT_KEY_FLAGS))
+    return _JIT_FLAG_KEY[1]
 
 
 def _compiler_options():
@@ -319,18 +385,16 @@ class Executor:
         if scope.find_var(_RNG_KEY) is None:
             scope.set(_RNG_KEY, jax.random.PRNGKey(program.random_seed or 0))
 
-        free = _collect_free_inputs(program, 0)
-        state_in = [n for n in free if n not in feed_vals and scope.has_var(n)]
-        missing = [n for n in free if n not in feed_vals and not scope.has_var(n)
-                   and not block.has_var(n)]
-        # names that are block vars but have no runtime value anywhere: the ops
-        # that produce them (e.g. fill ops) must come first; if an op truly
-        # reads them first, _run_ops raises a clean error.
-        written = _written_names(program, 0)
-        state_out = [n for n in written
-                     if (block.has_var(n) and block.var(n).persistable)
-                     or scope.has_var(n)]
-        del missing
+        # steady-state hot path: every per-program set below comes from the
+        # _ProgramAnalysis cache — no block walk after the first run. (A
+        # free name with no runtime value anywhere is produced by an earlier
+        # op, e.g. a fill; if an op truly reads it first, _run_ops raises a
+        # clean error.)
+        analysis = _analyze_program(program)
+        state_in = [n for n in analysis.free
+                    if n not in feed_vals and scope.has_var(n)]
+        state_out = [n for n in analysis.written
+                     if n in analysis.persistable_written or scope.has_var(n)]
 
         state = {n: scope.find_var(n) for n in state_in}
         state[_RNG_KEY] = scope.find_var(_RNG_KEY)
@@ -426,13 +490,12 @@ class Executor:
         if scope.find_var(_RNG_KEY) is None:
             scope.set(_RNG_KEY, jax.random.PRNGKey(program.random_seed or 0))
 
-        free = _collect_free_inputs(program, 0)
+        analysis = _analyze_program(program)
         feed_keys = set(stacked)
-        state_in = [n for n in free if n not in feed_keys and scope.has_var(n)]
-        written = _written_names(program, 0)
-        state_out = [n for n in written
-                     if (block.has_var(n) and block.var(n).persistable)
-                     or scope.has_var(n)]
+        state_in = [n for n in analysis.free
+                    if n not in feed_keys and scope.has_var(n)]
+        state_out = [n for n in analysis.written
+                     if n in analysis.persistable_written or scope.has_var(n)]
         # scan carry must have a fixed structure: carry everything read or
         # persistently written (all present in scope after startup ran)
         carry = list(dict.fromkeys(state_in + [n for n in state_out
@@ -487,16 +550,9 @@ class Executor:
 
     def _compiled_steps(self, program, feed_names, fetch_names, carry_keys,
                         K, B):
-        from .flags import get_flag
         key = ("multi", id(program), program._version, feed_names,
                fetch_names, carry_keys, K, B, self.donate, self.amp,
-               get_flag("xla_compiler_options"),
-               get_flag("use_pallas_rnn"), get_flag("bn_fusion_barrier"),
-               get_flag("bn_fusion_barrier_fwd"),
-               get_flag("bn_fusion_barrier_bwd"),
-               get_flag("conv_space_to_depth"),
-               get_flag("conv_1x1_grad_as_dot"),
-               get_flag("use_pallas_ctc"))
+               _jit_flag_key())
         fn = self._cache.get(key)
         if fn is not None:
             return fn
@@ -532,16 +588,9 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _compiled(self, program, feed_names, fetch_names, state_in, state_out):
-        from .flags import get_flag
         key = (id(program), program._version, feed_names, fetch_names,
                state_in, state_out, self.donate, self.amp, self.auto_layout,
-               get_flag("xla_compiler_options"),
-               get_flag("use_pallas_rnn"), get_flag("bn_fusion_barrier"),
-               get_flag("bn_fusion_barrier_fwd"),
-               get_flag("bn_fusion_barrier_bwd"),
-               get_flag("conv_space_to_depth"),
-               get_flag("conv_1x1_grad_as_dot"),
-               get_flag("use_pallas_ctc"))
+               _jit_flag_key())
         fn = self._cache.get(key)
         if fn is not None:
             return fn

@@ -14,11 +14,27 @@ import os
 
 _FLAGS: dict[str, dict] = {}
 
+# bumped on every mutation of the registry; lets callers that derive keys
+# from flag values (the Executor's jit-cache flag tuple) cache the derived
+# form and revalidate with one integer compare instead of N dict lookups
+_FLAGS_VERSION = 0
+
+
+def flags_version():
+    """Monotonic counter of registry mutations (DEFINE_flag / set_flags)."""
+    return _FLAGS_VERSION
+
+
+def _bump_version():
+    global _FLAGS_VERSION
+    _FLAGS_VERSION += 1
+
 
 def DEFINE_flag(name, default, help_str=""):
     if name not in _FLAGS:
         _FLAGS[name] = {"value": default, "default": default,
                         "help": help_str, "type": type(default)}
+        _bump_version()
     return _FLAGS[name]["value"]
 
 
@@ -36,6 +52,7 @@ def set_flags(flags: dict):
         if ty is bool and isinstance(value, str):
             value = value.lower() in ("1", "true", "yes", "on")
         _FLAGS[name]["value"] = ty(value)
+        _bump_version()
 
 
 def flags():

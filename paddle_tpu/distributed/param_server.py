@@ -199,7 +199,23 @@ def parse_endpoint(endpoint, default_port=None):
     connect error later. The one parser for every consumer of endpoint
     strings (transpiler, master client)."""
     if isinstance(endpoint, (tuple, list)):
-        return tuple(endpoint)
+        # same contract as the string form: host defaults to loopback, the
+        # port coerces to int, and a missing/non-numeric port is the same
+        # loud ValueError
+        host = endpoint[0] if len(endpoint) > 0 else ""
+        port = endpoint[1] if len(endpoint) > 1 else None
+        if port is None or str(port).strip() == "":
+            if default_port is None:
+                raise ValueError(
+                    f"endpoint {endpoint!r} has no port (want 'host:port')")
+            port = default_port
+        try:
+            port = int(port)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"endpoint {endpoint!r} has a non-numeric port "
+                "(want 'host:port')") from None
+        return (host or "127.0.0.1", port)
     host, sep, port = str(endpoint).rpartition(":")
     if not sep:             # no ':' at all -> whole string is the host
         host, port = port, ""

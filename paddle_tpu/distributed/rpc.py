@@ -38,10 +38,25 @@ class RpcServer:
         return self._listener.address
 
     def serve_forever(self):
+        from multiprocessing import AuthenticationError
         while not self._stop.is_set():
             try:
                 conn = self._listener.accept()
+            except (EOFError, ConnectionError, AuthenticationError):
+                # PER-CONNECTION handshake failure: a client vanished
+                # between connect and the authkey challenge (an elastic
+                # trainer killed mid-handshake raises EOFError /
+                # ConnectionResetError inside Listener.accept's
+                # deliver_challenge). Must not kill the accept loop —
+                # later clients' connects would complete into the dead
+                # listener's backlog and hang forever in answer_challenge.
+                if self._stop.is_set():
+                    break
+                continue
             except OSError:
+                # listener-level failure (shutdown closed it, fd
+                # exhaustion): exit rather than hot-spin on a broken
+                # listener
                 break
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True)

@@ -77,12 +77,27 @@ class Variable:
         self.shape = tuple(int(s) for s in shape) if shape is not None else None
         self.dtype = convert_dtype(dtype)
         self.lod_level = lod_level
-        self.persistable = persistable
+        self._persistable = persistable
         self.stop_gradient = stop_gradient
         self.type = type
         self.is_data = is_data
         # populated for Parameter only
         self.initializer = None
+
+    @property
+    def persistable(self):
+        return self._persistable
+
+    @persistable.setter
+    def persistable(self, value):
+        # a post-hoc persistable flip changes the executor's state-out
+        # surface, so it must invalidate the per-version program analysis
+        # cache exactly like an op/var mutation. No-op writes don't bump:
+        # program._version keys the jit cache too, and an idempotent
+        # re-stamp must not force a recompile.
+        if value != self._persistable:
+            self._persistable = value
+            self.block.program._bump_version()
 
     # -- sugar mirroring the reference's Variable operator overloads
     # (python/paddle/fluid/layers/math_op_patch.py) --

@@ -54,9 +54,11 @@ def open_recordio_file(filename, shapes, lod_levels, dtypes):
 
 
 def open_files(filenames, thread_num, shapes, lod_levels, dtypes):
-    """layers/io.py:290 — one READER over many files (thread_num is the
-    reference's C++ prefetch pool size; host decoding here is the reader
-    pipeline's job, the attr is recorded)."""
+    """layers/io.py:290 — one READER over many files. ``thread_num`` is the
+    decode-pool width (the reference's C++ prefetch pool size): at runtime
+    the reader op shards the file list into one raw reader per file,
+    interleaved, and decodes records across a thread_num-wide WorkerPool
+    (reader/pool.py)."""
     return _reader_var("create_recordio_file_reader", {},
                        {"filenames": list(filenames),
                         "thread_num": int(thread_num)},

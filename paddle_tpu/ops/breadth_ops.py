@@ -132,10 +132,14 @@ def _crop_infer(op, block):
     {"X@GRAD": G(op.input("X"))}, dict(op.attrs))])
 def crop(ctx):
     """crop_op.h: slice ``shape`` out of X at ``offsets`` (shape optionally
-    borrowed from reference input Y, crop_op.cc:60-64)."""
+    borrowed from reference input Y, crop_op.cc:60-64). A -1 shape entry
+    (the layer-level dynamic batch dim) resolves to the rest of that dim
+    past its offset."""
     x = data_of(ctx.input("X"))
     shape = _crop_shape(ctx)
     offsets = [int(o) for o in ctx.attr("offsets", [0] * x.ndim)]
+    shape = [xs - o if s == -1 else s
+             for s, xs, o in zip(shape, x.shape, offsets)]
     ctx.set_output("Out", lax.slice(
         x, offsets, [o + s for o, s in zip(offsets, shape)]))
 

@@ -179,10 +179,18 @@ def lod_array_length(ctx):
 def create_recordio_file_reader(ctx):
     """create_recordio_file_reader_op.cc / open_files: a creator over one or
     more recordio files; dict records (fluid.recordio_writer batches) become
-    slot tuples in insertion (feed) order, tuple records pass through."""
+    slot tuples in insertion (feed) order, tuple records pass through.
+
+    ``thread_num > 1`` (the open_files form) shards the file list into one
+    raw-bytes reader per file, interleaved, with record decode running on a
+    thread_num-wide WorkerPool (reader/pool.py) — the host-parallel decode
+    the reference got from its C++ prefetch pool. The pool lives for one
+    pass: created at iterator start, shut down when the pass ends or the
+    iterator is abandoned."""
     from ..reader import creator as reader_creator
 
     paths = list(ctx.attr("filenames"))
+    thread_num = int(ctx.attr("thread_num", 1) or 1)
 
     def _as_tuple(rec):
         if isinstance(rec, dict):
@@ -190,7 +198,7 @@ def create_recordio_file_reader(ctx):
         return rec
 
     def make():
-        base = reader_creator.recordio(paths)
+        base = reader_creator.recordio_sharded(paths, thread_num)
         return (_as_tuple(r) for r in base())
 
     ctx.set_output("Out", make)
@@ -219,9 +227,12 @@ def create_double_buffer_reader_op(ctx):
         else jax.devices()[0]
 
     def stage(item):
+        # ONE device_put per batch (the slot tuple is a pytree): one
+        # transfer submission instead of a round trip per slot — on remote
+        # TPU attachments each host->device call costs a full round trip
         if isinstance(item, (tuple, list)):
-            return tuple(jax.device_put(np.asarray(v), device)
-                         for v in item)
+            return jax.device_put(tuple(np.asarray(v) for v in item),
+                                  device)
         return jax.device_put(np.asarray(item), device)
 
     ctx.set_output("Out", background_buffer(underlying, capacity, stage))

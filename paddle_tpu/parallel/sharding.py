@@ -180,8 +180,8 @@ def shard_program_step(executor, program, feed_example, fetch_list, plan,
     the multi-chip equivalent of Executor._compiled, with every state/feed
     leaf placed by the ShardingPlan. Run it in a loop, carrying state.
     """
-    from ..core.executor import (_collect_free_inputs, _written_names,
-                                 _run_ops, _RNG_KEY, _is_traceable)
+    from ..core.executor import (_analyze_program, _run_ops, _RNG_KEY,
+                                 _is_traceable)
     from ..core.scope import global_scope
 
     scope = scope or global_scope()
@@ -192,12 +192,12 @@ def shard_program_step(executor, program, feed_example, fetch_list, plan,
     if scope.find_var(_RNG_KEY) is None:
         scope.set(_RNG_KEY, jax.random.PRNGKey(program.random_seed or 0))
 
-    free = _collect_free_inputs(program, 0)
-    state_in = [n for n in free if n not in feeds and scope.has_var(n)]
-    written = _written_names(program, 0)
-    state_out = [n for n in written
-                 if (block.has_var(n) and block.var(n).persistable)
-                 or scope.has_var(n)]
+    # per-(program, version) cached block walks, shared with Executor.run
+    analysis = _analyze_program(program)
+    state_in = [n for n in analysis.free
+                if n not in feeds and scope.has_var(n)]
+    state_out = [n for n in analysis.written
+                 if n in analysis.persistable_written or scope.has_var(n)]
     state = {n: scope.find_var(n) for n in state_in}
     state = {k: v for k, v in state.items() if _is_traceable(v)}
     state[_RNG_KEY] = scope.find_var(_RNG_KEY)

@@ -2,6 +2,7 @@ from .decorator import (map_readers, buffered, compose, chain, shuffle,
                         ComposeNotAligned, firstn, xmap_readers, cache,
                         bucket_by_length, bucket_bound_for)
 from .minibatch import batch
+from .pool import WorkerPool, pool_map, interleave
 from .prefetch import DeviceFeedIterator, double_buffer
 from . import creator
 from .creator import convert_reader_to_recordio_file
@@ -10,6 +11,7 @@ __all__ = [
     "map_readers", "buffered", "compose", "chain", "shuffle",
     "ComposeNotAligned", "firstn", "xmap_readers", "cache", "batch",
     "bucket_by_length", "bucket_bound_for",
+    "WorkerPool", "pool_map", "interleave",
     "DeviceFeedIterator", "double_buffer", "creator",
     "convert_reader_to_recordio_file",
 ]

@@ -33,9 +33,11 @@ def map_readers(func, *readers):
     return reader
 
 
-def shuffle(reader, buf_size):
+def shuffle(reader, buf_size, pool=None):
     """Buffered shuffling (decorator.py:52): fill a ``buf_size`` buffer,
-    shuffle it, emit, repeat. The classic streaming-shuffle compromise."""
+    shuffle it, emit, repeat. The classic streaming-shuffle compromise.
+    With ``pool`` (a reader.pool.WorkerPool) the buffer fill+shuffle runs
+    on a pool-bookkept staging thread, decoupled from the consumer."""
 
     def data_reader():
         buf = []
@@ -51,6 +53,8 @@ def shuffle(reader, buf_size):
             for b in buf:
                 yield b
 
+    if pool is not None:
+        return pool.background(data_reader, capacity=2)
     return data_reader
 
 
@@ -136,61 +140,12 @@ def firstn(reader, n):
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     """Parallel map over a reader with ``process_num`` worker threads
     (decorator.py:211 XmapEndSignal machinery). ``order=True`` preserves
-    input order via sequence numbers."""
-
-    class _End:
-        pass
-
-    def data_reader():
-        in_q = _queue.Queue(buffer_size)
-        out_q = _queue.Queue(buffer_size)
-
-        def feed():
-            for i, sample in enumerate(reader()):
-                in_q.put((i, sample))
-            for _ in range(process_num):
-                in_q.put(_End)
-
-        def work():
-            while True:
-                item = in_q.get()
-                if item is _End:
-                    out_q.put(_End)
-                    break
-                i, sample = item
-                out_q.put((i, mapper(sample)))
-
-        threading.Thread(target=feed, daemon=True).start()
-        workers = [threading.Thread(target=work, daemon=True)
-                   for _ in range(process_num)]
-        for w in workers:
-            w.start()
-
-        finished = 0
-        if order:
-            pending = {}
-            next_idx = 0
-            while finished < process_num:
-                item = out_q.get()
-                if item is _End:
-                    finished += 1
-                    continue
-                i, mapped = item
-                pending[i] = mapped
-                while next_idx in pending:
-                    yield pending.pop(next_idx)
-                    next_idx += 1
-            for i in sorted(pending):
-                yield pending[i]
-        else:
-            while finished < process_num:
-                item = out_q.get()
-                if item is _End:
-                    finished += 1
-                    continue
-                yield item[1]
-
-    return data_reader
+    input order via sequence numbers. A spelling of ``pool.pool_map``,
+    which replaces the reference's hang-on-error queue machinery with loud
+    worker-error propagation and leak-free shutdown."""
+    from .pool import pool_map
+    return pool_map(mapper, reader, process_num, ordered=order,
+                    capacity=buffer_size)
 
 
 def cache(reader):

@@ -9,7 +9,11 @@ fluid-era batch gained later — static-shape XLA steps want it).
 from __future__ import annotations
 
 
-def batch(reader, batch_size, drop_last=False):
+def batch(reader, batch_size, drop_last=False, pool=None):
+    """With ``pool`` (a reader.pool.WorkerPool) batch assembly runs on a
+    pool-bookkept staging thread, so the consumer pops ready batches off a
+    bounded queue while the next ones assemble."""
+
     def batch_reader():
         b = []
         for instance in reader():
@@ -20,4 +24,6 @@ def batch(reader, batch_size, drop_last=False):
         if b and not drop_last:
             yield b
 
+    if pool is not None:
+        return pool.background(batch_reader, capacity=2)
     return batch_reader

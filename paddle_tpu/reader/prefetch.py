@@ -17,41 +17,76 @@ double-buffer thread.
 from __future__ import annotations
 
 import queue as _queue
-import queue as _queue2
 import threading
 
 import jax
 
 
-def background_buffer(reader, capacity=2, stage=None):
+def background_buffer(reader, capacity=2, stage=None, register=None):
     """Record-agnostic bounded background prefetch: returns a creator whose
     iterator is fed by a daemon thread (``stage`` runs per item IN the
     feeder, e.g. jax.device_put). BaseException-safe: the end sentinel is
-    enqueued in a finally so the consumer can never hang, and feeder errors
-    re-raise consumer-side. One implementation for both the feed-dict
-    (DeviceFeedIterator) and slot-tuple (reader-graph op) flavors."""
+    enqueued in a finally so the consumer can never hang, feeder errors
+    re-raise consumer-side, and abandoning the iterator mid-pass releases
+    the feeder (stop flag polled on every bounded put). ``register`` is
+    called with ``(thread, stop_event)`` before each feeder starts
+    (WorkerPool.background uses it to bookkeep stagers and cancel/join
+    them at shutdown). One implementation for the feed-dict
+    (DeviceFeedIterator), slot-tuple (reader-graph op), and pool-staging
+    flavors."""
 
     def make():
-        q = _queue2.Queue(maxsize=max(1, int(capacity)))
+        q = _queue.Queue(maxsize=max(1, int(capacity)))
         end, err = object(), []
+        stop = threading.Event()
+
+        def put(item):
+            # bounded put that notices an abandoned consumer: without the
+            # stop check a `break` out of the consuming loop would leave the
+            # feeder blocked forever on the full queue, pinning its staged
+            # (device-resident) batches and the open readers
+            while True:
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except _queue.Full:
+                    if stop.is_set():
+                        return False
 
         def feed():
             try:
                 for item in reader():
-                    q.put(stage(item) if stage is not None else item)
+                    if not put(stage(item) if stage is not None else item) \
+                            or stop.is_set():
+                        return
             except BaseException as e:   # surface in consumer
                 err.append(e)
             finally:
-                q.put(end)
+                put(end)
 
-        threading.Thread(target=feed, daemon=True).start()
-        while True:
-            item = q.get()
-            if item is end:
-                if err:
-                    raise err[0]
-                return
-            yield item
+        t = threading.Thread(target=feed, daemon=True)
+        if register is not None:
+            register(t, stop)
+        t.start()
+        try:
+            while True:
+                try:
+                    item = q.get(timeout=0.05)
+                except _queue.Empty:
+                    if stop.is_set():
+                        # cancelled externally (pool shutdown): the feeder
+                        # is gone and may not have managed to enqueue the
+                        # end sentinel — fail loudly instead of hanging
+                        raise RuntimeError(
+                            "background reader cancelled mid-stream")
+                    continue
+                if item is end:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()
 
     return make
 
@@ -88,12 +123,12 @@ class DeviceFeedIterator:
     def _stage(self, batch):
         if self._convert is not None:
             batch = self._convert(batch)
-        staged = {}
-        for k, v in batch.items():
-            arr = jax.device_put(v, self._device)
-            if k in self._cast:
-                arr = arr.astype(self._cast[k])
-            staged[k] = arr
+        # ONE device_put per batch: the feed dict transfers as a single
+        # pytree submission instead of a host->device round trip per key
+        staged = dict(jax.device_put(dict(batch), self._device))
+        for k, dt in self._cast.items():
+            if k in staged:
+                staged[k] = staged[k].astype(dt)
         return staged
 
     def __iter__(self):

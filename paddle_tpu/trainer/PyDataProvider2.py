@@ -40,7 +40,16 @@ def _coerce_shuffle(value, is_train):
     if value is None:
         return bool(is_train)   # reference: shuffle iff training
     if isinstance(value, str):
-        value = value.lower()
+        lowered = value.lower()
+        if lowered in _TRUE:
+            return True
+        if lowered in _FALSE:
+            return False
+        # a typo like 'ture' must not silently become the is_train default
+        known = sorted(s for s in (_TRUE | _FALSE) if isinstance(s, str))
+        raise ValueError(
+            f"unrecognized should_shuffle string {value!r} (want one of "
+            f"{known})")
     if value in _TRUE:
         return True
     if value in _FALSE:

@@ -1246,12 +1246,22 @@ class _Projection:
                 dtype="float32")
             return fluid.layers.matmul(x, w, transpose_y=True)
         if self.kind == "identity":
-            if self.offset in (None, 0) and (in_size in (None, out_size)):
+            if self.offset is None:
+                # reference layers.py identity_projection config_assert:
+                # without an offset the sizes must agree — silently cropping
+                # to the first out_size columns would hide a wiring bug
+                if in_size not in (None, out_size):
+                    raise ValueError(
+                        f"identity_projection: input size {in_size} != "
+                        f"mixed_layer size {out_size} (pass offset= to "
+                        "select a column window)")
+                return x
+            if self.offset == 0 and in_size in (None, out_size):
                 return x
             # layers.py:548 identity_projection with offset: columns
             # [offset, offset+out_size)
             return fluid.layers.crop(
-                x, shape=[-1, out_size], offsets=[0, int(self.offset or 0)])
+                x, shape=[-1, out_size], offsets=[0, int(self.offset)])
         if self.kind == "table":
             ids = _unwrap(self.input, "seq_ids")   # int64 id sequence
             return fluid.layers.embedding(

@@ -402,3 +402,41 @@ def test_overlapped_remote_updater():
     assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])
     client.close()
     rpc.shutdown()
+
+
+def test_rpc_server_survives_client_dying_mid_handshake():
+    """A client that connects and dies before completing the authkey
+    challenge (an elastic trainer killed at the wrong moment) must not
+    kill the accept loop — later clients still get served."""
+    import socket
+
+    from paddle_tpu.distributed.rpc import RpcClient
+
+    ps, rpc = _start_ps(optimizer="sgd", mode="async")
+    for _ in range(3):
+        raw = socket.create_connection(rpc.address)
+        raw.close()          # vanish mid-handshake
+    time.sleep(0.2)          # let the accept loop hit the dead peers
+    c = RpcClient(rpc.address)
+    assert "params" in c.call("stats")
+    c.close()
+    rpc.shutdown()
+
+
+def test_parse_endpoint_tuple_passthrough():
+    """Tuple/list endpoints get the same coercion as 'host:port' strings:
+    int port, loopback default host, loud ValueError on a missing or
+    non-numeric port (advisor round-5 finding)."""
+    from paddle_tpu.distributed.param_server import parse_endpoint
+
+    assert parse_endpoint(("10.0.0.1", "7164")) == ("10.0.0.1", 7164)
+    assert parse_endpoint(["10.0.0.1", 7164]) == ("10.0.0.1", 7164)
+    assert parse_endpoint(("", 7164)) == ("127.0.0.1", 7164)
+    assert parse_endpoint(("h",), default_port=9) == ("h", 9)
+    with pytest.raises(ValueError):
+        parse_endpoint(("hostonly",))
+    with pytest.raises(ValueError):
+        parse_endpoint(("h", "notaport"))
+    # string form unchanged
+    assert parse_endpoint("h:80") == ("h", 80)
+    assert parse_endpoint(":80") == ("127.0.0.1", 80)

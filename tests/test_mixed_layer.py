@@ -182,3 +182,31 @@ def test_reference_hsigmoid_config_builds_and_trains(tmp_path):
     # 3-class hierarchical sigmoid on random labels sits near its ~2-bit
     # path cost; wildly larger values would mean broken code paths
     assert all(np.isfinite(c) and 0.2 < c < 3.0 for c in costs), costs
+
+
+def test_identity_projection_size_mismatch_raises():
+    """offset=None with in_size != out_size is a config error (reference
+    config_assert), not a silent crop to the first out_size columns; an
+    explicit offset selects a window as before."""
+    from paddle_tpu.v2.config_helpers import (
+        LayerOutput, identity_projection, mixed_layer)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        b = fluid.layers.data("b", shape=[5])
+        with pytest.raises(ValueError, match="identity_projection"):
+            with mixed_layer(size=3, act=None) as m:
+                m += identity_projection(input=LayerOutput(b, size=5))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        b = fluid.layers.data("b", shape=[5])
+        with mixed_layer(size=3, act=None) as m:
+            m += identity_projection(input=LayerOutput(b, size=5), offset=1)
+        out = m.var
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    bv = np.arange(10, dtype="float32").reshape(2, 5)
+    got, = exe.run(main, feed={"b": bv}, fetch_list=[out], scope=scope)
+    np.testing.assert_allclose(np.asarray(got), bv[:, 1:4])

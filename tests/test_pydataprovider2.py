@@ -182,3 +182,17 @@ def test_v2_data_feeder_converts_rows():
     np.testing.assert_array_equal(feed["image"],
                                   [[1, 2, 3, 4], [4, 3, 2, 1]])
     np.testing.assert_array_equal(feed["label"], [[5], [7]])
+
+
+def test_shuffle_typo_string_raises():
+    """A should_shuffle typo ('ture') must fail loudly at provider
+    construction, not silently fall back to the is_train default."""
+    import pytest
+    from paddle_tpu.trainer.PyDataProvider2 import integer_value, provider
+
+    @provider(input_types=[integer_value(10)], should_shuffle="ture")
+    def process(settings, filename):
+        yield 0
+
+    with pytest.raises(ValueError, match="ture"):
+        process(["f"], is_train=True)

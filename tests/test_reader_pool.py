@@ -1,0 +1,386 @@
+"""Host data-pipeline tests: the reader WorkerPool (ordered/unordered map,
+error propagation, clean shutdown), the sharded open_files(thread_num=N)
+decode chain, and the Executor's _ProgramAnalysis cache.
+
+Reference analog: the C++ multi-threaded prefetch pool behind
+operators/reader/create_double_buffer_reader_op.cc and open_files'
+thread_num; the analysis cache mirrors the Prepare/RunPreparedContext
+split (framework/executor.cc:271)."""
+
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.reader.pool import WorkerPool, interleave, pool_map
+
+
+# ---------------------------------------------------------------------------
+# WorkerPool core
+# ---------------------------------------------------------------------------
+
+def test_pool_ordered_preserves_input_order():
+    with WorkerPool(4) as p:
+        # jittered task durations so completion order differs from input
+        def f(x):
+            time.sleep(0.002 * (x % 3))
+            return x * x
+
+        assert list(p.imap(f, range(30), ordered=True)) == \
+            [x * x for x in range(30)]
+
+
+def test_pool_unordered_exactly_once():
+    with WorkerPool(4) as p:
+        def f(x):
+            time.sleep(0.002 * (x % 3))
+            return x * x
+
+        out = list(p.imap(f, range(30), ordered=False))
+    # completion order, but every input mapped exactly once
+    assert sorted(out) == [x * x for x in range(30)]
+
+
+def test_pool_worker_exception_propagates():
+    def boom(x):
+        if x == 7:
+            raise ValueError("decode failed on record 7")
+        return x
+
+    with WorkerPool(3) as p:
+        with pytest.raises(ValueError, match="record 7"):
+            list(p.imap(boom, range(20)))
+
+
+def test_pool_feeder_exception_propagates():
+    def bad_source():
+        yield 1
+        yield 2
+        raise OSError("shard truncated")
+
+    with WorkerPool(2) as p:
+        with pytest.raises(OSError, match="shard truncated"):
+            list(p.imap(lambda x: x, bad_source()))
+
+
+def test_pool_shutdown_leaks_no_threads():
+    p = WorkerPool(4)
+    assert list(p.imap(lambda x: -x, range(50))) == \
+        [-x for x in range(50)]
+    # abandon a second stream mid-flight, then shut down
+    g = p.imap(lambda x: x, range(1000))
+    next(g)
+    g.close()
+    p.shutdown()
+    assert p.live_threads() == []
+    # idempotent
+    p.shutdown()
+
+
+def test_pool_shutdown_mid_stream_raises():
+    """shutdown() racing an active stream cancels it loudly (RuntimeError),
+    never hangs the consumer, and still joins every thread."""
+    def slow(x):
+        time.sleep(0.005)
+        return x
+
+    p = WorkerPool(2)
+    g = p.imap(slow, range(500))
+    next(g)
+    p.shutdown()
+    with pytest.raises(RuntimeError, match="shut down"):
+        list(g)
+    assert p.live_threads() == []
+    with pytest.raises(RuntimeError, match="shut-down"):
+        p.imap(lambda x: x, range(3))
+
+
+def test_pool_shutdown_cancels_background_stagers():
+    """shutdown() cancels live background() stagers promptly — no
+    timeout-long stall, no leaked stage thread."""
+    p = WorkerPool(2)
+    it = p.background(lambda: iter(range(100_000)), capacity=2)()
+    assert next(it) == 0
+    t0 = time.time()
+    p.shutdown()
+    assert time.time() - t0 < 2.0
+    assert p.live_threads() == []
+
+
+def test_background_buffer_abandon_unblocks_feeder():
+    """Breaking out of a prefetch iterator mid-pass releases the feeder:
+    production stops instead of blocking forever on the full queue."""
+    from paddle_tpu.reader.prefetch import background_buffer
+
+    fed = []
+
+    def reader():
+        for i in range(10_000):
+            fed.append(i)
+            yield i
+
+    it = background_buffer(reader, capacity=2)()
+    assert next(it) == 0
+    it.close()
+    time.sleep(0.3)       # feeder notices the stop flag within one tick
+    n_after_close = len(fed)
+    time.sleep(0.2)
+    assert len(fed) == n_after_close < 10_000
+
+
+def test_pool_concurrent_workers():
+    """thread_num=4 means 4 decodes genuinely in flight at once: each
+    decode blocks on a 4-party barrier, so a pool running fewer than 4
+    concurrent workers would deadlock (BrokenBarrierError via timeout)."""
+    barrier = threading.Barrier(4, timeout=10)
+
+    def decode(x):
+        barrier.wait()
+        return x
+
+    with WorkerPool(4) as p:
+        assert sorted(p.imap(decode, range(8), ordered=False)) == \
+            list(range(8))
+
+
+def test_interleave_round_robin_exactly_once():
+    r = interleave([lambda: iter([0, 3, 5]), lambda: iter([1, 4]),
+                    lambda: iter([2])])
+    assert list(r()) == [0, 1, 2, 3, 4, 5]
+    # re-iterable: a reader, not a one-shot iterator
+    assert sorted(r()) == [0, 1, 2, 3, 4, 5]
+
+
+def test_interleave_max_open_bounds_live_shards():
+    """max_open shards are live at once; finished shards hand their slot
+    to pending ones — still exactly-once over everything."""
+    started = []
+
+    def shard(i):
+        def reader():
+            started.append(i)
+            yield from (i * 10 + j for j in range(3))
+        return reader
+
+    r = interleave([shard(i) for i in range(6)], max_open=2)
+    it = r()
+    first = [next(it) for _ in range(4)]
+    assert len(started) == 2          # only max_open shards opened so far
+    out = first + list(it)
+    assert sorted(out) == sorted(i * 10 + j for i in range(6)
+                                 for j in range(3))
+    assert len(started) == 6
+
+
+def test_post_hoc_persistable_flip_invalidates_analysis():
+    """var.persistable = True after a run bumps the program version, so the
+    cached analysis recomputes and the var joins the persistable writes."""
+    from paddle_tpu.core.executor import _analyze_program
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3])
+        y = fluid.layers.fc(x, 2)
+    a1 = _analyze_program(main)
+    assert y.name not in a1.persistable_written
+    main.global_block().var(y.name).persistable = True
+    a2 = _analyze_program(main)
+    assert a2 is not a1
+    assert y.name in a2.persistable_written
+
+
+def test_pool_map_transient_pool_cleans_up():
+    before = {t.name for t in threading.enumerate()}
+    r = pool_map(lambda x: x + 1, lambda: iter(range(40)), thread_num=3)
+    assert list(r()) == list(range(1, 41))
+    time.sleep(0.05)
+    leaked = [t for t in threading.enumerate()
+              if t.name.startswith("reader-pool") and t.name not in before
+              and t.is_alive()]
+    assert leaked == []
+
+
+# ---------------------------------------------------------------------------
+# sharded open_files chain
+# ---------------------------------------------------------------------------
+
+def _write_shards(tmp_path, counts):
+    """One recordio file per count; record i is (np-array batch, label i),
+    labels globally unique across shards."""
+    from paddle_tpu.recordio import write_records
+
+    paths, label = [], 0
+    for s, count in enumerate(counts):
+        recs = []
+        for _ in range(count):
+            recs.append(pickle.dumps(
+                (np.full((2, 3), label, "float32"),
+                 np.full((2, 1), label, "int64"))))
+            label += 1
+        p = str(tmp_path / f"shard-{s}.recordio")
+        write_records(p, recs)
+        paths.append(p)
+    return paths, label
+
+
+def test_recordio_sharded_concurrent_decode(tmp_path):
+    """The decode behind open_files(thread_num=4) runs 4-wide: decoders
+    rendezvous on a 4-party barrier, impossible with fewer workers."""
+    from paddle_tpu.reader.creator import recordio_sharded
+
+    paths, total = _write_shards(tmp_path, [2, 2, 2, 2])
+    barrier = threading.Barrier(4, timeout=10)
+
+    def decode(rec):
+        barrier.wait()
+        return pickle.loads(rec)
+
+    reader = recordio_sharded(paths, thread_num=4, decoder=decode,
+                              ordered=False)
+    labels = sorted(int(s[1].reshape(-1)[0]) for s in reader())
+    assert labels == list(range(total))
+
+
+def test_open_files_chain_exactly_once(tmp_path):
+    """End-to-end fluid chain: open_files(thread_num=4) over uneven shards
+    -> read_file pops every record exactly once, decoded through a
+    4-thread WorkerPool (spied on), ending the pass with StopIteration."""
+    from paddle_tpu.reader import pool as pool_mod
+
+    paths, total = _write_shards(tmp_path, [3, 5, 2, 4])
+
+    pool_widths = []
+    orig_init = pool_mod.WorkerPool.__init__
+
+    def spying_init(self, thread_num, capacity=None):
+        pool_widths.append(thread_num)
+        orig_init(self, thread_num, capacity)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.open_files(
+            paths, thread_num=4, shapes=[[-1, 3], [-1, 1]],
+            lod_levels=[0, 0], dtypes=["float32", "int64"])
+        img, lbl = fluid.layers.read_file(reader)
+
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    pool_mod.WorkerPool.__init__ = spying_init
+    try:
+        seen = []
+        for _ in range(total):
+            iv, lv = exe.run(main, fetch_list=[img, lbl], scope=scope,
+                             use_program_cache=False)
+            assert np.asarray(iv).shape == (2, 3)
+            seen.append(int(np.asarray(lv).reshape(-1)[0]))
+        with pytest.raises(StopIteration):
+            exe.run(main, fetch_list=[img], scope=scope,
+                    use_program_cache=False)
+    finally:
+        pool_mod.WorkerPool.__init__ = orig_init
+    # every record from every shard exactly once (no loss, no duplication)
+    assert sorted(seen) == list(range(total))
+    assert pool_widths == [4]
+
+
+def test_open_files_thread1_serial_path(tmp_path):
+    """thread_num=1 keeps the serial no-pool path and the same exactly-once
+    delivery (deterministic file order)."""
+    paths, total = _write_shards(tmp_path, [2, 3])
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.open_files(
+            paths, thread_num=1, shapes=[[-1, 3], [-1, 1]],
+            lod_levels=[0, 0], dtypes=["float32", "int64"])
+        img, lbl = fluid.layers.read_file(reader)
+
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    seen = [int(np.asarray(exe.run(main, fetch_list=[lbl], scope=scope,
+                                   use_program_cache=False)[0]).reshape(-1)[0])
+            for _ in range(total)]
+    assert seen == list(range(total))
+
+
+def test_shuffle_and_batch_accept_pool(tmp_path):
+    """shuffle/batch with a pool stage through pool-bookkept threads and
+    still deliver every sample exactly once."""
+    from paddle_tpu.reader import batch, shuffle
+
+    src = lambda: iter(range(57))
+    with WorkerPool(2) as p:
+        shuffled = shuffle(src, buf_size=16, pool=p)
+        batched = batch(shuffled, 10, pool=p)
+        out = [s for b in batched() for s in b]
+        assert sorted(out) == list(range(57))
+    assert p.live_threads() == []
+
+
+# ---------------------------------------------------------------------------
+# Executor program-analysis cache
+# ---------------------------------------------------------------------------
+
+def test_executor_analysis_cache_no_steady_state_walk(monkeypatch):
+    """Steady-state Executor.run does NO block walk: free_reads runs once
+    per (program, version), then every later run() is a cache hit."""
+    import paddle_tpu.core.block_walk as bw
+
+    calls = {"free": 0}
+    orig = bw.free_reads
+
+    def counting(*a, **kw):
+        calls["free"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(bw, "free_reads", counting)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3])
+        y = fluid.layers.fc(x, 4)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss, startup)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    feed = {"x": np.ones((2, 3), "float32")}
+    exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    after_first = calls["free"]
+    for _ in range(4):
+        exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    assert calls["free"] == after_first, \
+        "steady-state run() re-walked the program"
+    # mutating the program invalidates the cache (version bump); mean adds
+    # an op + tmp var but no parameter, so the scope stays valid
+    with fluid.program_guard(main, startup):
+        fluid.layers.mean(y)
+    exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    assert calls["free"] == after_first + 1
+
+
+def test_executor_analysis_cache_results_match_walk():
+    """Cached analysis equals a fresh walk (same free/written contract)."""
+    from paddle_tpu.core.block_walk import free_reads, written_names
+    from paddle_tpu.core.executor import _analyze_program
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        loss = fluid.layers.mean(fluid.layers.fc(x, 3))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss, startup)
+
+    a = _analyze_program(main)
+    assert a.free == free_reads(main, 0)
+    assert a.written == written_names(main, 0)
+    blk = main.global_block()
+    assert a.persistable_written == frozenset(
+        n for n in a.written if blk.has_var(n) and blk.var(n).persistable)
+    # second call returns the identical cached object
+    assert _analyze_program(main) is a
