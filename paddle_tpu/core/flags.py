@@ -143,6 +143,14 @@ DEFINE_flag("bn_bf16_stats", False,
             "accumulator width is on the critical path of the conv+stat "
             "reduce fusions")
 
+DEFINE_flag("pserver_barrier_timeout_s", 60.0,
+            "parameter-server wait bound in seconds: how long a sync-mode "
+            "push waits at the fan-in barrier (and an async push waits on "
+            "bounded staleness) before declaring the round broken by a dead "
+            "peer and raising TimeoutError. Overridable per server via "
+            "ParameterServer(barrier_timeout_s=...)/serve(); the flag is "
+            "the process-wide default (was a hardcoded 60.0)")
+
 DEFINE_flag("conv_1x1_grad_as_dot", False,
             "A/B probe: emit 1x1-conv input/filter gradients as dot_general "
             "channel matmuls instead of jax's transposed convolutions (see "

@@ -9,13 +9,22 @@ dispatch with task leases and retries — runs as host services, mirroring
 the reference's listen_and_serv/ParameterServer2/Go-master designs
 (SURVEY.md §2.3). Everything is testable multiprocess-on-localhost
 (reference test_recv_op.py pattern).
+
+Fault tolerance (the v2 etcd-backed generation's contract): pserver
+checkpoint/restore with sequence-number replay dedup (param_server),
+reconnect-and-resend retry (rpc.RetryPolicy), supervised failover
+(launch.PserverSupervisor), and deterministic fault injection for tests
+(fault.FaultPlan).
 """
 
 from .param_server import (ParameterServer, ParamClient, serve, shard_names,
                            OPTIMIZERS, OverlappedRemoteUpdater)
 from .master import Master, MasterClient
-from .rpc import RpcServer, RpcClient
+from .rpc import RpcServer, RpcClient, RetryPolicy
+from .fault import FaultPlan
+from .launch import PserverSupervisor
 
 __all__ = ["ParameterServer", "ParamClient", "serve", "shard_names",
            "OPTIMIZERS", "OverlappedRemoteUpdater", "Master", "MasterClient",
-           "RpcServer", "RpcClient"]
+           "RpcServer", "RpcClient", "RetryPolicy", "FaultPlan",
+           "PserverSupervisor"]

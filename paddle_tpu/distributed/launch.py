@@ -20,6 +20,8 @@ import os
 import socket
 import subprocess
 import sys
+import threading
+import time
 
 ENV_COORD = "PDTPU_COORDINATOR"
 ENV_NPROC = "PDTPU_NUM_PROCESSES"
@@ -95,6 +97,172 @@ def launch(script, script_args=(), nproc=2, devices_per_proc=None,
         for line in outputs[rank].splitlines():
             print(f"[rank {rank}] {line}")
     return codes
+
+
+def _pserver_child(address, checkpoint_path, cfg):
+    """Child-process entry: serve one pserver shard on a FIXED address,
+    restoring from its checkpoint when one exists (the restart path)."""
+    from .param_server import serve
+    _ps, rpc = serve(address=tuple(address), checkpoint_path=checkpoint_path,
+                     **cfg)
+    rpc.serve_forever()
+
+
+class PserverSupervisor:
+    """Supervise N parameter-server processes: spawn each shard on a fixed
+    address with a per-shard checkpoint file, heartbeat the children over
+    RPC, and restart a dead (or wedged) shard from its latest checkpoint on
+    the SAME address — so a trainer's ``ParamClient`` placement stays valid
+    and its retry policy (rpc.RetryPolicy) reconnects straight through the
+    restart. The reference analog is the etcd-supervised v2 Go pserver
+    (go/pserver: a crashed server pod restarts, recovers its checkpoint,
+    and trainers transparently reconnect).
+
+        with PserverSupervisor(n_servers=2, checkpoint_dir=d) as sup:
+            client = ParamClient(sup.addresses, retry=RetryPolicy())
+            client.init_params(params)   # first-write-wins: a RESTORED
+            ...                          # shard keeps its restored state
+
+    A trainer resuming against a restarted shard just keeps pushing: it may
+    re-run ``init_params`` (no-op against restored params) and the shard's
+    sequence-number dedup absorbs any replayed push.
+    """
+
+    def __init__(self, n_servers=1, checkpoint_dir=None, optimizer="sgd",
+                 opt_kwargs=None, mode="async", fan_in=1, max_staleness=None,
+                 barrier_timeout_s=None, checkpoint_every=1,
+                 heartbeat_interval_s=0.25, heartbeat_timeout_s=5.0,
+                 heartbeat_misses=3, max_restarts=5, host="127.0.0.1"):
+        import multiprocessing as mp
+        import tempfile
+
+        self._cfg = dict(optimizer=optimizer, opt_kwargs=opt_kwargs,
+                         mode=mode, fan_in=fan_in,
+                         max_staleness=max_staleness,
+                         barrier_timeout_s=barrier_timeout_s,
+                         checkpoint_every=checkpoint_every)
+        self._ckpt_dir = checkpoint_dir or tempfile.mkdtemp(
+            prefix="pdtpu_pserver_ckpt_")
+        os.makedirs(self._ckpt_dir, exist_ok=True)
+        # fork: the children reuse the parent's imported modules and the
+        # pserver path is numpy-only (no jax backend touched in-child)
+        self._ctx = mp.get_context("fork")
+        self.addresses = [(host, free_port()) for _ in range(n_servers)]
+        self.restarts = [0] * n_servers
+        self._max_restarts = int(max_restarts)
+        self._interval = float(heartbeat_interval_s)
+        self._hb_timeout = float(heartbeat_timeout_s)
+        self._hb_misses_allowed = int(heartbeat_misses)
+        self._hb_failures = [0] * n_servers
+        self._hb_clients = [None] * n_servers
+        self._hb_lock = threading.Lock()  # monitor + wait_ready share these
+        self._procs = [None] * n_servers
+        self._stop = threading.Event()
+        # gates _spawn against stop(): without it the monitor could respawn
+        # a child between stop()'s flag-set and its terminate sweep,
+        # leaking a live pserver on the fixed port
+        self._spawn_lock = threading.Lock()
+        for i in range(n_servers):
+            self._spawn(i)
+        self._monitor = threading.Thread(target=self._watch, daemon=True)
+        self._monitor.start()
+
+    def checkpoint_path(self, i):
+        return os.path.join(self._ckpt_dir, f"pserver{i}.ckpt")
+
+    def _spawn(self, i):
+        with self._spawn_lock:
+            if self._stop.is_set():
+                return
+            p = self._ctx.Process(
+                target=_pserver_child,
+                args=(self.addresses[i], self.checkpoint_path(i),
+                      self._cfg),
+                daemon=True)
+            p.start()
+            self._procs[i] = p
+            self._hb_failures[i] = 0
+
+    def _heartbeat_ok(self, i):
+        from .rpc import RpcClient
+        with self._hb_lock:
+            try:
+                if self._hb_clients[i] is None:
+                    self._hb_clients[i] = RpcClient(
+                        self.addresses[i], timeout=self._hb_timeout)
+                self._hb_clients[i].call("stats")
+                return True
+            except Exception:
+                c, self._hb_clients[i] = self._hb_clients[i], None
+                if c is not None:
+                    c.close()
+                return False
+
+    def _watch(self):
+        while not self._stop.wait(self._interval):
+            for i in range(len(self._procs)):
+                p = self._procs[i]
+                if self._stop.is_set() or p is None:
+                    continue
+                if p.is_alive():
+                    if self._heartbeat_ok(i):
+                        self._hb_failures[i] = 0
+                        continue
+                    self._hb_failures[i] += 1
+                    if self._hb_failures[i] < self._hb_misses_allowed:
+                        continue
+                    p.terminate()  # alive but not answering: wedged
+                p.join()
+                if self._stop.is_set():
+                    return
+                if self.restarts[i] >= self._max_restarts:
+                    self._procs[i] = None  # crash-looping: give the shard up
+                    continue
+                self.restarts[i] += 1
+                self._spawn(i)
+
+    def kill(self, i):
+        """Hard-kill shard ``i`` (SIGKILL — no atexit, exactly a crash);
+        the monitor restarts it from its latest checkpoint. Test hook."""
+        p = self._procs[i]
+        if p is not None and p.is_alive():
+            p.kill()
+
+    def wait_ready(self, timeout=10.0):
+        """Block until every live shard answers an RPC — the post-start
+        (or post-restart) barrier tests want before pushing."""
+        deadline = time.monotonic() + timeout
+        for i in range(len(self.addresses)):
+            while self._procs[i] is not None and not self._heartbeat_ok(i):
+                if time.monotonic() > deadline:
+                    return False
+                time.sleep(0.05)
+        return True
+
+    def stop(self):
+        self._stop.set()
+        self._monitor.join(self._interval * 4 + self._hb_timeout + 1.0)
+        for c in self._hb_clients:
+            if c is not None:
+                c.close()
+        with self._spawn_lock:
+            # after this acquisition no new child can start (_spawn sees
+            # _stop), and any child a racing _spawn just started is in
+            # _procs for this sweep
+            procs = list(self._procs)
+        for p in procs:
+            if p is not None and p.is_alive():
+                p.terminate()
+        for p in procs:
+            if p is not None:
+                p.join(5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
 
 
 def main(argv=None):

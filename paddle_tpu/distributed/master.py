@@ -16,6 +16,7 @@ import os
 import pickle
 import threading
 import time
+import warnings
 
 
 class Task:
@@ -57,8 +58,16 @@ class Master:
         self._done = []
         self._pass_id = 0
         self._next_id = 0
-        if snapshot_path and os.path.exists(snapshot_path):
-            self._recover()
+        if snapshot_path:
+            # a crash mid-snapshot leaves a stale .tmp beside the real
+            # file; it is never valid state (os.replace is the commit
+            # point), so clean it up on every start
+            try:
+                os.unlink(snapshot_path + ".tmp")
+            except OSError:
+                pass
+            if os.path.exists(snapshot_path):
+                self._recover()
 
     # ---- RPC surface ----
     def set_dataset(self, chunks, chunks_per_task=1):
@@ -176,20 +185,36 @@ class Master:
         os.replace(tmp, self._snapshot_path)  # atomic
 
     def _recover(self):
-        with open(self._snapshot_path, "rb") as f:
-            state = pickle.load(f)
-        for s in state["todo"]:
-            t = Task(s["task_id"], s["chunks"])
-            t.failures = s["failures"]
-            t.epoch = s.get("epoch", 0)
-            self._todo.append(t)
-        for s in state["done"]:
-            t = Task(s["task_id"], s["chunks"])
-            t.failures = s["failures"]
-            t.epoch = s.get("epoch", 0)
-            self._done.append(t)
-        self._next_id = state["next_id"]
-        self._pass_id = state["pass_id"]
+        """Resume from the snapshot; a corrupt/truncated file (the master
+        crashed while the disk was unhappy) must NOT crash the restarted
+        master — warn and start with a fresh queue instead. The full state
+        is parsed before any of it is installed, so a half-bad snapshot
+        can't leave a half-recovered queue."""
+        try:
+            with open(self._snapshot_path, "rb") as f:
+                state = pickle.load(f)
+            todo, done = [], []
+            for s in state["todo"]:
+                t = Task(s["task_id"], s["chunks"])
+                t.failures = s["failures"]
+                t.epoch = s.get("epoch", 0)
+                todo.append(t)
+            for s in state["done"]:
+                t = Task(s["task_id"], s["chunks"])
+                t.failures = s["failures"]
+                t.epoch = s.get("epoch", 0)
+                done.append(t)
+            next_id = int(state["next_id"])
+            pass_id = int(state["pass_id"])
+        except Exception as e:
+            warnings.warn(
+                f"master snapshot {self._snapshot_path!r} unreadable "
+                f"({type(e).__name__}: {e}); starting with a fresh queue")
+            return
+        self._todo = todo
+        self._done = done
+        self._next_id = next_id
+        self._pass_id = pass_id
 
 
 class MasterClient:

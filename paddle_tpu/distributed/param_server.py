@@ -15,6 +15,15 @@ Reference capabilities reproduced (SURVEY.md §2.3 "DP (sync+async)"):
   (distribute_transpiler.py:92 split_dense_variable + round robin
   distributed_spliter.py:16), optimizer state living WITH the shard
   (the Go pserver runs the optimizer in-server, go/pserver/optimizer.go).
+* fault tolerance — the v2 etcd-backed Go pserver's crash contract
+  (go/pserver/service.go checkpoint/recover): ``save_checkpoint`` persists
+  params + optimizer state + progress counters + replay-dedup marks
+  atomically (tmp + os.replace); ``serve(checkpoint_path=...)`` restores on
+  startup and auto-checkpoints as updates apply. Trainer pushes carry a
+  per-trainer monotonic sequence number, so a push replayed by an RPC
+  retry (rpc.RetryPolicy) after a lost response is answered from the
+  server's dedup table instead of double-applied — exactly-once relative
+  to the state the server is serving.
 
 The server is pure numpy (no jax): it runs as a plain OS process, the way
 the reference pserver is a separate binary; trainers are this framework's
@@ -23,10 +32,14 @@ executors pushing fetched gradients.
 
 from __future__ import annotations
 
+import os
+import pickle
 import threading
+import warnings
 
 import numpy as np
 
+from ..core.flags import get_flag
 from .rpc import RpcServer, RpcClient
 
 
@@ -80,14 +93,25 @@ OPTIMIZERS = {"sgd": SgdRule, "momentum": MomentumRule, "adam": AdamRule}
 
 class ParameterServer:
     """One shard server. mode="sync" aggregates fan_in pushes per step;
-    mode="async" applies each push immediately with bounded staleness."""
+    mode="async" applies each push immediately with bounded staleness.
+
+    ``barrier_timeout_s`` bounds the sync fan-in barrier and the async
+    staleness wait (default: the ``pserver_barrier_timeout_s`` flag).
+    ``checkpoint_path`` + ``checkpoint_every`` enable crash tolerance: the
+    full server state is persisted atomically every ``checkpoint_every``
+    applied updates (sync rounds / async pushes), and ``restore()`` loads
+    it back after a restart."""
 
     def __init__(self, optimizer="sgd", opt_kwargs=None, mode="async",
-                 fan_in=1, max_staleness=None):
+                 fan_in=1, max_staleness=None, barrier_timeout_s=None,
+                 checkpoint_path=None, checkpoint_every=1):
         self._rule = OPTIMIZERS[optimizer](**(opt_kwargs or {}))
         self._mode = mode
         self._fan_in = fan_in
         self._max_staleness = max_staleness
+        if barrier_timeout_s is None:
+            barrier_timeout_s = get_flag("pserver_barrier_timeout_s")
+        self._barrier_timeout = float(barrier_timeout_s)
         self._params = {}
         self._opt_state = {}
         self._lock = threading.Condition()
@@ -98,11 +122,29 @@ class ParameterServer:
         self._broken_round = -1  # round invalidated by a barrier timeout
         # async-mode staleness tracking
         self._trainer_steps = {}
+        # replay dedup: per-trainer newest APPLIED seq (its gradient is in
+        # the params) and the newest push's (seq, outcome) for answering
+        # duplicates — outcome None while the original is still in flight
+        self._applied_seq = {}
+        self._seq_result = {}
+        self._round_contribs = []  # (trainer_id, seq) in the open sync round
+        # checkpointing: snapshots are TAKEN under the condition lock (at
+        # the apply point, so dedup marks and params are captured at the
+        # same instant) but WRITTEN outside it — disk IO must not stall
+        # every other trainer's push/pull or the supervisor's heartbeat
+        self._checkpoint_path = checkpoint_path
+        self._checkpoint_every = int(checkpoint_every)
+        self._updates_since_ckpt = 0
+        self._state_version = 0       # bumped per applied update
+        self._due_ckpt = None         # (version, snapshot) pending a write
+        self._ckpt_io_lock = threading.Lock()
+        self._ckpt_written_version = -1
 
     # ---- RPC surface ----
     def init_params(self, params):
         """First trainer wins (reference: startup program runs once;
-        go/pserver InitParam)."""
+        go/pserver InitParam) — which also makes a restarted server's
+        RESTORED params win over a resuming trainer's re-init."""
         with self._lock:
             for name, value in params.items():
                 if name not in self._params:
@@ -115,12 +157,73 @@ class ParameterServer:
             names = names or list(self._params)
             return {n: self._params[n] for n in names}
 
-    def push(self, grads, trainer_id=0):
-        if self._mode == "sync":
-            return self._push_sync(grads)
-        return self._push_async(grads, trainer_id)
+    def push(self, grads, trainer_id=0, seq=None):
+        """Apply (sync: accumulate) gradients. ``seq`` is the trainer's
+        monotonic push counter (ParamClient assigns it): a replayed push —
+        an RPC retry after the response was lost — is detected server-side
+        and answered with the original outcome instead of re-applied. A
+        replay of a push still blocked at the barrier joins the wait."""
+        with self._lock:
+            if seq is None:
+                if self._mode == "sync":
+                    out = self._push_sync(grads)
+                else:
+                    out = self._push_async(grads, trainer_id)
+            else:
+                newest = self._newest_seq_locked(trainer_id)
+                if newest is not None and seq <= newest:
+                    return self._replay_locked(trainer_id, seq)
+                self._seq_result[trainer_id] = [seq, None]
+                try:
+                    if self._mode == "sync":
+                        out = self._push_sync(grads, trainer_id, seq)
+                    else:
+                        out = self._push_async(grads, trainer_id, seq)
+                except Exception as e:
+                    self._seq_result[trainer_id] = [seq, ("err", e)]
+                    self._lock.notify_all()
+                    raise
+                self._seq_result[trainer_id] = [seq, ("ok", out)]
+                self._lock.notify_all()
+            # claim any checkpoint this push made due BEFORE releasing the
+            # lock (only the completing thread sees its own snapshot), then
+            # write it outside the lock but before acking — durable state
+            # always includes an acked update when checkpoint_every=1
+            due, self._due_ckpt = self._due_ckpt, None
+        if due is not None:
+            self._write_checkpoint(self._checkpoint_path, *due)
+        return out
 
-    def _push_sync(self, grads):
+    def _newest_seq_locked(self, trainer_id):
+        rec = self._seq_result.get(trainer_id)
+        newest = self._applied_seq.get(trainer_id)
+        if rec is not None and (newest is None or rec[0] > newest):
+            newest = rec[0]
+        return newest
+
+    def _replay_locked(self, trainer_id, seq):
+        rec = self._seq_result.get(trainer_id)
+        if rec is not None and rec[0] == seq:
+            # duplicate of the newest push; the original may still be
+            # blocked at the barrier (its connection died mid-wait)
+            while rec is not None and rec[0] == seq and rec[1] is None:
+                if not self._lock.wait(timeout=self._barrier_timeout):
+                    raise TimeoutError(
+                        "replayed push timed out waiting for the original")
+                rec = self._seq_result.get(trainer_id)
+            if rec is not None and rec[0] == seq:
+                kind, payload = rec[1]
+                if kind == "err":
+                    raise payload
+                return payload
+        # older than the newest applied seq (or known only through a
+        # restored checkpoint's dedup table): its effect is already in the
+        # params — answer with the authoritative progress counter
+        if self._mode == "sync":
+            return self._round
+        return self._trainer_steps.get(trainer_id, 0)
+
+    def _push_sync(self, grads, trainer_id=None, seq=None):
         """Accumulate; the fan_in-th push triggers the optimize step and
         wakes all waiters (the batch-barrier contract). A barrier timeout
         ABANDONS the round (advancing the round counter), so retried pushes
@@ -132,6 +235,8 @@ class ParameterServer:
                 acc = self._pending.get(n)
                 self._pending[n] = np.asarray(g, np.float32) if acc is None \
                     else acc + np.asarray(g, np.float32)
+            if seq is not None:
+                self._round_contribs.append((trainer_id, seq))
             self._push_count += 1
             if self._push_count >= self._fan_in:
                 for n, g in self._pending.items():
@@ -141,19 +246,29 @@ class ParameterServer:
                 self._pending = {}
                 self._push_count = 0
                 self._round += 1
+                # every contributor's gradient is now IN the params; mark
+                # the seqs applied in the SAME critical section (and
+                # checkpoint if due) so no checkpoint can hold the update
+                # without its dedup marks or the marks without the update
+                for t, s in self._round_contribs:
+                    self._applied_seq[t] = s
+                self._round_contribs = []
+                self._maybe_checkpoint_locked()
                 self._lock.notify_all()
             else:
                 while (self._round == my_round
                        and self._broken_round != my_round):
-                    if not self._lock.wait(timeout=60.0):
+                    if not self._lock.wait(timeout=self._barrier_timeout):
                         # a dead trainer broke the barrier: discard the
                         # whole round's partial aggregation AND advance the
                         # round so retried pushes accumulate fresh, then
-                        # fail every waiter
+                        # fail every waiter. Nothing applied -> no seqs
+                        # marked; a trainer-level retry re-sends in full.
                         self._broken_round = my_round
                         self._round += 1
                         self._pending = {}
                         self._push_count = 0
+                        self._round_contribs = []
                         self._lock.notify_all()
                         raise TimeoutError("sync barrier timed out")
                 if self._broken_round == my_round:
@@ -161,7 +276,7 @@ class ParameterServer:
                                        "timeout; round discarded")
             return self._round
 
-    def _push_async(self, grads, trainer_id):
+    def _push_async(self, grads, trainer_id, seq=None):
         with self._lock:
             if self._max_staleness is not None and self._trainer_steps:
                 # block while this trainer is too far ahead of the slowest
@@ -175,7 +290,7 @@ class ParameterServer:
                     return me - min(others) > self._max_staleness
 
                 while too_fast():
-                    if not self._lock.wait(timeout=60.0):
+                    if not self._lock.wait(timeout=self._barrier_timeout):
                         raise TimeoutError("staleness wait timed out")
             for n, g in grads.items():
                 self._params[n] = self._rule.apply(
@@ -183,13 +298,130 @@ class ParameterServer:
                     self._opt_state[n])
             self._trainer_steps[trainer_id] = \
                 self._trainer_steps.get(trainer_id, 0) + 1
+            if seq is not None:
+                self._applied_seq[trainer_id] = seq
+            self._maybe_checkpoint_locked()
             self._lock.notify_all()
             return self._trainer_steps[trainer_id]
 
     def stats(self):
         with self._lock:
             return {"params": sorted(self._params), "round": self._round,
-                    "trainer_steps": dict(self._trainer_steps)}
+                    "trainer_steps": dict(self._trainer_steps),
+                    "applied_seq": dict(self._applied_seq)}
+
+    # ---- checkpoint / restore (the Go pserver's crash contract) ----
+    def save_checkpoint(self, path=None):
+        """Atomically persist the full server state: params, optimizer
+        state, sync round, per-trainer step counters, and the replay-dedup
+        table. The dedup marks travel WITH the params: a restore rolls both
+        back to the same instant, so a replayed push re-applies exactly
+        when its effect was lost with the crash and never when it
+        survived. Returns the path written."""
+        path = path or self._checkpoint_path
+        if not path:
+            raise ValueError("no checkpoint path: pass path= or construct "
+                             "with checkpoint_path=")
+        with self._lock:
+            version, snapshot = self._snapshot_locked()
+        self._write_checkpoint(path, version, snapshot)
+        return path
+
+    def _snapshot_locked(self):
+        """Consistent point-in-time copy of the server state. Shallow
+        per-dict copies suffice: the optimizer rules REBIND array values
+        (value - lr*..., state["m1"] = ...), never mutate them in place,
+        so the captured arrays are immutable once snapshotted."""
+        state = {
+            "version": 1,
+            "params": dict(self._params),
+            "opt_state": {n: dict(st) for n, st in self._opt_state.items()},
+            "round": self._round,
+            "trainer_steps": dict(self._trainer_steps),
+            "applied_seq": dict(self._applied_seq),
+            # only ACKED outcomes persist; in-flight pushes are covered by
+            # applied_seq once their round lands
+            "acked": {t: (rec[0], rec[1][1])
+                      for t, rec in self._seq_result.items()
+                      if rec[1] is not None and rec[1][0] == "ok"},
+        }
+        return self._state_version, state
+
+    def _write_checkpoint(self, path, version, state):
+        """Serialize + write OUTSIDE the condition lock; the io lock
+        serializes concurrent writers and the version guard keeps a slow
+        older snapshot from clobbering a newer one on disk."""
+        with self._ckpt_io_lock:
+            if version <= self._ckpt_written_version:
+                return  # a newer snapshot already reached the disk
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(state, f)
+            os.replace(tmp, path)  # atomic (the master's snapshot pattern)
+            self._ckpt_written_version = version
+
+    def _maybe_checkpoint_locked(self):
+        """Called at each applied update, under the lock: records the
+        snapshot as due; the pushing thread writes it after releasing."""
+        self._state_version += 1
+        if not self._checkpoint_path or self._checkpoint_every <= 0:
+            return
+        self._updates_since_ckpt += 1
+        if self._updates_since_ckpt >= self._checkpoint_every:
+            self._updates_since_ckpt = 0
+            self._due_ckpt = self._snapshot_locked()
+
+    def restore(self, path=None):
+        """Load a ``save_checkpoint`` file into this server. Returns True
+        when state was restored; False when the file is missing or
+        unreadable — a corrupt/truncated checkpoint warns and starts fresh
+        (a crashed server must come back up), and a stale ``.tmp`` left by
+        a crash mid-checkpoint is cleaned away."""
+        path = path or self._checkpoint_path
+        if not path:
+            raise ValueError("no checkpoint path: pass path= or construct "
+                             "with checkpoint_path=")
+        tmp = path + ".tmp"
+        with self._lock:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+            if not os.path.exists(path):
+                return False
+            try:
+                with open(path, "rb") as f:
+                    state = pickle.load(f)
+                # preserve stored dtypes exactly — a restore must be
+                # bitwise, not a float32 re-coercion of what was saved
+                params = {n: np.asarray(v)
+                          for n, v in state["params"].items()}
+                opt_state = state["opt_state"]
+                rnd = int(state["round"])
+                steps = dict(state["trainer_steps"])
+                applied = dict(state["applied_seq"])
+                acked = {t: [s, ("ok", payload)]
+                         for t, (s, payload)
+                         in state.get("acked", {}).items()}
+            except Exception as e:  # corrupt/truncated/missing-field
+                warnings.warn(
+                    f"pserver checkpoint {path!r} unreadable "
+                    f"({type(e).__name__}: {e}); starting fresh")
+                return False
+            self._params = params
+            self._opt_state = opt_state
+            self._round = rnd
+            self._trainer_steps = steps
+            self._applied_seq = applied
+            self._seq_result = acked
+            self._pending = {}
+            self._push_count = 0
+            self._broken_round = -1
+            self._round_contribs = []
+            self._updates_since_ckpt = 0
+            self._due_ckpt = None
+            return True
 
 
 def parse_endpoint(endpoint, default_port=None):
@@ -237,11 +469,23 @@ def shard_names(names, n_shards):
 
 
 def serve(optimizer="sgd", opt_kwargs=None, mode="async", fan_in=1,
-          max_staleness=None, address=("127.0.0.1", 0)):
+          max_staleness=None, address=("127.0.0.1", 0),
+          barrier_timeout_s=None, checkpoint_path=None, checkpoint_every=1,
+          fault_plan=None):
     """Start a ParameterServer's RPC loop in this process (call in a forked
-    child, the reference test_recv_op pattern). Returns (server, rpc)."""
-    ps = ParameterServer(optimizer, opt_kwargs, mode, fan_in, max_staleness)
-    rpc = RpcServer(ps, address)
+    child, the reference test_recv_op pattern). Returns (server, rpc).
+
+    With ``checkpoint_path``, an existing checkpoint is restored BEFORE
+    serving (the crash-restart path) and the server auto-checkpoints every
+    ``checkpoint_every`` applied updates. ``fault_plan`` (fault.FaultPlan)
+    deterministically injects drops/delays/crashes for tests."""
+    ps = ParameterServer(optimizer, opt_kwargs, mode, fan_in, max_staleness,
+                         barrier_timeout_s=barrier_timeout_s,
+                         checkpoint_path=checkpoint_path,
+                         checkpoint_every=checkpoint_every)
+    if checkpoint_path:
+        ps.restore()
+    rpc = RpcServer(ps, address, fault_plan=fault_plan)
     return ps, rpc
 
 
@@ -254,12 +498,22 @@ class ParamClient:
     ``param_names`` or by calling ``init_params``) computes the identical
     layout. Multi-shard pushes go out concurrently — sequential pushes in
     trainer-specific orders would deadlock sync-mode barriers across shards
-    (a lock-order inversion between trainers)."""
+    (a lock-order inversion between trainers).
 
-    def __init__(self, addresses, trainer_id=0, param_names=None):
-        self._clients = [RpcClient(a) for a in addresses]
+    Every ``push`` carries a monotonic sequence number (per trainer), so a
+    server answering a retried push (rpc.RetryPolicy reconnect-and-resend
+    after a lost response or a pserver restart) deduplicates instead of
+    double-applying. ``trainer_id`` must therefore be unique per trainer
+    process — two pushers sharing an id would collide in the dedup table."""
+
+    def __init__(self, addresses, trainer_id=0, param_names=None,
+                 retry=None, rpc_timeout=90.0):
+        self._clients = [RpcClient(a, timeout=rpc_timeout, retry=retry)
+                         for a in addresses]
         self._placement = {}  # name -> client index
         self._trainer_id = trainer_id
+        self._seq = 0
+        self._seq_lock = threading.Lock()
         if param_names is not None:
             self._set_placement(param_names)
 
@@ -288,18 +542,22 @@ class ParamClient:
         for n, g in grads.items():
             self._client_for(n)  # raise the friendly error on misuse
             by_client.setdefault(self._placement[n], {})[n] = g
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
         if len(by_client) == 1:
             (idx, shard), = by_client.items()
             return {idx: self._clients[idx].call(
-                "push", grads=shard, trainer_id=self._trainer_id)}
+                "push", grads=shard, trainer_id=self._trainer_id, seq=seq)}
         out, errors = {}, []
 
         def push_shard(idx, shard):
             try:
                 out[idx] = self._clients[idx].call(
-                    "push", grads=shard, trainer_id=self._trainer_id)
+                    "push", grads=shard, trainer_id=self._trainer_id,
+                    seq=seq)
             except Exception as e:
-                errors.append(e)
+                errors.append((idx, e))
 
         ts = [threading.Thread(target=push_shard, args=(idx, shard))
               for idx, shard in by_client.items()]
@@ -308,7 +566,17 @@ class ParamClient:
         for t in ts:
             t.join()
         if errors:
-            raise errors[0]
+            if len(errors) == 1:
+                raise errors[0][1]
+            # a multi-shard outage must be diagnosable in one message, not
+            # just whichever shard happened to fail first
+            errors.sort(key=lambda ie: ie[0])
+            detail = "; ".join(
+                f"shard {idx} ({self._clients[idx]._address}): "
+                f"{type(e).__name__}: {e}" for idx, e in errors)
+            raise RuntimeError(
+                f"push failed on {len(errors)} of {len(by_client)} "
+                f"shard(s): {detail}")
         return out
 
     def pull(self):

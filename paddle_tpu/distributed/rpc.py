@@ -11,14 +11,51 @@ reference tests them multiprocess-on-localhost
 
 Wire form: pickled (method, kwargs) requests, pickled (ok, payload)
 responses over multiprocessing.connection (length-prefixed, authenticated).
+
+Fault tolerance: ``RpcClient`` takes a :class:`RetryPolicy` — a
+connection-level failure (server died mid-call, connect refused while it
+restarts) is retried by reconnecting and resending, with bounded
+exponential backoff + jitter and a hard retry budget. Remote exceptions
+and response timeouts are NOT retried: only the caller knows if the method
+is safe to replay (the pserver's ``push`` is, via sequence-number dedup —
+param_server.py). ``RpcServer`` takes a ``fault_plan`` (fault.py) that
+deterministically drops/delays/severs scheduled calls, and ``kill()``
+simulates a crash: the listener closes AND every live connection is
+severed, exactly what clients of a SIGKILLed process observe.
 """
 
 from __future__ import annotations
 
+import random
+import socket
 import threading
+import time
 from multiprocessing.connection import Listener, Client
 
 AUTHKEY = b"paddle-tpu-rpc"
+
+
+class RetryPolicy:
+    """Bounded exponential backoff + jitter for reconnect-and-resend.
+
+    ``max_retries`` is the budget of RE-sends (a call makes at most
+    1 + max_retries attempts). Delay before attempt k (1-based) is
+    ``min(backoff_max_s, backoff_base_s * 2**(k-1))`` stretched by up to
+    ``jitter`` (uniform), so a fleet of trainers retrying a restarted
+    pserver doesn't stampede it in lockstep.
+    """
+
+    def __init__(self, max_retries=5, backoff_base_s=0.05, backoff_max_s=1.0,
+                 jitter=0.25):
+        self.max_retries = int(max_retries)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.jitter = float(jitter)
+
+    def delay_s(self, attempt):
+        base = min(self.backoff_max_s,
+                   self.backoff_base_s * (2.0 ** (attempt - 1)))
+        return base * (1.0 + self.jitter * random.random())
 
 
 class RpcServer:
@@ -27,11 +64,14 @@ class RpcServer:
     dies. One thread per connection — the reference's completion-queue
     concurrency scoped to localhost control traffic."""
 
-    def __init__(self, handler, address=("127.0.0.1", 0)):
+    def __init__(self, handler, address=("127.0.0.1", 0), fault_plan=None):
         self._handler = handler
         self._listener = Listener(address, authkey=AUTHKEY)
         self._stop = threading.Event()
         self._threads = []
+        self._fault = fault_plan
+        self._conns = set()          # live connections, for kill()
+        self._conns_lock = threading.Lock()
 
     @property
     def address(self):
@@ -72,45 +112,117 @@ class RpcServer:
         return t
 
     def _serve_conn(self, conn):
+        with self._conns_lock:
+            self._conns.add(conn)
         try:
             while not self._stop.is_set():
                 try:
                     method, kwargs = conn.recv()
-                except (EOFError, OSError):
+                except (EOFError, OSError, TypeError):
+                    # TypeError: kill() closed this Connection under us —
+                    # close() nulls the handle while recv() is blocked on
+                    # it, and the next read(None, n) raises TypeError, not
+                    # OSError
                     return
                 if method == "__shutdown__":
                     conn.send((True, None))
                     self.shutdown()
                     return
+                rule = self._fault.on_call(method) \
+                    if self._fault is not None else None
+                if rule is not None and rule.kind == "delay":
+                    time.sleep(rule.seconds)
+                    rule.fired.set()
+                    rule = None          # then serve normally
+                if rule is not None and rule.kind == "drop_request":
+                    rule.fired.set()
+                    return               # sever; method never applied
+                if rule is not None and rule.kind == "die_before":
+                    self.kill()
+                    rule.fired.set()
+                    return
                 try:
                     fn = getattr(self._handler, method)
-                    conn.send((True, fn(**kwargs)))
+                    result = (True, fn(**kwargs))
                 except Exception as e:  # surface remote errors to the caller
-                    conn.send((False, f"{type(e).__name__}: {e}"))
+                    result = (False, f"{type(e).__name__}: {e}")
+                if rule is not None and rule.kind == "drop_response":
+                    rule.fired.set()
+                    return               # applied, but the reply is lost
+                if rule is not None and rule.kind == "die_after":
+                    self.kill()
+                    rule.fired.set()
+                    return
+                try:
+                    conn.send(result)
+                except (OSError, BrokenPipeError, TypeError):
+                    return  # client vanished (or kill() closed us) mid-reply
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             conn.close()
 
     def shutdown(self):
         self._stop.set()
+        # kick the accept loop out of accept(2) BEFORE closing the
+        # listener: close() alone does not wake a thread already blocked
+        # in accept — the in-progress syscall pins the kernel socket, the
+        # port stays in LISTEN, and a restarted server can't rebind the
+        # address (the failover contract requires the SAME address). The
+        # throwaway connection completes the accept; its immediate close
+        # makes the authkey handshake fail, which the loop treats as a
+        # vanished client and then sees _stop.
+        try:
+            s = socket.create_connection(self.address, timeout=0.5)
+            s.close()
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:
             pass
 
+    def kill(self):
+        """Simulate a process crash: stop accepting AND sever every live
+        connection. ``shutdown()`` alone leaves in-flight connections open
+        (a graceful drain); a crashed pserver gives its clients EOF on
+        in-flight calls and connection-refused on reconnects — which is
+        what retry policies and failover supervisors must handle."""
+        self.shutdown()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
 
 class RpcClient:
     """Blocking stub: client.call("method", key=value) -> payload.
 
-    A timed-out call DISCARDS the connection (the late response would
-    otherwise sit in the pipe and be returned as the answer to the next,
-    unrelated request); the next call reconnects."""
+    Connects lazily (a client may be built while its server is still
+    restarting). A timed-out call DISCARDS the connection (the late
+    response would otherwise sit in the pipe and be returned as the answer
+    to the next, unrelated request); the next call reconnects.
 
-    def __init__(self, address, timeout=90.0):
+    With a ``retry`` policy, connection-level failures (EOF mid-call,
+    refused connect during a server restart) reconnect and resend within
+    the policy's budget. Safe for the pserver surface: ``push`` carries a
+    sequence number the server dedups, ``pull``/``init_params``/``stats``
+    are idempotent. Leave retry off for non-idempotent surfaces (a retried
+    master ``get_task`` would lease two tasks — harmless under the lease-
+    timeout contract, but not free)."""
+
+    _RETRYABLE = (EOFError, ConnectionError, BrokenPipeError, OSError)
+
+    def __init__(self, address, timeout=90.0, retry=None):
         self._address = tuple(address) if isinstance(address, (list, tuple)) \
             else address
-        self._conn = Client(self._address, authkey=AUTHKEY)
+        self._conn = None
         self._lock = threading.Lock()
         self._timeout = timeout
+        self._retry = retry
 
     def _drop_conn(self):
         if self._conn is not None:
@@ -120,7 +232,7 @@ class RpcClient:
                 pass
             self._conn = None
 
-    def call(self, method, **kwargs):
+    def _call_once(self, method, kwargs):
         with self._lock:
             if self._conn is None:
                 self._conn = Client(self._address, authkey=AUTHKEY)
@@ -130,14 +242,30 @@ class RpcClient:
                     self._drop_conn()
                     raise TimeoutError(f"rpc {method} timed out")
                 ok, payload = self._conn.recv()
-            except (EOFError, OSError, BrokenPipeError):
+            except self._RETRYABLE:
                 # server died mid-call: discard the dead connection so the
-                # next call reconnects (to a restarted server)
+                # next call/attempt reconnects (to a restarted server)
                 self._drop_conn()
                 raise
         if not ok:
             raise RuntimeError(f"remote {method} failed: {payload}")
         return payload
+
+    def call(self, method, **kwargs):
+        attempt = 0
+        while True:
+            try:
+                return self._call_once(method, kwargs)
+            except TimeoutError:
+                # a response timeout is ambiguous (the call may have
+                # applied) and bounded by its own deadline — never retried
+                raise
+            except self._RETRYABLE:
+                if self._retry is None or attempt >= self._retry.max_retries:
+                    raise
+                attempt += 1
+                # back off OUTSIDE the conn lock, then reconnect-and-resend
+                time.sleep(self._retry.delay_s(attempt))
 
     def close(self):
         with self._lock:

@@ -43,7 +43,8 @@ class PServerProgram:
     service (the reference's listen_and_serv program)."""
 
     def __init__(self, endpoint, param_names, optimizer, opt_kwargs, mode,
-                 fan_in, max_staleness=None):
+                 fan_in, max_staleness=None, barrier_timeout_s=None,
+                 checkpoint_path=None, checkpoint_every=1):
         self.endpoint = endpoint
         self.param_names = list(param_names)
         self.optimizer = optimizer
@@ -51,6 +52,9 @@ class PServerProgram:
         self.mode = mode
         self.fan_in = fan_in
         self.max_staleness = max_staleness
+        self.barrier_timeout_s = barrier_timeout_s
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = checkpoint_every
         self._rpc = None
 
     def _address(self):
@@ -63,7 +67,10 @@ class PServerProgram:
                         opt_kwargs=self.opt_kwargs, mode=self.mode,
                         fan_in=self.fan_in,
                         max_staleness=self.max_staleness,
-                        address=self._address())
+                        address=self._address(),
+                        barrier_timeout_s=self.barrier_timeout_s,
+                        checkpoint_path=self.checkpoint_path,
+                        checkpoint_every=self.checkpoint_every)
         self._rpc = rpc
         return ps, rpc
 
