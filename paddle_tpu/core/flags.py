@@ -151,6 +151,21 @@ DEFINE_flag("pserver_barrier_timeout_s", 60.0,
             "ParameterServer(barrier_timeout_s=...)/serve(); the flag is "
             "the process-wide default (was a hardcoded 60.0)")
 
+DEFINE_flag("rpc_timeout_s", 90.0,
+            "host-RPC response deadline in seconds (was a hardcoded 90.0): "
+            "how long RpcClient waits for a reply before declaring the "
+            "call timed out (timeouts are never retried — the call may "
+            "have applied). Threaded through ParamClient and the "
+            "PserverSupervisor heartbeat clients; overridable per client "
+            "via RpcClient(timeout=)/ParamClient(rpc_timeout=)")
+
+DEFINE_flag("pserver_wire_dtype", "fp32",
+            "dtype dense gradients travel in on the trainer->pserver push "
+            "wire: fp32 (exact, default) or fp16 (half the push bytes; "
+            "the server upcasts and accumulates in fp32, the reference's "
+            "half-precision parameter-server transfer). Pulled params "
+            "always return fp32")
+
 DEFINE_flag("conv_1x1_grad_as_dot", False,
             "A/B probe: emit 1x1-conv input/filter gradients as dot_general "
             "channel matmuls instead of jax's transposed convolutions (see "

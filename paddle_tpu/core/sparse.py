@@ -80,6 +80,10 @@ def merge_rows(sr: SparseRows) -> SparseRows:
     if sr.merged:
         return sr
     n = sr.rows.shape[0]
+    if n == 0:
+        # zero-entry grads (an empty batch slice) have nothing to merge —
+        # and the head/segment construction below needs at least one entry
+        return SparseRows(sr.rows, sr.values, sr.nrows, merged=True)
     order = jnp.argsort(sr.rows)
     srows = sr.rows[order]
     svals = sr.values[order]

@@ -131,10 +131,18 @@ class PserverSupervisor:
     def __init__(self, n_servers=1, checkpoint_dir=None, optimizer="sgd",
                  opt_kwargs=None, mode="async", fan_in=1, max_staleness=None,
                  barrier_timeout_s=None, checkpoint_every=1,
-                 heartbeat_interval_s=0.25, heartbeat_timeout_s=5.0,
+                 heartbeat_interval_s=0.25, heartbeat_timeout_s=None,
                  heartbeat_misses=3, max_restarts=5, host="127.0.0.1"):
         import multiprocessing as mp
         import tempfile
+
+        from ..core.flags import get_flag
+
+        if heartbeat_timeout_s is None:
+            # derive from the process-wide rpc_timeout_s flag, but never
+            # slower than the 5 s wedge-detection default — a 90 s response
+            # deadline is fine for a pull, not for declaring a shard dead
+            heartbeat_timeout_s = min(5.0, float(get_flag("rpc_timeout_s")))
 
         self._cfg = dict(optimizer=optimizer, opt_kwargs=opt_kwargs,
                          mode=mode, fan_in=fan_in,

@@ -40,12 +40,20 @@ import warnings
 import numpy as np
 
 from ..core.flags import get_flag
-from .rpc import RpcServer, RpcClient
+from .rpc import RpcServer, RpcClient, SparseGrad
 
 
 # ---------------------------------------------------------------------------
 # server-side optimizers (the paddle/optimizer C++ lib the Go pserver links,
-# /root/reference/paddle/optimizer/parameter_optimizer.h — numpy here)
+# /root/reference/paddle/optimizer/parameter_optimizer.h — numpy here).
+#
+# Each rule has two entry points: ``apply`` (dense, rebinds a fresh array)
+# and ``apply_rows`` (sparse, the reference's lazy optimizer branches —
+# operators/adam_op.h SparseAdamFunctor, sgd_op.cu): only the rows a
+# SparseGrad touched are read and written, IN PLACE, so apply cost is
+# O(touched rows) not O(table). ``rows`` must be duplicate-free
+# (SparseGrad.merged_rows dedups first) — fancy-index in-place updates
+# silently drop duplicate contributions otherwise.
 # ---------------------------------------------------------------------------
 
 class SgdRule:
@@ -57,6 +65,9 @@ class SgdRule:
 
     def apply(self, value, grad, state):
         return value - self.lr * grad
+
+    def apply_rows(self, value, rows, grows, state):
+        value[rows] -= self.lr * grows
 
 
 class MomentumRule:
@@ -70,6 +81,11 @@ class MomentumRule:
         state["velocity"] = self.mu * state["velocity"] + grad
         return value - self.lr * state["velocity"]
 
+    def apply_rows(self, value, rows, grows, state):
+        v = state["velocity"]
+        v[rows] = self.mu * v[rows] + grows
+        value[rows] -= self.lr * v[rows]
+
 
 class AdamRule:
     def __init__(self, lr=0.001, b1=0.9, b2=0.999, eps=1e-8):
@@ -80,12 +96,34 @@ class AdamRule:
                 "t": 0}
 
     def apply(self, value, grad, state):
-        state["t"] += 1
+        # ``t`` is a scalar until the first sparse push converts it to a
+        # per-row counter (lazy adam: each row's bias correction tracks how
+        # often THAT row updated); the dense path handles both forms
+        state["t"] = state["t"] + 1
         state["m1"] = self.b1 * state["m1"] + (1 - self.b1) * grad
         state["m2"] = self.b2 * state["m2"] + (1 - self.b2) * grad * grad
-        lr = self.lr * np.sqrt(1 - self.b2 ** state["t"]) \
-            / (1 - self.b1 ** state["t"])
+        t = state["t"]
+        lr = self.lr * np.sqrt(1 - self.b2 ** t) / (1 - self.b1 ** t)
+        if np.ndim(lr):
+            lr = lr.astype(np.float32).reshape(
+                lr.shape + (1,) * (value.ndim - 1))
         return value - lr * state["m1"] / (np.sqrt(state["m2"]) + self.eps)
+
+    def apply_rows(self, value, rows, grows, state):
+        if np.ndim(state["t"]) == 0:
+            state["t"] = np.full((value.shape[0],), int(state["t"]),
+                                 np.int64)
+        t = state["t"]
+        t[rows] += 1
+        tr = t[rows]
+        m1, m2 = state["m1"], state["m2"]
+        m1[rows] = self.b1 * m1[rows] + (1 - self.b1) * grows
+        m2[rows] = self.b2 * m2[rows] + (1 - self.b2) * grows * grows
+        lr = (self.lr * np.sqrt(1 - self.b2 ** tr)
+              / (1 - self.b1 ** tr)).astype(np.float32)
+        lr = lr.reshape(lr.shape + (1,) * (value.ndim - 1))
+        value[rows] = value[rows] - lr * m1[rows] \
+            / (np.sqrt(m2[rows]) + self.eps)
 
 
 OPTIMIZERS = {"sgd": SgdRule, "momentum": MomentumRule, "adam": AdamRule}
@@ -114,6 +152,9 @@ class ParameterServer:
         self._barrier_timeout = float(barrier_timeout_s)
         self._params = {}
         self._opt_state = {}
+        # params that have taken an in-place rowwise apply (copy-on-write
+        # marker — see _apply_locked/pull)
+        self._sparse_applied = set()
         self._lock = threading.Condition()
         # sync-mode accumulation
         self._pending = {}
@@ -139,6 +180,12 @@ class ParameterServer:
         self._due_ckpt = None         # (version, snapshot) pending a write
         self._ckpt_io_lock = threading.Lock()
         self._ckpt_written_version = -1
+        # wire counters of the RpcServer fronting this shard (serve() and
+        # PServerProgram attach it) — surfaced through stats()
+        self._wire_stats = None
+
+    def attach_wire_stats(self, wire_stats):
+        self._wire_stats = wire_stats
 
     # ---- RPC surface ----
     def init_params(self, params):
@@ -148,14 +195,27 @@ class ParameterServer:
         with self._lock:
             for name, value in params.items():
                 if name not in self._params:
-                    self._params[name] = np.asarray(value, np.float32)
+                    # own the buffer: sparse applies update rows of the
+                    # stored array IN PLACE, which must never alias a
+                    # caller's array
+                    self._params[name] = np.array(value, dtype=np.float32)
                     self._opt_state[name] = self._rule.init(self._params[name])
             return True
 
     def pull(self, names=None):
         with self._lock:
             names = names or list(self._params)
-            return {n: self._params[n] for n in names}
+            # params that have taken a rowwise apply mutate IN PLACE, and
+            # the RPC layer serializes the response OUTSIDE the lock —
+            # those must be copied under the lock or a concurrent sparse
+            # push could tear the bytes mid-send. Dense-only params are
+            # safe to return by reference: dense rules rebind fresh
+            # arrays, and _apply_locked copy-on-writes a param before its
+            # FIRST in-place apply, so an array handed out here is never
+            # mutated afterwards.
+            return {n: self._params[n].copy()
+                    if n in self._sparse_applied else self._params[n]
+                    for n in names}
 
     def push(self, grads, trainer_id=0, seq=None):
         """Apply (sync: accumulate) gradients. ``seq`` is the trainer's
@@ -223,6 +283,59 @@ class ParameterServer:
             return self._round
         return self._trainer_steps.get(trainer_id, 0)
 
+    def _accumulate_locked(self, name, g):
+        """Fold one trainer's gradient into the open round's accumulator.
+        Dense: the first push COPIES into an owned buffer and later pushes
+        accumulate in place (``acc += g``) — no fresh allocation per
+        trainer. Sparse: SparseGrads collect in a list (merged once at
+        apply time); a round mixing dense and sparse pushes for the same
+        param densifies the sparse side."""
+        acc = self._pending.get(name)
+        if isinstance(g, SparseGrad):
+            if acc is None:
+                self._pending[name] = [g]
+            elif isinstance(acc, list):
+                acc.append(g)
+            else:                       # dense accumulator: scatter-add in
+                rows, vals = g.merged_rows()
+                np.add.at(acc, rows, vals)
+        else:
+            g = np.asarray(g, np.float32)
+            if acc is None:
+                self._pending[name] = np.array(g, dtype=np.float32)
+            elif isinstance(acc, list):
+                dense = _densify(acc, self._params[name].shape)
+                dense += g
+                self._pending[name] = dense
+            else:
+                acc += g
+
+    def _apply_locked(self, name, g, divisor=1):
+        """Run the optimizer on one accumulated gradient. Sparse grads
+        (or lists of them from a sync round) merge duplicates and take the
+        rowwise branch — O(touched rows); dense grads keep the rebind-only
+        rule.apply path."""
+        if isinstance(g, list):
+            g = _concat_sparse(g)
+        if isinstance(g, SparseGrad):
+            if name not in self._sparse_applied:
+                # copy-on-write before the param's FIRST in-place rowwise
+                # update: references pull() handed out while the param was
+                # dense-only stay immutable (see pull)
+                self._params[name] = self._params[name].copy()
+                self._sparse_applied.add(name)
+            rows, vals = g.merged_rows()
+            if divisor != 1:
+                vals = vals / divisor
+            self._rule.apply_rows(self._params[name], rows, vals,
+                                  self._opt_state[name])
+        else:
+            g = np.asarray(g, np.float32)
+            if divisor != 1:
+                g = g / divisor
+            self._params[name] = self._rule.apply(self._params[name], g,
+                                                  self._opt_state[name])
+
     def _push_sync(self, grads, trainer_id=None, seq=None):
         """Accumulate; the fan_in-th push triggers the optimize step and
         wakes all waiters (the batch-barrier contract). A barrier timeout
@@ -232,17 +345,13 @@ class ParameterServer:
         with self._lock:
             my_round = self._round
             for n, g in grads.items():
-                acc = self._pending.get(n)
-                self._pending[n] = np.asarray(g, np.float32) if acc is None \
-                    else acc + np.asarray(g, np.float32)
+                self._accumulate_locked(n, g)
             if seq is not None:
                 self._round_contribs.append((trainer_id, seq))
             self._push_count += 1
             if self._push_count >= self._fan_in:
                 for n, g in self._pending.items():
-                    self._params[n] = self._rule.apply(
-                        self._params[n], g / self._fan_in,
-                        self._opt_state[n])
+                    self._apply_locked(n, g, divisor=self._fan_in)
                 self._pending = {}
                 self._push_count = 0
                 self._round += 1
@@ -293,9 +402,7 @@ class ParameterServer:
                     if not self._lock.wait(timeout=self._barrier_timeout):
                         raise TimeoutError("staleness wait timed out")
             for n, g in grads.items():
-                self._params[n] = self._rule.apply(
-                    self._params[n], np.asarray(g, np.float32),
-                    self._opt_state[n])
+                self._apply_locked(n, g)
             self._trainer_steps[trainer_id] = \
                 self._trainer_steps.get(trainer_id, 0) + 1
             if seq is not None:
@@ -306,9 +413,15 @@ class ParameterServer:
 
     def stats(self):
         with self._lock:
-            return {"params": sorted(self._params), "round": self._round,
-                    "trainer_steps": dict(self._trainer_steps),
-                    "applied_seq": dict(self._applied_seq)}
+            out = {"params": sorted(self._params), "round": self._round,
+                   "trainer_steps": dict(self._trainer_steps),
+                   "applied_seq": dict(self._applied_seq)}
+        if self._wire_stats is not None:
+            # bytes in/out + per-method call counts and latency of the RPC
+            # front-end (rpc.WireStats) — the reference pserver's
+            # sendrecv byte accounting, queryable by trainers and tools
+            out["wire"] = self._wire_stats.snapshot()
+        return out
 
     # ---- checkpoint / restore (the Go pserver's crash contract) ----
     def save_checkpoint(self, path=None):
@@ -328,14 +441,18 @@ class ParameterServer:
         return path
 
     def _snapshot_locked(self):
-        """Consistent point-in-time copy of the server state. Shallow
-        per-dict copies suffice: the optimizer rules REBIND array values
-        (value - lr*..., state["m1"] = ...), never mutate them in place,
-        so the captured arrays are immutable once snapshotted."""
+        """Consistent point-in-time copy of the server state. Arrays are
+        DEEP-copied: the dense optimizer paths rebind fresh arrays, but
+        the rowwise sparse branches (apply_rows) update rows in place —
+        a shallow snapshot could be mutated between capture and the
+        off-lock disk write. The copy is a straight memcpy under the
+        lock, amortized by ``checkpoint_every``."""
         state = {
             "version": 1,
-            "params": dict(self._params),
-            "opt_state": {n: dict(st) for n, st in self._opt_state.items()},
+            "params": {n: v.copy() for n, v in self._params.items()},
+            "opt_state": {n: {k: v.copy() if isinstance(v, np.ndarray)
+                              else v for k, v in st.items()}
+                          for n, st in self._opt_state.items()},
             "round": self._round,
             "trainer_steps": dict(self._trainer_steps),
             "applied_seq": dict(self._applied_seq),
@@ -411,6 +528,9 @@ class ParameterServer:
                 return False
             self._params = params
             self._opt_state = opt_state
+            # restored arrays are fresh (unpickled) — no outstanding pull
+            # references; the next sparse apply re-marks (and re-COWs)
+            self._sparse_applied = set()
             self._round = rnd
             self._trainer_steps = steps
             self._applied_seq = applied
@@ -422,6 +542,27 @@ class ParameterServer:
             self._updates_since_ckpt = 0
             self._due_ckpt = None
             return True
+
+
+def _concat_sparse(grads):
+    """Concatenate a sync round's SparseGrads for one param into a single
+    unmerged SparseGrad (duplicates across trainers merge at apply)."""
+    if len(grads) == 1:
+        return grads[0]
+    rows = np.concatenate([g.rows for g in grads])
+    vals = np.concatenate([g.values.astype(np.float32, copy=False)
+                           for g in grads], axis=0)
+    return SparseGrad(rows, vals, grads[0].nrows)
+
+
+def _densify(grads, shape):
+    """Scatter a list of SparseGrads into a dense fp32 gradient (the
+    mixed dense+sparse sync-round path)."""
+    out = np.zeros(shape, np.float32)
+    for g in grads:
+        rows, vals = g.merged_rows()
+        np.add.at(out, rows, vals)
+    return out
 
 
 def parse_endpoint(endpoint, default_port=None):
@@ -486,6 +627,7 @@ def serve(optimizer="sgd", opt_kwargs=None, mode="async", fan_in=1,
     if checkpoint_path:
         ps.restore()
     rpc = RpcServer(ps, address, fault_plan=fault_plan)
+    ps.attach_wire_stats(rpc.wire_stats)
     return ps, rpc
 
 
@@ -504,16 +646,32 @@ class ParamClient:
     server answering a retried push (rpc.RetryPolicy reconnect-and-resend
     after a lost response or a pserver restart) deduplicates instead of
     double-applying. ``trainer_id`` must therefore be unique per trainer
-    process — two pushers sharing an id would collide in the dedup table."""
+    process — two pushers sharing an id would collide in the dedup table.
+
+    Wire: gradients travel on rpc.py's framed tensor codec (``wire=``
+    selects the legacy pickle codec for A/B runs). A grad value that is a
+    ``core.sparse.SparseRows`` (or rpc.SparseGrad) ships as ids + touched
+    rows only — O(touched rows) bytes, the reference's sparse parameter
+    update (ParameterServer2 sparse formats / SelectedRows send). The
+    ``pserver_wire_dtype`` flag ("fp32"|"fp16") halves dense push bytes;
+    the server always accumulates fp32. ``rpc_timeout`` defaults to the
+    ``rpc_timeout_s`` flag."""
 
     def __init__(self, addresses, trainer_id=0, param_names=None,
-                 retry=None, rpc_timeout=90.0):
-        self._clients = [RpcClient(a, timeout=rpc_timeout, retry=retry)
+                 retry=None, rpc_timeout=None, wire="framed",
+                 sparse_param_names=()):
+        self._clients = [RpcClient(a, timeout=rpc_timeout, retry=retry,
+                                   wire=wire)
                          for a in addresses]
         self._placement = {}  # name -> client index
         self._trainer_id = trainer_id
         self._seq = 0
         self._seq_lock = threading.Lock()
+        # params the transpiler marked sparse (embedding tables): a DENSE
+        # gradient pushed for one of these is sparsified to its touched
+        # rows before hitting the wire (see _wire_grad)
+        self._sparse_names = set(sparse_param_names)
+        self._pool = None   # lazy per-shard fan-out pool (see _fanout)
         if param_names is not None:
             self._set_placement(param_names)
 
@@ -537,34 +695,76 @@ class ParamClient:
         for idx, shard in by_client.items():
             self._clients[idx].call("init_params", params=shard)
 
-    def push(self, grads):
-        by_client = {}
-        for n, g in grads.items():
-            self._client_for(n)  # raise the friendly error on misuse
-            by_client.setdefault(self._placement[n], {})[n] = g
-        with self._seq_lock:
-            self._seq += 1
-            seq = self._seq
-        if len(by_client) == 1:
-            (idx, shard), = by_client.items()
-            return {idx: self._clients[idx].call(
-                "push", grads=shard, trainer_id=self._trainer_id, seq=seq)}
-        out, errors = {}, []
+    @staticmethod
+    def _wire_dtype():
+        wire_dtype = get_flag("pserver_wire_dtype")
+        if wire_dtype not in ("fp32", "fp16"):
+            raise ValueError(
+                f"pserver_wire_dtype must be 'fp32' or 'fp16', "
+                f"got {wire_dtype!r}")
+        return wire_dtype
 
-        def push_shard(idx, shard):
+    def _wire_grad(self, name, g, wire_dtype=None):
+        """Convert one gradient to its wire form: SparseRows/SparseGrad →
+        rpc.SparseGrad (ids + touched rows, sentinel padding filtered);
+        a DENSE gradient for a param in ``sparse_param_names`` (the
+        transpiler's is_sparse marking) is sparsified to its nonzero rows
+        — a backward that densified an embedding grad (e.g. summed
+        lookups) still ships O(touched rows) — when at most half the
+        table moved; other dense grads ship as host ndarrays. Either
+        form is downcast to fp16 when the ``pserver_wire_dtype`` flag
+        asks for the half-width wire (``push`` validates the flag once
+        per call and threads it through)."""
+        if wire_dtype is None:
+            wire_dtype = self._wire_dtype()
+        if isinstance(g, SparseGrad):
+            sg = g
+        elif hasattr(g, "rows") and hasattr(g, "values") \
+                and hasattr(g, "nrows"):
+            sg = SparseGrad.from_sparse_rows(g)
+        else:
+            sg = None
+            arr = np.asarray(g)
+            if name in self._sparse_names and arr.ndim and arr.shape[0]:
+                touched = np.flatnonzero(
+                    arr.reshape(arr.shape[0], -1).any(axis=1))
+                if touched.size <= arr.shape[0] // 2:
+                    sg = SparseGrad(touched, arr[touched], arr.shape[0],
+                                    merged=True)
+        if sg is not None:
+            if wire_dtype == "fp16" and sg.values.dtype in (np.float32,
+                                                            np.float64):
+                sg = sg.astype(np.float16)
+            return sg
+        if wire_dtype == "fp16" and arr.dtype in (np.float32, np.float64):
+            arr = arr.astype(np.float16)
+        return arr
+
+    def _fanout(self, method, requests):
+        """Issue one RPC per shard concurrently (sequential per-shard calls
+        in trainer-specific orders would deadlock sync-mode barriers across
+        shards — a lock-order inversion between trainers) and aggregate ALL
+        shard failures into one diagnosable error; a single failure keeps
+        its original type."""
+        if len(requests) == 1:
+            (idx, kwargs), = requests.items()
+            return {idx: self._clients[idx].call(method, **kwargs)}
+        if self._pool is None:
+            # persistent pool, one worker per shard: per-step fan-outs
+            # must not pay thread construction on the training hot path
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=len(self._clients),
+                thread_name_prefix="param-client")
+        futures = {idx: self._pool.submit(self._clients[idx].call, method,
+                                          **kwargs)
+                   for idx, kwargs in requests.items()}
+        out, errors = {}, []
+        for idx, fut in futures.items():
             try:
-                out[idx] = self._clients[idx].call(
-                    "push", grads=shard, trainer_id=self._trainer_id,
-                    seq=seq)
+                out[idx] = fut.result()
             except Exception as e:
                 errors.append((idx, e))
-
-        ts = [threading.Thread(target=push_shard, args=(idx, shard))
-              for idx, shard in by_client.items()]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join()
         if errors:
             if len(errors) == 1:
                 raise errors[0][1]
@@ -575,22 +775,59 @@ class ParamClient:
                 f"shard {idx} ({self._clients[idx]._address}): "
                 f"{type(e).__name__}: {e}" for idx, e in errors)
             raise RuntimeError(
-                f"push failed on {len(errors)} of {len(by_client)} "
+                f"{method} failed on {len(errors)} of {len(requests)} "
                 f"shard(s): {detail}")
         return out
+
+    def push(self, grads):
+        wire_dtype = self._wire_dtype()   # read + validate once per push
+        by_client = {}
+        for n, g in grads.items():
+            self._client_for(n)  # raise the friendly error on misuse
+            by_client.setdefault(self._placement[n], {})[n] = \
+                self._wire_grad(n, g, wire_dtype)
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        return self._fanout("push", {
+            idx: dict(grads=shard, trainer_id=self._trainer_id, seq=seq)
+            for idx, shard in by_client.items()})
 
     def pull(self):
         if not self._placement:
             raise KeyError("no placement: pass param_names= at construction "
                            "or call init_params first")
+        by_client = {}
+        for n, idx in self._placement.items():
+            by_client.setdefault(idx, []).append(n)
+        shards = self._fanout("pull", {idx: {"names": names}
+                                       for idx, names in by_client.items()})
         params = {}
-        for idx, c in enumerate(self._clients):
-            names = [n for n, i in self._placement.items() if i == idx]
-            if names:
-                params.update(c.call("pull", names=names))
+        for part in shards.values():
+            params.update(part)
         return params
 
+    def wire_stats(self):
+        """Aggregate client-side wire counters (rpc.WireStats) across the
+        shard connections: bytes sent/received + per-method call count and
+        latency."""
+        agg = {"bytes_sent": 0, "bytes_recv": 0, "calls": {}}
+        for c in self._clients:
+            snap = c.wire_stats.snapshot()
+            agg["bytes_sent"] += snap["bytes_sent"]
+            agg["bytes_recv"] += snap["bytes_recv"]
+            for m, rec in snap["calls"].items():
+                dst = agg["calls"].setdefault(
+                    m, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+                dst["count"] += rec["count"]
+                dst["total_s"] += rec["total_s"]
+                dst["max_s"] = max(dst["max_s"], rec["max_s"])
+        return agg
+
     def close(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
         for c in self._clients:
             c.close()
 

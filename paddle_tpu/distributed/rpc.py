@@ -1,4 +1,5 @@
-"""Minimal request/response RPC over localhost TCP.
+"""Minimal request/response RPC over localhost TCP with a zero-copy
+tensor wire format.
 
 The transport role of the reference's gRPC layer (/root/reference/paddle/
 fluid/operators/detail/grpc_server.h, grpc_client.h) and the legacy epoll
@@ -9,8 +10,30 @@ elastic-master control/payload messages between local processes, the way the
 reference tests them multiprocess-on-localhost
 (python/paddle/fluid/tests/unittests/test_recv_op.py:25-67).
 
-Wire form: pickled (method, kwargs) requests, pickled (ok, payload)
-responses over multiprocessing.connection (length-prefixed, authenticated).
+Wire form — every message is one of two codecs, tagged per message so mixed
+clients interoperate and the server always answers in the caller's codec:
+
+* ``framed`` (default) — the gRPC layer's zero-copy tensor payload
+  (the reference serializes LoDTensors as a small proto header + raw bytes,
+  operators/detail/sendrecvop_utils.cc): a fixed prefix
+  ``[tag][n_frames][n_oob][u64 frame lengths...]`` followed by (0) a small
+  pickled header holding the message skeleton — kwargs with every ndarray
+  replaced by a placeholder — plus per-tensor dtype/shape specs, (1..n_oob)
+  pickle protocol-5 out-of-band buffers for arrays nested inside objects
+  the skeleton walker does not open (the fallback path), and then one raw
+  frame per tensor, written with ``sendall(memoryview)`` straight from the
+  array's buffer and read with ``recv_into`` into a preallocated
+  ``np.empty`` of the advertised dtype/shape. Array bytes are never
+  pickled: one userspace copy on receive, zero on send.
+* ``pickle`` — the legacy codec (one pickled frame), kept selectable for
+  A/B benchmarking (bench.py pserver_wire_throughput) and as the
+  compatibility baseline the round-trip guard test pins.
+
+:class:`SparseGrad` is the wire form of a sparse-row gradient (the
+reference's SelectedRows, framework/selected_rows.h): ids + touched rows
+only, so embedding pushes cost O(touched rows) on the wire. It is
+numpy-only — the pserver process never touches jax — and the framed codec
+ships its two arrays as raw frames like any other tensor.
 
 Fault tolerance: ``RpcClient`` takes a :class:`RetryPolicy` — a
 connection-level failure (server died mid-call, connect refused while it
@@ -22,17 +45,328 @@ param_server.py). ``RpcServer`` takes a ``fault_plan`` (fault.py) that
 deterministically drops/delays/severs scheduled calls, and ``kill()``
 simulates a crash: the listener closes AND every live connection is
 severed, exactly what clients of a SIGKILLed process observe.
+
+Accounting: both ends keep a :class:`WireStats` — bytes sent/received and
+per-method call counts/latency — surfaced through
+``ParameterServer.stats()["wire"]`` / ``ParamClient.wire_stats()``, and
+every client call and served request runs inside a ``core.profiler``
+span (kind="rpc") so wire time shows up in profiler reports and chrome
+traces.
 """
 
 from __future__ import annotations
 
+import hmac
+import os
+import pickle
 import random
 import socket
+import struct
 import threading
 import time
-from multiprocessing.connection import Listener, Client
+from multiprocessing import AuthenticationError
+
+import numpy as np
+
+from ..core.flags import get_flag
+from ..core.profiler import record_event
 
 AUTHKEY = b"paddle-tpu-rpc"
+
+_MAGIC = b"PDTPU-RPC-1."          # handshake hello prefix (12 bytes)
+_WELCOME = b"WELCOME!"
+_HANDSHAKE_TIMEOUT_S = 10.0
+
+WIRE_FRAMED = "framed"
+WIRE_PICKLE = "pickle"
+_TAG = {WIRE_FRAMED: b"F", WIRE_PICKLE: b"P"}
+_UNTAG = {v: k for k, v in _TAG.items()}
+
+# prefix: codec tag, frame count, how many frames are pickle-5 out-of-band
+_PREFIX = struct.Struct("<cII")
+_FLEN = struct.Struct("<Q")
+_MAX_FRAMES = 65536               # sanity bound against corrupt prefixes
+
+
+class SparseGrad:
+    """Sparse-row gradient wire form: ``values[i]`` is the gradient for row
+    ``rows[i]`` of a dense ``[nrows, ...]`` parameter — the reference's
+    SelectedRows over the wire (operators/detail/sendrecvop_utils.cc
+    serializes rows + a dense value block the same way). Numpy-only so the
+    pserver side never imports a jax backend; trainers convert
+    ``core.sparse.SparseRows`` via :meth:`from_sparse_rows` (ParamClient
+    does it automatically on push).
+
+    ``merged`` promises rows are duplicate-free (post MergeAdd); unmerged
+    grads are merged server-side by :meth:`merged_rows`."""
+
+    __slots__ = ("rows", "values", "nrows", "merged")
+
+    def __init__(self, rows, values, nrows, merged=False):
+        rows = np.asarray(rows)
+        values = np.asarray(values)
+        if rows.ndim != 1:
+            raise ValueError(f"rows must be 1-d, got shape {rows.shape}")
+        if values.shape[:1] != rows.shape:
+            raise ValueError(
+                f"values rows ({values.shape[0] if values.ndim else '?'}) "
+                f"!= ids ({rows.shape[0]})")
+        self.rows = rows
+        self.values = values
+        self.nrows = int(nrows)
+        self.merged = bool(merged)
+
+    @classmethod
+    def from_sparse_rows(cls, sr):
+        """Convert a ``core.sparse.SparseRows`` (jax arrays, sentinel
+        padding rows == nrows) to the wire form: host numpy arrays with the
+        padding entries filtered out, so wire bytes are O(real touched
+        rows), not O(static batch width)."""
+        nrows = int(sr.nrows)
+        rows = np.asarray(sr.rows)
+        values = np.asarray(sr.values)
+        keep = (rows >= 0) & (rows < nrows)
+        if not bool(keep.all()):
+            rows, values = rows[keep], values[keep]
+        return cls(rows, values, nrows, bool(getattr(sr, "merged", False)))
+
+    @property
+    def nbytes(self):
+        return self.rows.nbytes + self.values.nbytes
+
+    def astype(self, dtype):
+        return SparseGrad(self.rows, self.values.astype(dtype), self.nrows,
+                          self.merged)
+
+    def merged_rows(self):
+        """MergeAdd (operators/math/selected_rows_functor.cc): combine
+        duplicate ids by summation. Returns ``(unique_rows, fp32_values)``
+        — accumulation is always fp32 regardless of the wire dtype."""
+        vals = self.values.astype(np.float32, copy=False)
+        if self.merged:
+            return self.rows, vals
+        uniq, inv = np.unique(self.rows, return_inverse=True)
+        out = np.zeros((len(uniq),) + self.values.shape[1:], np.float32)
+        np.add.at(out, inv, vals)
+        return uniq, out
+
+    def to_dense(self):
+        """Densify to fp32 ``[nrows, ...]`` (duplicates summed)."""
+        out = np.zeros((self.nrows,) + self.values.shape[1:], np.float32)
+        np.add.at(out, self.rows,
+                  self.values.astype(np.float32, copy=False))
+        return out
+
+    def __reduce__(self):
+        # plain-pickle wire (and disk checkpoints) round-trip SparseGrad
+        # through its arrays; protocol 5 extracts them out-of-band
+        return (SparseGrad, (self.rows, self.values, self.nrows,
+                             self.merged))
+
+    def __repr__(self):
+        return (f"SparseGrad(n={self.rows.shape[0]}, nrows={self.nrows}, "
+                f"dim={tuple(self.values.shape[1:])}, "
+                f"dtype={self.values.dtype}, merged={self.merged})")
+
+
+class _TensorRef:
+    """Skeleton placeholder for the i-th raw tensor frame."""
+
+    __slots__ = ("i",)
+
+    def __init__(self, i):
+        self.i = i
+
+    def __reduce__(self):
+        return (_TensorRef, (self.i,))
+
+
+class _SparseRef:
+    """Skeleton placeholder for a SparseGrad whose rows/values ship as raw
+    tensor frames ri and vi."""
+
+    __slots__ = ("ri", "vi", "nrows", "merged")
+
+    def __init__(self, ri, vi, nrows, merged):
+        self.ri = ri
+        self.vi = vi
+        self.nrows = nrows
+        self.merged = merged
+
+    def __reduce__(self):
+        return (_SparseRef, (self.ri, self.vi, self.nrows, self.merged))
+
+
+def _strip(obj, specs, tensors):
+    """Replace every ndarray leaf in dict/list/tuple containers with a
+    _TensorRef, recording (dtype, shape) specs and the contiguous array for
+    raw framing. Anything else stays in the skeleton; arrays hidden inside
+    unopened objects still avoid a copy via pickle-5 out-of-band buffers."""
+    if isinstance(obj, np.ndarray) and not obj.dtype.hasobject:
+        # keep 0-d arrays 0-d: ascontiguousarray would promote () to (1,)
+        a = obj if obj.flags.c_contiguous else np.ascontiguousarray(obj)
+        specs.append((a.dtype.str, a.shape))
+        tensors.append(a)
+        return _TensorRef(len(specs) - 1)
+    if isinstance(obj, SparseGrad):
+        r = _strip(obj.rows, specs, tensors)
+        v = _strip(obj.values, specs, tensors)
+        return _SparseRef(r.i, v.i, obj.nrows, obj.merged)
+    if type(obj) is dict:
+        return {k: _strip(v, specs, tensors) for k, v in obj.items()}
+    if type(obj) is list:
+        return [_strip(v, specs, tensors) for v in obj]
+    if type(obj) is tuple:
+        return tuple(_strip(v, specs, tensors) for v in obj)
+    return obj
+
+
+def _fill(obj, arrays):
+    """Inverse of _strip: graft the received tensors back into the
+    skeleton."""
+    if isinstance(obj, _TensorRef):
+        return arrays[obj.i]
+    if isinstance(obj, _SparseRef):
+        return SparseGrad(arrays[obj.ri], arrays[obj.vi], obj.nrows,
+                          obj.merged)
+    if type(obj) is dict:
+        return {k: _fill(v, arrays) for k, v in obj.items()}
+    if type(obj) is list:
+        return [_fill(v, arrays) for v in obj]
+    if type(obj) is tuple:
+        return tuple(_fill(v, arrays) for v in obj)
+    return obj
+
+
+def send_msg(sock, obj, wire=WIRE_FRAMED):
+    """Encode + send one message; returns bytes written. Framed messages
+    write tensor bytes straight from the array buffers (no pickling of
+    array data); small messages coalesce into a single send so the
+    request/response ping-pong stays one packet each way."""
+    if wire == WIRE_PICKLE:
+        frames = [pickle.dumps(obj)]
+        n_oob = 0
+    else:
+        specs, tensors, oob = [], [], []
+        skeleton = _strip(obj, specs, tensors)
+        head = pickle.dumps((skeleton, specs), protocol=5,
+                            buffer_callback=oob.append)
+        frames = ([head] + [b.raw() for b in oob]
+                  + [memoryview(a).cast("B") if a.nbytes else b""
+                     for a in tensors])
+        n_oob = len(oob)
+    prefix = (_PREFIX.pack(_TAG[wire], len(frames), n_oob)
+              + b"".join(_FLEN.pack(len(f)) for f in frames))
+    total = len(prefix) + sum(len(f) for f in frames)
+    if total <= 65536:
+        sock.sendall(b"".join([prefix, *frames]))
+    else:
+        sock.sendall(prefix)
+        for f in frames:
+            sock.sendall(f)
+    return total
+
+
+def _recv_into(sock, view):
+    while len(view):
+        n = sock.recv_into(view)
+        if n == 0:
+            raise EOFError("connection closed mid-message")
+        view = view[n:]
+
+
+def _recv_exact(sock, n):
+    buf = bytearray(n)
+    _recv_into(sock, memoryview(buf))
+    return buf
+
+
+def recv_msg(sock):
+    """Receive one message; returns ``(obj, bytes_read, wire)``. Framed
+    tensor frames are read with ``recv_into`` directly into preallocated
+    arrays of the header-advertised dtype/shape — the zero-copy half of
+    the codec."""
+    prefix = _recv_exact(sock, _PREFIX.size)
+    tag, n_frames, n_oob = _PREFIX.unpack(bytes(prefix))
+    if tag not in _UNTAG or not 1 <= n_frames <= _MAX_FRAMES \
+            or n_oob >= n_frames or (tag == b"P" and n_frames != 1):
+        # a pickle-tagged message is exactly one frame; accepting more
+        # would leave unread frames to desync the stream
+        raise EOFError(f"corrupt message prefix {bytes(prefix)!r}")
+    lens = struct.unpack(f"<{n_frames}Q", _recv_exact(sock, 8 * n_frames))
+    total = _PREFIX.size + 8 * n_frames + sum(lens)
+    if tag == b"P":
+        payload = _recv_exact(sock, lens[0])
+        return pickle.loads(payload), total, WIRE_PICKLE
+    head = _recv_exact(sock, lens[0])
+    oob = [_recv_exact(sock, n) for n in lens[1:1 + n_oob]]
+    skeleton, specs = pickle.loads(head, buffers=oob)
+    if len(specs) != n_frames - 1 - n_oob:
+        raise EOFError("tensor spec count does not match frame count")
+    arrays = []
+    for (dt, shape), n in zip(specs, lens[1 + n_oob:]):
+        a = np.empty(shape, dtype=np.dtype(dt))
+        if a.nbytes != n:
+            raise EOFError(f"tensor frame length {n} != {a.nbytes} "
+                           f"for dtype {dt} shape {shape}")
+        if a.nbytes:
+            _recv_into(sock, memoryview(a).cast("B"))
+        arrays.append(a)
+    return _fill(skeleton, arrays), total, WIRE_FRAMED
+
+
+# ---------------------------------------------------------------------------
+# authkey handshake (the multiprocessing.connection challenge, inlined over
+# the raw socket so the data path owns the fd end to end)
+# ---------------------------------------------------------------------------
+
+def _server_handshake(sock):
+    challenge = os.urandom(20)
+    sock.sendall(_MAGIC + challenge)
+    digest = bytes(_recv_exact(sock, 32))
+    expect = hmac.new(AUTHKEY, challenge, "sha256").digest()
+    if not hmac.compare_digest(digest, expect):
+        raise AuthenticationError("digest received was wrong")
+    sock.sendall(_WELCOME)
+
+
+def _client_handshake(sock):
+    hello = bytes(_recv_exact(sock, len(_MAGIC) + 20))
+    if hello[:len(_MAGIC)] != _MAGIC:
+        raise AuthenticationError(f"bad hello {hello[:len(_MAGIC)]!r}")
+    sock.sendall(hmac.new(AUTHKEY, hello[len(_MAGIC):], "sha256").digest())
+    if bytes(_recv_exact(sock, len(_WELCOME))) != _WELCOME:
+        raise AuthenticationError("server rejected the digest")
+
+
+class WireStats:
+    """Bytes + call-latency counters for one endpoint (client or server).
+    ``snapshot()`` is cheap and picklable, so a server's counters travel
+    inside ``stats()`` responses."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.bytes_sent = 0
+        self.bytes_recv = 0
+        self._calls = {}   # method -> [count, total_s, max_s]
+
+    def note(self, method, sent, recvd, seconds):
+        with self._lock:
+            self.bytes_sent += sent
+            self.bytes_recv += recvd
+            rec = self._calls.setdefault(method, [0, 0.0, 0.0])
+            rec[0] += 1
+            rec[1] += seconds
+            rec[2] = max(rec[2], seconds)
+
+    def snapshot(self):
+        with self._lock:
+            return {
+                "bytes_sent": self.bytes_sent,
+                "bytes_recv": self.bytes_recv,
+                "calls": {m: {"count": c, "total_s": t, "max_s": mx}
+                          for m, (c, t, mx) in self._calls.items()},
+            }
 
 
 class RetryPolicy:
@@ -62,42 +396,42 @@ class RpcServer:
     """Serve ``handler`` (an object whose public methods are the RPC
     surface) on ``address`` until ``shutdown`` is called or the process
     dies. One thread per connection — the reference's completion-queue
-    concurrency scoped to localhost control traffic."""
+    concurrency scoped to localhost control traffic. Responses are encoded
+    in the codec of the request they answer, so framed and legacy-pickle
+    clients can share one server."""
 
     def __init__(self, handler, address=("127.0.0.1", 0), fault_plan=None):
         self._handler = handler
-        self._listener = Listener(address, authkey=AUTHKEY)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(tuple(address))
+        self._listener.listen(16)
         self._stop = threading.Event()
         self._threads = []
         self._fault = fault_plan
         self._conns = set()          # live connections, for kill()
         self._conns_lock = threading.Lock()
+        self.wire_stats = WireStats()
 
     @property
     def address(self):
-        return self._listener.address
+        return self._listener.getsockname()
 
     def serve_forever(self):
-        from multiprocessing import AuthenticationError
         while not self._stop.is_set():
             try:
-                conn = self._listener.accept()
-            except (EOFError, ConnectionError, AuthenticationError):
-                # PER-CONNECTION handshake failure: a client vanished
-                # between connect and the authkey challenge (an elastic
-                # trainer killed mid-handshake raises EOFError /
-                # ConnectionResetError inside Listener.accept's
-                # deliver_challenge). Must not kill the accept loop —
-                # later clients' connects would complete into the dead
-                # listener's backlog and hang forever in answer_challenge.
-                if self._stop.is_set():
-                    break
-                continue
+                conn, _peer = self._listener.accept()
             except OSError:
-                # listener-level failure (shutdown closed it, fd
-                # exhaustion): exit rather than hot-spin on a broken
-                # listener
+                # listener closed (shutdown) or fd exhaustion: exit rather
+                # than hot-spin on a broken listener
                 break
+            if self._stop.is_set():
+                conn.close()
+                break
+            # the authkey handshake runs in the connection's own thread, so
+            # a client that vanishes mid-handshake (an elastic trainer
+            # killed at the wrong moment) never stalls or kills the accept
+            # loop — later clients keep getting served
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True)
             t.start()
@@ -112,20 +446,28 @@ class RpcServer:
         return t
 
     def _serve_conn(self, conn):
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn.settimeout(_HANDSHAKE_TIMEOUT_S)
+            _server_handshake(conn)
+            conn.settimeout(None)
+        except Exception:
+            # vanished/impostor client: drop it, keep serving others
+            conn.close()
+            return
         with self._conns_lock:
             self._conns.add(conn)
         try:
             while not self._stop.is_set():
                 try:
-                    method, kwargs = conn.recv()
-                except (EOFError, OSError, TypeError):
-                    # TypeError: kill() closed this Connection under us —
-                    # close() nulls the handle while recv() is blocked on
-                    # it, and the next read(None, n) raises TypeError, not
-                    # OSError
+                    (method, kwargs), nr, wire = recv_msg(conn)
+                except Exception:
+                    # EOF/OSError: client vanished or kill() severed us;
+                    # decode errors: a corrupt stream is unrecoverable
+                    # mid-connection either way
                     return
                 if method == "__shutdown__":
-                    conn.send((True, None))
+                    send_msg(conn, (True, None), wire)
                     self.shutdown()
                     return
                 rule = self._fault.on_call(method) \
@@ -141,9 +483,11 @@ class RpcServer:
                     self.kill()
                     rule.fired.set()
                     return
+                t0 = time.perf_counter()
                 try:
                     fn = getattr(self._handler, method)
-                    result = (True, fn(**kwargs))
+                    with record_event(f"rpc.serve/{method}", kind="rpc"):
+                        result = (True, fn(**kwargs))
                 except Exception as e:  # surface remote errors to the caller
                     result = (False, f"{type(e).__name__}: {e}")
                 if rule is not None and rule.kind == "drop_response":
@@ -154,9 +498,11 @@ class RpcServer:
                     rule.fired.set()
                     return
                 try:
-                    conn.send(result)
-                except (OSError, BrokenPipeError, TypeError):
+                    ns = send_msg(conn, result, wire)
+                except Exception:
                     return  # client vanished (or kill() closed us) mid-reply
+                self.wire_stats.note(method, ns, nr,
+                                     time.perf_counter() - t0)
         finally:
             with self._conns_lock:
                 self._conns.discard(conn)
@@ -169,9 +515,8 @@ class RpcServer:
         # in accept — the in-progress syscall pins the kernel socket, the
         # port stays in LISTEN, and a restarted server can't rebind the
         # address (the failover contract requires the SAME address). The
-        # throwaway connection completes the accept; its immediate close
-        # makes the authkey handshake fail, which the loop treats as a
-        # vanished client and then sees _stop.
+        # throwaway connection completes the accept; the loop sees _stop
+        # and exits.
         try:
             s = socket.create_connection(self.address, timeout=0.5)
             s.close()
@@ -193,6 +538,12 @@ class RpcServer:
             conns = list(self._conns)
         for c in conns:
             try:
+                # SHUT_RDWR wakes any thread blocked in recv on this socket
+                # (a bare close() would leave it blocked forever)
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
                 c.close()
             except OSError:
                 pass
@@ -202,9 +553,11 @@ class RpcClient:
     """Blocking stub: client.call("method", key=value) -> payload.
 
     Connects lazily (a client may be built while its server is still
-    restarting). A timed-out call DISCARDS the connection (the late
-    response would otherwise sit in the pipe and be returned as the answer
-    to the next, unrelated request); the next call reconnects.
+    restarting). ``timeout`` defaults to the ``rpc_timeout_s`` flag. A
+    timed-out call DISCARDS the connection (the late response would
+    otherwise sit in the pipe and be returned as the answer to the next,
+    unrelated request); the next call reconnects. ``wire`` picks the codec
+    ("framed" zero-copy tensors, default; "pickle" is the legacy baseline).
 
     With a ``retry`` policy, connection-level failures (EOF mid-call,
     refused connect during a server restart) reconnect and resend within
@@ -216,37 +569,60 @@ class RpcClient:
 
     _RETRYABLE = (EOFError, ConnectionError, BrokenPipeError, OSError)
 
-    def __init__(self, address, timeout=90.0, retry=None):
-        self._address = tuple(address) if isinstance(address, (list, tuple)) \
-            else address
-        self._conn = None
+    def __init__(self, address, timeout=None, retry=None, wire=WIRE_FRAMED):
+        if wire not in _TAG:
+            raise ValueError(f"unknown wire codec {wire!r}; "
+                             f"want one of {sorted(_TAG)}")
+        self._address = tuple(address)
+        self._sock = None
         self._lock = threading.Lock()
-        self._timeout = timeout
+        self._timeout = float(get_flag("rpc_timeout_s")) if timeout is None \
+            else float(timeout)
         self._retry = retry
+        self._wire = wire
+        self.wire_stats = WireStats()
+
+    def _connect(self):
+        try:
+            s = socket.create_connection(self._address,
+                                         timeout=self._timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _client_handshake(s)
+        except TimeoutError as e:
+            # a connect/handshake timeout is a CONNECTION failure (server
+            # still restarting, wedged listener) — retryable, unlike a
+            # response timeout on a sent request (which may have applied)
+            raise ConnectionError(
+                f"connect to {self._address} timed out") from e
+        return s
 
     def _drop_conn(self):
-        if self._conn is not None:
+        if self._sock is not None:
             try:
-                self._conn.close()
+                self._sock.close()
             except OSError:
                 pass
-            self._conn = None
+            self._sock = None
 
     def _call_once(self, method, kwargs):
+        t0 = time.perf_counter()
         with self._lock:
-            if self._conn is None:
-                self._conn = Client(self._address, authkey=AUTHKEY)
+            if self._sock is None:
+                self._sock = self._connect()
             try:
-                self._conn.send((method, kwargs))
-                if not self._conn.poll(self._timeout):
-                    self._drop_conn()
-                    raise TimeoutError(f"rpc {method} timed out")
-                ok, payload = self._conn.recv()
+                self._sock.settimeout(self._timeout)
+                ns = send_msg(self._sock, (method, kwargs), self._wire)
+                resp, nr, _wire = recv_msg(self._sock)
+            except TimeoutError:
+                self._drop_conn()
+                raise TimeoutError(f"rpc {method} timed out") from None
             except self._RETRYABLE:
                 # server died mid-call: discard the dead connection so the
                 # next call/attempt reconnects (to a restarted server)
                 self._drop_conn()
                 raise
+            self.wire_stats.note(method, ns, nr, time.perf_counter() - t0)
+        ok, payload = resp
         if not ok:
             raise RuntimeError(f"remote {method} failed: {payload}")
         return payload
@@ -255,7 +631,8 @@ class RpcClient:
         attempt = 0
         while True:
             try:
-                return self._call_once(method, kwargs)
+                with record_event(f"rpc.client/{method}", kind="rpc"):
+                    return self._call_once(method, kwargs)
             except TimeoutError:
                 # a response timeout is ambiguous (the call may have
                 # applied) and bounded by its own deadline — never retried

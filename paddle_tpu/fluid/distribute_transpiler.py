@@ -44,9 +44,14 @@ class PServerProgram:
 
     def __init__(self, endpoint, param_names, optimizer, opt_kwargs, mode,
                  fan_in, max_staleness=None, barrier_timeout_s=None,
-                 checkpoint_path=None, checkpoint_every=1):
+                 checkpoint_path=None, checkpoint_every=1,
+                 sparse_param_names=()):
         self.endpoint = endpoint
         self.param_names = list(param_names)
+        # params whose gradients arrive as SparseRows/SparseGrad (ids +
+        # touched rows — the transpiler marks embedding tables); the server
+        # applies them rowwise, O(touched rows)
+        self.sparse_param_names = list(sparse_param_names)
         self.optimizer = optimizer
         self.opt_kwargs = dict(opt_kwargs)
         self.mode = mode
@@ -122,6 +127,15 @@ class DistributeTranspiler:
 
         self.params_grads = [(op.input("Param")[0], op.input("Grad")[0])
                              for op in opt_ops]
+        # params whose backward emits a sparse-row gradient (lookup_table
+        # with is_sparse, the reference's SelectedRows W@GRAD): trainers
+        # push these as ids + touched rows (ParamClient ships them on the
+        # O(touched-rows) sparse wire) and the pserver applies rowwise
+        placed = {p for p, _ in self.params_grads}
+        self.sparse_param_names = sorted(
+            {op.input("W")[0] for op in block.ops
+             if op.type == "lookup_table" and op.attr("is_sparse", False)}
+            & placed)
         lr = self._resolve_lr(opt_ops[0], program, self._startup)
         self.optimizer, self.opt_kwargs = _SERVER_RULES[opt_ops[0].type](
             opt_ops[0], lr)
@@ -187,7 +201,10 @@ class DistributeTranspiler:
                               self.opt_kwargs,
                               mode="sync" if self.sync_mode else "async",
                               fan_in=self.trainers,
-                              max_staleness=self.max_staleness)
+                              max_staleness=self.max_staleness,
+                              sparse_param_names=[
+                                  n for n in shard
+                                  if n in self.sparse_param_names])
 
     def get_startup_program(self, endpoint, pserver_program=None):
         """The user startup pruned to this endpoint's shard (reference
@@ -217,7 +234,8 @@ class DistributeTranspiler:
         from ..distributed.param_server import ParamClient, parse_endpoint
         return ParamClient([parse_endpoint(e) for e in self.endpoints],
                            trainer_id=self.trainer_id,
-                           param_names=[p for p, _ in self.params_grads])
+                           param_names=[p for p, _ in self.params_grads],
+                           sparse_param_names=self.sparse_param_names)
 
 
 class SimpleDistributeTranspiler(DistributeTranspiler):

@@ -156,3 +156,41 @@ def test_transpile_requires_optimize_ops():
     with pytest.raises(ValueError, match="optimize ops"):
         t.transpile(0, program=main, pservers="127.0.0.1:1", trainers=1,
                     startup_program=startup)
+
+
+def test_transpiler_marks_sparse_embedding_params():
+    """Params fed by an is_sparse lookup_table backward (SelectedRows
+    W@GRAD) are marked sparse: trainers ship their grads as ids + touched
+    rows, and each PServerProgram knows which of its shard's params take
+    the rowwise path."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        y = fluid.layers.data("y", shape=[4])
+        e = fluid.layers.embedding(ids, size=[12, 6], is_sparse=True)
+        e2 = fluid.layers.embedding(ids, size=[12, 6], is_sparse=False)
+        h = fluid.layers.elementwise_add(
+            fluid.layers.reshape(e, [-1, 6]),
+            fluid.layers.reshape(e2, [-1, 6]))
+        pred = fluid.layers.fc(h, size=4, act=None)
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss, startup)
+
+    lookup_sparse = [op for op in main.global_block().ops
+                     if op.type == "lookup_table" and op.attr("is_sparse")]
+    assert len(lookup_sparse) == 1
+    sparse_w = lookup_sparse[0].input("W")[0]
+
+    eps = ["127.0.0.1:6474", "127.0.0.1:6475"]
+    t = fluid.DistributeTranspiler()
+    t.transpile(0, program=main, pservers=",".join(eps), trainers=1,
+                startup_program=startup)
+    # only the is_sparse table is marked; the dense embedding is not
+    assert t.sparse_param_names == [sparse_w]
+    specs = [t.get_pserver_program(ep) for ep in eps]
+    marked = [n for s in specs for n in s.sparse_param_names]
+    assert marked == [sparse_w]
+    # the mark lives with the shard that owns the param
+    owner = [s for s in specs if sparse_w in s.param_names]
+    assert len(owner) == 1 and owner[0].sparse_param_names == [sparse_w]

@@ -1,0 +1,593 @@
+"""Parameter-server wire path: framed tensor codec, sparse push, fp16 wire
+dtype, parallel pulls, wire accounting.
+
+Reference contracts: the gRPC layer serializes tensors as a small header +
+raw bytes (operators/detail/sendrecvop_utils.cc); ParameterServer2's sparse
+parameter formats and the SelectedRows send path make gradient traffic
+O(touched rows) (pserver/ParameterServer2.h, framework/selected_rows.h);
+optimizer sparse branches update only touched rows
+(operators/adam_op.h SparseAdamFunctor).
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import flags
+from paddle_tpu.distributed import (ParameterServer, ParamClient, serve,
+                                    RpcClient, SparseGrad, send_msg,
+                                    recv_msg)
+
+
+def _start_ps(**kw):
+    ps, rpc = serve(**kw)
+    rpc.serve_in_thread()
+    return ps, rpc
+
+
+def _roundtrip(obj, wire):
+    """Send obj through a socketpair with the given codec; return the
+    decoded object (reader on a thread so large payloads can't deadlock
+    the kernel socket buffer)."""
+    a, b = socket.socketpair()
+    out = {}
+
+    def read():
+        out["msg"] = recv_msg(b)
+
+    t = threading.Thread(target=read)
+    t.start()
+    sent = send_msg(a, obj, wire=wire)
+    t.join(10.0)
+    a.close()
+    b.close()
+    got, nbytes, got_wire = out["msg"]
+    assert nbytes == sent
+    assert got_wire == wire
+    return got
+
+
+def _assert_payload_equal(x, y):
+    if isinstance(x, np.ndarray):
+        assert isinstance(y, np.ndarray)
+        assert x.dtype == y.dtype and x.shape == y.shape
+        np.testing.assert_array_equal(x, y)
+    elif isinstance(x, SparseGrad):
+        assert isinstance(y, SparseGrad)
+        assert x.nrows == y.nrows and x.merged == y.merged
+        _assert_payload_equal(x.rows, y.rows)
+        _assert_payload_equal(x.values, y.values)
+    elif isinstance(x, dict):
+        assert set(x) == set(y)
+        for k in x:
+            _assert_payload_equal(x[k], y[k])
+    elif isinstance(x, (list, tuple)):
+        assert type(x) is type(y) and len(x) == len(y)
+        for xi, yi in zip(x, y):
+            _assert_payload_equal(xi, yi)
+    else:
+        assert x == y and type(x) is type(y)
+
+
+# ---------------------------------------------------------------------------
+# codec round-trip (the forward-compat guard: both wires carry identical
+# payloads, so a framed client can always fall back to the pickle codec)
+# ---------------------------------------------------------------------------
+
+def test_framed_and_pickled_codecs_roundtrip_identical_payloads():
+    payload = (
+        "push",
+        {
+            "grads": {
+                "w": np.arange(24, dtype=np.float32).reshape(4, 6),
+                "half": np.ones((3, 2), np.float16),
+                "ids": np.array([5, 1, 5], np.int64),
+                "scalar0d": np.asarray(np.float32(2.5)),
+                "empty": np.empty((0, 4), np.float32),
+                "noncontig": np.arange(10, dtype=np.float64)[::2],
+                "emb": SparseGrad(np.array([3, 1, 3], np.int64),
+                                  np.ones((3, 2), np.float32), nrows=7),
+            },
+            "trainer_id": 3,
+            "seq": 9,
+            "note": "control strings ride the skeleton",
+            "nested": [1, (2.5, None), {"deep": np.full((2,), 7, np.int32)}],
+        },
+    )
+    framed = _roundtrip(payload, "framed")
+    pickled = _roundtrip(payload, "pickle")
+    _assert_payload_equal(framed, payload)
+    _assert_payload_equal(pickled, payload)
+    _assert_payload_equal(framed, pickled)
+
+
+def test_framed_wire_is_smaller_than_pickle_for_tensors_and_counts_bytes():
+    big = {"w": np.ones((64, 1024), np.float32)}
+    a, b = socket.socketpair()
+    out = {}
+
+    def read():
+        out["m"] = recv_msg(b)
+
+    for wire in ("framed", "pickle"):
+        t = threading.Thread(target=read)
+        t.start()
+        sent = send_msg(a, big, wire=wire)
+        t.join(10.0)
+        out[wire] = sent
+    a.close()
+    b.close()
+    # framing overhead over the raw 256 KiB of tensor bytes is tiny
+    assert out["framed"] < big["w"].nbytes + 512
+    assert out["framed"] <= out["pickle"]
+
+
+def test_server_answers_in_the_request_codec():
+    ps, rpc = _start_ps(optimizer="sgd", opt_kwargs={"lr": 1.0},
+                        mode="async")
+    framed = ParamClient([rpc.address], trainer_id=0)
+    legacy = ParamClient([rpc.address], trainer_id=1, param_names=["w"],
+                         wire="pickle")
+    framed.init_params({"w": np.zeros(4, np.float32)})
+    framed.push({"w": np.ones(4, np.float32)})
+    legacy.push({"w": np.ones(4, np.float32)})
+    np.testing.assert_array_equal(legacy.pull()["w"],
+                                  -2.0 * np.ones(4, np.float32))
+    np.testing.assert_array_equal(framed.pull()["w"], legacy.pull()["w"])
+    framed.close()
+    legacy.close()
+    rpc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# sparse push: O(touched rows) wire + rowwise server-side apply
+# ---------------------------------------------------------------------------
+
+def test_sparse_push_matches_dense_sgd():
+    """A SparseGrad push (with duplicate ids the server must merge) lands
+    exactly like the equivalent dense gradient."""
+    table0 = np.random.RandomState(0).normal(
+        size=(10, 4)).astype(np.float32)
+    rows = np.array([3, 1, 3], np.int64)           # 3 twice: MergeAdd
+    vals = np.array([[1, 1, 1, 1], [2, 2, 2, 2], [4, 4, 4, 4]], np.float32)
+
+    dense = np.zeros_like(table0)
+    np.add.at(dense, rows, vals)
+
+    ps_d = ParameterServer(optimizer="sgd", opt_kwargs={"lr": 0.1})
+    ps_d.init_params({"emb": table0})
+    ps_d.push({"emb": dense}, trainer_id=0, seq=1)
+
+    ps_s = ParameterServer(optimizer="sgd", opt_kwargs={"lr": 0.1})
+    ps_s.init_params({"emb": table0})
+    ps_s.push({"emb": SparseGrad(rows, vals, nrows=10)}, trainer_id=0,
+              seq=1)
+
+    np.testing.assert_allclose(ps_s.pull()["emb"], ps_d.pull()["emb"],
+                               rtol=1e-6)
+    # untouched rows are bitwise untouched
+    untouched = [i for i in range(10) if i not in (1, 3)]
+    np.testing.assert_array_equal(ps_s.pull()["emb"][untouched],
+                                  table0[untouched])
+
+
+def test_sparse_push_rowwise_adam_state_and_laziness():
+    """Rowwise Adam: m1/m2/t update only for touched rows (per-row t —
+    lazy bias correction), untouched rows keep zero state and do not
+    move."""
+    table0 = np.ones((6, 3), np.float32)
+    ps = ParameterServer(optimizer="adam", opt_kwargs={"lr": 0.01})
+    ps.init_params({"emb": table0})
+    g = SparseGrad(np.array([0, 2], np.int64),
+                   np.ones((2, 3), np.float32), nrows=6)
+    ps.push({"emb": g}, trainer_id=0, seq=1)
+    ps.push({"emb": g}, trainer_id=0, seq=2)
+    st = ps._opt_state["emb"]
+    np.testing.assert_array_equal(st["t"], [2, 0, 2, 0, 0, 0])
+    assert st["m1"][[1, 3, 4, 5]].sum() == 0.0
+    assert np.abs(st["m1"][[0, 2]]).min() > 0
+    w = ps.pull()["emb"]
+    np.testing.assert_array_equal(w[[1, 3, 4, 5]], table0[[1, 3, 4, 5]])
+    assert np.abs(w[[0, 2]] - table0[[0, 2]]).min() > 1e-4
+
+
+def test_sparse_rowwise_state_checkpoints_bitwise(tmp_path):
+    """Rowwise m1/m2/t persist and restore bitwise, and the restored
+    server continues bit-identically through further sparse pushes (the
+    PR-2 checkpoint invariant extended to sparse state)."""
+    path = str(tmp_path / "ps.ckpt")
+    rng = np.random.RandomState(1)
+    ps = ParameterServer(optimizer="adam", opt_kwargs={"lr": 0.01},
+                         mode="async")
+    ps.init_params({"emb": rng.normal(size=(8, 3)).astype(np.float32)})
+    for s in range(1, 4):
+        g = SparseGrad(rng.randint(0, 8, size=(4,)),
+                       rng.normal(size=(4, 3)).astype(np.float32), nrows=8)
+        ps.push({"emb": g}, trainer_id=1, seq=s)
+    ps.save_checkpoint(path)
+
+    ps2 = ParameterServer(optimizer="adam", opt_kwargs={"lr": 0.01},
+                          mode="async")
+    assert ps2.restore(path) is True
+    for k in ("m1", "m2", "t"):
+        np.testing.assert_array_equal(ps._opt_state["emb"][k],
+                                      ps2._opt_state["emb"][k])
+    # replayed pre-crash sparse push: answered from dedup, not re-applied
+    before = np.array(ps2.pull()["emb"], copy=True)
+    ps2.push({"emb": SparseGrad(np.array([0]), np.ones((1, 3), np.float32),
+                                nrows=8)}, trainer_id=1, seq=3)
+    np.testing.assert_array_equal(ps2.pull()["emb"], before)
+    # the next fresh sparse push continues bit-identically on both
+    g4 = SparseGrad(np.array([2, 5]),
+                    rng.normal(size=(2, 3)).astype(np.float32), nrows=8)
+    ps.push({"emb": g4}, trainer_id=1, seq=4)
+    ps2.push({"emb": g4}, trainer_id=1, seq=4)
+    np.testing.assert_array_equal(ps.pull()["emb"], ps2.pull()["emb"])
+
+
+def test_sync_round_merges_sparse_pushes_across_trainers():
+    """fan_in=2 sync round of two SparseGrads (overlapping rows): the
+    applied update is the averaged merged gradient, like the dense
+    barrier contract."""
+    table0 = np.zeros((5, 2), np.float32)
+    ps = ParameterServer(optimizer="sgd", opt_kwargs={"lr": 1.0},
+                         mode="sync", fan_in=2)
+    ps.init_params({"emb": table0})
+    g1 = SparseGrad(np.array([0, 2]), np.ones((2, 2), np.float32), nrows=5)
+    g2 = SparseGrad(np.array([2, 4]),
+                    2 * np.ones((2, 2), np.float32), nrows=5)
+
+    t = threading.Thread(target=lambda: ps.push({"emb": g1}, trainer_id=0,
+                                                seq=1))
+    t.start()
+    ps.push({"emb": g2}, trainer_id=1, seq=1)
+    t.join()
+    expect = np.zeros((5, 2), np.float32)
+    expect[0] -= 1.0 / 2
+    expect[2] -= (1.0 + 2.0) / 2
+    expect[4] -= 2.0 / 2
+    np.testing.assert_allclose(ps.pull()["emb"], expect, rtol=1e-6)
+
+
+def test_sync_round_mixing_dense_and_sparse_for_one_param():
+    ps = ParameterServer(optimizer="sgd", opt_kwargs={"lr": 1.0},
+                         mode="sync", fan_in=2)
+    ps.init_params({"emb": np.zeros((4, 2), np.float32)})
+    sparse = SparseGrad(np.array([1]), np.ones((1, 2), np.float32), nrows=4)
+    dense = np.full((4, 2), 2.0, np.float32)
+
+    t = threading.Thread(target=lambda: ps.push({"emb": sparse},
+                                                trainer_id=0, seq=1))
+    t.start()
+    ps.push({"emb": dense}, trainer_id=1, seq=1)
+    t.join()
+    expect = -(dense + SparseGrad(np.array([1]),
+                                  np.ones((1, 2), np.float32),
+                                  nrows=4).to_dense()) / 2
+    np.testing.assert_allclose(ps.pull()["emb"], expect, rtol=1e-6)
+
+
+def test_param_client_converts_core_sparse_rows():
+    """A trainer pushing the executor's own SparseRows (jax arrays,
+    sentinel padding == nrows) ships only the real touched rows and the
+    server result matches the densified gradient."""
+    jnp = pytest.importorskip("jax.numpy")
+    from paddle_tpu.core.sparse import SparseRows
+
+    ps, rpc = _start_ps(optimizer="sgd", opt_kwargs={"lr": 1.0},
+                        mode="async")
+    c = ParamClient([rpc.address], trainer_id=0)
+    nrows, dim = 512, 8
+    table0 = np.zeros((nrows, dim), np.float32)
+    c.init_params({"emb": table0})
+    # 5 entries, two of them sentinel padding (row 512 == nrows)
+    sr = SparseRows(jnp.asarray([1, 4, 1, nrows, nrows], jnp.int32),
+                    jnp.ones((5, dim), jnp.float32), nrows=nrows)
+    sent0 = c.wire_stats()["bytes_sent"]
+    c.push({"emb": sr})
+    pushed_bytes = c.wire_stats()["bytes_sent"] - sent0
+    # wire carries the 3 real rows + a small header — far below the dense
+    # 16 KiB table gradient
+    assert pushed_bytes < 2000 < table0.nbytes
+    expect = np.zeros((nrows, dim), np.float32)
+    expect[1] -= 2.0   # row 1 twice, merged
+    expect[4] -= 1.0
+    np.testing.assert_allclose(c.pull()["emb"], expect, rtol=1e-6)
+    c.close()
+    rpc.shutdown()
+
+
+def test_sparse_push_bytes_scale_with_touched_rows():
+    ps, rpc = _start_ps(optimizer="sgd", mode="async")
+    c = ParamClient([rpc.address], trainer_id=0)
+    dim, nrows = 16, 4096
+    c.init_params({"emb": np.zeros((nrows, dim), np.float32)})
+
+    def push_bytes(k):
+        g = SparseGrad(np.arange(k, dtype=np.int64),
+                       np.ones((k, dim), np.float32), nrows=nrows,
+                       merged=True)
+        before = c.wire_stats()["bytes_sent"]
+        c.push({"emb": g})
+        return c.wire_stats()["bytes_sent"] - before
+
+    b64, b512 = push_bytes(64), push_bytes(512)
+    # bytes grow ~8x for 8x the rows (headers amortize), and both are far
+    # below the dense table push
+    assert 6.0 < b512 / b64 < 9.0
+    assert b512 < nrows * dim * 4 / 2
+    c.close()
+    rpc.shutdown()
+
+
+def test_marked_param_sparsifies_densified_grads_on_the_wire():
+    """A param in sparse_param_names (the transpiler's is_sparse marking)
+    whose backward handed the trainer a DENSE grad still ships only its
+    touched rows."""
+    nrows, dim = 1024, 16
+    ps, rpc = _start_ps(optimizer="sgd", opt_kwargs={"lr": 1.0},
+                        mode="async")
+    c = ParamClient([rpc.address], trainer_id=0,
+                    sparse_param_names=["emb"])
+    c.init_params({"emb": np.zeros((nrows, dim), np.float32)})
+    dense = np.zeros((nrows, dim), np.float32)
+    dense[3] = 1.0
+    dense[700] = 2.0
+    before = c.wire_stats()["bytes_sent"]
+    c.push({"emb": dense})
+    pushed = c.wire_stats()["bytes_sent"] - before
+    assert pushed < 2000 < dense.nbytes          # 2 rows, not the table
+    np.testing.assert_allclose(c.pull()["emb"], -dense, rtol=1e-6)
+    # an UNmarked param with the same grad ships dense (no scan, no
+    # behavior change)
+    assert isinstance(c._wire_grad("other", dense), np.ndarray)
+    # a mostly-dense grad for a marked param stays dense too
+    assert isinstance(c._wire_grad("emb", np.ones((4, 2), np.float32)),
+                      np.ndarray)
+    c.close()
+    rpc.shutdown()
+
+
+def test_pull_copies_only_params_with_sparse_history():
+    """Dense-only params pull by reference (dense rules rebind, so the
+    handed-out array is immutable); a param's first rowwise apply
+    copy-on-writes it and marks it copied-on-pull thereafter."""
+    ps = ParameterServer(optimizer="sgd", opt_kwargs={"lr": 1.0})
+    ps.init_params({"w": np.zeros(4, np.float32),
+                    "emb": np.zeros((4, 2), np.float32)})
+    assert ps.pull()["w"] is ps._params["w"]          # no per-pull memcpy
+    held = ps.pull()["emb"]                           # ref from dense era
+    ps.push({"emb": SparseGrad(np.array([1]),
+                               np.ones((1, 2), np.float32), nrows=4)},
+            trainer_id=0, seq=1)
+    # COW: the in-place apply ran on a fresh copy, not the held reference
+    np.testing.assert_array_equal(held, np.zeros((4, 2), np.float32))
+    got = ps.pull()["emb"]
+    assert got is not ps._params["emb"]               # sparse params copy
+    np.testing.assert_array_equal(got[1], [-1.0, -1.0])
+
+
+# ---------------------------------------------------------------------------
+# fp16 wire dtype
+# ---------------------------------------------------------------------------
+
+def test_fp16_wire_halves_push_bytes_and_accumulates_fp32():
+    old = flags.get_flag("pserver_wire_dtype")
+    ps, rpc = _start_ps(optimizer="sgd", opt_kwargs={"lr": 1.0},
+                        mode="async")
+    c = ParamClient([rpc.address], trainer_id=0)
+    g = np.random.RandomState(0).normal(size=(256, 64)).astype(np.float32)
+    c.init_params({"w": np.zeros_like(g)})
+    try:
+        before = c.wire_stats()["bytes_sent"]
+        c.push({"w": g})
+        fp32_bytes = c.wire_stats()["bytes_sent"] - before
+
+        flags.set_flags({"pserver_wire_dtype": "fp16"})
+        before = c.wire_stats()["bytes_sent"]
+        c.push({"w": g})
+        fp16_bytes = c.wire_stats()["bytes_sent"] - before
+        assert fp16_bytes < 0.6 * fp32_bytes
+        got = c.pull()["w"]
+        # server params stay fp32; the applied value reflects the fp16
+        # wire rounding of the SECOND push only
+        assert got.dtype == np.float32
+        np.testing.assert_allclose(
+            got, -(g + g.astype(np.float16).astype(np.float32)),
+            rtol=1e-6)
+    finally:
+        flags.set_flags({"pserver_wire_dtype": old})
+        c.close()
+        rpc.shutdown()
+
+
+def test_fp16_wire_applies_to_sparse_values():
+    old = flags.get_flag("pserver_wire_dtype")
+    ps = ParameterServer(optimizer="sgd", opt_kwargs={"lr": 1.0})
+    ps.init_params({"emb": np.zeros((4, 2), np.float32)})
+    try:
+        flags.set_flags({"pserver_wire_dtype": "fp16"})
+        sg = ParamClient([("127.0.0.1", 1)])._wire_grad(
+            "emb", SparseGrad(np.array([1]),
+                              np.full((1, 2), 0.1, np.float32), nrows=4))
+        assert sg.values.dtype == np.float16
+        ps.push({"emb": sg}, trainer_id=0, seq=1)
+        assert ps.pull()["emb"].dtype == np.float32
+        np.testing.assert_allclose(
+            ps.pull()["emb"][1],
+            -np.full((2,), 0.1, np.float16).astype(np.float32))
+    finally:
+        flags.set_flags({"pserver_wire_dtype": old})
+
+
+# ---------------------------------------------------------------------------
+# parallel pull + error aggregation (the push contract, now on pull)
+# ---------------------------------------------------------------------------
+
+def test_pull_fans_out_and_aggregates_all_shard_errors():
+    ps1, rpc1 = _start_ps(optimizer="sgd")
+    ps2, rpc2 = _start_ps(optimizer="sgd")
+    c = ParamClient([rpc1.address, rpc2.address], trainer_id=1)
+    params = {f"p{i}": np.full((2,), float(i), np.float32)
+              for i in range(4)}
+    c.init_params(params)
+    got = c.pull()
+    for i in range(4):
+        np.testing.assert_array_equal(got[f"p{i}"], params[f"p{i}"])
+    rpc1.kill()
+    rpc2.kill()
+    with pytest.raises(RuntimeError) as ei:
+        c.pull()
+    msg = str(ei.value)
+    assert "shard 0" in msg and "shard 1" in msg, msg
+    c.close()
+
+
+def test_pull_single_shard_error_keeps_original_type():
+    ps1, rpc1 = _start_ps(optimizer="sgd")
+    ps2, rpc2 = _start_ps(optimizer="sgd")
+    c = ParamClient([rpc1.address, rpc2.address], trainer_id=1)
+    c.init_params({f"p{i}": np.zeros(2, np.float32) for i in range(4)})
+    rpc2.kill()
+    with pytest.raises((EOFError, ConnectionError, OSError)):
+        c.pull()
+    c.close()
+    rpc1.shutdown()
+
+
+def test_pull_runs_shards_concurrently():
+    """A slow shard must overlap with the fast one — pull wall time is
+    max(shards), not sum (the satellite's whole point)."""
+    from paddle_tpu.distributed import FaultPlan
+
+    delay = 0.4
+    plan1 = FaultPlan().delay("pull", 0, delay)
+    plan2 = FaultPlan().delay("pull", 0, delay)
+    ps1, rpc1 = _start_ps(optimizer="sgd", fault_plan=plan1)
+    ps2, rpc2 = _start_ps(optimizer="sgd", fault_plan=plan2)
+    c = ParamClient([rpc1.address, rpc2.address], trainer_id=1)
+    c.init_params({f"p{i}": np.zeros(2, np.float32) for i in range(4)})
+    t0 = time.monotonic()
+    c.pull()
+    dt = time.monotonic() - t0
+    assert dt < 2 * delay * 0.95, f"pull took {dt:.3f}s — sequential?"
+    c.close()
+    rpc1.shutdown()
+    rpc2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# sync fan-in accumulation owns its buffer (satellite)
+# ---------------------------------------------------------------------------
+
+def test_sync_fan_in_accumulation_does_not_mutate_caller_arrays():
+    ps = ParameterServer(optimizer="sgd", opt_kwargs={"lr": 1.0},
+                         mode="sync", fan_in=2)
+    ps.init_params({"w": np.zeros(3, np.float32)})
+    g = np.ones(3, np.float32)          # SAME array pushed by both
+
+    t = threading.Thread(target=lambda: ps.push({"w": g}, trainer_id=0,
+                                                seq=1))
+    t.start()
+    ps.push({"w": g}, trainer_id=1, seq=1)
+    t.join()
+    np.testing.assert_array_equal(g, np.ones(3, np.float32))
+    np.testing.assert_array_equal(ps.pull()["w"], -np.ones(3, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# rpc_timeout_s flag threading (satellite)
+# ---------------------------------------------------------------------------
+
+def test_rpc_timeout_flag_threads_through_clients():
+    old = flags.get_flag("rpc_timeout_s")
+    try:
+        flags.set_flags({"rpc_timeout_s": 0.5})
+        assert RpcClient(("127.0.0.1", 1))._timeout == 0.5
+        pc = ParamClient([("127.0.0.1", 1)])
+        assert all(c._timeout == 0.5 for c in pc._clients)
+        # explicit override still wins
+        assert RpcClient(("127.0.0.1", 1), timeout=2.0)._timeout == 2.0
+    finally:
+        flags.set_flags({"rpc_timeout_s": old})
+
+
+def test_rpc_timeout_flag_bounds_a_stuck_call():
+    class Stuck:
+        def hang(self):
+            time.sleep(5.0)
+
+    from paddle_tpu.distributed import RpcServer
+
+    srv = RpcServer(Stuck())
+    srv.serve_in_thread()
+    old = flags.get_flag("rpc_timeout_s")
+    try:
+        flags.set_flags({"rpc_timeout_s": 0.3})
+        c = RpcClient(srv.address)
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            c.call("hang")
+        assert time.monotonic() - t0 < 2.0
+        c.close()
+    finally:
+        flags.set_flags({"rpc_timeout_s": old})
+        srv.shutdown()
+
+
+def test_supervisor_heartbeat_timeout_follows_flag():
+    from paddle_tpu.distributed import PserverSupervisor
+
+    old = flags.get_flag("rpc_timeout_s")
+    try:
+        flags.set_flags({"rpc_timeout_s": 0.75})
+        sup = PserverSupervisor(n_servers=1)
+        try:
+            assert sup._hb_timeout == 0.75
+        finally:
+            sup.stop()
+    finally:
+        flags.set_flags({"rpc_timeout_s": old})
+
+
+# ---------------------------------------------------------------------------
+# wire accounting surfaces
+# ---------------------------------------------------------------------------
+
+def test_wire_counters_surface_in_server_stats_and_client():
+    ps, rpc = _start_ps(optimizer="sgd", mode="async")
+    c = ParamClient([rpc.address], trainer_id=0)
+    c.init_params({"w": np.zeros((32, 8), np.float32)})
+    c.push({"w": np.ones((32, 8), np.float32)})
+    c.pull()
+    st = ps.stats()
+    assert st["wire"]["bytes_recv"] > 32 * 8 * 4         # saw the push
+    assert st["wire"]["calls"]["push"]["count"] == 1
+    assert st["wire"]["calls"]["pull"]["count"] == 1
+    cs = c.wire_stats()
+    assert cs["bytes_sent"] > 32 * 8 * 4
+    assert cs["calls"]["pull"]["count"] == 1
+    assert cs["calls"]["pull"]["total_s"] > 0
+    c.close()
+    rpc.shutdown()
+
+
+def test_rpc_calls_record_profiler_spans():
+    from paddle_tpu.core import profiler
+
+    ps, rpc = _start_ps(optimizer="sgd", mode="async")
+    c = ParamClient([rpc.address], trainer_id=0)
+    profiler.enable_profiler()
+    try:
+        c.init_params({"w": np.zeros(4, np.float32)})
+        c.push({"w": np.ones(4, np.float32)})
+        rows = profiler.disable_profiler(sorted_key="total")
+    finally:
+        c.close()
+        rpc.shutdown()
+    names = {r["name"] for r in rows}
+    assert "rpc.client/push" in names
+    assert "rpc.client/init_params" in names

@@ -32,6 +32,71 @@ def test_merge_rows_combines_duplicates():
     assert m.merged
 
 
+def test_merge_rows_empty_is_identity():
+    """Zero-entry SparseRows (an empty batch slice) must merge and densify
+    without tripping the head/segment construction."""
+    sr = SparseRows(jnp.zeros((0,), jnp.int32),
+                    jnp.zeros((0, 3), jnp.float32), nrows=6)
+    m = merge_rows(sr)
+    assert m.merged
+    assert m.rows.shape == (0,) and m.values.shape == (0, 3)
+    dense = np.asarray(m.to_dense())
+    assert dense.shape == (6, 3)
+    np.testing.assert_allclose(dense, 0.0)
+
+
+def test_astype_preserves_rows_nrows_and_merged():
+    sr = SparseRows(jnp.array([2, 0], jnp.int32),
+                    jnp.ones((2, 4), jnp.float32), nrows=5, merged=True)
+    h = sr.astype(jnp.float16)
+    assert h.values.dtype == jnp.float16
+    assert h.rows is sr.rows and h.nrows == 5 and h.merged is True
+    assert h.shape == (5, 4) and h.dtype == jnp.float16
+    back = h.astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(back.values),
+                               np.asarray(sr.values))
+
+
+def test_apply_rowwise_with_adam_state():
+    """apply_rowwise drives a full Adam step over touched rows only:
+    param + m1 + m2 move for touched rows (duplicates pre-merged),
+    untouched rows keep zero state — matches a dense numpy Adam whose
+    grad is the densified SparseRows."""
+    from paddle_tpu.core.sparse import apply_rowwise
+
+    lr, b1, b2, eps, t = 0.1, 0.9, 0.999, 1e-8, 1
+    rng = np.random.RandomState(3)
+    w0 = rng.normal(size=(7, 2)).astype(np.float32)
+    sr = SparseRows(jnp.array([4, 1, 4, 7], jnp.int32),  # dup + sentinel
+                    jnp.asarray(rng.normal(size=(4, 2)), jnp.float32),
+                    nrows=7)
+
+    def adam_rows(g, w, m1, m2):
+        m1n = b1 * m1 + (1 - b1) * g
+        m2n = b2 * m2 + (1 - b2) * g * g
+        lr_t = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        return (w - lr_t * m1n / (jnp.sqrt(m2n) + eps), m1n, m2n)
+
+    states = [jnp.asarray(w0), jnp.zeros((7, 2)), jnp.zeros((7, 2))]
+    w1, m1, m2 = apply_rowwise(sr, states, adam_rows)
+
+    g_dense = np.asarray(sr.to_dense())
+    touched = sorted({1, 4})
+    m1_ref = (1 - b1) * g_dense
+    m2_ref = (1 - b2) * g_dense * g_dense
+    w_ref = w0 - (lr * np.sqrt(1 - b2) / (1 - b1)) \
+        * m1_ref / (np.sqrt(m2_ref) + eps)
+    np.testing.assert_allclose(np.asarray(m1)[touched], m1_ref[touched],
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m2)[touched], m2_ref[touched],
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(w1)[touched], w_ref[touched],
+                               rtol=1e-5, atol=1e-6)
+    untouched = [i for i in range(7) if i not in touched]
+    np.testing.assert_allclose(np.asarray(w1)[untouched], w0[untouched])
+    np.testing.assert_allclose(np.asarray(m1)[untouched], 0.0)
+
+
 def test_to_dense_drops_sentinel_rows():
     sr = SparseRows(jnp.array([0, 5, 5], dtype=jnp.int32),
                     jnp.ones((3, 4), jnp.float32), nrows=5)
