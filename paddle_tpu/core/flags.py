@@ -171,6 +171,28 @@ DEFINE_flag("conv_1x1_grad_as_dot", False,
             "channel matmuls instead of jax's transposed convolutions (see "
             "conv2d_grad)")
 
+DEFINE_flag("serving_batch_buckets", "1,2,4,8,16,32",
+            "comma-separated power-of-two batch buckets the serving "
+            "InferenceEngine pads incoming batches up to. Each bucket is "
+            "one jitted executable shape, compiled at warmup; the largest "
+            "bucket is the DynamicBatcher's coalesce target and the "
+            "chunk width for oversized direct batches. A small fixed set "
+            "keeps the XLA trace cache bounded and the hot path "
+            "recompile-free (serving/engine.py)")
+
+DEFINE_flag("serving_max_delay_ms", 5.0,
+            "how long the serving DynamicBatcher holds an under-full "
+            "batch open for more concurrent requests before dispatching "
+            "it anyway — the latency bound a single quiet-traffic "
+            "request pays for batching (a full bucket dispatches "
+            "immediately)")
+
+DEFINE_flag("serving_queue_capacity", 256,
+            "bound on requests waiting in the serving DynamicBatcher "
+            "queue. When full, new requests are rejected fast with a "
+            "typed ServerOverloaded the client can back off on, instead "
+            "of stretching everyone's latency without bound")
+
 # PDTPU_FLAGS=check_nan_inf=1,benchmark=0 — unknown names warn and are
 # ignored (a typo'd env var must not make the package unimportable)
 _env = os.environ.get("PDTPU_FLAGS", "")

@@ -124,6 +124,84 @@ def print_summary(rows, file=None):
     print("\n".join(lines), file=file)
 
 
+def _percentile_sorted(vals, q):
+    """q-th percentile of an already-sorted sample (linear interpolation,
+    numpy's default definition — hand-rolled so this module keeps its
+    stdlib-only import surface)."""
+    if not vals:
+        return 0.0
+    if len(vals) == 1:
+        return float(vals[0])
+    pos = (len(vals) - 1) * (float(q) / 100.0)
+    lo = int(pos)
+    hi = min(lo + 1, len(vals) - 1)
+    frac = pos - lo
+    return float(vals[lo] * (1.0 - frac) + vals[hi] * frac)
+
+
+def percentile(durations, q):
+    """q-th percentile (0..100) by linear interpolation of the sorted
+    sample — the serving stats' p50/p99 definition. Returns 0.0 on an
+    empty sample so health endpoints never divide-by-zero."""
+    return _percentile_sorted(sorted(durations), q)
+
+
+class LatencyWindow:
+    """Thread-safe sliding window of recent span durations with percentile
+    readout — the always-on per-request latency tracker the model server's
+    stats RPC reports from (p50/p99). Unlike the global profiler above it
+    needs no enable/disable: recording into a bounded ring is cheap enough
+    for every served request, and ``spans()`` feeds the same
+    ``record_event`` machinery when the global profiler IS enabled, so
+    serving spans still land in chrome traces."""
+
+    def __init__(self, capacity=2048, name="span", kind="rpc"):
+        self._lock = threading.Lock()
+        self._cap = int(capacity)
+        self._durs = []          # ring of recent durations (seconds)
+        self._next = 0
+        self.count = 0
+        self.name = name
+        self.kind = kind
+
+    def record(self, seconds):
+        with self._lock:
+            self.count += 1
+            if len(self._durs) < self._cap:
+                self._durs.append(float(seconds))
+            else:
+                self._durs[self._next] = float(seconds)
+                self._next = (self._next + 1) % self._cap
+
+    @contextmanager
+    def span(self):
+        """Time a block into the window AND the global profiler (when
+        enabled) under this window's name/kind."""
+        with record_event(self.name, kind=self.kind):
+            t0 = _now()
+            try:
+                yield
+            finally:
+                self.record(_now() - t0)
+
+    def percentiles(self, qs=(50, 99)):
+        """{q: milliseconds} over the windowed sample (one sort)."""
+        with self._lock:
+            durs = sorted(self._durs)
+        return {q: _percentile_sorted(durs, q) * 1e3 for q in qs}
+
+    def snapshot(self):
+        with self._lock:
+            durs = sorted(self._durs)
+            n = self.count
+        out = {"count": n, "window": len(durs)}
+        for q in (50, 99):
+            out[f"p{q}_ms"] = _percentile_sorted(durs, q) * 1e3
+        if durs:
+            out["max_ms"] = durs[-1] * 1e3
+        return out
+
+
 def export_chrome_tracing(path, evs=None):
     """Write chrome://tracing 'Complete' events (ph="X"), the exact schema of
     the reference's tools/timeline.py:40-134 _ChromeTraceFormatter."""

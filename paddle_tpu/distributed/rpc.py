@@ -406,16 +406,29 @@ class RpcServer:
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind(tuple(address))
         self._listener.listen(16)
+        # cache the bound address: getsockname() on a closed listener is
+        # EBADF, but callers legitimately ask a drained/killed server
+        # where it WAS (restart-on-same-address, post-shutdown asserts)
+        self._address = self._listener.getsockname()
         self._stop = threading.Event()
+        self._draining = threading.Event()
         self._threads = []
         self._fault = fault_plan
         self._conns = set()          # live connections, for kill()
         self._conns_lock = threading.Lock()
+        # in-flight request count + wakeup for drain(): a request is
+        # active from the moment it is fully received until its response
+        # is sent (or dropped). _drain_finalized closes the race where a
+        # request finishes its recv after drain() observed active == 0:
+        # such a request is dropped UNAPPLIED instead of being half-served
+        self._active = 0
+        self._active_cv = threading.Condition()
+        self._drain_finalized = False
         self.wire_stats = WireStats()
 
     @property
     def address(self):
-        return self._listener.getsockname()
+        return self._address
 
     def serve_forever(self):
         while not self._stop.is_set():
@@ -425,7 +438,7 @@ class RpcServer:
                 # listener closed (shutdown) or fd exhaustion: exit rather
                 # than hot-spin on a broken listener
                 break
-            if self._stop.is_set():
+            if self._stop.is_set() or self._draining.is_set():
                 conn.close()
                 break
             # the authkey handshake runs in the connection's own thread, so
@@ -466,57 +479,72 @@ class RpcServer:
                     # decode errors: a corrupt stream is unrecoverable
                     # mid-connection either way
                     return
-                if method == "__shutdown__":
-                    send_msg(conn, (True, None), wire)
-                    self.shutdown()
-                    return
-                rule = self._fault.on_call(method) \
-                    if self._fault is not None else None
-                if rule is not None and rule.kind == "delay":
-                    time.sleep(rule.seconds)
-                    rule.fired.set()
-                    rule = None          # then serve normally
-                if rule is not None and rule.kind == "drop_request":
-                    rule.fired.set()
-                    return               # sever; method never applied
-                if rule is not None and rule.kind == "die_before":
-                    self.kill()
-                    rule.fired.set()
-                    return
-                t0 = time.perf_counter()
+                with self._active_cv:
+                    if self._drain_finalized:
+                        # this request lost the race with drain()'s idle
+                        # declaration: sever WITHOUT applying (the same
+                        # outcome as arriving after the kill that follows)
+                        return
+                    self._active += 1
                 try:
-                    fn = getattr(self._handler, method)
-                    with record_event(f"rpc.serve/{method}", kind="rpc"):
-                        result = (True, fn(**kwargs))
-                except Exception as e:  # surface remote errors to the caller
-                    result = (False, f"{type(e).__name__}: {e}")
-                if rule is not None and rule.kind == "drop_response":
-                    rule.fired.set()
-                    return               # applied, but the reply is lost
-                if rule is not None and rule.kind == "die_after":
-                    self.kill()
-                    rule.fired.set()
+                    if method == "__shutdown__":
+                        send_msg(conn, (True, None), wire)
+                        self.shutdown()
+                        return
+                    rule = self._fault.on_call(method) \
+                        if self._fault is not None else None
+                    if rule is not None and rule.kind == "delay":
+                        time.sleep(rule.seconds)
+                        rule.fired.set()
+                        rule = None          # then serve normally
+                    if rule is not None and rule.kind == "drop_request":
+                        rule.fired.set()
+                        return               # sever; method never applied
+                    if rule is not None and rule.kind == "die_before":
+                        self.kill()
+                        rule.fired.set()
+                        return
+                    t0 = time.perf_counter()
+                    try:
+                        fn = getattr(self._handler, method)
+                        with record_event(f"rpc.serve/{method}", kind="rpc"):
+                            result = (True, fn(**kwargs))
+                    except Exception as e:  # surface remote errors to caller
+                        result = (False, f"{type(e).__name__}: {e}")
+                    if rule is not None and rule.kind == "drop_response":
+                        rule.fired.set()
+                        return               # applied, but the reply is lost
+                    if rule is not None and rule.kind == "die_after":
+                        self.kill()
+                        rule.fired.set()
+                        return
+                    try:
+                        ns = send_msg(conn, result, wire)
+                    except Exception:
+                        return  # client vanished (or kill()ed) mid-reply
+                    self.wire_stats.note(method, ns, nr,
+                                         time.perf_counter() - t0)
+                finally:
+                    with self._active_cv:
+                        self._active -= 1
+                        self._active_cv.notify_all()
+                if self._draining.is_set():
+                    # drain(): the in-flight request was answered; close
+                    # the keep-alive connection instead of taking more work
                     return
-                try:
-                    ns = send_msg(conn, result, wire)
-                except Exception:
-                    return  # client vanished (or kill() closed us) mid-reply
-                self.wire_stats.note(method, ns, nr,
-                                     time.perf_counter() - t0)
         finally:
             with self._conns_lock:
                 self._conns.discard(conn)
             conn.close()
 
-    def shutdown(self):
-        self._stop.set()
-        # kick the accept loop out of accept(2) BEFORE closing the
-        # listener: close() alone does not wake a thread already blocked
-        # in accept — the in-progress syscall pins the kernel socket, the
-        # port stays in LISTEN, and a restarted server can't rebind the
-        # address (the failover contract requires the SAME address). The
-        # throwaway connection completes the accept; the loop sees _stop
-        # and exits.
+    def _wake_and_close_listener(self):
+        """Kick the accept loop out of accept(2) BEFORE closing the
+        listener: close() alone does not wake a thread already blocked
+        in accept — the in-progress syscall pins the kernel socket, the
+        port stays in LISTEN, and a restarted server can't rebind the
+        address (the failover contract requires the SAME address). The
+        throwaway connection completes the accept; the loop sees
+        _stop/_draining and exits."""
         try:
             s = socket.create_connection(self.address, timeout=0.5)
             s.close()
@@ -526,6 +554,42 @@ class RpcServer:
             self._listener.close()
         except OSError:
             pass
+
+    def shutdown(self):
+        self._stop.set()
+        self._wake_and_close_listener()
+
+    def drain(self, timeout=30.0):
+        """Graceful drain (the model server's shutdown contract): stop
+        accepting new connections, let every in-flight request finish and
+        be ANSWERED, then close the remaining (idle) connections and the
+        listener. Returns True when the server went idle within
+        ``timeout``; False means the timeout expired with requests still
+        running — the server is closed regardless. A request whose receive
+        completes AFTER the idle declaration is dropped unapplied (its
+        client sees the same EOF a crash produces — never an applied-but-
+        unanswered mutation). Contrast ``shutdown`` (stops serving without
+        severing, so blocked in-flight recvs leak) and ``kill`` (severs
+        everything immediately, simulating a crash)."""
+        self._draining.set()
+        self._wake_and_close_listener()
+        deadline = time.monotonic() + timeout
+        with self._active_cv:
+            while self._active > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._active_cv.wait(left)
+            drained = self._active == 0
+            # finalize under the SAME lock that admits requests: a recv
+            # completing after this point sees the flag and drops its
+            # request unapplied, so "drained" can never race a request
+            # into the applied-but-unanswered state
+            self._drain_finalized = True
+        # connections now idle in recv are waiting for requests that will
+        # never be served; sever them and stop the serve loops
+        self.kill()
+        return drained
 
     def kill(self):
         """Simulate a process crash: stop accepting AND sever every live

@@ -33,20 +33,22 @@ def _is_persistable(var):
 MANIFEST_FILENAME = "MANIFEST.json"
 
 
-def save_vars(executor, dirname, main_program=None, vars=None, predicate=None):
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              scope=None):
     """Write each var via temp-file + atomic rename, then a MANIFEST.json
     (written LAST, atomically) naming every saved var with shape/dtype — a
     torn save is detectable instead of silently partial, and vars listed in
     the manifest but missing from the scope are an error rather than a
     silent skip (round-2 verdict weakness #6; the reference's Go pserver
     checkpoints carry the same checksum+meta contract,
-    go/pserver/service.go:119-174)."""
+    go/pserver/service.go:119-174). ``scope`` defaults to the global scope
+    (the reference contract); pass one to save from a private scope."""
     program = main_program or default_main_program()
     if vars is None:
         vars = [v for v in program.global_block().vars.values()
                 if (predicate or _is_persistable)(v)]
     os.makedirs(dirname, exist_ok=True)
-    scope = global_scope()
+    scope = scope or global_scope()
     missing = [v.name for v in vars if scope.find_var(v.name) is None]
     if missing:
         raise RuntimeError(
@@ -69,20 +71,22 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None):
     os.replace(mtmp, os.path.join(dirname, MANIFEST_FILENAME))
 
 
-def save_params(executor, dirname, main_program=None):
+def save_params(executor, dirname, main_program=None, scope=None):
     program = main_program or default_main_program()
     save_vars(executor, dirname, program,
-              vars=[p for p in program.all_parameters()])
+              vars=[p for p in program.all_parameters()], scope=scope)
 
 
-def save_persistables(executor, dirname, main_program=None):
-    save_vars(executor, dirname, main_program)
+def save_persistables(executor, dirname, main_program=None, scope=None):
+    save_vars(executor, dirname, main_program, scope=scope)
 
 
-def load_vars(executor, dirname, main_program=None, vars=None, predicate=None):
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              scope=None):
     """When a MANIFEST is present (post-upgrade checkpoints), vars it lists
     must exist on disk — a torn/corrupt checkpoint raises instead of loading
-    partially."""
+    partially. ``scope`` defaults to the global scope; a serving engine
+    loads into its own private scope so concurrent models never collide."""
     program = main_program or default_main_program()
     if vars is None:
         vars = [v for v in program.global_block().vars.values()
@@ -92,7 +96,7 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None):
     if os.path.exists(mpath):
         with open(mpath) as f:
             manifest = json.load(f)
-    scope = global_scope()
+    scope = scope or global_scope()
     for v in vars:
         path = os.path.join(dirname, v.name + ".npy")
         if os.path.exists(path):
@@ -113,14 +117,14 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None):
                 f"{v.name!r} but {path!r} is missing")
 
 
-def load_params(executor, dirname, main_program=None):
+def load_params(executor, dirname, main_program=None, scope=None):
     program = main_program or default_main_program()
     load_vars(executor, dirname, program,
-              vars=[p for p in program.all_parameters()])
+              vars=[p for p in program.all_parameters()], scope=scope)
 
 
-def load_persistables(executor, dirname, main_program=None):
-    load_vars(executor, dirname, main_program)
+def load_persistables(executor, dirname, main_program=None, scope=None):
+    load_vars(executor, dirname, main_program, scope=scope)
 
 
 def _prune_program(program, feed_names, fetch_names):
@@ -150,7 +154,7 @@ def _prune_program(program, feed_names, fetch_names):
 
 
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
-                         main_program=None):
+                         main_program=None, scope=None):
     program = main_program or default_main_program()
     fetch_names = [v if isinstance(v, str) else v.name for v in target_vars]
     pruned = _prune_program(program, feeded_var_names, fetch_names)
@@ -160,15 +164,32 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     meta["fetch_var_names"] = fetch_names
     with open(os.path.join(dirname, MODEL_FILENAME), "w") as f:
         json.dump(meta, f)
-    save_persistables(executor, dirname, pruned)
+    save_persistables(executor, dirname, pruned, scope=scope)
     return fetch_names
 
 
-def load_inference_model(dirname, executor):
-    with open(os.path.join(dirname, MODEL_FILENAME)) as f:
-        meta = json.load(f)
+def load_inference_model(dirname, executor, scope=None):
+    """Load a ``save_inference_model`` bundle. A missing or corrupt model
+    dir raises a ValueError NAMING the dirname (instead of a raw
+    FileNotFoundError/JSONDecodeError from deep inside the json module) —
+    the same unreadable-artifact contract the pserver/master snapshot
+    recovery follows, except a serving process cannot "start fresh" from a
+    model it does not have, so this is loud rather than a warning."""
+    path = os.path.join(dirname, MODEL_FILENAME)
+    try:
+        with open(path) as f:
+            meta = json.load(f)
+    except (FileNotFoundError, NotADirectoryError) as e:
+        raise ValueError(
+            f"load_inference_model: {dirname!r} is not a saved inference "
+            f"model (no {MODEL_FILENAME!r} file: {e})") from e
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise ValueError(
+            f"load_inference_model: {dirname!r} holds a corrupt "
+            f"{MODEL_FILENAME!r} ({type(e).__name__}: {e}); re-export the "
+            "model with save_inference_model") from e
     program = Program.from_dict(meta)
-    load_persistables(executor, dirname, program)
+    load_persistables(executor, dirname, program, scope=scope)
     feed_names = meta["feed_var_names"]
     fetch_vars = [program.global_block().var(n)
                   for n in meta["fetch_var_names"]]

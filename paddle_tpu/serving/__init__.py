@@ -1,0 +1,29 @@
+"""Model serving: dynamic-batching inference over saved programs.
+
+The reference tree serves trained models through blocking one-shot paths
+(``v2.inference`` feeds the whole input as a single batch;
+``fluid.io.load_inference_model`` hands back a raw program). This package
+assembles the pieces PRs 1-3 built — the framed zero-copy RPC transport,
+retry policies, fault injection, profiler spans — into the missing
+subsystem: a model server that keeps a TPU fed under concurrent traffic
+without ever recompiling on the hot path.
+
+* :class:`InferenceEngine` (engine.py) — wraps a ``load_inference_model``
+  bundle with shape-bucketed execution: batches pad up to a small set of
+  power-of-two buckets so each bucket's XLA executable compiles once at
+  warmup.
+* :class:`DynamicBatcher` (batcher.py) — coalesces concurrent single
+  requests into one bucket-sized batch under a ``max_delay_ms`` deadline,
+  with bounded-queue backpressure (:class:`ServerOverloaded`).
+* :class:`ModelServer` / :class:`InferClient` (server.py / client.py) — a
+  multi-threaded server over ``distributed/rpc.py``'s framed codec with
+  health/stats RPCs, graceful drain, and retry-surviving clients.
+"""
+
+from .engine import InferenceEngine
+from .batcher import DynamicBatcher, ServerOverloaded
+from .server import ModelServer
+from .client import InferClient
+
+__all__ = ["InferenceEngine", "DynamicBatcher", "ServerOverloaded",
+           "ModelServer", "InferClient"]

@@ -1,0 +1,213 @@
+"""DynamicBatcher: coalesce concurrent requests into bucket-sized batches.
+
+The throughput lever of a model server: N concurrent single-row requests
+cost N dispatches unbatched, but ONE dispatch coalesced — and on an
+accelerator a dispatch has a large fixed cost (host round trip, executable
+launch) that row count barely moves. The batcher holds a bounded queue;
+a worker thread groups whole requests into a batch up to ``max_batch``
+rows, waiting at most ``max_delay_ms`` for stragglers (a full batch
+dispatches immediately, so the delay bound is only paid under quiet
+traffic), runs the batch through the engine, and splits the fetches back
+per caller.
+
+Backpressure is the bounded queue: when ``capacity`` requests are already
+waiting, :meth:`submit` rejects FAST with the typed
+:class:`ServerOverloaded` — the client backs off and retries — instead of
+admitting work the server cannot finish and stretching every caller's
+latency without bound (the reference's unbounded-queue collapse mode).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..core.flags import get_flag
+
+
+class ServerOverloaded(RuntimeError):
+    """The serving queue is full: reject-fast backpressure. Clients should
+    back off (bounded exponential delay) and retry or shed the request —
+    InferClient re-raises this type from the remote error string."""
+
+
+class _Request:
+    __slots__ = ("feed", "n", "sig", "done", "result", "error")
+
+    def __init__(self, feed, n):
+        self.feed = feed
+        self.n = n
+        # coalesce-compatibility signature: requests only batch with
+        # requests of the same feed names, dtypes and trailing shapes —
+        # one malformed request (float64 from numpy's default, a wrong
+        # feature dim) must fail ALONE, not upcast/except the whole batch
+        self.sig = tuple(sorted(
+            (k, np.asarray(v).dtype.str, np.asarray(v).shape[1:])
+            for k, v in feed.items()))
+        self.done = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class DynamicBatcher:
+    """``run_batch`` is the batch executor — ``InferenceEngine.infer``'s
+    signature: feed dict of [n, ...] arrays in, list of fetch arrays
+    (leading dim n) out. ``max_batch`` is the coalesce target (the
+    engine's largest bucket); ``max_delay_ms``/``capacity`` default from
+    the ``serving_max_delay_ms``/``serving_queue_capacity`` flags."""
+
+    def __init__(self, run_batch, max_batch, max_delay_ms=None,
+                 capacity=None):
+        self._run_batch = run_batch
+        self.max_batch = int(max_batch)
+        if max_delay_ms is None:
+            max_delay_ms = get_flag("serving_max_delay_ms")
+        self.max_delay_s = float(max_delay_ms) / 1e3
+        self.capacity = int(get_flag("serving_queue_capacity")
+                            if capacity is None else capacity)
+        self._pending = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        # counters (under _cv): total/rejected requests, per-batch-size
+        # histogram of dispatched row counts
+        self._n_requests = 0
+        self._n_rejected = 0
+        self._n_batches = 0
+        self._batch_hist = {}
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------------
+    def submit(self, feed):
+        """Block until this request's rows come back from a coalesced
+        batch; raises :class:`ServerOverloaded` immediately when the queue
+        is full (never queues past ``capacity``)."""
+        if not feed:
+            raise ValueError("cannot submit an empty feed")
+        ns = {np.asarray(v).shape[0] if np.ndim(v) else 1
+              for v in feed.values()}
+        if len(ns) != 1:
+            # reject the malformed request HERE: coalesced with others it
+            # would fail the engine's row-count check for the whole batch
+            raise ValueError(
+                f"inconsistent batch sizes across feeds: "
+                f"{ {k: np.asarray(v).shape for k, v in feed.items()} }")
+        n = int(ns.pop())
+        if n == 0:
+            # alone it would raise the engine's empty-batch error anyway;
+            # coalesced it would silently return empty arrays — reject
+            # deterministically instead of traffic-dependently
+            raise ValueError("cannot submit an empty (0-row) batch")
+        req = _Request(feed, n)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("DynamicBatcher is closed")
+            self._n_requests += 1
+            if len(self._pending) >= self.capacity:
+                self._n_rejected += 1
+                raise ServerOverloaded(
+                    f"serving queue full ({self.capacity} requests "
+                    "waiting); back off and retry")
+            self._pending.append(req)
+            self._cv.notify_all()
+        req.done.wait()
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # ------------------------------------------------------------------
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                if not self._pending and self._closed:
+                    return
+                # coalesce: hold the batch open for stragglers until the
+                # deadline, but dispatch a full batch (or a closing
+                # batcher's flush) immediately
+                deadline = time.monotonic() + self.max_delay_s
+                while (sum(r.n for r in self._pending) < self.max_batch
+                       and not self._closed):
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cv.wait(left)
+                batch = [self._pending.popleft()]
+                total = batch[0].n
+                while self._pending and \
+                        total + self._pending[0].n <= self.max_batch and \
+                        self._pending[0].sig == batch[0].sig:
+                    # an incompatible head ends the batch and forms its
+                    # own on the next loop turn (FIFO preserved)
+                    r = self._pending.popleft()
+                    batch.append(r)
+                    total += r.n
+                self._n_batches += 1
+                self._batch_hist[total] = \
+                    self._batch_hist.get(total, 0) + 1
+            self._dispatch(batch, total)
+
+    def _dispatch(self, batch, total):
+        """Run one coalesced batch OUTSIDE the queue lock and route the
+        fetch rows back to their callers (an error fans out to every
+        caller in the batch)."""
+        try:
+            if len(batch) == 1:
+                feed = batch[0].feed
+            else:
+                feed = {k: np.concatenate(
+                            [np.asarray(r.feed[k]) for r in batch], axis=0)
+                        for k in batch[0].feed}
+            fetches = self._run_batch(feed)
+            for f in fetches:
+                if not (isinstance(f, np.ndarray) and f.ndim >= 1
+                        and f.shape[0] == total):
+                    # a non-per-row fetch cannot be split back per caller
+                    # — it was computed over the COALESCED rows of every
+                    # request in this batch (the engine enforces the same
+                    # contract; this guards foreign run_batch callables)
+                    raise ValueError(
+                        f"run_batch returned a non-per-row fetch (shape "
+                        f"{getattr(f, 'shape', None)}, batch rows {total})"
+                        "; dynamic batching requires fetches with a "
+                        "leading batch dimension")
+            lo = 0
+            for r in batch:
+                r.result = [f[lo:lo + r.n] for f in fetches]
+                lo += r.n
+        except Exception as e:
+            for r in batch:
+                r.error = e
+        finally:
+            for r in batch:
+                r.done.set()
+
+    # ------------------------------------------------------------------
+    def stats(self):
+        with self._cv:
+            return {
+                "queue_depth": len(self._pending),
+                "capacity": self.capacity,
+                "max_batch": self.max_batch,
+                "max_delay_ms": self.max_delay_s * 1e3,
+                "requests": self._n_requests,
+                "rejected": self._n_rejected,
+                "batches": self._n_batches,
+                "batch_size_hist": dict(sorted(self._batch_hist.items())),
+            }
+
+    def close(self, timeout=30.0):
+        """Stop admitting requests, FLUSH everything already queued (their
+        callers get real results), and join the worker."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._worker.join(timeout)
+        return not self._worker.is_alive()
+
+
+__all__ = ["DynamicBatcher", "ServerOverloaded"]

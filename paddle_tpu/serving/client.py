@@ -1,0 +1,66 @@
+"""InferClient: the trainer-side ParamClient's serving twin.
+
+A thin typed stub over ``rpc.RpcClient``: feeds travel on the framed
+zero-copy codec, and connection-level failures (the server restarting
+under a supervisor, a dropped conn) reconnect-and-resend under a
+``RetryPolicy`` — safe because ``infer`` is stateless and idempotent, so
+a server restart mid-request is survivable without an at-most-once
+escape hatch. Two remote conditions come back TYPED instead of as bare
+RuntimeErrors so callers can program against them:
+
+* :class:`~.batcher.ServerOverloaded` — the server's bounded queue
+  rejected the request; back off (the client does NOT auto-retry
+  overloads: retrying into a full queue is how collapse spreads).
+* everything else re-raises as the RpcClient's usual errors.
+"""
+
+from __future__ import annotations
+
+from ..distributed.rpc import RetryPolicy, RpcClient, WIRE_FRAMED
+from .batcher import ServerOverloaded
+
+_OVERLOAD_MARK = "ServerOverloaded"
+
+
+class InferClient:
+    """``InferClient(address)`` retries connection failures by default
+    (``retry=None`` disables; pass a ``RetryPolicy`` to tune)."""
+
+    def __init__(self, address, timeout=None, retry=True, wire=WIRE_FRAMED):
+        if retry is True:
+            retry = RetryPolicy()
+        self._rpc = RpcClient(address, timeout=timeout, retry=retry or None,
+                              wire=wire)
+
+    def infer(self, feed):
+        """One request; returns the fetch arrays for these rows. Raises
+        :class:`ServerOverloaded` when the server rejected under
+        backpressure."""
+        try:
+            return self._rpc.call("infer", feed=feed)
+        except RuntimeError as e:
+            if _OVERLOAD_MARK in str(e):
+                raise ServerOverloaded(str(e)) from None
+            raise
+
+    def health(self):
+        return self._rpc.call("health")
+
+    def stats(self):
+        return self._rpc.call("stats")
+
+    def wire_stats(self):
+        return self._rpc.wire_stats.snapshot()
+
+    def close(self):
+        self._rpc.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+__all__ = ["InferClient"]

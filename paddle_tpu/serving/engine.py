@@ -1,0 +1,281 @@
+"""InferenceEngine: shape-bucketed execution of a saved inference program.
+
+On TPU the serving problem is dominated by avoiding XLA recompiles: the
+jitted step retraces for every new feed SHAPE, and a model server sees a
+different batch size on nearly every request. The engine pads each
+incoming batch up to a small fixed set of power-of-two batch buckets (the
+``serving_batch_buckets`` flag), so the executable for each bucket
+compiles once at :meth:`warmup` and the hot path only ever replays
+compiled traces — the same static-shape discipline the training side's
+``reader.bucket_by_length`` applies to ragged sequence lengths.
+
+The engine reuses the Executor's ``_ProgramAnalysis`` cache (PR 1): the
+steady-state dispatch does no block walks, and the per-program jit cache
+holds exactly one trace per bucket. Per-bucket compile/hit counters (and a
+``hot_recompiles`` alarm — a compile observed AFTER warmup) are surfaced
+through :meth:`stats` so a server can prove the no-recompile contract.
+
+Feeds are dense host arrays keyed by feed name (the serving wire form —
+LoD/ragged inputs belong to the batch-shaping layer above, which must pad
+them to static shapes before they reach a server anyway). Padding rows
+replicate the batch's last row — numerically inert for any per-row model
+and never a NaN source — and every fetch is trimmed back to the true row
+count before it leaves the engine.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+import numpy as np
+
+from ..core.flags import get_flag
+from ..core.profiler import record_event
+from ..core.scope import Scope
+from ..core.types import np_dtype
+
+
+def parse_buckets(spec=None):
+    """'1,2,4,8' -> sorted unique positive ints (flag default when None)."""
+    if spec is None:
+        spec = get_flag("serving_batch_buckets")
+    if isinstance(spec, str):
+        vals = [int(s) for s in spec.split(",") if s.strip()]
+    else:
+        vals = [int(b) for b in spec]
+    if not vals or any(b <= 0 for b in vals):
+        raise ValueError(f"serving batch buckets must be positive ints, "
+                         f"got {spec!r}")
+    return sorted(set(vals))
+
+
+def _pad_rows(a, bucket):
+    """Pad a [n, ...] array up to [bucket, ...] by replicating its last
+    row (outputs for the padding rows are discarded by the caller)."""
+    a = np.asarray(a)
+    pad = bucket - a.shape[0]
+    if pad <= 0:
+        return a
+    return np.concatenate(
+        [a, np.broadcast_to(a[-1:], (pad,) + a.shape[1:])], axis=0)
+
+
+class InferenceEngine:
+    """Bucket-padded executor for one saved inference model.
+
+    Either point it at a ``save_inference_model`` directory::
+
+        engine = InferenceEngine(model_dir)
+
+    or hand it an already-loaded bundle (``program``, ``feed_names``,
+    ``fetch_vars``). A ``model_dir`` engine loads persistables into its
+    OWN private scope, so many engines (many models) coexist in one
+    process without colliding in the global scope.
+
+    Thread safety: :meth:`infer` serializes dispatches with a lock — the
+    scope (rng key, params) is shared mutable state, and a server's
+    concurrency comes from batching, not from racing executors.
+    """
+
+    def __init__(self, model_dir=None, program=None, feed_names=None,
+                 fetch_vars=None, executor=None, scope=None, buckets=None):
+        import paddle_tpu.fluid as fluid
+
+        self._scope = scope or Scope()
+        self._exe = executor or fluid.Executor()
+        if model_dir is not None:
+            program, feed_names, fetch_vars = fluid.io.load_inference_model(
+                model_dir, self._exe, scope=self._scope)
+        if program is None or feed_names is None or fetch_vars is None:
+            raise ValueError(
+                "InferenceEngine needs model_dir= or all of program=/"
+                "feed_names=/fetch_vars=")
+        self._program = program
+        self._feed_names = list(feed_names)
+        self._fetch_names = [v if isinstance(v, str) else v.name
+                             for v in fetch_vars]
+        self.buckets = parse_buckets(buckets)
+        # _lock serializes DISPATCH only; counters live under their own
+        # lock so stats()/health() stay cheap while a dispatch (or a
+        # multi-second warmup compile) is running
+        self._lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        # (bucket, per-feed dtype/trailing-shape signature) dispatched so
+        # far: a new signature is a compile, a seen one is a trace-cache
+        # hit — exactly the jit cache's keying (shape+dtype avals)
+        self._seen = set()
+        self._per_bucket = {b: {"compiles": 0, "hits": 0}
+                            for b in self.buckets}
+        self._warmed = False
+        self.hot_recompiles = 0
+
+    # ------------------------------------------------------------------
+    @property
+    def program(self):
+        return self._program
+
+    @property
+    def feed_names(self):
+        return list(self._feed_names)
+
+    @property
+    def fetch_names(self):
+        return list(self._fetch_names)
+
+    @property
+    def max_batch(self):
+        return self.buckets[-1]
+
+    def bucket_for(self, n):
+        """Smallest bucket >= n (the largest bucket for oversized n —
+        :meth:`infer` chunks those)."""
+        i = bisect.bisect_left(self.buckets, n)
+        return self.buckets[min(i, len(self.buckets) - 1)]
+
+    # ------------------------------------------------------------------
+    def _template_feed(self):
+        """One-row zero feed synthesized from the program's feed-var
+        metadata (shape [-1, d1, ...] + dtype), for metadata-only warmup."""
+        block = self._program.global_block()
+        feed = {}
+        for name in self._feed_names:
+            v = block.var(name)
+            if v.lod_level and v.lod_level > 0:
+                raise ValueError(
+                    f"feed var {name!r} is LoD (ragged); pass warmup() an "
+                    "explicit sample_feed of padded dense arrays")
+            dims = list(v.shape or [])
+            if dims and dims[0] == -1:
+                dims = dims[1:]
+            if any(d is None or int(d) < 0 for d in dims):
+                raise ValueError(
+                    f"feed var {name!r} has unknown dims {v.shape}; pass "
+                    "warmup() an explicit sample_feed")
+            dt = np_dtype(v.dtype) if v.dtype is not None else np.float32
+            feed[name] = np.zeros([1] + [int(d) for d in dims], dt)
+        return feed
+
+    def _normalize_dtypes(self, arrs):
+        """Cast feeds to their declared var dtypes — the same coercion
+        Executor._prepare_feed applies before jit. Doing it HERE keeps the
+        engine's compile/hit signature aligned with the avals jit actually
+        sees (a client feeding float64 — numpy's default — neither skews
+        the counters nor changes numerics for its batch-mates)."""
+        block = self._program.global_block()
+        for name, a in arrs.items():
+            if block.has_var(name):
+                want = block.var(name).dtype
+                if want is not None and a.dtype != np_dtype(want):
+                    arrs[name] = a.astype(np_dtype(want))
+        return arrs
+
+    def warmup(self, sample_feed=None):
+        """Compile every bucket's executable up front: pad a one-row
+        template (from ``sample_feed`` or the program's feed-var metadata)
+        to each bucket and dispatch it. After this returns, a correctly-
+        shaped request can never trigger a hot-path compile; any compile
+        observed later increments ``hot_recompiles``. Returns the number
+        of executables compiled."""
+        if sample_feed is None:
+            feed = self._template_feed()
+        else:
+            feed = self._normalize_dtypes(
+                {k: np.asarray(v)[:1] for k, v in sample_feed.items()})
+        before = sum(s["compiles"] for s in self._per_bucket.values())
+        with record_event("serving/warmup", kind="stage"):
+            for b in self.buckets:
+                self._dispatch(feed, 1, b)
+        self._warmed = True
+        return sum(s["compiles"] for s in self._per_bucket.values()) - before
+
+    # ------------------------------------------------------------------
+    def infer(self, feed, fetch_list=None):
+        """Run one batch; returns the fetch arrays trimmed to the true row
+        count. Batches larger than the biggest bucket are chunked through
+        it and the per-chunk results concatenated."""
+        fetch_names = self._fetch_names if fetch_list is None else \
+            [v if isinstance(v, str) else v.name for v in fetch_list]
+        missing = [n for n in self._feed_names if n not in feed]
+        if missing:
+            raise ValueError(f"infer feed is missing vars {missing}; "
+                             f"the model feeds {self._feed_names}")
+        arrs = self._normalize_dtypes(
+            {n: np.asarray(feed[n]) for n in self._feed_names})
+        ns = {a.shape[0] if a.ndim else 0 for a in arrs.values()}
+        if len(ns) != 1:
+            raise ValueError(
+                f"inconsistent batch sizes across feeds: "
+                f"{ {n: a.shape for n, a in arrs.items()} }")
+        n = ns.pop()
+        if n == 0:
+            raise ValueError("cannot infer an empty batch")
+        if n <= self.max_batch:
+            return self._dispatch(arrs, n, self.bucket_for(n),
+                                  fetch_names)
+        parts = []
+        for lo in range(0, n, self.max_batch):
+            chunk = {k: a[lo:lo + self.max_batch] for k, a in arrs.items()}
+            cn = min(self.max_batch, n - lo)
+            parts.append(self._dispatch(chunk, cn, self.bucket_for(cn),
+                                        fetch_names))
+        # _dispatch guarantees per-row outputs, so chunk concat is exact
+        return [np.concatenate([p[i] for p in parts], axis=0)
+                for i in range(len(fetch_names))]
+
+    def _dispatch(self, arrs, n, bucket, fetch_names=None):
+        fetch_names = fetch_names or self._fetch_names
+        padded = {k: _pad_rows(a, bucket) for k, a in arrs.items()}
+        # fetch names stay IN ORDER: the executor's jit cache keys on the
+        # ordered fetch tuple, so a reordered fetch_list is a distinct
+        # executable and must count as a compile here too
+        sig = (bucket, tuple(fetch_names),
+               tuple(sorted((k, a.dtype.str, a.shape[1:])
+                            for k, a in padded.items())))
+        with self._stats_lock:
+            if sig in self._seen:
+                self._per_bucket[bucket]["hits"] += 1
+            else:
+                self._seen.add(sig)
+                self._per_bucket[bucket]["compiles"] += 1
+                if self._warmed:
+                    self.hot_recompiles += 1
+        with self._lock:
+            with record_event(f"serving/infer_b{bucket}", kind="stage"):
+                outs = self._exe.run(self._program, feed=padded,
+                                     fetch_list=list(fetch_names),
+                                     scope=self._scope)
+        trimmed = []
+        for name, o in zip(fetch_names, outs):
+            if isinstance(o, np.ndarray) and o.ndim >= 1 \
+                    and o.shape[0] == bucket:
+                trimmed.append(o[:n])
+                continue
+            # a fetch without a leading batch dim was computed OVER the
+            # padding rows (and, batched, over other callers' coalesced
+            # rows) — its value is silently wrong, so reject the model
+            # configuration loudly instead of serving corrupt answers
+            shape = getattr(o, "shape", None)
+            raise ValueError(
+                f"fetch {name!r} is not per-row (shape {shape}, bucket "
+                f"{bucket}): serving requires every fetch to carry a "
+                "leading batch dimension — batch-reduced outputs (means, "
+                "aggregate metrics) cannot be padded or split per caller")
+        return trimmed
+
+    # ------------------------------------------------------------------
+    def stats(self):
+        with self._stats_lock:
+            return {
+                "buckets": list(self.buckets),
+                "per_bucket": {b: dict(s)
+                               for b, s in self._per_bucket.items()},
+                "compiles": sum(s["compiles"]
+                                for s in self._per_bucket.values()),
+                "hits": sum(s["hits"] for s in self._per_bucket.values()),
+                "hot_recompiles": self.hot_recompiles,
+                "warmed": self._warmed,
+            }
+
+
+__all__ = ["InferenceEngine", "parse_buckets"]

@@ -1,0 +1,143 @@
+"""ModelServer: a multi-threaded dynamic-batching server for one model.
+
+Transport is ``distributed/rpc.py``'s framed codec — feed and fetch
+tensors travel as raw buffers (zero-copy send, one preallocated-recv copy)
+both directions, one thread per client connection, so N concurrent clients
+decode/encode in parallel while their requests coalesce in the
+DynamicBatcher into bucket-sized engine dispatches.
+
+RPC surface (all reachable through :class:`~.client.InferClient`):
+
+* ``infer(feed=...)`` — run one request; the answer is the engine's fetch
+  list trimmed to the request's rows. Stateless and idempotent, so
+  clients retry it safely through server restarts (rpc.RetryPolicy).
+* ``health()`` — cheap liveness: status, queue depth, warmed flag.
+* ``stats()`` — engine bucket compile/hit counters, batcher queue/batch
+  histogram, request-latency p50/p99 (an always-on
+  ``core.profiler.LatencyWindow``; spans also land in chrome traces when
+  the global profiler is enabled), and the RPC layer's WireStats.
+
+Shutdown is a graceful DRAIN by default: stop accepting, let every
+in-flight request finish and be answered (flushing the batcher's queued
+work), then close — ``shutdown(drain=False)`` and ``kill()`` keep the
+abrupt forms for tests and crash simulation.
+"""
+
+from __future__ import annotations
+
+from ..core.flags import get_flag
+from ..core.profiler import LatencyWindow
+from ..distributed.rpc import RpcServer
+from .batcher import DynamicBatcher
+from .engine import InferenceEngine
+
+
+class _ServingHandler:
+    """The RPC-visible surface (RpcServer dispatches public methods)."""
+
+    def __init__(self, server):
+        self._server = server
+
+    def infer(self, feed):
+        return self._server.run_infer(feed)
+
+    def health(self):
+        return self._server.health()
+
+    def stats(self):
+        return self._server.stats()
+
+
+class ModelServer:
+    """Serve one saved inference model.
+
+        server = ModelServer(model_dir)            # batching on
+        server.start()                             # warmup + serve
+        ... InferClient(server.address).infer(...) ...
+        server.shutdown()                          # graceful drain
+
+    ``batching=False`` dispatches each request through the engine
+    individually (the A/B baseline the bench lane measures against).
+    ``engine=`` substitutes a pre-built engine (shared scope, custom
+    buckets); ``fault_plan=`` reaches the underlying RpcServer for
+    deterministic crash injection in tests."""
+
+    def __init__(self, model_dir=None, engine=None, address=("127.0.0.1", 0),
+                 batching=True, max_delay_ms=None, queue_capacity=None,
+                 buckets=None, fault_plan=None):
+        if engine is None:
+            engine = InferenceEngine(model_dir, buckets=buckets)
+        self.engine = engine
+        self.batching = bool(batching)
+        self.batcher = DynamicBatcher(
+            engine.infer, max_batch=engine.max_batch,
+            max_delay_ms=max_delay_ms, capacity=queue_capacity) \
+            if self.batching else None
+        self.latency = LatencyWindow(name="serving/request", kind="rpc")
+        self._rpc = RpcServer(_ServingHandler(self), address,
+                              fault_plan=fault_plan)
+        self._serving = False
+
+    # ------------------------------------------------------------------
+    @property
+    def address(self):
+        return self._rpc.address
+
+    def start(self, warmup_feed=None, warmup=True):
+        """Warm every bucket (so the serving hot path never compiles),
+        then serve in a background thread. Returns the bound address."""
+        if warmup:
+            self.engine.warmup(warmup_feed)
+        self._serving = True
+        self._rpc.serve_in_thread()
+        return self.address
+
+    # ------------------------------------------------------------------
+    def run_infer(self, feed):
+        with self.latency.span():
+            if self.batcher is not None:
+                return self.batcher.submit(feed)
+            return self.engine.infer(feed)
+
+    def health(self):
+        out = {"status": "serving" if self._serving else "stopped",
+               "warmed": self.engine.stats()["warmed"],
+               "batching": self.batching,
+               "queue_depth": 0}
+        if self.batcher is not None:
+            out["queue_depth"] = self.batcher.stats()["queue_depth"]
+        return out
+
+    def stats(self):
+        out = {"engine": self.engine.stats(),
+               "latency": self.latency.snapshot(),
+               "wire": self._rpc.wire_stats.snapshot()}
+        if self.batcher is not None:
+            out["batcher"] = self.batcher.stats()
+        return out
+
+    # ------------------------------------------------------------------
+    def shutdown(self, drain=True, timeout=30.0):
+        """Graceful by default: stop accepting, flush in-flight requests
+        (every caller gets its answer), then close. Returns True when the
+        server went idle within ``timeout``."""
+        self._serving = False
+        if drain:
+            drained = self._rpc.drain(timeout)
+        else:
+            self._rpc.shutdown()
+            drained = True
+        if self.batcher is not None:
+            # in-flight submits completed during the rpc drain; this
+            # flushes nothing in the normal path and joins the worker
+            drained = self.batcher.close(timeout) and drained
+        return drained
+
+    def kill(self):
+        """Crash simulation (tests): sever everything, no drain — what a
+        SIGKILLed serving process looks like to its clients."""
+        self._serving = False
+        self._rpc.kill()
+
+
+__all__ = ["ModelServer"]

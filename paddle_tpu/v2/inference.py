@@ -75,14 +75,28 @@ class Inference:
         self._scope = scope
         self._exe = fluid.Executor()
 
-    def iter_infer(self, input, feeding=None):
-        """Yield per-batch fetch lists (reference iter_infer forwards the
-        whole ``input`` as one batch)."""
+    def iter_infer(self, input, feeding=None, batch_size=None):
+        """Yield per-batch fetch lists. The reference iter_infer forwards
+        the whole ``input`` as ONE batch; ``batch_size=`` chunks it instead
+        (bounding peak memory and XLA trace shapes for large inputs) and
+        yields once per chunk — ``infer()`` concatenates the chunks back,
+        so results are identical either way. Default ``None`` keeps the
+        reference single-batch behavior."""
         block = self._program.global_block()
-        feed = build_feed(block, self._feed_names, list(input), feeding)
-        yield self._exe.run(self._program, feed=feed,
-                            fetch_list=list(self._fetch_names),
-                            scope=self._scope)
+        samples = list(input)
+        if batch_size is not None:
+            batch_size = int(batch_size)
+            if batch_size <= 0:
+                raise ValueError(f"batch_size must be positive, "
+                                 f"got {batch_size}")
+        chunks = [samples] if batch_size is None else \
+            [samples[i:i + batch_size]
+             for i in range(0, len(samples), batch_size)]
+        for chunk in chunks:
+            feed = build_feed(block, self._feed_names, chunk, feeding)
+            yield self._exe.run(self._program, feed=feed,
+                                fetch_list=list(self._fetch_names),
+                                scope=self._scope)
 
     def iter_infer_field(self, field, **kwargs):
         from paddle_tpu.core.lod import LoDArray, lodarray_to_flat
@@ -122,13 +136,16 @@ class Inference:
         return retv
 
 
-def infer(output_layer, parameters, input, feeding=None, field="value"):
+def infer(output_layer, parameters, input, feeding=None, field="value",
+          batch_size=None):
     """paddle.infer(output_layer=prediction, parameters=params, input=batch)
     (reference inference.py:125-172). ``input`` is a list of sample tuples
     ordered like the network's data layers (or per ``feeding``); returns the
-    prediction array(s)."""
+    prediction array(s). ``batch_size=`` chunks the input instead of
+    forwarding it as one batch (results identical, concatenated)."""
     inferer = Inference(output_layer=output_layer, parameters=parameters)
-    return inferer.infer(field=field, input=input, feeding=feeding)
+    return inferer.infer(field=field, input=input, feeding=feeding,
+                         batch_size=batch_size)
 
 
 __all__ = ["infer", "Inference"]

@@ -1,0 +1,98 @@
+"""save_inference_model -> load_inference_model round-trip contract:
+the pruned program executes identically to the original on the same feed,
+persistables load BITWISE, and a missing/corrupt model dir fails with a
+clear ValueError naming the dirname (reference io.py:298-362; the error
+contract mirrors the pserver/master corrupt-snapshot handling, except
+serving cannot "start fresh" so it is loud, not a warning).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.scope import global_scope
+
+
+def _train_and_export(tmp_path, steps=4):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[5])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=12, act="relu")
+        pred = fluid.layers.fc(input=h, size=3, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss, startup)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xs = rng.normal(0, 1, (24, 5)).astype("float32")
+    ys = rng.randint(0, 3, (24, 1)).astype("int64")
+    for _ in range(steps):   # real training so accumulators exist too
+        exe.run(main, feed={"x": xs, "label": ys}, fetch_list=[loss])
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [pred], exe, main)
+    return d, main, pred, exe, xs, ys
+
+
+def test_roundtrip_pruned_program_matches_original(tmp_path):
+    d, main, pred, exe, xs, ys = _train_and_export(tmp_path)
+    want = exe.run(main, feed={"x": xs, "label": ys},
+                   fetch_list=[pred])[0]
+    prog2, feed_names, fetch_vars = fluid.io.load_inference_model(d, exe)
+    assert feed_names == ["x"]
+    assert [v.name for v in fetch_vars] == [pred.name]
+    # pruning stripped the loss/backward/optimizer ops: the loaded
+    # program is strictly smaller and runs WITHOUT the label feed
+    assert len(prog2.global_block().ops) < len(main.global_block().ops)
+    got = exe.run(prog2, feed={"x": xs}, fetch_list=fetch_vars)[0]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_persistables_load_bitwise_into_private_scope(tmp_path):
+    d, main, pred, exe, xs, ys = _train_and_export(tmp_path)
+    fresh = fluid.Scope()
+    prog2, _feeds, fetch_vars = fluid.io.load_inference_model(
+        d, exe, scope=fresh)
+    block = prog2.global_block()
+    names = [v.name for v in block.vars.values()
+             if v.persistable and not v.is_data]
+    assert names, "pruned program lost its persistables"
+    for n in names:
+        trained = np.asarray(global_scope().find_var(n))
+        loaded = np.asarray(fresh.find_var(n))
+        assert loaded.dtype == trained.dtype
+        np.testing.assert_array_equal(loaded, trained)   # bitwise
+    # the private scope really is where they live: it serves inference
+    # without touching the training scope
+    got = exe.run(prog2, feed={"x": xs[:6]}, fetch_list=fetch_vars,
+                  scope=fresh)[0]
+    want = exe.run(main, feed={"x": xs[:6], "label": ys[:6]},
+                   fetch_list=[pred])[0]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_missing_model_dir_is_a_clear_valueerror(tmp_path):
+    exe = fluid.Executor()
+    nope = str(tmp_path / "does_not_exist")
+    with pytest.raises(ValueError, match="not a saved inference model"):
+        fluid.io.load_inference_model(nope, exe)
+    with pytest.raises(ValueError, match="does_not_exist"):
+        fluid.io.load_inference_model(nope, exe)   # names the dirname
+    # an existing dir without a __model__ file is the same clear error
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(ValueError, match="not a saved inference model"):
+        fluid.io.load_inference_model(str(empty), exe)
+
+
+def test_corrupt_model_file_is_a_clear_valueerror(tmp_path):
+    d, *_ = _train_and_export(tmp_path, steps=1)
+    exe = fluid.Executor()
+    with open(os.path.join(d, fluid.io.MODEL_FILENAME), "w") as f:
+        f.write("{definitely not json")
+    with pytest.raises(ValueError, match="corrupt"):
+        fluid.io.load_inference_model(d, exe)
+    with pytest.raises(ValueError, match="re-export"):
+        fluid.io.load_inference_model(d, exe)

@@ -1,0 +1,423 @@
+"""Serving subsystem tests: bucket-padded engine execution (compile once
+at warmup, hits only on the hot path), dynamic batching with bounded-queue
+backpressure, the model server's RPC surface (infer/health/stats),
+graceful drain, and the crash-restart contract — a server killed
+mid-request via a deterministic FaultPlan, with the retrying client
+getting a correct answer from the restarted server.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import FaultPlan, RetryPolicy
+from paddle_tpu.serving import (DynamicBatcher, InferClient, InferenceEngine,
+                                ModelServer, ServerOverloaded)
+
+
+def _export_model(tmp_path, dim=6, hidden=8, classes=3, seed=0, n=16):
+    """Build a tiny MLP, export it with save_inference_model, and return
+    (model_dir, inputs, reference outputs from the ORIGINAL program)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[dim])
+        h = fluid.layers.fc(input=x, size=hidden, act="relu")
+        y = fluid.layers.fc(input=h, size=classes, act="softmax")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [y], exe, main, scope=scope)
+    rng = np.random.RandomState(seed)
+    xs = rng.normal(0, 1, (n, dim)).astype("float32")
+    want = exe.run(main, feed={"x": xs}, fetch_list=[y], scope=scope)[0]
+    return d, xs, want
+
+
+# ---------------------------------------------------------------------------
+# InferenceEngine: bucket padding + compile-once contract
+# ---------------------------------------------------------------------------
+
+def test_engine_bucket_padding_matches_direct(tmp_path):
+    d, xs, want = _export_model(tmp_path)
+    eng = InferenceEngine(d, buckets="1,2,4,8")
+    assert eng.buckets == [1, 2, 4, 8] and eng.max_batch == 8
+    # metadata-only warmup (no sample needed for dense feed vars)
+    compiled = eng.warmup()
+    assert compiled == 4                      # one executable per bucket
+    for n in (1, 2, 3, 5, 8):
+        out = eng.infer({"x": xs[:n]})
+        assert out[0].shape == (n, 3)         # trimmed to true rows
+        np.testing.assert_allclose(out[0], want[:n], rtol=1e-5, atol=1e-6)
+    st = eng.stats()
+    # every post-warmup request was a trace-cache hit: 4 compiles (all at
+    # warmup), ZERO hot-path recompiles
+    assert st["warmed"] and st["compiles"] == 4
+    assert st["hot_recompiles"] == 0
+    assert st["hits"] == 5
+    assert st["per_bucket"][4]["hits"] == 1   # n=3 padded up to bucket 4
+    assert st["per_bucket"][8]["hits"] == 2   # n=5 and n=8 share bucket 8
+
+
+def test_engine_normalizes_feed_dtypes(tmp_path):
+    """A float64 feed (numpy's default dtype — the classic client slip)
+    casts to the declared var dtype BEFORE the compile/hit signature, so
+    it neither skews the counters nor lands a new executable."""
+    d, xs, want = _export_model(tmp_path)
+    eng = InferenceEngine(d, buckets="1,2,4")
+    eng.warmup()
+    out = eng.infer({"x": xs[:2].astype(np.float64)})
+    np.testing.assert_allclose(out[0], want[:2], rtol=1e-5, atol=1e-6)
+    st = eng.stats()
+    assert st["hot_recompiles"] == 0 and st["hits"] == 1
+
+
+def test_engine_chunks_oversized_batch(tmp_path):
+    d, xs, want = _export_model(tmp_path, n=11)
+    eng = InferenceEngine(d, buckets="1,2,4")
+    eng.warmup(sample_feed={"x": xs})         # explicit-sample warmup path
+    out = eng.infer({"x": xs})                # 11 rows through max bucket 4
+    np.testing.assert_allclose(out[0], want, rtol=1e-5, atol=1e-6)
+    assert eng.stats()["hot_recompiles"] == 0
+
+
+def test_engine_rejects_batch_reduced_fetches(tmp_path):
+    """A fetch without a leading batch dim (a mean, an aggregate metric)
+    would be computed over padding rows — and, batched, over other
+    callers' rows. The engine refuses the model configuration loudly
+    instead of serving silently-wrong answers."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.fc(input=x, size=2, act="softmax")
+        m = fluid.layers.mean(y)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [y, m], exe, main, scope=scope)
+    eng = InferenceEngine(d, buckets="1,2")
+    with pytest.raises(ValueError, match="per-row"):
+        eng.warmup()
+
+
+def test_engine_rejects_bad_feeds(tmp_path):
+    d, xs, _ = _export_model(tmp_path)
+    eng = InferenceEngine(d)
+    with pytest.raises(ValueError, match="missing vars"):
+        eng.infer({})
+    with pytest.raises(ValueError, match="empty batch"):
+        eng.infer({"x": xs[:0]})
+    with pytest.raises(ValueError, match="buckets"):
+        InferenceEngine(d, buckets="0,4")
+
+
+# ---------------------------------------------------------------------------
+# DynamicBatcher: coalescing, routing, backpressure, error fan-out
+# ---------------------------------------------------------------------------
+
+def test_batcher_coalesces_and_routes_per_caller():
+    calls = []
+
+    def run_batch(feed):
+        calls.append(int(feed["v"].shape[0]))
+        time.sleep(0.01)            # let the queue build behind the batch
+        return [feed["v"] * 2.0]
+
+    b = DynamicBatcher(run_batch, max_batch=8, max_delay_ms=30,
+                       capacity=64)
+    results = {}
+    start = threading.Barrier(8)
+
+    def caller(i):
+        start.wait()
+        results[i] = b.submit({"v": np.full((1, 2), float(i))})
+
+    ts = [threading.Thread(target=caller, args=(i,)) for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for i in range(8):              # each caller got ITS rows back
+        np.testing.assert_array_equal(results[i][0],
+                                      np.full((1, 2), 2.0 * i))
+    st = b.stats()
+    assert st["requests"] == 8 and st["rejected"] == 0
+    assert st["batches"] == len(calls) < 8          # coalescing happened
+    assert sum(k * v for k, v in st["batch_size_hist"].items()) == 8
+    assert b.close()
+
+
+def test_batcher_full_bucket_dispatches_before_deadline():
+    """A full batch must not wait out max_delay: 8 queued rows with a
+    huge deadline still dispatch immediately."""
+    seen = []
+
+    def run_batch(feed):
+        seen.append(feed["v"].shape[0])
+        return [feed["v"]]
+
+    b = DynamicBatcher(run_batch, max_batch=4, max_delay_ms=5000,
+                       capacity=64)
+    t0 = time.monotonic()
+    start = threading.Barrier(4)
+
+    def caller(i):
+        start.wait()
+        b.submit({"v": np.zeros((1, 1), np.float32)})
+
+    ts = [threading.Thread(target=caller, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert time.monotonic() - t0 < 2.0   # nowhere near the 5 s deadline
+    assert b.close()
+
+
+def test_batcher_overload_rejects_fast():
+    release = threading.Event()
+
+    def slow_batch(feed):
+        release.wait(5.0)
+        return [feed["v"]]
+
+    b = DynamicBatcher(slow_batch, max_batch=1, max_delay_ms=1, capacity=2)
+    outcomes = []
+
+    def caller():
+        try:
+            b.submit({"v": np.zeros((1, 1), np.float32)})
+            outcomes.append("ok")
+        except ServerOverloaded:
+            outcomes.append("overloaded")
+
+    ts = [threading.Thread(target=caller) for _ in range(6)]
+    t0 = time.monotonic()
+    for t in ts:
+        t.start()
+    # rejections are immediate — well before the worker unblocks
+    deadline = time.monotonic() + 2.0
+    while outcomes.count("overloaded") < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    rejected_at = time.monotonic() - t0
+    release.set()
+    for t in ts:
+        t.join()
+    assert outcomes.count("overloaded") >= 1
+    assert rejected_at < 1.0, "reject-fast took as long as the slow batch"
+    assert outcomes.count("ok") + outcomes.count("overloaded") == 6
+    st = b.stats()
+    assert st["rejected"] == outcomes.count("overloaded")
+    assert b.close()
+
+
+def test_batcher_never_coalesces_incompatible_requests():
+    """A malformed request (different dtype or trailing shape) must fail
+    or serve ALONE — np.concatenate over a mixed batch would otherwise
+    silently upcast every batch-mate's rows (or except them all out)."""
+    def run_batch(feed):
+        time.sleep(0.005)           # let the queue build
+        return [feed["v"]]
+
+    b = DynamicBatcher(run_batch, max_batch=8, max_delay_ms=20,
+                       capacity=64)
+    results = {}
+    start = threading.Barrier(6)
+
+    def caller(i):
+        dt = np.float32 if i % 2 == 0 else np.float64
+        start.wait()
+        results[i] = b.submit({"v": np.full((1, 2), float(i), dt)})[0]
+
+    ts = [threading.Thread(target=caller, args=(i,)) for i in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for i in range(6):              # each caller's dtype came back intact
+        assert results[i].dtype == (np.float32 if i % 2 == 0
+                                    else np.float64), (i, results[i].dtype)
+        np.testing.assert_array_equal(results[i],
+                                      np.full((1, 2), float(i)))
+    assert b.close()
+
+
+def test_batcher_rejects_non_per_row_fetches():
+    b = DynamicBatcher(lambda feed: [np.float32(1.0)], max_batch=4,
+                       max_delay_ms=1, capacity=8)
+    with pytest.raises(ValueError, match="per-row"):
+        b.submit({"v": np.zeros((1, 1), np.float32)})
+    assert b.close()
+
+
+def test_batcher_propagates_errors_and_flushes_on_close():
+    def failing(feed):
+        raise ValueError("model exploded")
+
+    b = DynamicBatcher(failing, max_batch=4, max_delay_ms=1, capacity=8)
+    with pytest.raises(ValueError, match="model exploded"):
+        b.submit({"v": np.zeros((1, 1), np.float32)})
+    assert b.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit({"v": np.zeros((1, 1), np.float32)})
+
+
+# ---------------------------------------------------------------------------
+# ModelServer + InferClient end to end
+# ---------------------------------------------------------------------------
+
+def test_server_end_to_end_with_health_and_stats(tmp_path):
+    d, xs, want = _export_model(tmp_path)
+    server = ModelServer(d, buckets="1,2,4,8", max_delay_ms=2.0)
+    server.start()
+    with InferClient(server.address) as c:
+        h = c.health()
+        assert h["status"] == "serving" and h["warmed"] and h["batching"]
+        out = c.infer({"x": xs[:5]})
+        np.testing.assert_allclose(out[0], want[:5], rtol=1e-5, atol=1e-6)
+        # concurrent single-row clients coalesce and all route correctly
+        results = {}
+
+        def one(i):
+            with InferClient(server.address) as cc:
+                results[i] = cc.infer({"x": xs[i:i + 1]})[0]
+
+        ts = [threading.Thread(target=one, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for i in range(8):
+            np.testing.assert_allclose(results[i], want[i:i + 1],
+                                       rtol=1e-5, atol=1e-6)
+        st = c.stats()
+        assert st["engine"]["hot_recompiles"] == 0
+        assert st["engine"]["warmed"]
+        assert st["latency"]["count"] == 9
+        assert st["latency"]["p99_ms"] >= st["latency"]["p50_ms"] >= 0.0
+        assert st["batcher"]["requests"] == 9
+        assert st["wire"]["calls"]["infer"]["count"] == 9
+    assert server.shutdown() is True
+    # drained server is really closed: a no-retry client can't reach it
+    dead = InferClient(server.address, retry=None, timeout=1.0)
+    with pytest.raises((ConnectionError, EOFError, OSError, TimeoutError)):
+        dead.infer({"x": xs[:1]})
+    dead.close()
+
+
+def test_server_overload_is_typed_across_the_wire(tmp_path):
+    d, xs, _ = _export_model(tmp_path)
+    eng = InferenceEngine(d, buckets="1,2")
+    release = threading.Event()
+    inner = eng.infer
+
+    def slow_infer(feed, fetch_list=None):
+        release.wait(5.0)
+        return inner(feed, fetch_list)
+
+    eng.infer = slow_infer
+    server = ModelServer(engine=eng, batching=True, queue_capacity=1,
+                         max_delay_ms=1.0)
+    server.start()
+    outcomes = []
+
+    def caller(i):
+        with InferClient(server.address, retry=None) as c:
+            try:
+                c.infer({"x": xs[i:i + 1]})
+                outcomes.append("ok")
+            except ServerOverloaded:
+                outcomes.append("overloaded")
+
+    ts = [threading.Thread(target=caller, args=(i,)) for i in range(5)]
+    for t in ts:
+        t.start()
+    deadline = time.monotonic() + 3.0
+    while outcomes.count("overloaded") < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    release.set()
+    for t in ts:
+        t.join()
+    # the rejection surfaced CLIENT-side as the typed ServerOverloaded
+    # (not a bare RuntimeError), while admitted requests completed
+    assert outcomes.count("overloaded") >= 1
+    assert outcomes.count("ok") >= 1
+    server.shutdown()
+
+
+def test_server_graceful_drain_answers_inflight(tmp_path):
+    d, xs, want = _export_model(tmp_path)
+    eng = InferenceEngine(d, buckets="1,2")
+    started = threading.Event()
+    inner = eng.infer
+
+    def slow_infer(feed, fetch_list=None):
+        started.set()
+        time.sleep(0.2)
+        return inner(feed, fetch_list)
+
+    eng.infer = slow_infer
+    server = ModelServer(engine=eng, batching=True, max_delay_ms=1.0)
+    server.start()
+    got = {}
+
+    def request():
+        with InferClient(server.address) as c:
+            got["out"] = c.infer({"x": xs[:1]})
+
+    t = threading.Thread(target=request)
+    t.start()
+    assert started.wait(5.0)          # the request is now mid-batch
+    assert server.shutdown(drain=True, timeout=10.0) is True
+    t.join(5.0)
+    assert not t.is_alive()
+    # the in-flight request was ANSWERED, not severed
+    np.testing.assert_allclose(got["out"][0], want[:1], rtol=1e-5,
+                               atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# crash-restart: kill the server mid-request; the retrying client gets a
+# correct answer from the restarted server (the CI fault case)
+# ---------------------------------------------------------------------------
+
+def test_kill_mid_request_client_retries_restarted_server(tmp_path):
+    d, xs, want = _export_model(tmp_path)
+    # 2nd infer request: the server dies BEFORE serving it — the crashed-
+    # process simulation (listener closed + every live conn severed)
+    plan = FaultPlan().die("infer", 1, before=True)
+    server1 = ModelServer(d, buckets="1,2,4", max_delay_ms=1.0,
+                          fault_plan=plan)
+    server1.start()
+    addr = server1.address
+    c = InferClient(addr, retry=RetryPolicy(max_retries=25,
+                                            backoff_base_s=0.02,
+                                            backoff_max_s=0.2))
+    out = c.infer({"x": xs[:1]})      # infer #0 serves normally
+    np.testing.assert_allclose(out[0], want[:1], rtol=1e-5, atol=1e-6)
+
+    restarted = []
+
+    def restart():
+        assert plan.wait("infer", 1, timeout=15.0)
+        s2 = ModelServer(d, buckets="1,2,4", max_delay_ms=1.0,
+                         address=addr)  # same address, same model dir
+        s2.start()
+        restarted.append(s2)
+
+    threading.Thread(target=restart, daemon=True).start()
+    # infer #1 hits the crash: EOF mid-call -> reconnect-and-resend
+    # against the restarted server; inference is stateless/idempotent so
+    # the replay is safe and the answer must be CORRECT
+    out2 = c.infer({"x": xs[1:3]})
+    np.testing.assert_allclose(out2[0], want[1:3], rtol=1e-5, atol=1e-6)
+    # the restarted server really served it (fresh engine, warmed)
+    st = c.stats()
+    assert st["engine"]["warmed"] and st["engine"]["hits"] >= 1
+    c.close()
+    assert restarted, "restart thread never brought the server back"
+    restarted[0].shutdown()

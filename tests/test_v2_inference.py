@@ -12,6 +12,7 @@ parameters through streams into a fresh Inference.
 import io
 
 import numpy as np
+import pytest
 
 import paddle_tpu.fluid as fluid
 import paddle_tpu.v2 as paddle
@@ -76,6 +77,33 @@ def test_infer_matches_fluid_forward():
                           input=[(0, x) for x in xs[:10]],
                           feeding={"pixel_v2i": 1})
     np.testing.assert_allclose(probs2, probs, rtol=1e-6)
+
+
+def test_infer_batch_size_chunks_match_whole_batch():
+    """batch_size= chunks the input through iter_infer instead of the
+    reference's single whole-input batch; infer() concatenates the chunks
+    back, so results are identical either way."""
+    trainer, params, pred, xs = _build_and_train()
+    samples = [(x,) for x in xs[:10]]
+    whole = paddle.infer(output_layer=pred, parameters=params,
+                         input=samples)
+
+    inferer = paddle.Inference(params, output_layer=pred)
+    # 10 samples at batch_size=4 -> 3 chunks (4, 4, 2), yielded per chunk
+    chunks = list(inferer.iter_infer(samples, batch_size=4))
+    assert len(chunks) == 3
+    assert np.asarray(chunks[0][0]).shape[0] == 4
+    assert np.asarray(chunks[-1][0]).shape[0] == 2
+    np.testing.assert_allclose(inferer.infer(input=samples, batch_size=4),
+                               whole, rtol=1e-5, atol=1e-6)
+    # default None keeps reference behavior: one batch
+    assert len(list(inferer.iter_infer(samples))) == 1
+    # the top-level spelling routes batch_size too, field='id' included
+    ids = paddle.infer(output_layer=pred, parameters=params, input=samples,
+                       field="id", batch_size=3)
+    np.testing.assert_array_equal(ids, np.argmax(whole, axis=1))
+    with pytest.raises(ValueError, match="batch_size"):
+        inferer.infer(input=samples, batch_size=0)
 
 
 def test_topology_serialize_roundtrip():
