@@ -193,6 +193,23 @@ DEFINE_flag("serving_queue_capacity", 256,
             "typed ServerOverloaded the client can back off on, instead "
             "of stretching everyone's latency without bound")
 
+DEFINE_flag("serving_fleet_replicas", 2,
+            "default replica count for serving.FleetSupervisor: how many "
+            "supervised ModelServer child processes serve one registry "
+            "model (each on a fixed address, restarted from the "
+            "registry's current version on crash)")
+
+DEFINE_flag("serving_probe_interval_ms", 100.0,
+            "how often the serving FleetClient's background prober "
+            "health-checks EJECTED replicas (healthy replicas are not "
+            "probed — real traffic is their probe)")
+
+DEFINE_flag("serving_probation_probes", 2,
+            "consecutive successful health probes an ejected replica "
+            "must pass before the FleetClient re-admits it to the "
+            "routing set — one lucky probe doesn't un-eject a flapping "
+            "replica")
+
 # PDTPU_FLAGS=check_nan_inf=1,benchmark=0 — unknown names warn and are
 # ignored (a typo'd env var must not make the package unimportable)
 _env = os.environ.get("PDTPU_FLAGS", "")

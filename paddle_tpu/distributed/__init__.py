@@ -20,13 +20,13 @@ reconnect-and-resend retry (rpc.RetryPolicy), supervised failover
 from .param_server import (ParameterServer, ParamClient, serve, shard_names,
                            OPTIMIZERS, OverlappedRemoteUpdater)
 from .master import Master, MasterClient
-from .rpc import (RpcServer, RpcClient, RetryPolicy, SparseGrad, WireStats,
-                  send_msg, recv_msg)
+from .rpc import (RpcServer, RpcClient, RemoteError, RetryPolicy,
+                  SparseGrad, WireStats, send_msg, recv_msg)
 from .fault import FaultPlan
-from .launch import PserverSupervisor
+from .launch import ChildSupervisor, PserverSupervisor
 
 __all__ = ["ParameterServer", "ParamClient", "serve", "shard_names",
            "OPTIMIZERS", "OverlappedRemoteUpdater", "Master", "MasterClient",
-           "RpcServer", "RpcClient", "RetryPolicy", "SparseGrad",
-           "WireStats", "send_msg", "recv_msg", "FaultPlan",
-           "PserverSupervisor"]
+           "RpcServer", "RpcClient", "RemoteError", "RetryPolicy",
+           "SparseGrad", "WireStats", "send_msg", "recv_msg", "FaultPlan",
+           "ChildSupervisor", "PserverSupervisor"]

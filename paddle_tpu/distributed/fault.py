@@ -109,6 +109,22 @@ class FaultPlan:
         return self._add(FaultRule(method, index,
                                    DIE_BEFORE if before else DIE_AFTER))
 
+    # ---- pickling (ship a plan to a SPAWNED server child) ----
+    # Thread primitives don't pickle, so a plan serializes as its rule
+    # schedule and rebuilds fresh on the other side: counts reset and the
+    # parent's wait()/history never observe child-side firings (the same
+    # caveat as fork, documented above) — assert on observable server
+    # behavior instead.
+    def __getstate__(self):
+        with self._lock:
+            return [(r.method, r.index, r.kind, r.seconds)
+                    for r in self._rules.values()]
+
+    def __setstate__(self, rules):
+        self.__init__()
+        for method, index, kind, seconds in rules:
+            self._add(FaultRule(method, index, kind, seconds))
+
     # ---- server side ----
     def on_call(self, method):
         """Count this call; return the rule scheduled for it, or None.

@@ -108,88 +108,96 @@ def _pserver_child(address, checkpoint_path, cfg):
     rpc.serve_forever()
 
 
-class PserverSupervisor:
-    """Supervise N parameter-server processes: spawn each shard on a fixed
-    address with a per-shard checkpoint file, heartbeat the children over
-    RPC, and restart a dead (or wedged) shard from its latest checkpoint on
-    the SAME address — so a trainer's ``ParamClient`` placement stays valid
-    and its retry policy (rpc.RetryPolicy) reconnects straight through the
-    restart. The reference analog is the etcd-supervised v2 Go pserver
-    (go/pserver: a crashed server pod restarts, recovers its checkpoint,
-    and trainers transparently reconnect).
+class ChildSupervisor:
+    """Generic supervised child fleet: fork/spawn N RPC-serving children on
+    FIXED addresses, heartbeat each over RPC, and restart a dead (or
+    alive-but-unresponsive, i.e. wedged) child on the SAME address with a
+    per-child restart cap — so any client placement keyed on the address
+    list stays valid across crashes and a ``rpc.RetryPolicy`` client
+    reconnects straight through the restart. The reference analog is the
+    etcd supervision loop of the v2 Go pserver/master (go/pserver,
+    go/master/service.go: a crashed pod restarts, recovers its state, and
+    its peers transparently reconnect).
 
-        with PserverSupervisor(n_servers=2, checkpoint_dir=d) as sup:
-            client = ParamClient(sup.addresses, retry=RetryPolicy())
-            client.init_params(params)   # first-write-wins: a RESTORED
-            ...                          # shard keeps its restored state
+    Subclasses provide the child by overriding :meth:`_child_spec`, which
+    returns the ``(target, args)`` for one child process — called at EVERY
+    (re)spawn, so args can carry state that moved since the last spawn
+    (the serving fleet's current registry version). Two users:
 
-    A trainer resuming against a restarted shard just keeps pushing: it may
-    re-run ``init_params`` (no-op against restored params) and the shard's
-    sequence-number dedup absorbs any replayed push.
+    * :class:`PserverSupervisor` — parameter-server shards restarting from
+      their checkpoints (heartbeat method ``stats``, fork start method:
+      the pserver path is numpy-only in-child).
+    * ``serving.fleet.FleetSupervisor`` — inference replicas restarting
+      from the model registry's current version (heartbeat ``health``,
+      SPAWN start method: replica children execute jitted programs, and a
+      forked child would inherit the parent's already-initialized XLA
+      runtime in an unusable state).
+
+    ``startup_grace_s`` suppresses heartbeat-miss COUNTING for that long
+    after each (re)spawn — a spawned replica pays a full interpreter +
+    framework import plus model warmup before it binds, and terminating it
+    for not answering during startup would crash-loop the fleet. A child
+    that exits during the grace window is still restarted immediately
+    (liveness is checked regardless); the default 0.0 preserves the
+    pserver supervisor's original timing exactly.
     """
 
-    def __init__(self, n_servers=1, checkpoint_dir=None, optimizer="sgd",
-                 opt_kwargs=None, mode="async", fan_in=1, max_staleness=None,
-                 barrier_timeout_s=None, checkpoint_every=1,
+    def __init__(self, n_children, heartbeat_method="stats",
                  heartbeat_interval_s=0.25, heartbeat_timeout_s=None,
-                 heartbeat_misses=3, max_restarts=5, host="127.0.0.1"):
+                 heartbeat_misses=3, max_restarts=5, startup_grace_s=0.0,
+                 mp_start_method="fork", host="127.0.0.1"):
         import multiprocessing as mp
-        import tempfile
 
         from ..core.flags import get_flag
 
         if heartbeat_timeout_s is None:
             # derive from the process-wide rpc_timeout_s flag, but never
             # slower than the 5 s wedge-detection default — a 90 s response
-            # deadline is fine for a pull, not for declaring a shard dead
+            # deadline is fine for a pull, not for declaring a child dead
             heartbeat_timeout_s = min(5.0, float(get_flag("rpc_timeout_s")))
 
-        self._cfg = dict(optimizer=optimizer, opt_kwargs=opt_kwargs,
-                         mode=mode, fan_in=fan_in,
-                         max_staleness=max_staleness,
-                         barrier_timeout_s=barrier_timeout_s,
-                         checkpoint_every=checkpoint_every)
-        self._ckpt_dir = checkpoint_dir or tempfile.mkdtemp(
-            prefix="pdtpu_pserver_ckpt_")
-        os.makedirs(self._ckpt_dir, exist_ok=True)
-        # fork: the children reuse the parent's imported modules and the
-        # pserver path is numpy-only (no jax backend touched in-child)
-        self._ctx = mp.get_context("fork")
-        self.addresses = [(host, free_port()) for _ in range(n_servers)]
-        self.restarts = [0] * n_servers
+        self._ctx = mp.get_context(mp_start_method)
+        self.addresses = [(host, free_port()) for _ in range(n_children)]
+        self.restarts = [0] * n_children
         self._max_restarts = int(max_restarts)
+        self._hb_method = str(heartbeat_method)
         self._interval = float(heartbeat_interval_s)
         self._hb_timeout = float(heartbeat_timeout_s)
         self._hb_misses_allowed = int(heartbeat_misses)
-        self._hb_failures = [0] * n_servers
-        self._hb_clients = [None] * n_servers
+        self._hb_failures = [0] * n_children
+        self._hb_clients = [None] * n_children
         self._hb_lock = threading.Lock()  # monitor + wait_ready share these
-        self._procs = [None] * n_servers
+        self._grace = float(startup_grace_s)
+        self._spawned_at = [0.0] * n_children
+        self._procs = [None] * n_children
         self._stop = threading.Event()
         # gates _spawn against stop(): without it the monitor could respawn
         # a child between stop()'s flag-set and its terminate sweep,
-        # leaking a live pserver on the fixed port
+        # leaking a live child process on the fixed port
         self._spawn_lock = threading.Lock()
-        for i in range(n_servers):
+        for i in range(n_children):
             self._spawn(i)
         self._monitor = threading.Thread(target=self._watch, daemon=True)
         self._monitor.start()
 
-    def checkpoint_path(self, i):
-        return os.path.join(self._ckpt_dir, f"pserver{i}.ckpt")
+    # ---- subclass hook ----
+    def _child_spec(self, i):
+        """Return ``(target, args)`` for child ``i`` — evaluated at every
+        (re)spawn. ``args`` must be inheritable under the chosen start
+        method (fork: anything; spawn: picklable)."""
+        raise NotImplementedError
 
+    # ---- supervision loop ----
     def _spawn(self, i):
         with self._spawn_lock:
             if self._stop.is_set():
                 return
-            p = self._ctx.Process(
-                target=_pserver_child,
-                args=(self.addresses[i], self.checkpoint_path(i),
-                      self._cfg),
-                daemon=True)
+            target, args = self._child_spec(i)
+            p = self._ctx.Process(target=target, args=args, daemon=True)
             p.start()
             self._procs[i] = p
             self._hb_failures[i] = 0
+            self._spawned_at[i] = time.monotonic()
 
     def _heartbeat_ok(self, i):
         from .rpc import RpcClient
@@ -198,7 +206,7 @@ class PserverSupervisor:
                 if self._hb_clients[i] is None:
                     self._hb_clients[i] = RpcClient(
                         self.addresses[i], timeout=self._hb_timeout)
-                self._hb_clients[i].call("stats")
+                self._hb_clients[i].call(self._hb_method)
                 return True
             except Exception:
                 c, self._hb_clients[i] = self._hb_clients[i], None
@@ -216,6 +224,9 @@ class PserverSupervisor:
                     if self._heartbeat_ok(i):
                         self._hb_failures[i] = 0
                         continue
+                    if (time.monotonic() - self._spawned_at[i]
+                            < self._grace):
+                        continue   # still starting up: misses don't count
                     self._hb_failures[i] += 1
                     if self._hb_failures[i] < self._hb_misses_allowed:
                         continue
@@ -224,21 +235,40 @@ class PserverSupervisor:
                 if self._stop.is_set():
                     return
                 if self.restarts[i] >= self._max_restarts:
-                    self._procs[i] = None  # crash-looping: give the shard up
+                    self._procs[i] = None  # crash-looping: give the child up
                     continue
                 self.restarts[i] += 1
-                self._spawn(i)
+                try:
+                    self._spawn(i)
+                except Exception as e:
+                    # _child_spec can now fail at RESPAWN time (e.g. the
+                    # fleet's registry version was deleted out-of-band);
+                    # give this child up loudly instead of letting the
+                    # exception kill the monitor thread and silently end
+                    # supervision for every OTHER child
+                    import warnings
+                    warnings.warn(
+                        f"ChildSupervisor: respawn of child {i} failed "
+                        f"({type(e).__name__}: {e}); giving it up")
+                    self._procs[i] = None
+
+    # ---- operator surface ----
+    def child_alive(self, i):
+        """Is child ``i`` a live process (a crash-looping child the
+        supervisor gave up on reports False forever)?"""
+        p = self._procs[i]
+        return p is not None and p.is_alive()
 
     def kill(self, i):
-        """Hard-kill shard ``i`` (SIGKILL — no atexit, exactly a crash);
-        the monitor restarts it from its latest checkpoint. Test hook."""
+        """Hard-kill child ``i`` (SIGKILL — no atexit, exactly a crash);
+        the monitor restarts it on the same address. Test hook."""
         p = self._procs[i]
         if p is not None and p.is_alive():
             p.kill()
 
     def wait_ready(self, timeout=10.0):
-        """Block until every live shard answers an RPC — the post-start
-        (or post-restart) barrier tests want before pushing."""
+        """Block until every live child answers an RPC — the post-start
+        (or post-restart) barrier callers want before sending work."""
         deadline = time.monotonic() + timeout
         for i in range(len(self.addresses)):
             while self._procs[i] is not None and not self._heartbeat_ok(i):
@@ -271,6 +301,59 @@ class PserverSupervisor:
     def __exit__(self, *exc):
         self.stop()
         return False
+
+
+class PserverSupervisor(ChildSupervisor):
+    """Supervise N parameter-server processes: spawn each shard on a fixed
+    address with a per-shard checkpoint file, heartbeat the children over
+    RPC, and restart a dead (or wedged) shard from its latest checkpoint on
+    the SAME address — so a trainer's ``ParamClient`` placement stays valid
+    and its retry policy (rpc.RetryPolicy) reconnects straight through the
+    restart. The fork/heartbeat/restart loop itself lives in
+    :class:`ChildSupervisor` (shared with the serving fleet supervisor);
+    this subclass contributes the pserver child — serve the shard's config
+    on its fixed address, restoring from its checkpoint when one exists.
+
+        with PserverSupervisor(n_servers=2, checkpoint_dir=d) as sup:
+            client = ParamClient(sup.addresses, retry=RetryPolicy())
+            client.init_params(params)   # first-write-wins: a RESTORED
+            ...                          # shard keeps its restored state
+
+    A trainer resuming against a restarted shard just keeps pushing: it may
+    re-run ``init_params`` (no-op against restored params) and the shard's
+    sequence-number dedup absorbs any replayed push.
+    """
+
+    def __init__(self, n_servers=1, checkpoint_dir=None, optimizer="sgd",
+                 opt_kwargs=None, mode="async", fan_in=1, max_staleness=None,
+                 barrier_timeout_s=None, checkpoint_every=1,
+                 heartbeat_interval_s=0.25, heartbeat_timeout_s=None,
+                 heartbeat_misses=3, max_restarts=5, host="127.0.0.1"):
+        import tempfile
+
+        self._cfg = dict(optimizer=optimizer, opt_kwargs=opt_kwargs,
+                         mode=mode, fan_in=fan_in,
+                         max_staleness=max_staleness,
+                         barrier_timeout_s=barrier_timeout_s,
+                         checkpoint_every=checkpoint_every)
+        self._ckpt_dir = checkpoint_dir or tempfile.mkdtemp(
+            prefix="pdtpu_pserver_ckpt_")
+        os.makedirs(self._ckpt_dir, exist_ok=True)
+        # fork: the children reuse the parent's imported modules and the
+        # pserver path is numpy-only (no jax backend touched in-child)
+        super().__init__(
+            n_servers, heartbeat_method="stats",
+            heartbeat_interval_s=heartbeat_interval_s,
+            heartbeat_timeout_s=heartbeat_timeout_s,
+            heartbeat_misses=heartbeat_misses, max_restarts=max_restarts,
+            mp_start_method="fork", host=host)
+
+    def checkpoint_path(self, i):
+        return os.path.join(self._ckpt_dir, f"pserver{i}.ckpt")
+
+    def _child_spec(self, i):
+        return _pserver_child, (self.addresses[i], self.checkpoint_path(i),
+                                self._cfg)
 
 
 def main(argv=None):

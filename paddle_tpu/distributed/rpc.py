@@ -64,6 +64,7 @@ import socket
 import struct
 import threading
 import time
+import traceback
 from multiprocessing import AuthenticationError
 
 import numpy as np
@@ -369,6 +370,41 @@ class WireStats:
             }
 
 
+class RemoteError(RuntimeError):
+    """A handler exception surfaced across the wire as a STRUCTURED error:
+    ``code`` is the remote exception's type name (machine-checkable — the
+    serving router keys its ``ServerOverloaded`` spillover on it instead of
+    sniffing message substrings), ``remote_message`` the remote ``str(e)``,
+    and ``remote_traceback`` the remote stack — preserved so a failure deep
+    inside a replica is diagnosable from the client side. Subclasses
+    RuntimeError, so callers that only catch the legacy bare type keep
+    working."""
+
+    def __init__(self, method, code, message, remote_traceback=None):
+        self.method = method
+        self.code = code
+        self.remote_message = message
+        self.remote_traceback = remote_traceback
+        text = f"remote {method} failed: {code}: {message}"
+        if remote_traceback:
+            text += ("\n--- remote traceback ---\n"
+                     + str(remote_traceback).rstrip())
+        super().__init__(text)
+
+    @classmethod
+    def from_payload(cls, method, payload):
+        """Build from a server error payload: the structured dict form
+        (``{"code", "message", "traceback"}``) or the legacy
+        ``"TypeName: message"`` string a pre-upgrade server sends."""
+        if isinstance(payload, dict):
+            return cls(method, payload.get("code", "RuntimeError"),
+                       payload.get("message", ""), payload.get("traceback"))
+        code, sep, msg = str(payload).partition(": ")
+        if not sep:
+            code, msg = "RuntimeError", str(payload)
+        return cls(method, code, msg)
+
+
 class RetryPolicy:
     """Bounded exponential backoff + jitter for reconnect-and-resend.
 
@@ -510,7 +546,10 @@ class RpcServer:
                         with record_event(f"rpc.serve/{method}", kind="rpc"):
                             result = (True, fn(**kwargs))
                     except Exception as e:  # surface remote errors to caller
-                        result = (False, f"{type(e).__name__}: {e}")
+                        result = (False, {"code": type(e).__name__,
+                                          "message": str(e),
+                                          "traceback":
+                                              traceback.format_exc()})
                     if rule is not None and rule.kind == "drop_response":
                         rule.fired.set()
                         return               # applied, but the reply is lost
@@ -688,7 +727,7 @@ class RpcClient:
             self.wire_stats.note(method, ns, nr, time.perf_counter() - t0)
         ok, payload = resp
         if not ok:
-            raise RuntimeError(f"remote {method} failed: {payload}")
+            raise RemoteError.from_payload(method, payload)
         return payload
 
     def call(self, method, **kwargs):

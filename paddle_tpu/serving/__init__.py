@@ -17,13 +17,31 @@ without ever recompiling on the hot path.
   with bounded-queue backpressure (:class:`ServerOverloaded`).
 * :class:`ModelServer` / :class:`InferClient` (server.py / client.py) — a
   multi-threaded server over ``distributed/rpc.py``'s framed codec with
-  health/stats RPCs, graceful drain, and retry-surviving clients.
+  health/stats RPCs, zero-downtime hot reload, graceful drain, and
+  retry-surviving clients.
+
+On top of the single server sits the fleet control plane:
+
+* :class:`ModelRegistry` (registry.py) — versioned, content-hashed store
+  of ``save_inference_model`` bundles (``publish``/``resolve``; a version
+  is visible only once its manifest lands atomically).
+* :class:`FleetSupervisor` (fleet.py) — N supervised replica processes on
+  fixed addresses (the pserver supervision loop transplanted to the
+  inference plane) with ``rolling_reload``: canary-gated, zero-downtime
+  version rollouts that roll back a failed canary.
+* :class:`FleetClient` (router.py) — client-side balancer: power-of-two-
+  choices picks, connection-failure failover, overload spillover, and
+  health probes that eject/probation-readmit replicas.
 """
 
 from .engine import InferenceEngine
 from .batcher import DynamicBatcher, ServerOverloaded
 from .server import ModelServer
 from .client import InferClient
+from .registry import ModelRegistry
+from .fleet import FleetSupervisor
+from .router import FleetClient
 
 __all__ = ["InferenceEngine", "DynamicBatcher", "ServerOverloaded",
-           "ModelServer", "InferClient"]
+           "ModelServer", "InferClient", "ModelRegistry",
+           "FleetSupervisor", "FleetClient"]

@@ -202,12 +202,30 @@ class DynamicBatcher:
 
     def close(self, timeout=30.0):
         """Stop admitting requests, FLUSH everything already queued (their
-        callers get real results), and join the worker."""
+        callers get real results), and join the worker. If the worker is
+        WEDGED (a run_batch that never returns) and the join times out,
+        requests still waiting in the queue are rejected with a typed
+        RuntimeError instead of hanging their callers forever — a queued
+        request at close() is always either answered or rejected typed.
+        Returns True when the worker exited within ``timeout``."""
         with self._cv:
             self._closed = True
             self._cv.notify_all()
         self._worker.join(timeout)
-        return not self._worker.is_alive()
+        closed_clean = not self._worker.is_alive()
+        if not closed_clean:
+            # pop the undispatched queue under the lock so the wedged
+            # worker can never race these requests back out of it
+            with self._cv:
+                stranded, self._pending = list(self._pending), deque()
+            err = RuntimeError(
+                "DynamicBatcher is closed: the dispatch worker did not "
+                f"exit within {timeout}s (wedged run_batch); this queued "
+                "request was rejected without being served")
+            for r in stranded:
+                r.error = err
+                r.done.set()
+        return closed_clean
 
 
 __all__ = ["DynamicBatcher", "ServerOverloaded"]

@@ -5,21 +5,24 @@ zero-copy codec, and connection-level failures (the server restarting
 under a supervisor, a dropped conn) reconnect-and-resend under a
 ``RetryPolicy`` — safe because ``infer`` is stateless and idempotent, so
 a server restart mid-request is survivable without an at-most-once
-escape hatch. Two remote conditions come back TYPED instead of as bare
-RuntimeErrors so callers can program against them:
+escape hatch. Remote failures arrive as :class:`rpc.RemoteError` with the
+remote exception's type name as a structured ``code`` (and the remote
+traceback attached), and one condition re-raises TYPED on every method so
+callers can program against it:
 
 * :class:`~.batcher.ServerOverloaded` — the server's bounded queue
   rejected the request; back off (the client does NOT auto-retry
-  overloads: retrying into a full queue is how collapse spreads).
-* everything else re-raises as the RpcClient's usual errors.
+  overloads: retrying into a full queue is how collapse spreads). The
+  fleet router keys its spillover-to-the-next-replica logic on this type.
+* everything else re-raises as the RpcClient's usual errors
+  (``RemoteError`` for handler exceptions, connection errors otherwise).
 """
 
 from __future__ import annotations
 
-from ..distributed.rpc import RetryPolicy, RpcClient, WIRE_FRAMED
+from ..distributed.rpc import (RemoteError, RetryPolicy, RpcClient,
+                               WIRE_FRAMED)
 from .batcher import ServerOverloaded
-
-_OVERLOAD_MARK = "ServerOverloaded"
 
 
 class InferClient:
@@ -32,22 +35,28 @@ class InferClient:
         self._rpc = RpcClient(address, timeout=timeout, retry=retry or None,
                               wire=wire)
 
+    def _call(self, method, **kwargs):
+        """One RPC with the structured-code overload mapping applied
+        uniformly (infer, health and stats alike — a drained-but-loaded
+        server may reject any of them under backpressure)."""
+        try:
+            return self._rpc.call(method, **kwargs)
+        except RemoteError as e:
+            if e.code == "ServerOverloaded":
+                raise ServerOverloaded(e.remote_message) from None
+            raise
+
     def infer(self, feed):
         """One request; returns the fetch arrays for these rows. Raises
         :class:`ServerOverloaded` when the server rejected under
         backpressure."""
-        try:
-            return self._rpc.call("infer", feed=feed)
-        except RuntimeError as e:
-            if _OVERLOAD_MARK in str(e):
-                raise ServerOverloaded(str(e)) from None
-            raise
+        return self._call("infer", feed=feed)
 
     def health(self):
-        return self._rpc.call("health")
+        return self._call("health")
 
     def stats(self):
-        return self._rpc.call("stats")
+        return self._call("stats")
 
     def wire_stats(self):
         return self._rpc.wire_stats.snapshot()

@@ -1,0 +1,255 @@
+"""FleetSupervisor: a supervised fleet of ModelServer replicas with
+zero-downtime rolling version rollouts.
+
+The inference-plane transplant of the training plane's supervision design
+(``distributed/launch.py``): the shared :class:`ChildSupervisor` loop
+forks/heartbeats/restarts children on FIXED addresses; this subclass
+contributes the replica child — resolve the registry's CURRENT version,
+warm every bucket BEFORE binding the address (so a restarting replica is
+never half-ready: until it binds, health probes fail fast and the router
+keeps it ejected), then serve. A replica that crashes restarts from the
+registry's current version, which after a rollout is the NEW version —
+the registry is the source of truth, not the dead process.
+
+Replicas are SPAWNED, not forked: a replica child executes jitted
+programs, and a forked child would inherit the parent's
+already-initialized XLA runtime (its thread pools die in the fork) in an
+unusable state. Spawn pays an interpreter + import + warmup startup cost,
+which is why ``startup_grace_s`` defaults high here — the supervisor must
+not declare a replica wedged while it is importing jax.
+
+``rolling_reload(version)`` is the rollout: one replica at a time, ask it
+to hot-reload (``ModelServer.reload`` builds + warms the new engine OFF
+the hot path, so the replica keeps serving throughout — the fleet never
+drops below N−1 ready, and in the healthy path never below N), then
+health-gate (serving + warmed + reporting the target version) before
+moving on. Replica 0 is the CANARY: only after it passes does the
+supervisor's current version advance (so mid-rollout crash-restarts pick
+the right side of the rollout), and a failed canary is rolled back to the
+previous manifest version and the rollout aborted — N−1 replicas never
+even saw the bad version.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..core.flags import get_flag
+from ..distributed.launch import ChildSupervisor
+from ..distributed.rpc import RpcClient
+from .registry import ModelRegistry
+
+
+def _replica_child(address, model_dir, version, cfg, fault_plan=None):
+    """Spawned child entry: pin the parent's jax platform BEFORE any
+    backend initialization (the machine's sitecustomize would otherwise
+    pick its own), build + WARM the engine, and only then bind the fixed
+    address and serve — health-gating for free: an unbound replica is
+    loudly dead, never silently cold."""
+    import os
+
+    platform = cfg.get("jax_platform")
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+        import jax
+        jax.config.update("jax_platforms", platform)
+    from .engine import InferenceEngine
+    from .server import ModelServer
+
+    engine = InferenceEngine(model_dir, buckets=cfg.get("buckets"))
+    engine.warmup()
+    server = ModelServer(
+        engine=engine, model_dir=model_dir, address=tuple(address),
+        batching=cfg.get("batching", True),
+        max_delay_ms=cfg.get("max_delay_ms"),
+        queue_capacity=cfg.get("queue_capacity"),
+        fault_plan=fault_plan, version=version)
+    server.serve_forever(warmup=False)
+
+
+class FleetSupervisor(ChildSupervisor):
+    """Supervise N ModelServer replicas serving one registry model.
+
+        reg = ModelRegistry(root); reg.publish("ranker", export_dir)
+        with FleetSupervisor(root, "ranker", n_replicas=2) as sup:
+            sup.wait_ready(120)
+            client = FleetClient(sup.addresses)
+            ...
+            sup.rolling_reload(2)      # zero-downtime rollout to v2
+
+    ``fault_plans`` maps replica index -> FaultPlan, applied on the FIRST
+    spawn only (a restarted replica comes back clean — otherwise the
+    schedule would re-fire every restart and the replica could never
+    rejoin). ``n_replicas`` defaults from the ``serving_fleet_replicas``
+    flag."""
+
+    def __init__(self, registry_root, model, version="latest",
+                 n_replicas=None, batching=True, buckets=None,
+                 max_delay_ms=None, queue_capacity=None,
+                 heartbeat_interval_s=0.25, heartbeat_timeout_s=None,
+                 heartbeat_misses=3, max_restarts=5, startup_grace_s=120.0,
+                 fault_plans=None, host="127.0.0.1"):
+        import jax
+
+        self.registry = registry_root if isinstance(registry_root,
+                                                    ModelRegistry) \
+            else ModelRegistry(registry_root)
+        self.model = model
+        _path, v = self.registry.resolve(model, version)
+        self._version = v
+        self._version_lock = threading.Lock()
+        self._cfg = dict(batching=batching, buckets=buckets,
+                         max_delay_ms=max_delay_ms,
+                         queue_capacity=queue_capacity,
+                         # resolved platform, not the env var: the child
+                         # must land on the same backend the parent
+                         # exported/validated the model on
+                         jax_platform=jax.default_backend())
+        self._fault_plans = dict(fault_plans or {})
+        if n_replicas is None:
+            n_replicas = int(get_flag("serving_fleet_replicas"))
+        super().__init__(
+            int(n_replicas), heartbeat_method="health",
+            heartbeat_interval_s=heartbeat_interval_s,
+            heartbeat_timeout_s=heartbeat_timeout_s,
+            heartbeat_misses=heartbeat_misses, max_restarts=max_restarts,
+            startup_grace_s=startup_grace_s, mp_start_method="spawn",
+            host=host)
+
+    # ------------------------------------------------------------------
+    @property
+    def version(self):
+        """The fleet's CURRENT target version — what a restarted replica
+        comes back serving."""
+        with self._version_lock:
+            return self._version
+
+    def _child_spec(self, i):
+        with self._version_lock:
+            v = self._version
+        path, v = self.registry.resolve(self.model, v)
+        plan = self._fault_plans.pop(i, None)   # first spawn only
+        return _replica_child, (self.addresses[i], path, v, self._cfg,
+                                plan)
+
+    # ------------------------------------------------------------------
+    def replica_health(self, i, timeout=2.0):
+        """One health RPC to replica ``i`` — None when unreachable."""
+        c = RpcClient(self.addresses[i], timeout=timeout)
+        try:
+            return c.call("health")
+        except Exception:
+            return None
+        finally:
+            c.close()
+
+    def ready_count(self, timeout=2.0):
+        """How many replicas currently answer health as serving+warmed —
+        what the rollout invariant (never below N−1) is measured in."""
+        n = 0
+        for i in range(len(self.addresses)):
+            h = self.replica_health(i, timeout=timeout)
+            if h is not None and h.get("status") == "serving" \
+                    and h.get("warmed"):
+                n += 1
+        return n
+
+    def _await_replica(self, i, deadline, target_version=None):
+        """Wait for replica ``i`` to answer health (optionally on a given
+        version) — rides out a concurrent crash-restart mid-rollout."""
+        while True:
+            h = self.replica_health(i)
+            if h is not None and h.get("status") == "serving" \
+                    and h.get("warmed") \
+                    and (target_version is None
+                         or h.get("version") == target_version):
+                return h
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"replica {i} at {self.addresses[i]} did not become "
+                    f"ready (last health: {h})")
+            time.sleep(0.1)
+
+    def _reload_replica(self, i, path, version, timeout):
+        """Ask replica ``i`` to hot-swap, then health-gate the result.
+        Returns None on success, the failure on any error."""
+        c = RpcClient(self.addresses[i], timeout=timeout)
+        try:
+            h = c.call("health")
+            if h.get("version") != version:
+                # a replica that crash-restarted AFTER the version advanced
+                # already serves the target; reloading it again is harmless
+                # but wasteful
+                c.call("reload", model_dir=path, version=version)
+            h = c.call("health")
+            if not (h.get("status") == "serving" and h.get("warmed")
+                    and h.get("version") == version):
+                return RuntimeError(f"replica {i} unhealthy after reload: "
+                                    f"{h}")
+            return None
+        except Exception as e:
+            return e
+        finally:
+            c.close()
+
+    def rolling_reload(self, version, wait_timeout=120.0):
+        """Zero-downtime rollout to ``version`` (any :meth:`~.registry.
+        ModelRegistry.resolve` spelling): reload one health-gated replica
+        at a time. Replica 0 is the canary — on its failure the canary is
+        rolled back to the PREVIOUS version and the rollout aborts with a
+        RuntimeError (the rest of the fleet never saw the bad version).
+        After the canary passes, the supervisor's current version
+        advances, so a replica that crashes mid-rollout restarts straight
+        onto the target. Returns the rolled-out version."""
+        path, target = self.registry.resolve(self.model, version)
+        prev = self.version
+        for i in range(len(self.addresses)):
+            deadline = time.monotonic() + wait_timeout
+            self._await_replica(i, deadline)
+            err = self._reload_replica(i, path, target,
+                                       timeout=wait_timeout)
+            if err is not None:
+                if i == 0:
+                    self._rollback_canary(prev, wait_timeout)
+                    raise RuntimeError(
+                        f"rolling_reload: canary (replica 0) failed for "
+                        f"version {target}; rolled back to {prev}: "
+                        f"{type(err).__name__}: {err}") from err
+                raise RuntimeError(
+                    f"rolling_reload: replica {i} failed after the canary "
+                    f"passed — fleet is mixed-version (replicas <{i} on "
+                    f"{target}, rest on {prev}): "
+                    f"{type(err).__name__}: {err}") from err
+            if i == 0:
+                with self._version_lock:
+                    self._version = target
+        return target
+
+    def _rollback_canary(self, prev_version, wait_timeout):
+        try:
+            ppath, pv = self.registry.resolve(self.model, prev_version)
+        except ValueError:
+            return   # nothing to roll back to (first ever version)
+        # best-effort: in the common corrupt-bundle case the canary never
+        # swapped (reload failures keep the old engine serving), so even a
+        # failed rollback RPC leaves it on prev; the main raise carries
+        # the canary failure detail either way
+        self._reload_replica(0, ppath, pv, timeout=wait_timeout)
+
+    def replica_stats(self, timeout=5.0):
+        """stats() from every reachable replica (index -> stats|None) —
+        what the bench lane aggregates hot_recompiles/version over."""
+        out = {}
+        for i in range(len(self.addresses)):
+            c = RpcClient(self.addresses[i], timeout=timeout)
+            try:
+                out[i] = c.call("stats")
+            except Exception:
+                out[i] = None
+            finally:
+                c.close()
+        return out
+
+
+__all__ = ["FleetSupervisor"]

@@ -1,0 +1,200 @@
+"""ModelRegistry: a versioned store of ``save_inference_model`` bundles.
+
+The missing link between "a model was exported somewhere in /tmp" and "a
+fleet of replicas serves version N and can roll to N+1": versions live
+under ``<root>/<model>/<version>/`` as plain copies of the exported
+bundle, and a version becomes VISIBLE only when its ``VERSION.json``
+manifest (per-file sha256 digests + a combined content hash) lands via
+tmp + ``os.replace`` — the same atomic-last-write discipline the pserver
+checkpoints and ``fluid.io.save_vars`` use, so a torn publish is an
+invisible version, never a corrupt "latest". Versions are immutable once
+published; rollback is just resolving the previous version, which is why
+the fleet's ``rolling_reload`` can rescue a failed canary without any
+undo machinery.
+
+Corruption is detected at two depths: :meth:`verify` re-hashes the files
+against the manifest (bit rot, torn copies), and actually LOADING a
+resolved bundle reuses ``load_inference_model``'s typed ValueError
+(missing/corrupt ``__model__``) — the serving engine raises it before a
+bad version can swap in, which is what a rollout's canary gate catches.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+
+from ..fluid.io import MODEL_FILENAME
+
+VERSION_MANIFEST = "VERSION.json"
+
+
+def _sha256_file(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _content_hash(files):
+    """Combined hash over the sorted (name, digest) pairs — one value that
+    pins the whole bundle's bytes."""
+    h = hashlib.sha256()
+    for name in sorted(files):
+        h.update(f"{name}:{files[name]}\n".encode())
+    return h.hexdigest()
+
+
+class ModelRegistry:
+    """``ModelRegistry(root)`` over a directory of
+    ``<model>/<version>/`` bundles.
+
+        reg = ModelRegistry(root)
+        v = reg.publish("ranker", export_dir)        # auto-increments
+        path, v = reg.resolve("ranker", "latest")    # newest published
+        reg.verify("ranker", v)                      # re-hash the bytes
+    """
+
+    def __init__(self, root):
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    # ------------------------------------------------------------------
+    def model_dir(self, model):
+        if (not model or os.sep in model or (os.altsep or "/") in model
+                or model.startswith(".")):
+            raise ValueError(
+                f"invalid model name {model!r}: one plain path component")
+        return os.path.join(self.root, model)
+
+    def version_dir(self, model, version):
+        return os.path.join(self.model_dir(model), str(int(version)))
+
+    def models(self):
+        return sorted(d for d in os.listdir(self.root)
+                      if os.path.isdir(os.path.join(self.root, d)))
+
+    def versions(self, model):
+        """PUBLISHED versions (ascending) — a version dir without its
+        VERSION.json (a torn publish in progress or abandoned) is
+        invisible."""
+        d = self.model_dir(model)
+        if not os.path.isdir(d):
+            return []
+        out = []
+        for name in os.listdir(d):
+            if name.isdigit() and os.path.exists(
+                    os.path.join(d, name, VERSION_MANIFEST)):
+                out.append(int(name))
+        return sorted(out)
+
+    # ------------------------------------------------------------------
+    def publish(self, model, src_dir, version=None):
+        """Copy the bundle at ``src_dir`` in as ``version`` (next integer
+        when None) and make it visible by writing the manifest LAST,
+        atomically. Returns the published version number. Versions are
+        immutable: republishing an existing one raises."""
+        if not os.path.exists(os.path.join(src_dir, MODEL_FILENAME)):
+            raise ValueError(
+                f"publish: {src_dir!r} is not a save_inference_model "
+                f"bundle (no {MODEL_FILENAME!r} file)")
+        existing = self.versions(model)
+        if version is None:
+            version = existing[-1] + 1 if existing else 1
+        version = int(version)
+        if version <= 0:
+            raise ValueError(f"version must be a positive int, "
+                             f"got {version}")
+        dst = self.version_dir(model, version)
+        if version in existing or os.path.exists(dst):
+            raise ValueError(
+                f"version {version} of model {model!r} already exists "
+                "(published versions are immutable; publish a new one)")
+        os.makedirs(dst)
+        files = {}
+        for name in sorted(os.listdir(src_dir)):
+            src = os.path.join(src_dir, name)
+            if not os.path.isfile(src) or name == VERSION_MANIFEST \
+                    or name.endswith(".tmp"):
+                continue
+            shutil.copyfile(src, os.path.join(dst, name))
+            # hash the DESTINATION bytes: the manifest certifies what the
+            # registry holds, not what the source held mid-copy
+            files[name] = _sha256_file(os.path.join(dst, name))
+        manifest = {"model": model, "version": version, "files": files,
+                    "content_hash": _content_hash(files)}
+        tmp = os.path.join(dst, VERSION_MANIFEST + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        os.replace(tmp, os.path.join(dst, VERSION_MANIFEST))
+        return version
+
+    # ------------------------------------------------------------------
+    def resolve(self, model, version="latest"):
+        """-> ``(bundle_path, version_int)``. ``"latest"`` (or None) picks
+        the newest published version. Unknown models/versions raise a
+        ValueError naming what IS available."""
+        published = self.versions(model)
+        if not published:
+            raise ValueError(
+                f"model {model!r} has no published versions in registry "
+                f"{self.root!r} (known models: {self.models()})")
+        if version in (None, "latest"):
+            v = published[-1]
+        else:
+            v = int(version)
+            if v not in published:
+                raise ValueError(
+                    f"model {model!r} has no published version {v}; "
+                    f"published: {published}")
+        return self.version_dir(model, v), v
+
+    def previous(self, model, version):
+        """The newest published version strictly older than ``version``
+        (what a failed canary rolls back to), or None."""
+        older = [v for v in self.versions(model) if v < int(version)]
+        return older[-1] if older else None
+
+    def manifest(self, model, version):
+        path, v = self.resolve(model, version)
+        mpath = os.path.join(path, VERSION_MANIFEST)
+        try:
+            with open(mpath) as f:
+                return json.load(f)
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise ValueError(
+                f"registry version {model!r}/{v} holds a corrupt "
+                f"{VERSION_MANIFEST!r} ({type(e).__name__}: {e}); "
+                "republish the version") from e
+
+    def verify(self, model, version):
+        """Re-hash the stored files against the manifest; raises ValueError
+        on a torn (file missing) or corrupt (digest mismatch) version.
+        Returns the manifest. Note the deeper check — whether the bundle
+        actually LOADS — is ``load_inference_model``'s typed ValueError,
+        raised by the engine when a resolved version is served."""
+        path, v = self.resolve(model, version)
+        m = self.manifest(model, v)
+        for name, want in m.get("files", {}).items():
+            fpath = os.path.join(path, name)
+            if not os.path.exists(fpath):
+                raise ValueError(
+                    f"registry version {model!r}/{v} is torn: manifest "
+                    f"lists {name!r} but {fpath!r} is missing")
+            got = _sha256_file(fpath)
+            if got != want:
+                raise ValueError(
+                    f"registry version {model!r}/{v} is corrupt: "
+                    f"{name!r} hashes {got[:12]}… but the manifest "
+                    f"records {want[:12]}…")
+        if _content_hash(m.get("files", {})) != m.get("content_hash"):
+            raise ValueError(
+                f"registry version {model!r}/{v} is corrupt: content "
+                "hash does not match the manifest's file digests")
+        return m
+
+
+__all__ = ["ModelRegistry", "VERSION_MANIFEST"]

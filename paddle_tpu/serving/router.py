@@ -1,0 +1,295 @@
+"""FleetClient: a client-side load balancer over a replica fleet.
+
+The routing layer the reference delegated to an external LB sits client-
+side here (the gRPC "thick client" pattern): pick a replica by
+power-of-two-choices over in-flight counts, fail an idempotent ``infer``
+over to a DIFFERENT replica on connection failure, spill a typed
+``ServerOverloaded`` to the next replica before surfacing it, and keep a
+health view of the fleet — a replica that fails is EJECTED from the pick
+set and re-admitted only after a probation of consecutive successful
+background health probes (flapping replicas don't bounce in and out on a
+single lucky probe).
+
+Error taxonomy (what moves where):
+
+* connection failure (EOF mid-call, refused connect — a crashed or
+  restarting replica) — eject the replica, fail over to another; when a
+  whole sweep of the fleet fails this way, back off under the
+  ``rpc.RetryPolicy`` and sweep again (infer is stateless/idempotent, so
+  resending is always safe).
+* :class:`~.batcher.ServerOverloaded` (structured code over the wire) —
+  the replica is alive but saturated: NOT an ejection (health is fine),
+  just spill to the next replica; only when every available replica is
+  overloaded does the caller see the typed overload (never auto-retried —
+  retrying into a saturated fleet spreads collapse).
+* response timeout — ambiguous (the request may be executing), surfaced
+  to the caller like every other client in this codebase.
+* remote errors (``rpc.RemoteError``) — deterministic (a bad feed fails
+  identically on every replica): surfaced, no failover.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from ..core.flags import get_flag
+from ..core.profiler import LatencyWindow
+from ..distributed.rpc import RetryPolicy, RpcClient
+from .batcher import ServerOverloaded
+from .client import InferClient
+
+_CONN_ERRORS = (EOFError, ConnectionError, BrokenPipeError, OSError)
+
+
+class _Replica:
+    """Router-side view of one replica: a CONNECTION POOL (one RpcClient
+    serializes its socket, so concurrent requests to the same replica
+    each need their own connection — the pool's size tracks peak
+    concurrency and idle connections are reused), the in-flight count,
+    and the health/probation state (all mutated under the router lock)."""
+
+    __slots__ = ("address", "timeout", "free", "inflight", "healthy",
+                 "consec_ok", "ejections")
+
+    def __init__(self, address, timeout):
+        self.address = tuple(address)
+        self.timeout = timeout
+        self.free = []          # idle InferClients, LIFO (warm conn first)
+        self.inflight = 0
+        self.healthy = True
+        self.consec_ok = 0
+        self.ejections = 0
+
+    def acquire_locked(self):
+        """Check an idle connection out (caller holds the router lock) —
+        or a fresh one; retry=None because the ROUTER owns failure
+        policy: a per-connection retry would pin a request to a dead
+        replica for the whole backoff budget instead of failing over."""
+        if self.free:
+            return self.free.pop()
+        return InferClient(self.address, timeout=self.timeout, retry=None)
+
+    def release_locked(self, client, broken):
+        if broken:
+            client.close()
+        else:
+            self.free.append(client)
+
+    def close_all_locked(self):
+        while self.free:
+            self.free.pop().close()
+
+
+class FleetClient:
+    """``FleetClient(addresses)`` — balance infers over a replica set.
+
+    ``retry`` (default a stock ``RetryPolicy``) bounds the full-fleet
+    retry sweeps, NOT per-replica attempts; ``probe_interval_ms`` /
+    ``probation_probes`` default from the ``serving_probe_interval_ms`` /
+    ``serving_probation_probes`` flags."""
+
+    def __init__(self, addresses, timeout=None, retry=True,
+                 probe_interval_ms=None, probation_probes=None,
+                 probe_timeout=2.0):
+        if not addresses:
+            raise ValueError("FleetClient needs at least one replica "
+                             "address")
+        if retry is True:
+            retry = RetryPolicy()
+        self._retry = retry or None
+        self._replicas = [_Replica(a, timeout) for a in addresses]
+        self._lock = threading.Lock()
+        self.latency = LatencyWindow(name="fleet/request", kind="rpc")
+        self._requests = 0
+        self._failovers = 0
+        self._spillovers = 0
+        self._ejections = 0
+        if probe_interval_ms is None:
+            probe_interval_ms = get_flag("serving_probe_interval_ms")
+        self._probe_interval_s = float(probe_interval_ms) / 1e3
+        if probation_probes is None:
+            probation_probes = get_flag("serving_probation_probes")
+        self._probation = max(1, int(probation_probes))
+        self._probe_timeout = float(probe_timeout)
+        self._stop = threading.Event()
+        self._prober = threading.Thread(target=self._probe_loop,
+                                        daemon=True)
+        self._prober.start()
+
+    # ------------------------------------------------------------------
+    def _pick(self, tried):
+        """Power-of-two-choices over in-flight counts, healthy replicas
+        first; falls back to ejected ones (a refused connect is cheap and
+        beats stalling when the prober lags a restart). None when every
+        replica was tried this sweep."""
+        with self._lock:
+            pool = [r for r in self._replicas
+                    if r.healthy and id(r) not in tried]
+            if not pool:
+                pool = [r for r in self._replicas if id(r) not in tried]
+            if not pool:
+                return None
+            if len(pool) == 1:
+                r = pool[0]
+            else:
+                a, b = random.sample(pool, 2)
+                r = a if a.inflight <= b.inflight else b
+            r.inflight += 1
+            return r
+
+    def _release(self, r, client, broken):
+        with self._lock:
+            r.inflight -= 1
+            r.release_locked(client, broken)
+
+    def _eject(self, r):
+        with self._lock:
+            self._failovers += 1
+            if r.healthy:
+                r.healthy = False
+                r.ejections += 1
+                self._ejections += 1
+            r.consec_ok = 0
+            # pooled idle connections point at the dead incarnation; drop
+            # them so a re-admitted replica starts on fresh sockets
+            r.close_all_locked()
+
+    # ------------------------------------------------------------------
+    def infer(self, feed):
+        """One request through the fleet. Raises ``ServerOverloaded``
+        only when every available replica rejected it, connection errors
+        only when the whole fleet stayed unreachable through the retry
+        budget."""
+        with self._lock:
+            self._requests += 1
+        with self.latency.span():
+            attempt = 0
+            while True:
+                overload = None
+                conn_err = None
+                tried = set()
+                while True:
+                    r = self._pick(tried)
+                    if r is None:
+                        break
+                    tried.add(id(r))
+                    with self._lock:
+                        client = r.acquire_locked()
+                    broken = True    # returned to the pool only on success
+                    try:
+                        out = client.infer(feed)
+                        broken = False
+                        return out
+                    except ServerOverloaded as e:
+                        with self._lock:
+                            self._spillovers += 1
+                        broken = False   # replica alive; conn still good
+                        overload = e
+                    except TimeoutError:
+                        raise        # ambiguous: may be executing; surface
+                    except _CONN_ERRORS as e:
+                        self._eject(r)
+                        conn_err = e
+                    finally:
+                        self._release(r, client, broken)
+                if overload is not None:
+                    # every reachable replica is saturated: typed overload,
+                    # never auto-retried (see module docstring)
+                    raise overload
+                if conn_err is None:
+                    raise ConnectionError("fleet has no replicas to try")
+                if self._retry is None \
+                        or attempt >= self._retry.max_retries:
+                    raise conn_err
+                attempt += 1
+                time.sleep(self._retry.delay_s(attempt))
+
+    # ------------------------------------------------------------------
+    def _probe_loop(self):
+        """Background health probes for EJECTED replicas: ``_probation``
+        consecutive successes re-admit (one fluke doesn't); any failure
+        resets the streak. Healthy replicas are not probed — real traffic
+        is their probe."""
+        while not self._stop.wait(self._probe_interval_s):
+            for r in self._replicas:
+                if r.healthy or self._stop.is_set():
+                    continue
+                ok = False
+                try:
+                    c = RpcClient(r.address, timeout=self._probe_timeout)
+                    try:
+                        h = c.call("health")
+                        ok = (h.get("status") == "serving"
+                              and bool(h.get("warmed", True)))
+                    finally:
+                        c.close()
+                except Exception:
+                    ok = False
+                with self._lock:
+                    if ok:
+                        r.consec_ok += 1
+                        if r.consec_ok >= self._probation:
+                            r.healthy = True
+                    else:
+                        r.consec_ok = 0
+
+    # ------------------------------------------------------------------
+    def fleet_stats(self, include_server_stats=True):
+        """Aggregate view: per-replica health/in-flight/ejections (plus
+        each reachable replica's full server stats), router counters, and
+        client-observed latency percentiles."""
+        with self._lock:
+            reps = [{"address": f"{r.address[0]}:{r.address[1]}",
+                     "healthy": r.healthy, "inflight": r.inflight,
+                     "ejections": r.ejections} for r in self._replicas]
+            counters = {"requests": self._requests,
+                        "failovers": self._failovers,
+                        "spillovers": self._spillovers,
+                        "ejections": self._ejections}
+        engine = {"compiles": 0, "hits": 0, "hot_recompiles": 0}
+        versions = set()
+        if include_server_stats:
+            for entry, r in zip(reps, self._replicas):
+                try:
+                    c = RpcClient(r.address, timeout=self._probe_timeout)
+                    try:
+                        st = c.call("stats")
+                    finally:
+                        c.close()
+                except Exception:
+                    st = None
+                entry["server"] = st
+                if st is not None:
+                    for k in engine:
+                        engine[k] += st.get("engine", {}).get(k, 0)
+                    versions.add(st.get("version"))
+        lat = self.latency.snapshot()
+        out = {"replicas": reps,
+               "healthy": sum(1 for e in reps if e["healthy"]),
+               "p50_ms": lat["p50_ms"], "p99_ms": lat["p99_ms"]}
+        out.update(counters)
+        if include_server_stats:
+            out["engine"] = engine
+            out["versions"] = sorted(versions,
+                                     key=lambda v: (v is None, v))
+        return out
+
+    def close(self):
+        self._stop.set()
+        self._prober.join(self._probe_interval_s * 4
+                          + self._probe_timeout + 1.0)
+        with self._lock:
+            for r in self._replicas:
+                r.close_all_locked()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+__all__ = ["FleetClient"]

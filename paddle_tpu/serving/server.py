@@ -11,11 +11,20 @@ RPC surface (all reachable through :class:`~.client.InferClient`):
 * ``infer(feed=...)`` — run one request; the answer is the engine's fetch
   list trimmed to the request's rows. Stateless and idempotent, so
   clients retry it safely through server restarts (rpc.RetryPolicy).
-* ``health()`` — cheap liveness: status, queue depth, warmed flag.
+* ``health()`` — cheap liveness: status, queue depth, warmed flag, and
+  the serving model ``version`` (what a rolling rollout health-gates on).
 * ``stats()`` — engine bucket compile/hit counters, batcher queue/batch
   histogram, request-latency p50/p99 (an always-on
   ``core.profiler.LatencyWindow``; spans also land in chrome traces when
-  the global profiler is enabled), and the RPC layer's WireStats.
+  the global profiler is enabled), WireStats, plus the serving
+  ``version`` and a ``reloads`` counter.
+* ``reload(model_dir=..., version=...)`` — ZERO-DOWNTIME hot swap: the
+  new engine is built and warmed OFF the hot path (requests keep serving
+  from the old engine, including while the new buckets compile), then
+  swapped in under the engine lock. In-flight dispatches finish on the
+  old engine; the old private scope is dropped with its last reference;
+  ``hot_recompiles`` stays 0 across the swap because every new-engine
+  bucket compiled before the swap.
 
 Shutdown is a graceful DRAIN by default: stop accepting, let every
 in-flight request finish and be answered (flushing the batcher's queued
@@ -25,7 +34,8 @@ abrupt forms for tests and crash simulation.
 
 from __future__ import annotations
 
-from ..core.flags import get_flag
+import threading
+
 from ..core.profiler import LatencyWindow
 from ..distributed.rpc import RpcServer
 from .batcher import DynamicBatcher
@@ -47,6 +57,9 @@ class _ServingHandler:
     def stats(self):
         return self._server.stats()
 
+    def reload(self, model_dir, version=None):
+        return self._server.reload(model_dir, version=version)
+
 
 class ModelServer:
     """Serve one saved inference model.
@@ -54,23 +67,38 @@ class ModelServer:
         server = ModelServer(model_dir)            # batching on
         server.start()                             # warmup + serve
         ... InferClient(server.address).infer(...) ...
+        server.reload(new_model_dir, version=2)    # zero-downtime swap
         server.shutdown()                          # graceful drain
 
     ``batching=False`` dispatches each request through the engine
     individually (the A/B baseline the bench lane measures against).
     ``engine=`` substitutes a pre-built engine (shared scope, custom
-    buckets); ``fault_plan=`` reaches the underlying RpcServer for
-    deterministic crash injection in tests."""
+    buckets, or warmed BEFORE the address binds — the fleet replica
+    path); ``version=`` labels what is serving (a registry version,
+    surfaced by health/stats so rollouts can gate on it); ``fault_plan=``
+    reaches the underlying RpcServer for deterministic crash injection
+    in tests."""
 
     def __init__(self, model_dir=None, engine=None, address=("127.0.0.1", 0),
                  batching=True, max_delay_ms=None, queue_capacity=None,
-                 buckets=None, fault_plan=None):
+                 buckets=None, fault_plan=None, version=None):
         if engine is None:
             engine = InferenceEngine(model_dir, buckets=buckets)
         self.engine = engine
+        self.model_dir = model_dir
+        # the reload path rebuilds engines with the SAME bucket set, so
+        # the batcher's coalesce target stays valid across swaps
+        self._buckets = list(engine.buckets)
         self.batching = bool(batching)
+        # _engine_lock guards the engine REFERENCE (reload swaps it);
+        # dispatches read the reference under it and run outside it, so
+        # in-flight batches finish on the engine they started on
+        self._engine_lock = threading.Lock()
+        self._reload_lock = threading.Lock()   # serializes reloads
+        self._version = version
+        self._reloads = 0
         self.batcher = DynamicBatcher(
-            engine.infer, max_batch=engine.max_batch,
+            self._engine_infer, max_batch=engine.max_batch,
             max_delay_ms=max_delay_ms, capacity=queue_capacity) \
             if self.batching else None
         self.latency = LatencyWindow(name="serving/request", kind="rpc")
@@ -83,6 +111,10 @@ class ModelServer:
     def address(self):
         return self._rpc.address
 
+    @property
+    def version(self):
+        return self._version
+
     def start(self, warmup_feed=None, warmup=True):
         """Warm every bucket (so the serving hot path never compiles),
         then serve in a background thread. Returns the bound address."""
@@ -92,26 +124,69 @@ class ModelServer:
         self._rpc.serve_in_thread()
         return self.address
 
+    def serve_forever(self, warmup_feed=None, warmup=True):
+        """Like :meth:`start` but serves in the CALLING thread — the
+        fleet replica child entry point (returns when the server is
+        killed or shut down)."""
+        if warmup:
+            self.engine.warmup(warmup_feed)
+        self._serving = True
+        self._rpc.serve_forever()
+
     # ------------------------------------------------------------------
+    def _current_engine(self):
+        with self._engine_lock:
+            return self.engine
+
+    def _engine_infer(self, feed, fetch_list=None):
+        # read the engine reference under the lock, dispatch outside it:
+        # a reload swapping mid-batch never strands this dispatch, it
+        # just completes on the engine it started on
+        return self._current_engine().infer(feed, fetch_list)
+
     def run_infer(self, feed):
         with self.latency.span():
             if self.batcher is not None:
                 return self.batcher.submit(feed)
-            return self.engine.infer(feed)
+            return self._engine_infer(feed)
+
+    def reload(self, model_dir, version=None):
+        """Zero-downtime hot swap to the model at ``model_dir``: build a
+        NEW engine (own private scope) and warm every bucket OFF the hot
+        path — the old engine keeps serving throughout, so a rollout
+        never makes this replica unready — then swap the reference under
+        the engine lock. In-flight requests finish on the old engine; its
+        scope is dropped with the last reference. Raises (and keeps the
+        old engine serving) if the new bundle fails to load
+        (``load_inference_model``'s typed ValueError) or fails warmup.
+        Returns the new serving version and the warmup compile count."""
+        with self._reload_lock:
+            new = InferenceEngine(model_dir, buckets=self._buckets)
+            compiled = new.warmup()          # off the hot path: old engine
+            with self._engine_lock:          # still answers during this
+                self.engine = new
+                self.model_dir = model_dir
+                self._version = version
+                self._reloads += 1
+        return {"version": version, "compiles": compiled}
 
     def health(self):
+        engine = self._current_engine()
         out = {"status": "serving" if self._serving else "stopped",
-               "warmed": self.engine.stats()["warmed"],
+               "warmed": engine.stats()["warmed"],
                "batching": self.batching,
+               "version": self._version,
                "queue_depth": 0}
         if self.batcher is not None:
             out["queue_depth"] = self.batcher.stats()["queue_depth"]
         return out
 
     def stats(self):
-        out = {"engine": self.engine.stats(),
+        out = {"engine": self._current_engine().stats(),
                "latency": self.latency.snapshot(),
-               "wire": self._rpc.wire_stats.snapshot()}
+               "wire": self._rpc.wire_stats.snapshot(),
+               "version": self._version,
+               "reloads": self._reloads}
         if self.batcher is not None:
             out["batcher"] = self.batcher.stats()
         return out

@@ -1,0 +1,39 @@
+"""Flags-vs-docs drift guard (the test_packaging.py pattern: run the
+repo tool as a subprocess and gate tier-1 on its exit code): every
+``DEFINE_flag`` in ``core/flags.py`` must have a row in the README flags
+table, so a PR adding a flag without documenting it fails here instead
+of silently rotting the docs."""
+
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "check_flags_doc.py")
+
+
+def test_every_flag_documented_in_readme():
+    r = subprocess.run([sys.executable, TOOL], capture_output=True,
+                       text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+
+
+def test_checker_actually_detects_drift():
+    """The guard must FAIL on a missing row — pin the detection, not just
+    the happy path (a regexp that matches nothing passes vacuously)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_flags_doc as mod
+    finally:
+        sys.path.pop(0)
+    flags = mod.defined_flags(open(mod.FLAGS_PY).read())
+    assert len(flags) >= 20 and "serving_fleet_replicas" in flags
+    documented = mod.documented_flags(open(mod.README).read())
+    assert set(flags) <= documented
+    # strip one row: the checker must notice
+    readme = open(mod.README).read()
+    broken = re.sub(r"^\|\s*`serving_fleet_replicas`.*\n", "", readme,
+                    flags=re.MULTILINE)
+    assert "serving_fleet_replicas" not in mod.documented_flags(broken)

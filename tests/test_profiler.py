@@ -74,3 +74,50 @@ def test_profiler_off_records_nothing():
     exe.run(startup)
     exe.run(main, feed=_feed(np.random.RandomState(2)), fetch_list=[loss])
     assert core_prof.events() == []
+
+
+# ---------------------------------------------------------------------------
+# LatencyWindow edges: empty window, single sample, capacity wraparound
+# ---------------------------------------------------------------------------
+
+def test_latency_window_empty():
+    w = core_prof.LatencyWindow(capacity=8)
+    snap = w.snapshot()
+    # health endpoints read these straight: no samples must mean zeros,
+    # never a divide-by-zero or a missing key
+    assert snap == {"count": 0, "window": 0, "p50_ms": 0.0, "p99_ms": 0.0}
+    assert w.percentiles((50, 90, 99)) == {50: 0.0, 90: 0.0, 99: 0.0}
+
+
+def test_latency_window_single_sample():
+    w = core_prof.LatencyWindow(capacity=8)
+    w.record(0.004)                    # 4 ms
+    snap = w.snapshot()
+    assert snap["count"] == 1 and snap["window"] == 1
+    # every percentile of a single sample IS that sample
+    np.testing.assert_allclose(snap["p50_ms"], 4.0)
+    np.testing.assert_allclose(snap["p99_ms"], 4.0)
+    np.testing.assert_allclose(snap["max_ms"], 4.0)
+
+
+def test_latency_window_capacity_wraparound_percentiles():
+    w = core_prof.LatencyWindow(capacity=8)
+    for ms in range(12):               # 0..11 ms; ring keeps the LAST 8
+        w.record(ms / 1e3)
+    snap = w.snapshot()
+    assert snap["count"] == 12 and snap["window"] == 8
+    # the window holds 4..11: percentiles are over THOSE, the evicted
+    # 0..3 must not drag the percentiles down
+    np.testing.assert_allclose(snap["p50_ms"], np.percentile(
+        np.arange(4, 12), 50), rtol=1e-6)
+    np.testing.assert_allclose(snap["max_ms"], 11.0)
+    ps = w.percentiles((0, 50, 100))
+    np.testing.assert_allclose(ps[0], 4.0)
+    np.testing.assert_allclose(ps[100], 11.0)
+    # keep wrapping a full extra lap: still exactly the last 8
+    for ms in range(12, 24):
+        w.record(ms / 1e3)
+    snap = w.snapshot()
+    assert snap["window"] == 8 and snap["count"] == 24
+    np.testing.assert_allclose(snap["p50_ms"], np.percentile(
+        np.arange(16, 24), 50), rtol=1e-6)

@@ -254,6 +254,86 @@ def test_batcher_rejects_non_per_row_fetches():
     assert b.close()
 
 
+def test_batcher_close_answers_queued_requests():
+    """Requests already queued when close() lands are FLUSHED — their
+    callers get real results, not an error and never a hang."""
+    release = threading.Event()
+    calls = []
+
+    def gated(feed):
+        calls.append(feed["v"].shape[0])
+        release.wait(5.0)             # first batch holds the worker busy
+        return [feed["v"] * 2.0]
+
+    b = DynamicBatcher(gated, max_batch=1, max_delay_ms=1, capacity=16)
+    results = {}
+
+    def caller(i):
+        results[i] = b.submit({"v": np.full((1, 1), float(i))})
+
+    ts = [threading.Thread(target=caller, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    deadline = time.monotonic() + 2.0
+    while not calls and time.monotonic() < deadline:
+        time.sleep(0.005)             # worker holds batch 0, rest queued
+    closed = []
+    ct = threading.Thread(target=lambda: closed.append(b.close(10.0)))
+    ct.start()
+    time.sleep(0.05)
+    release.set()                     # un-wedge: close must now flush
+    ct.join(10.0)
+    for t in ts:
+        t.join(10.0)
+    assert closed == [True]
+    for i in range(4):                # every queued caller was ANSWERED
+        np.testing.assert_array_equal(results[i][0],
+                                      np.full((1, 1), 2.0 * i))
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit({"v": np.zeros((1, 1), np.float32)})
+
+
+def test_batcher_close_rejects_queued_typed_when_worker_wedged():
+    """A run_batch that NEVER returns must not hang queued callers across
+    close(): the undispatched queue is rejected with a typed
+    RuntimeError when the join times out."""
+    wedged = threading.Event()
+
+    def black_hole(feed):
+        wedged.set()
+        threading.Event().wait()      # never returns
+
+    b = DynamicBatcher(black_hole, max_batch=1, max_delay_ms=1, capacity=16)
+    outcomes = {}
+
+    def caller(i):
+        try:
+            b.submit({"v": np.full((1, 1), float(i))})
+            outcomes[i] = "ok"
+        except RuntimeError as e:
+            outcomes[i] = e
+
+    # daemon: caller 0 stays parked in the wedged batch forever by
+    # construction — it must not block interpreter exit
+    ts = [threading.Thread(target=caller, args=(i,), daemon=True)
+          for i in range(3)]
+    for t in ts:
+        t.start()
+    assert wedged.wait(5.0)           # caller 0's batch is in the hole
+    deadline = time.monotonic() + 2.0
+    while b.stats()["queue_depth"] < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)             # callers 1,2 queued behind it
+    assert b.close(timeout=0.3) is False    # worker is wedged
+    for t in ts[1:]:
+        t.join(5.0)                   # queued callers came back...
+        assert not t.is_alive()
+    rejected = [v for v in outcomes.values()
+                if isinstance(v, RuntimeError)]
+    assert len(rejected) == 2         # ...with the TYPED rejection
+    assert all("rejected without being served" in str(e)
+               for e in rejected)
+
+
 def test_batcher_propagates_errors_and_flushes_on_close():
     def failing(feed):
         raise ValueError("model exploded")

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Guard against flags-vs-docs drift: every ``DEFINE_flag`` name in
+``paddle_tpu/core/flags.py`` must appear as a row in the README's flags
+table (a ``| `name` | ... |`` line). Regex-parses the source instead of
+importing it, so the check runs without a jax runtime (and without
+paying the package import in CI).
+
+Exit 0 when the docs cover every flag; exit 1 listing the missing ones.
+Wired into tier-1 via tests/test_flags_doc.py.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLAGS_PY = os.path.join(REPO, "paddle_tpu", "core", "flags.py")
+README = os.path.join(REPO, "README.md")
+
+
+def defined_flags(flags_src):
+    """DEFINE_flag("name", ...) occurrences, in definition order."""
+    return re.findall(r'DEFINE_flag\(\s*["\']([A-Za-z0-9_]+)["\']',
+                      flags_src)
+
+
+def documented_flags(readme_src):
+    """Flag names with a markdown table row: | `name` | ... |"""
+    return set(re.findall(r'^\|\s*`([A-Za-z0-9_]+)`\s*\|', readme_src,
+                          flags=re.MULTILINE))
+
+
+def main():
+    with open(FLAGS_PY) as f:
+        flags = defined_flags(f.read())
+    if not flags:
+        print(f"check_flags_doc: no DEFINE_flag found in {FLAGS_PY} — "
+              "the parser is broken, not the docs", file=sys.stderr)
+        return 1
+    with open(README) as f:
+        documented = documented_flags(f.read())
+    missing = [n for n in flags if n not in documented]
+    if missing:
+        print("check_flags_doc: flags missing from the README flags "
+              f"table ({len(missing)} of {len(flags)}):", file=sys.stderr)
+        for n in missing:
+            print(f"  | `{n}` | <default> | <what it does> |",
+                  file=sys.stderr)
+        print("add a row per flag to the 'Flags' table in README.md",
+              file=sys.stderr)
+        return 1
+    print(f"check_flags_doc: OK — {len(flags)} flags all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
