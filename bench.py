@@ -88,7 +88,11 @@ Round-5 numbers (v5e single chip, shared dev machine):
 
 Prints one json line per lane, the flagship ResNet line LAST:
 {"metric", "value", "unit", "vs_baseline"} (+ jnp/pallas detail for the
-LSTM lane, reference benchmark/README.md:115-127 protocol).
+LSTM lane, reference benchmark/README.md:115-127 protocol). Every record
+carries "kernel_tier" (what the --kernel-tier/kernel_tier flag resolved
+to); when the tier resolves to pallas the flagship program is built
+FUSED (fuse_conv_bn + fused_momentum) and the fused_kernels_microbench
+lane A/Bs the new kernels against their jnp twins.
 """
 
 import argparse
@@ -103,6 +107,16 @@ import numpy as np
 # dimension so BN reductions reduce across sublanes and elementwise tiles
 # align — measured ~2x step time vs NCHW for this model on v5e.
 LAYOUT = "NHWC"
+
+
+def _rec(d):
+    """Stamp every lane record with the ACTIVE kernel tier (what the
+    kernel_tier flag resolved to for this process) so bench JSON rows are
+    attributable to the lowering tier that produced them."""
+    from paddle_tpu.ops.pallas import resolve_tier
+    out = dict(d)
+    out.setdefault("kernel_tier", resolve_tier())
+    return out
 
 
 def conv_bn_layer(input, num_filters, filter_size, stride=1, padding=None,
@@ -147,7 +161,11 @@ def resnet50(img, class_dim=1000):
     return fluid.layers.fc(input=pool, size=class_dim, act=None)
 
 
-def build(batch, image_size, class_dim):
+def build(batch, image_size, class_dim, fuse=False):
+    """``fuse=True`` (the Pallas-tier flagship config) rewrites the
+    conv→bn(→relu) chains into fused_conv2d_bn ops (fluid.fuse_conv_bn,
+    BEFORE minimize so the backward fuses too) and emits the momentum
+    update as ONE fused_momentum op instead of ~160 per-param ops."""
     import paddle_tpu.fluid as fluid
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
@@ -158,8 +176,10 @@ def build(batch, image_size, class_dim):
         logits = resnet50(img, class_dim)
         loss = fluid.layers.softmax_with_cross_entropy(logits, label)
         avg_loss = fluid.layers.mean(loss)
-        fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
-            avg_loss, startup)
+        if fuse:
+            fluid.fuse_conv_bn(main)
+        fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                 fused=fuse).minimize(avg_loss, startup)
     return main, startup, avg_loss
 
 
@@ -855,6 +875,165 @@ def run_fleet_serving_lane(n_clients=8, min_requests_per_client=30,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_fused_kernels_lane(smoke):
+    """A/B microbench for the two new kernel-tier families against their
+    jnp twins, measured OUTSIDE the Program machinery so the numbers
+    isolate the kernels:
+
+    * **conv_bn_relu**: one training fwd+bwd of a ResNet-block-shaped
+      conv+bn+relu — the fused Pallas pair (ops/pallas/conv_bn.py; conv
+      block VMEM-resident through stats/normalize/act, recomputed in the
+      bwd) vs the jnp chain under one jit (XLA's own conv+stat fusion).
+    * **optimizer_step**: one fused-momentum step over ~ResNet-50's param
+      -count worth of tensors — ONE arena megakernel (incl. the honest
+      concat/split the op pays) vs the per-param update loop XLA compiles
+      to one tiny kernel per parameter.
+
+    On CPU (smoke) the kernels run in INTERPRET mode: parity is asserted,
+    timings are printed but meaningless, and no gate applies. On TPU the
+    acceptance gate is >= 1.15x per family.
+    """
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import conv_bn as cbk
+    from paddle_tpu.ops.pallas import optimizer as opk
+
+    on_tpu = jax.default_backend() == "tpu"
+    eps = 1e-5
+
+    def best_ms(fn, args, steps, warmup):
+        for _ in range(warmup):
+            jax.block_until_ready(fn(*args))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - t0) / steps)
+        return best * 1e3
+
+    # ---- conv+bn+relu fwd+bwd ----
+    if smoke:
+        n, h, cin, cout, steps, warmup = 2, 8, 8, 8, 2, 1
+        dtype = jnp.float32
+    else:
+        n, h, cin, cout, steps, warmup = 32, 28, 128, 128, 16, 4
+        dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.normal(0, 1, (n, h, h, cin)).astype("float32"),
+                    ).astype(dtype)
+    w = jnp.asarray(rng.normal(0, 0.1, (cout, cin, 3, 3)).astype("float32"),
+                    ).astype(dtype)
+    scale = jnp.asarray(rng.uniform(0.5, 1.5, cout).astype("float32"))
+    bias = jnp.asarray(rng.normal(0, 0.2, cout).astype("float32"))
+    dy = jnp.asarray(rng.normal(0, 1, (n, h, h, cout)).astype("float32"),
+                     ).astype(dtype)
+
+    def fused_step(x, w, scale, bias, dy):
+        y, m, v = cbk.conv_bn_train_pallas(x, w, scale, bias, eps, (1, 1),
+                                           (1, 1), "relu")
+        dx, dw, ds, db = cbk.conv_bn_bwd_pallas(x, w, dy, scale, bias, m, v,
+                                                eps, (1, 1), (1, 1), "relu")
+        return y, dx, dw, ds, db
+
+    def twin_step(x, w, scale, bias, dy):
+        from jax import lax
+
+        def fwd(x, w, scale, bias):
+            z = lax.conv_general_dilated(
+                x, w, (1, 1), [(1, 1), (1, 1)],
+                dimension_numbers=("NHWC", "OIHW", "NHWC"))
+            zf = z.astype(jnp.float32)
+            m = jnp.mean(zf, axis=(0, 1, 2))
+            v = jnp.maximum(jnp.mean(zf * zf, axis=(0, 1, 2)) - m * m, 0.0)
+            inv = jax.lax.rsqrt(v + eps)
+            y = jnp.maximum(zf * (scale * inv) + (bias - m * scale * inv),
+                            0.0).astype(x.dtype)
+            return y, (m, v)
+
+        y, vjp, (m, v) = jax.vjp(
+            lambda x, w, s, b: fwd(x, w, s, b), x, w, scale, bias,
+            has_aux=True)
+        dx, dw, ds, db = vjp(dy.astype(y.dtype))
+        return y, dx, dw, ds, db
+
+    fused_jit = jax.jit(fused_step)
+    twin_jit = jax.jit(twin_step)
+    if not on_tpu:
+        got = fused_jit(x, w, scale, bias, dy)
+        want = twin_jit(x, w, scale, bias, dy)
+        np.testing.assert_allclose(np.asarray(got[0], np.float32),
+                                   np.asarray(want[0], np.float32),
+                                   rtol=5e-3, atol=1e-4)
+    conv_fused_ms = best_ms(fused_jit, (x, w, scale, bias, dy), steps,
+                            warmup)
+    conv_twin_ms = best_ms(twin_jit, (x, w, scale, bias, dy), steps, warmup)
+
+    # ---- fused optimizer step (momentum, the flagship's optimizer) ----
+    if smoke:
+        shapes = [(64, 16)] * 8 + [(16,)] * 8
+        steps, warmup = 2, 1
+    else:
+        # ~ResNet-50's parameter census: ~160 tensors, ~25M floats
+        shapes = ([(512, 512, 3, 3)] * 4 + [(256, 256, 3, 3)] * 12
+                  + [(128, 128, 3, 3)] * 12 + [(64, 64, 3, 3)] * 6
+                  + [(2048, 512)] * 6 + [(512, 128)] * 20
+                  + [(2048,)] * 20 + [(512,)] * 40 + [(64,)] * 40)
+        steps, warmup = 16, 4
+    ps = [jnp.asarray(rng.normal(0, 1, s).astype("float32"))
+          for s in shapes]
+    gs = [jnp.asarray(rng.normal(0, 1e-3, s).astype("float32"))
+          for s in shapes]
+    vs = [jnp.zeros(s, jnp.float32) for s in shapes]
+    lr, mu = 0.1, 0.9
+
+    def fused_opt(ps, gs, vs):
+        # includes the honest arena concat/split the fused op pays
+        pa, _ = opk.flatten_arena(ps)
+        ga, _ = opk.flatten_arena(gs)
+        va, _ = opk.flatten_arena(vs)
+        po, vo = opk.momentum_arena_pallas(pa, ga, va, lr, mu)
+        return (opk.split_arena(po, shapes), opk.split_arena(vo, shapes))
+
+    def twin_opt(ps, gs, vs):
+        new_p, new_v = [], []
+        for p, g, v in zip(ps, gs, vs):
+            vn = mu * v + g
+            new_p.append(p - lr * vn)
+            new_v.append(vn)
+        return new_p, new_v
+
+    fused_opt_jit = jax.jit(fused_opt)
+    twin_opt_jit = jax.jit(twin_opt)
+    if not on_tpu:
+        got_p, got_v = fused_opt_jit(ps, gs, vs)
+        want_p, want_v = twin_opt_jit(ps, gs, vs)
+        for a, b in zip(got_p, want_p):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-7)
+    opt_fused_ms = best_ms(fused_opt_jit, (ps, gs, vs), steps, warmup)
+    opt_twin_ms = best_ms(twin_opt_jit, (ps, gs, vs), steps, warmup)
+
+    out = {
+        "conv_bn_relu": {"pallas_ms": round(conv_fused_ms, 3),
+                         "jnp_ms": round(conv_twin_ms, 3),
+                         "speedup": round(conv_twin_ms / conv_fused_ms, 4)},
+        "optimizer_step": {"pallas_ms": round(opt_fused_ms, 3),
+                           "jnp_ms": round(opt_twin_ms, 3),
+                           "speedup": round(opt_twin_ms / opt_fused_ms, 4)},
+        "gate": 1.15,
+        # the >=1.15x acceptance applies on TPU only: interpret-mode CPU
+        # timings measure the interpreter, not the kernels
+        "gate_applies": bool(on_tpu),
+    }
+    if on_tpu:
+        out["gate_ok"] = bool(
+            out["conv_bn_relu"]["speedup"] >= 1.15
+            and out["optimizer_step"]["speedup"] >= 1.15)
+    return out
+
+
 def _best_of(run_fn, label, repeats, **kw):
     """Best-of-N jnp and Pallas timings for one RNN lane; the shared dev
     chip shows large run-to-run variance (8.7..14.4 ms for the identical
@@ -899,6 +1078,13 @@ def main():
     ap.add_argument("--bn-bf16-stats", action="store_true",
                     help="A/B probe: bf16 accumulators for BN batch "
                          "statistics (flags.bn_bf16_stats)")
+    ap.add_argument("--kernel-tier", default="auto",
+                    choices=("auto", "pallas", "jnp"),
+                    help="kernel tier for every lane (flags.kernel_tier): "
+                         "auto = Pallas on TPU for the measured-win set, "
+                         "jnp elsewhere; the flagship lane additionally "
+                         "fuses conv+bn chains and the momentum step when "
+                         "the tier resolves to pallas")
     args = ap.parse_args()
 
     if args.smoke:
@@ -907,6 +1093,8 @@ def main():
 
     import jax
     import paddle_tpu.fluid as fluid
+
+    fluid.set_flags({"kernel_tier": args.kernel_tier})
 
     if args.smoke:
         batch, image_size, class_dim = 8, 32, 10
@@ -920,7 +1108,7 @@ def main():
                    sparse_rows=(16, 128), table_shape=(2048, 32)) \
         if args.smoke else {}
     wire = run_pserver_wire_lane(**wire_kw)
-    print(json.dumps({
+    print(json.dumps(_rec({
         "metric": "pserver_wire_throughput"
                   + ("_smoke" if args.smoke else ""),
         "value": round(wire["framed"]["mb_s"], 1),
@@ -933,7 +1121,7 @@ def main():
         "pickle_steps_s": round(wire["pickle"]["steps_s"], 1),
         "framed_steps_s": round(wire["framed"]["steps_s"], 1),
         "sparse": wire["sparse"],
-    }))
+    })))
 
     # ---- serving lane (dynamic-batching model server milestone) ----
     # smoke keeps the model weight-streaming-bound (see the lane's sizing
@@ -942,7 +1130,7 @@ def main():
     serving_kw = dict(requests_per_client=24, feature_dim=128, hidden=1024,
                       depth=3, max_delay_ms=2.0) if args.smoke else {}
     sv = run_serving_lane(**serving_kw)
-    print(json.dumps({
+    print(json.dumps(_rec({
         "metric": "serving_throughput" + ("_smoke" if args.smoke else ""),
         "value": round(sv["batched"]["qps"], 1),
         "unit": "QPS, 8 concurrent 1-row clients, dynamic batching on",
@@ -957,14 +1145,14 @@ def main():
         # asserted zero inside the lane: after warmup the engine serves
         # from bucket-cache hits only
         "hot_recompiles": sv["batched"]["hot_recompiles"],
-    }))
+    })))
 
     # ---- fleet serving lane (control-plane milestone: versioned
     # registry + supervised replicas + rolling reload under chaos) ----
     fleet_kw = dict(min_requests_per_client=24, feature_dim=64, hidden=256,
                     depth=2, max_delay_ms=2.0) if args.smoke else {}
     fl = run_fleet_serving_lane(**fleet_kw)
-    print(json.dumps({
+    print(json.dumps(_rec({
         "metric": "fleet_serving" + ("_smoke" if args.smoke else ""),
         "value": round(fl["fleet_2"]["qps"], 1),
         "unit": "QPS, 8 FleetClients, 2-replica fleet surviving a mid-run "
@@ -983,7 +1171,18 @@ def main():
         "hot_recompiles": 0,
         "failovers": fl["fleet_2"]["failovers"],
         "replica_restarts": fl["fleet_2"]["restarts"],
-    }))
+    })))
+
+    # ---- fused-kernel microbench lane (Pallas kernel tier milestone) ----
+    fk = run_fused_kernels_lane(args.smoke)
+    print(json.dumps(_rec({
+        "metric": "fused_kernels_microbench" + ("_smoke" if args.smoke else ""),
+        "value": fk["conv_bn_relu"]["speedup"],
+        "unit": "x fused conv+bn+relu (fwd+bwd) vs its jnp twin "
+                "(interpret-mode parity only on CPU; gate applies on TPU)",
+        "vs_baseline": fk["conv_bn_relu"]["speedup"],
+        **fk,
+    })))
 
     # ---- host input pipeline lane (reader pool milestone) ----
     pipe_kw = dict(n_files=2, records_per_file=16, image_hw=64,
@@ -991,7 +1190,7 @@ def main():
     pipe_kw["fetch_latency_s"] = 0.0025
     rps = run_input_pipeline_lane(**pipe_kw)
     t_lo, t_hi = min(rps), max(rps)
-    print(json.dumps({
+    print(json.dumps(_rec({
         "metric": "input_pipeline_throughput"
                   + ("_smoke" if args.smoke else ""),
         "value": round(rps[t_hi], 1),
@@ -1004,7 +1203,7 @@ def main():
         f"thread{t_hi}_rps": round(rps[t_hi], 1),
         "modeled_fetch_latency_ms": round(
             pipe_kw["fetch_latency_s"] * 1000, 3),
-    }))
+    })))
 
     # ---- LSTM text-cls lane (reference benchmark/README.md:115-127) ----
     # printed BEFORE the flagship line so the driver's single-line parse
@@ -1017,7 +1216,7 @@ def main():
         best, jnp_ms, pallas_ms = _best_of(run_lstm_lane, "lstm", repeats,
                                            **lstm_kw)
         lstm_baseline = 184.0  # K40m ms/batch, bs64 hid512 (BASELINE.md)
-        print(json.dumps({
+        print(json.dumps(_rec({
             "metric": "lstm_textcls_train_ms_batch"
                       + ("_smoke" if args.smoke else ""),
             "value": round(best, 3),
@@ -1030,11 +1229,11 @@ def main():
             # time, a regression-detection bound rather than an aspiration
             "abs_gate_ms": 12.0,
             "abs_gate_ok": bool(args.smoke or best <= 12.0),
-        }))
+        })))
         ragged_kw = dict(batch=8, hidden=16, n_seqs=64, vocab=200) \
             if args.smoke else {}
         flat_ms, bucketed_ms = run_lstm_ragged_lane(**ragged_kw)
-        print(json.dumps({
+        print(json.dumps(_rec({
             "metric": "lstm_ragged_bucketing_speedup"
                       + ("_smoke" if args.smoke else ""),
             "value": round(flat_ms / bucketed_ms, 4),
@@ -1043,7 +1242,7 @@ def main():
             "vs_baseline": round(flat_ms / bucketed_ms, 4),
             "flat_ms_sample": round(flat_ms, 4),
             "bucketed_ms_sample": round(bucketed_ms, 4),
-        }))
+        })))
 
     from paddle_tpu.core.flags import set_flags
     if args.with_gru:
@@ -1053,7 +1252,7 @@ def main():
         repeats = 1 if args.smoke else 2
         gru_best, gru_jnp, gru_pallas = _best_of(run_gru_lane, "gru",
                                                  repeats, **gru_kw)
-        print(json.dumps({
+        print(json.dumps(_rec({
             "metric": "gru_textcls_train_ms_batch"
                       + ("_smoke" if args.smoke else ""),
             "value": round(gru_best, 3),
@@ -1066,7 +1265,7 @@ def main():
                               else round(gru_jnp / gru_pallas, 4),
             "jnp_ms": round(gru_jnp, 3),
             "pallas_ms": None if gru_pallas is None else round(gru_pallas, 3),
-        }))
+        })))
 
     if args.bn_barrier:
         set_flags({"bn_fusion_barrier": True})
@@ -1075,7 +1274,15 @@ def main():
     # space-to-depth stem: exact rewrite of the 7x7/s2 C=3 stem conv as a
     # 4x4/s1 conv over 112x112x12 (parity-tested in tests/test_conv_s2d.py)
     set_flags({"conv_space_to_depth": not args.no_s2d})
-    main_prog, startup, avg_loss = build(batch, image_size, class_dim)
+    # kernel tier: when the tier resolves to Pallas, the flagship program
+    # is built FUSED — conv+bn(+relu) chains as fused_conv2d_bn ops and
+    # the momentum tail as one fused_momentum op — so the lane measures
+    # the tier end to end (jnp-tier runs keep the unfused program, whose
+    # numerics are the pre-tier baseline bitwise)
+    from paddle_tpu.ops.pallas import resolve_tier
+    fuse = resolve_tier() == "pallas"
+    main_prog, startup, avg_loss = build(batch, image_size, class_dim,
+                                         fuse=fuse)
 
     # Pre-stage a rotating pool of device-resident batches: the benchmark
     # measures the training computation; per-step host→device streaming is the
@@ -1126,12 +1333,12 @@ def main():
         assert np.isfinite(loss_v), f"non-finite loss {loss_v}"
     images_per_sec = steps * batch / elapsed
     baseline = 3000.0  # BASELINE.json: ResNet-50 >= 3000 images/sec/chip
-    print(json.dumps({
+    print(json.dumps(_rec({
         "metric": "resnet50_train_throughput" + ("_smoke" if args.smoke else ""),
         "value": round(images_per_sec, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(images_per_sec / baseline, 4),
-    }))
+    })))
     return 0
 
 

@@ -82,7 +82,20 @@ DEFINE_flag("check_nan_inf", False,
 DEFINE_flag("benchmark", False,
             "log per-op timing in eager mode — reference --benchmark "
             "(executor.cc:321-324)")
+DEFINE_flag("kernel_tier", "auto",
+            "which lowering tier the hot-op dispatch sites use: 'auto' "
+            "(Pallas on TPU for the kernels measured to win — see "
+            "ops/pallas.AUTO_PALLAS — jnp elsewhere, so CPU suites never "
+            "pay interpret-mode kernels), 'pallas' (Pallas everywhere it "
+            "has a lowering; interpret mode on CPU — the parity-test "
+            "setting), or 'jnp' (the plain jax.numpy lowerings, bitwise "
+            "the pre-tier behavior). Per-kernel fallback: an unsupported "
+            "shape under a Pallas tier routes to the jnp twin silently "
+            "and bumps ops.pallas.fallback_counts()")
+
 DEFINE_flag("use_pallas_rnn", False,
+            "DEPRECATED (use kernel_tier; still honored — True forces the "
+            "Pallas path for the RNN kernels, with a one-time warning): "
             "use the Pallas whole-recurrence kernels (the hand-scheduled "
             "hl_cuda_lstm.cu analogs): LSTM and GRU each run their WHOLE "
             "sequence as one kernel with the recurrent weight VMEM-"
@@ -100,6 +113,8 @@ DEFINE_flag("xla_compiler_options", "",
             "reference's backend gflags (platform/gpu_info.cc)")
 
 DEFINE_flag("use_pallas_ctc", False,
+            "DEPRECATED (use kernel_tier; still honored — True forces the "
+            "Pallas CTC path, with a one-time warning): "
             "use the Pallas whole-recurrence CTC forward (alpha kept "
             "VMEM-resident across time, the warp-ctc shared-memory "
             "pattern) inside warpctc; default off — numerics pinned "

@@ -33,6 +33,7 @@ from .backward import append_backward
 from .param_attr import ParamAttr
 from .data_feeder import DataFeeder
 from .memory_optimization_transpiler import memory_optimize, release_memory
+from .fusion import fuse_conv_bn
 from .distribute_transpiler import (DistributeTranspiler,
                                     SimpleDistributeTranspiler)
 from .param_attr import WeightNormParamAttr
@@ -54,6 +55,7 @@ __all__ = [
     "set_flags", "get_flag", "flags", "init_flags", "evaluator",
     "concurrency", "Go", "Select", "make_channel", "channel_send",
     "channel_recv", "channel_close", "memory_optimize", "release_memory",
+    "fuse_conv_bn",
     "DistributeTranspiler", "SimpleDistributeTranspiler",
     "WeightNormParamAttr", "average", "recordio_writer", "executor",
     "LoDTensor",

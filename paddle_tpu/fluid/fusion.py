@@ -1,0 +1,91 @@
+"""Program-level op fusion passes targeting the Pallas kernel tier.
+
+``fuse_conv_bn`` rewrites every eligible ``conv2d → batch_norm (→ relu)``
+chain in a program's global block into ONE ``fused_conv2d_bn`` op
+(ops/fused_ops.py) whose lowering picks the fused Pallas kernel or the
+bitwise jnp twin per dispatch (the kernel tier's job, so the PROGRAM
+rewrite is tier-independent and safe to apply unconditionally). Run it
+BEFORE ``append_backward``/``minimize`` — the fused op carries its own
+grad maker, so the backward of a fused program is fused too.
+
+Eligibility is purely structural: the conv must feed the batch_norm's X
+directly (bias-free conv — ``conv_bn_layer``'s shape), the intermediate
+must have no other consumer, and conv ``data_format`` must equal bn
+``data_layout``. Kernel-size/stride/shape eligibility is NOT checked here
+— unsupported shapes execute the fused op's jnp twin (bitwise the unfused
+chain) with a tier fallback-counter bump.
+
+Caveat: the conv output (and the bn Y, when a relu is folded) cease to
+exist as program variables — fetching those intermediates from a fused
+program raises a clean undefined-variable error.
+"""
+
+from __future__ import annotations
+
+from .framework import Operator
+
+
+def fuse_conv_bn(program):
+    """Fuse conv2d→batch_norm(→relu) chains in block 0, in place.
+    Returns the number of chains fused."""
+    block = program.global_block()
+    uses: dict = {}
+    for op in block.ops:
+        for n in op.input_arg_names():
+            uses[n] = uses.get(n, 0) + 1
+
+    ops = block.ops
+    new_ops = []
+    i = 0
+    fused = 0
+    while i < len(ops):
+        op = ops[i]
+        nxt = ops[i + 1] if i + 1 < len(ops) else None
+        out = op.output("Output")
+        if (op.type == "conv2d" and nxt is not None
+                and nxt.type == "batch_norm" and out
+                and nxt.input("X") == out
+                and uses.get(out[0], 0) == 1
+                and (nxt.attr("data_layout", "NCHW") or "NCHW")
+                == (op.attr("data_format", "NCHW") or "NCHW")):
+            act = ""
+            final_out = nxt.output("Y")
+            j = i + 2
+            if (j < len(ops) and ops[j].type == "relu"
+                    and ops[j].input("X") == final_out
+                    and uses.get(final_out[0], 0) == 1
+                    and not ops[j].attrs):
+                act = "relu"
+                final_out = ops[j].output("Out")
+                j += 1
+            attrs = dict(op.attrs)
+            for k in ("epsilon", "momentum", "is_test", "data_layout"):
+                if k in nxt.attrs:
+                    attrs[k] = nxt.attrs[k]
+            attrs["act"] = act
+            new_ops.append(Operator(
+                block, "fused_conv2d_bn",
+                inputs={"Input": op.input("Input"),
+                        "Filter": op.input("Filter"),
+                        "Scale": nxt.input("Scale"),
+                        "Bias": nxt.input("Bias"),
+                        "Mean": nxt.input("Mean"),
+                        "Variance": nxt.input("Variance")},
+                outputs={"Output": final_out,
+                         "MeanOut": nxt.output("MeanOut"),
+                         "VarianceOut": nxt.output("VarianceOut"),
+                         "SavedMean": nxt.output("SavedMean"),
+                         "SavedVariance": nxt.output("SavedVariance")},
+                attrs=attrs))
+            fused += 1
+            i = j
+            continue
+        new_ops.append(op)
+        i += 1
+    if fused:
+        block.ops[:] = new_ops
+        program._bump_version()
+    return fused
+
+
+__all__ = ["fuse_conv_bn"]

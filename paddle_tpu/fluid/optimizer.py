@@ -22,11 +22,22 @@ from . import clip as _clip_mod
 
 
 class Optimizer:
-    """Base class (reference optimizer.py:34 Optimizer)."""
+    """Base class (reference optimizer.py:34 Optimizer).
 
-    def __init__(self, learning_rate, regularization=None):
+    ``fused=True`` (SGD/Momentum/Adam; no reference analog) emits ONE
+    variadic ``fused_*`` op covering every parameter instead of one op
+    per parameter: under a Pallas kernel tier the whole dense update runs
+    as a single arena megakernel (ops/pallas/optimizer.py); under
+    kernel_tier=jnp the fused op applies the identical per-param
+    expressions, so numerics are bitwise the per-param program's. Keep it
+    off for programs that must remain per-param-transpilable (the
+    DistributeTranspiler splits optimizer ops across pservers by param).
+    """
+
+    def __init__(self, learning_rate, regularization=None, fused=False):
         self._learning_rate = learning_rate
         self.regularization = regularization
+        self._fused = bool(fused)
         self._accumulators = {}  # name -> {param_name: Variable}
         self._lr_var = None
 
@@ -78,6 +89,11 @@ class Optimizer:
     def _append_optimize_op(self, block, param_and_grad, startup):
         raise NotImplementedError
 
+    def _append_fused_op(self, block, params_grads, startup):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no fused update op; construct it "
+            "with fused=False (only SGD/Momentum/Adam fuse)")
+
     # ---- main entry (reference optimizer.py:224 minimize) ----
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -92,8 +108,11 @@ class Optimizer:
         # weight decay / regularization appended as grad = grad + coef*param
         params_grads = _regularizer_mod.append_regularization_ops(
             params_grads, self.regularization)
-        for pg in params_grads:
-            self._append_optimize_op(block, pg, startup)
+        if self._fused and params_grads:
+            self._append_fused_op(block, params_grads, startup)
+        else:
+            for pg in params_grads:
+                self._append_optimize_op(block, pg, startup)
         return params_grads
 
 
@@ -104,6 +123,14 @@ class SGD(Optimizer):
                         inputs={"Param": [p.name], "Grad": [g.name],
                                 "LearningRate": [self._lr_var.name]},
                         outputs={"ParamOut": [p.name]})
+
+    def _append_fused_op(self, block, params_grads, startup):
+        ps = [p.name for p, _ in params_grads]
+        gs = [g.name for _, g in params_grads]
+        block.append_op("fused_sgd",
+                        inputs={"Params": ps, "Grads": gs,
+                                "LearningRate": [self._lr_var.name]},
+                        outputs={"ParamsOut": ps})
 
 
 SGDOptimizer = SGD
@@ -123,6 +150,18 @@ class Momentum(Optimizer):
                                 "Velocity": [v.name],
                                 "LearningRate": [self._lr_var.name]},
                         outputs={"ParamOut": [p.name], "VelocityOut": [v.name]},
+                        attrs={"mu": self._momentum,
+                               "use_nesterov": self._use_nesterov})
+
+    def _append_fused_op(self, block, params_grads, startup):
+        ps = [p.name for p, _ in params_grads]
+        gs = [g.name for _, g in params_grads]
+        vs = [self._add_accumulator("velocity", p, startup).name
+              for p, _ in params_grads]
+        block.append_op("fused_momentum",
+                        inputs={"Params": ps, "Grads": gs, "Velocities": vs,
+                                "LearningRate": [self._lr_var.name]},
+                        outputs={"ParamsOut": ps, "VelocitiesOut": vs},
                         attrs={"mu": self._momentum,
                                "use_nesterov": self._use_nesterov})
 
@@ -156,6 +195,38 @@ class Adam(Optimizer):
                    "epsilon": self._epsilon})
         # update beta powers, mirroring reference _finish_update
         # (optimizer.py:441-463) which appends scale ops
+        block.append_op("scale", inputs={"X": [b1p.name]},
+                        outputs={"Out": [b1p.name]},
+                        attrs={"scale": self._beta1})
+        block.append_op("scale", inputs={"X": [b2p.name]},
+                        outputs={"Out": [b2p.name]},
+                        attrs={"scale": self._beta2})
+
+    def _append_fused_op(self, block, params_grads, startup):
+        ps = [p.name for p, _ in params_grads]
+        gs = [g.name for _, g in params_grads]
+        m1s = [self._add_accumulator("moment1", p, startup).name
+               for p, _ in params_grads]
+        m2s = [self._add_accumulator("moment2", p, startup).name
+               for p, _ in params_grads]
+        # ONE shared beta-power pair: every param shares the step count,
+        # so the per-param pairs of the unfused form are N copies of the
+        # same scalar (numerics identical)
+        p0 = params_grads[0][0]
+        b1p = self._add_accumulator("beta1_pow_fused", p0, startup,
+                                    fill_value=self._beta1, shape=(1,))
+        b2p = self._add_accumulator("beta2_pow_fused", p0, startup,
+                                    fill_value=self._beta2, shape=(1,))
+        block.append_op(
+            "fused_adam",
+            inputs={"Params": ps, "Grads": gs, "Moment1s": m1s,
+                    "Moment2s": m2s, "Beta1Pow": [b1p.name],
+                    "Beta2Pow": [b2p.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamsOut": ps, "Moment1sOut": m1s,
+                     "Moment2sOut": m2s},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
         block.append_op("scale", inputs={"X": [b1p.name]},
                         outputs={"Out": [b1p.name]},
                         attrs={"scale": self._beta1})

@@ -21,6 +21,7 @@ from . import (  # noqa: F401
     control_flow_ops,
     crf_ops,
     ctc_ops,
+    fused_ops,
     optimizer_ops,
     metrics,
     detection_ops,

@@ -27,12 +27,14 @@ _NEG = -1e30
 
 def _ctc_loss(logits, x_lens, labels, y_lens, blank):
     """logits [b, T, C] unnormalized; labels [b, U] int; returns [b, 1].
-    Dispatches to the Pallas whole-recurrence kernel under use_pallas_ctc
-    (backward always runs the scan path via custom_vjp, like the RNN
-    cells)."""
-    from ..core.flags import get_flag
-    if get_flag("use_pallas_ctc") and logits.shape[1] > 1:
-        return _ctc_loss_pallas(logits, x_lens, labels, y_lens, blank)
+    Dispatches to the Pallas whole-recurrence kernel under the kernel
+    tier (legacy use_pallas_ctc still honored; backward always runs the
+    scan path via custom_vjp, like the RNN cells). T==1 sequences have no
+    recurrence to fuse and route to the scan path (counted fallback)."""
+    from .pallas import use_pallas, kernel_span
+    if use_pallas("ctc", logits.shape[1] > 1):
+        with kernel_span("pallas", "ctc"):
+            return _ctc_loss_pallas(logits, x_lens, labels, y_lens, blank)
     return _ctc_loss_scan(logits, x_lens, labels, y_lens, blank)
 
 
@@ -101,10 +103,10 @@ import functools
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
 def _ctc_loss_pallas(logits, x_lens, labels, y_lens, blank):
     """Pallas whole-recurrence CTC forward (alpha VMEM-resident across T,
-    the warp-ctc shared-memory pattern, pallas_kernels.ctc_alpha_pallas);
+    the warp-ctc shared-memory pattern, ops/pallas/ctc.ctc_alpha_pallas);
     the emit gather, masks and t=0 init are precomputed here where XLA owns
     them. Backward = jax.vjp of the scan path (custom_vjp)."""
-    from .pallas_kernels import ctc_alpha_pallas
+    from .pallas.ctc import ctc_alpha_pallas
 
     b, T, C = logits.shape
     U = labels.shape[1]

@@ -68,23 +68,16 @@ def _bn_bshape(x, layout):
     return tuple(x.shape[c] if i == c else 1 for i in range(x.ndim))
 
 
-@register_op("batch_norm", infer_shape=_bn_infer, grad=_bn_grad_maker)
-def batch_norm(ctx):
-    x = data_of(ctx.input("X"))
-    scale = data_of(ctx.input("Scale"))
-    bias = data_of(ctx.input("Bias"))
-    running_mean = data_of(ctx.input("Mean"))
-    running_var = data_of(ctx.input("Variance"))
-    eps = ctx.attr("epsilon", 1e-5)
-    momentum = ctx.attr("momentum", 0.9)
-    layout = ctx.attr("data_layout", "NCHW")
+def bn_forward_math(x, scale, bias, running_mean, running_var, eps,
+                    momentum, layout, is_test):
+    """The batch_norm op's forward math, shared with the fused
+    conv2d+bn op's jnp twin (ops/fused_ops.py) so the fused program and
+    the unfused chain are BITWISE identical under kernel_tier=jnp.
+    Returns (y, new_mean, new_var, saved_mean, saved_var)."""
+    from ..core.flags import get_flag
+
     axes = _bn_axes(x, layout)
     bshape = _bn_bshape(x, layout)
-
-    from ..core.flags import get_flag
-    if get_flag("bn_fusion_barrier") or get_flag("bn_fusion_barrier_fwd"):
-        # sever the producer conv from the stat reduces (see flags.py)
-        x = jax.lax.optimization_barrier(x)
 
     # stability island: statistics accumulate in float32 straight out of the
     # (possibly bf16) activations — single pass via E[x²]-E[x]², reductions
@@ -94,7 +87,7 @@ def batch_norm(ctx):
     out_dtype = x.dtype
 
     stat_dtype = jnp.bfloat16 if get_flag("bn_bf16_stats") else jnp.float32
-    if ctx.attr("is_test", False):
+    if is_test:
         mean, var = running_mean, running_var
         new_mean, new_var = running_mean, running_var
     else:
@@ -119,11 +112,58 @@ def batch_norm(ctx):
     inv_std = jax.lax.rsqrt(var + eps)
     y = (x.astype(jnp.float32) * (scale * inv_std).reshape(bshape)
          + (bias - mean * scale * inv_std).reshape(bshape)).astype(out_dtype)
+    return y, new_mean, new_var, mean, var
+
+
+@register_op("batch_norm", infer_shape=_bn_infer, grad=_bn_grad_maker)
+def batch_norm(ctx):
+    x = data_of(ctx.input("X"))
+    scale = data_of(ctx.input("Scale"))
+    bias = data_of(ctx.input("Bias"))
+    running_mean = data_of(ctx.input("Mean"))
+    running_var = data_of(ctx.input("Variance"))
+    eps = ctx.attr("epsilon", 1e-5)
+    momentum = ctx.attr("momentum", 0.9)
+    layout = ctx.attr("data_layout", "NCHW")
+
+    from ..core.flags import get_flag
+    if get_flag("bn_fusion_barrier") or get_flag("bn_fusion_barrier_fwd"):
+        # sever the producer conv from the stat reduces (see flags.py)
+        x = jax.lax.optimization_barrier(x)
+
+    y, new_mean, new_var, mean, var = bn_forward_math(
+        x, scale, bias, running_mean, running_var, eps, momentum, layout,
+        bool(ctx.attr("is_test", False)))
     ctx.set_output("Y", y)
     ctx.set_output("MeanOut", new_mean)
     ctx.set_output("VarianceOut", new_var)
     ctx.set_output("SavedMean", mean)
     ctx.set_output("SavedVariance", var)
+
+
+def bn_backward_math(x, scale, mean, var, dy, eps, layout, is_test):
+    """The batch_norm_grad closed form over the saved statistics, shared
+    with the fused conv2d+bn grad's jnp twin. Returns (dx, dscale, dbias);
+    dx comes back in the activation dtype."""
+    axes = _bn_axes(x, layout)
+    bshape = _bn_bshape(x, layout)
+    m = x.size // x.shape[_bn_channel_axis(x, layout)]
+
+    # float32 stability island mirroring the forward; dX returns in the
+    # activation dtype so the bf16 backward chain stays bf16
+    out_dtype = x.dtype
+    x = x.astype(jnp.float32)
+    dy = dy.astype(jnp.float32)
+    inv_std = jax.lax.rsqrt(var + eps).reshape(bshape)
+    xhat = (x - mean.reshape(bshape)) * inv_std
+    dbias = jnp.sum(dy, axis=axes)
+    dscale = jnp.sum(dy * xhat, axis=axes)
+    if is_test:
+        dx = dy * scale.reshape(bshape) * inv_std
+    else:
+        dx = (scale.reshape(bshape) * inv_std / m) * (
+            m * dy - dbias.reshape(bshape) - xhat * dscale.reshape(bshape))
+    return dx.astype(out_dtype), dscale, dbias
 
 
 @register_op("batch_norm_grad")
@@ -138,25 +178,10 @@ def batch_norm_grad(ctx):
     from ..core.flags import get_flag
     if get_flag("bn_fusion_barrier") or get_flag("bn_fusion_barrier_bwd"):
         x, dy = jax.lax.optimization_barrier((x, dy))
-    axes = _bn_axes(x, layout)
-    bshape = _bn_bshape(x, layout)
-    m = x.size // x.shape[_bn_channel_axis(x, layout)]
-
-    # float32 stability island mirroring the forward; dX returns in the
-    # activation dtype so the bf16 backward chain stays bf16
-    out_dtype = x.dtype
-    x = x.astype(jnp.float32)
-    dy = dy.astype(jnp.float32)
-    inv_std = jax.lax.rsqrt(var + eps).reshape(bshape)
-    xhat = (x - mean.reshape(bshape)) * inv_std
-    dbias = jnp.sum(dy, axis=axes)
-    dscale = jnp.sum(dy * xhat, axis=axes)
-    if ctx.attr("is_test", False):
-        dx = dy * scale.reshape(bshape) * inv_std
-    else:
-        dx = (scale.reshape(bshape) * inv_std / m) * (
-            m * dy - dbias.reshape(bshape) - xhat * dscale.reshape(bshape))
-    ctx.set_output("X@GRAD", dx.astype(out_dtype))
+    dx, dscale, dbias = bn_backward_math(
+        x, scale, mean, var, dy, eps, layout,
+        bool(ctx.attr("is_test", False)))
+    ctx.set_output("X@GRAD", dx)
     ctx.set_output("Scale@GRAD", dscale)
     ctx.set_output("Bias@GRAD", dbias)
 

@@ -1,0 +1,158 @@
+"""The Pallas kernel tier: a small library of fused TPU primitives.
+
+Design template: *Tensor Processing Primitives* (PAPERS.md) — the op layer
+targets a SMALL set of fused kernels (conv+bn+relu epilogues, one-kernel
+optimizer steps, rowwise embedding updates, whole-recurrence RNN/CTC)
+instead of growing one-off kernels per call site. Every kernel here has a
+jnp twin with pinned numerics (tests run the kernels in interpret mode on
+CPU), and every dispatch site routes through :func:`use_pallas` so tier
+selection, per-kernel fallback, and profiler attribution live in ONE place.
+
+Tier selection (the ``kernel_tier`` flag):
+
+* ``auto`` (default) — Pallas on TPU for the kernels measured to win
+  (:data:`AUTO_PALLAS`), jnp everywhere else (CPU suites never pay
+  interpret-mode kernels unless they opt in).
+* ``pallas`` — Pallas for every kernel with a lowering (interpret mode on
+  CPU: this is what the parity tests run).
+* ``jnp`` — the plain jax.numpy lowerings, bitwise-identical to the
+  pre-tier behavior.
+
+The legacy ``use_pallas_rnn`` / ``use_pallas_ctc`` flags are deprecated but
+still honored: set to True they force the Pallas path for their kernels
+(with a one-time DeprecationWarning) regardless of ``kernel_tier``.
+
+Fallback contract: when the tier resolves to Pallas but a dispatch site
+reports the shape/config unsupported (``supported=False``), the call
+SILENTLY routes to the jnp twin and bumps a per-kernel counter
+(:func:`fallback_counts`) — an unsupported shape is a routing decision,
+never an error. Profiler spans (``pallas/<kernel>`` vs ``jnp/<kernel>``,
+kind="kernel") land in chrome traces so the two paths are distinguishable
+per op.
+"""
+
+from __future__ import annotations
+
+import threading
+import warnings
+from contextlib import contextmanager
+
+from ...core.flags import get_flag
+from ...core.profiler import record_event
+
+# kernels that default to Pallas under kernel_tier=auto on TPU — the
+# measured-to-win set (lstm 1.22x on v5e; gru measured 0.98-1.08x across
+# sessions so it stays opt-in via kernel_tier=pallas)
+AUTO_PALLAS = frozenset({
+    "lstm", "ctc", "conv_bn", "optimizer", "embedding_sgd",
+})
+
+# kernel family -> the deprecated flag that used to gate it
+_LEGACY_FLAGS = {
+    "lstm": "use_pallas_rnn",
+    "gru": "use_pallas_rnn",
+    "ctc": "use_pallas_ctc",
+}
+
+_warned_legacy: set = set()
+
+_fallback_lock = threading.Lock()
+_fallbacks: dict = {}
+
+
+def _legacy_forced(kernel):
+    """True when the kernel's deprecated flag is set (warn once per flag)."""
+    name = _LEGACY_FLAGS.get(kernel)
+    if name is None or not get_flag(name):
+        return False
+    if name not in _warned_legacy:
+        _warned_legacy.add(name)
+        warnings.warn(
+            f"flag {name!r} is deprecated: use kernel_tier='pallas' (or "
+            "'auto', which picks Pallas on TPU) instead; the old flag is "
+            "still honored and forces the Pallas path for its kernels",
+            DeprecationWarning, stacklevel=3)
+    return True
+
+
+def on_cpu():
+    """Shared interpret-mode predicate: every kernel module passes
+    ``interpret=on_cpu()`` to pallas_call so CPU (tests, smoke benches)
+    runs the same kernel bodies through the interpreter."""
+    import jax
+    return jax.default_backend() == "cpu"
+
+
+def resolve_tier():
+    """The tier the ``kernel_tier`` flag resolves to: 'pallas' or 'jnp'
+    ('auto' = pallas on TPU, jnp elsewhere — per-kernel AUTO_PALLAS
+    membership is applied in :func:`use_pallas`, not here)."""
+    t = get_flag("kernel_tier")
+    if t == "auto":
+        import jax
+        return "pallas" if jax.default_backend() == "tpu" else "jnp"
+    if t not in ("pallas", "jnp"):
+        raise ValueError(
+            f"kernel_tier must be auto|pallas|jnp, got {t!r}")
+    return t
+
+
+def use_pallas(kernel, supported=True):
+    """Should this dispatch take the Pallas path?
+
+    ``kernel`` names the kernel family ("conv_bn", "optimizer",
+    "embedding_sgd", "lstm", "gru", "ctc"); ``supported`` is the call
+    site's shape/config predicate. Unsupported shapes under a Pallas tier
+    fall back to the jnp twin with a counter bump (never an error).
+    """
+    t = get_flag("kernel_tier")
+    if t not in ("auto", "pallas", "jnp"):
+        raise ValueError(
+            f"kernel_tier must be auto|pallas|jnp, got {t!r}")
+    want = _legacy_forced(kernel)
+    if not want:
+        if t == "pallas":
+            want = True
+        elif t == "auto" and kernel in AUTO_PALLAS:
+            import jax
+            want = jax.default_backend() == "tpu"
+    if want and not supported:
+        record_fallback(kernel)
+        return False
+    return want
+
+
+def record_fallback(kernel):
+    with _fallback_lock:
+        _fallbacks[kernel] = _fallbacks.get(kernel, 0) + 1
+
+
+def fallback_counts():
+    """{kernel: times an unsupported shape routed pallas->jnp}."""
+    with _fallback_lock:
+        return dict(_fallbacks)
+
+
+def reset_fallback_counts():
+    with _fallback_lock:
+        _fallbacks.clear()
+
+
+@contextmanager
+def kernel_span(tier, kernel):
+    """Profiler span around one kernel dispatch: chrome traces show
+    ``pallas/<kernel>`` vs ``jnp/<kernel>`` (kind="kernel") so tier time is
+    attributable per op. Host spans: real time in eager mode, trace-time
+    under jit (the repo's standard record_event semantics)."""
+    with record_event(f"{tier}/{kernel}", kind="kernel"):
+        yield
+
+
+# kernel modules (conv_bn, optimizer, embedding, rnn, ctc) are imported
+# lazily by their dispatch sites: the tier layer itself must stay cheap to
+# import (it is pulled in at ops-package import time)
+
+__all__ = [
+    "AUTO_PALLAS", "resolve_tier", "use_pallas", "record_fallback",
+    "fallback_counts", "reset_fallback_counts", "kernel_span",
+]

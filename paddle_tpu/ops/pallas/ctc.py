@@ -1,0 +1,85 @@
+"""CTC alpha-recurrence Pallas kernel (the warp-ctc replacement's hot loop).
+
+Migrated unchanged from the seed ``ops/pallas_kernels.py`` into the kernel
+tier. One program per batch row keeps the whole alpha vector VMEM-resident
+across all T steps — the reference's warp-ctc keeps it in shared memory per
+block (ctc_helper kernels). Dispatched by ``ops/ctc_ops.py`` under the
+tier; numerics pinned against the lax.scan path incl. gradients.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+
+from . import on_cpu as _on_cpu
+
+
+_NEG = -1e30
+
+
+def _ctc_alpha_kernel(e_ref, alpha0_ref, final0_ref, can_skip_ref,
+                      s_valid_ref, xlen_ref, ylen_ref, loss_ref):
+    """Whole-sequence CTC forward for ONE batch element: alpha stays
+    VMEM-resident across all T steps (the reference's warp-ctc keeps it in
+    shared memory per block, ctc_helper kernels). e [T, Sp] are the emit
+    log-probs at the blank-interleaved labels; masks are f32 0/1."""
+    e = e_ref[0]                          # [T, Sp]
+    can_skip = can_skip_ref[0]            # [Sp]
+    s_valid = s_valid_ref[0]
+    xlen = xlen_ref[0, 0]
+    ylen = ylen_ref[0, 0]
+    T = e.shape[0]
+    sp = e.shape[1]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (sp,), 0)
+
+    last = 2 * ylen                       # index of the final blank
+    onehot_last = (iota == last).astype(e.dtype)
+    onehot_lab = (iota == jnp.maximum(last - 1, 0)).astype(e.dtype)
+
+    def final_of(alpha):
+        a_last = jnp.sum(jnp.where(onehot_last > 0, alpha, 0.0))
+        a_lab = jnp.sum(jnp.where(onehot_lab > 0, alpha, 0.0))
+        a_lab = jnp.where(ylen > 0, a_lab, _NEG)
+        return jnp.logaddexp(a_last, a_lab)
+
+    def body(t, carry):
+        alpha, final = carry
+        a1 = jnp.where(iota >= 1, jnp.roll(alpha, 1), _NEG)
+        a2 = jnp.where((iota >= 2) & (can_skip > 0),
+                       jnp.roll(alpha, 2), _NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+        lp = jax.lax.dynamic_slice_in_dim(e, t, 1, axis=0)[0]
+        nxt = jnp.where(s_valid > 0, merged + lp, _NEG)
+        alpha = jnp.where(t < xlen, nxt, alpha)
+        final = jnp.where(t == xlen - 1, final_of(alpha), final)
+        return alpha, final
+
+    alpha0 = alpha0_ref[0]
+    _, final = jax.lax.fori_loop(1, T, body,
+                                 (alpha0, final0_ref[0, 0]))
+    loss_ref[0, 0] = -final
+
+
+def ctc_alpha_pallas(e, alpha0, final0, can_skip, s_valid, x_lens, y_lens):
+    """[b, T, Sp] emit matrix -> [b, 1] loss; one program per batch row."""
+    b, T, sp = e.shape
+    f32 = e.dtype
+    return pl.pallas_call(
+        _ctc_alpha_kernel,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, T, sp), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, sp), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, sp), lambda i: (i, 0)),
+            pl.BlockSpec((1, sp), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, 1), f32),
+        interpret=_on_cpu(),
+    )(e, alpha0, final0, can_skip, s_valid, x_lens, y_lens)

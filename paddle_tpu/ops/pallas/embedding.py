@@ -1,0 +1,82 @@
+"""Fused embedding rowwise-SGD Pallas kernel (the sparse-path hot update).
+
+The lookup_table backward produces a ``SparseRows`` gradient and the sgd
+op's sparse branch scatter-subtracts it into the [vocab, dim] table —
+XLA lowers that to a gather/scatter pair over the whole table layout.
+Here the update is ONE kernel walking the touched rows: the row index
+rides scalar prefetch (it computes each grid step's block mapping), every
+program reads its table row into VMEM, applies ``row -= lr * grad_row``
+and writes it back through an input/output alias — O(touched rows) HBM
+traffic with no dense-table intermediate, feeding the same SelectedRows
+machinery the pserver wire path (PR 3) speaks.
+
+Contract: rows must be MERGED (duplicate-free, core.sparse.merge_rows) —
+the caller pre-merges like every reference sparse optimizer kernel does.
+Sentinel rows (>= nrows) are clamped to row 0 with their update zeroed
+and REORDERED TO THE FRONT of the grid: a sequential grid only
+guarantees coherent read-modify-write for CONSECUTIVE same-block steps,
+so the sentinels' no-op rewrites of row 0 must run before (and
+contiguous with) any real row-0 update — at the tail they would race
+the refetch and stomp it with the pre-update row. Numerics pinned
+against the jnp scatter twin in tests/test_fused_embedding_sgd.py
+(interpret on CPU).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+
+from . import on_cpu as _on_cpu
+
+
+def _row_sgd_kernel(rows_ref, sc_ref, vals_ref, w_ref, w_out):
+    del rows_ref  # consumed by the index maps (scalar prefetch)
+    w_out[...] = w_ref[...] - sc_ref[0] * vals_ref[...]
+
+
+def embedding_sgd_pallas(w, rows, vals, lr):
+    """w[rows] -= lr * vals, one touched row per grid step.
+
+    w [V, D]; rows [R] int32 MERGED (unique or sentinel); vals [R, D] in
+    w's dtype. Returns the updated table (w is donated through an
+    input/output alias when jit allows)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    v_rows = w.shape[0]
+    r = rows.shape[0]
+    d = w.shape[1]
+    # sentinels first (argsort key -1), real rows ascending after — see
+    # the module docstring for why tail sentinels would be a write race
+    order = jnp.argsort(jnp.where(rows >= v_rows, -1, rows))
+    rows_s = rows[order]
+    sentinel = rows_s >= v_rows
+    rows_c = jnp.where(sentinel, 0, rows_s).astype(jnp.int32)
+    vals_c = jnp.where(sentinel[:, None], 0, vals[order]).astype(w.dtype)
+    lr_arr = jnp.asarray(lr, jnp.float32).reshape(1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(r,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i, rows_ref: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, d), lambda i, rows_ref: (i, 0)),
+            pl.BlockSpec((1, d), lambda i, rows_ref: (rows_ref[i], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, d), lambda i, rows_ref: (rows_ref[i], 0)),
+    )
+    return pl.pallas_call(
+        _row_sgd_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(w.shape, w.dtype),
+        input_output_aliases={3: 0},
+        interpret=_on_cpu(),
+    )(rows_c, lr_arr.reshape(1, 1), vals_c, w)
+
+
+def embedding_sgd_jnp(w, rows, vals, lr):
+    """The scatter twin: exactly the sgd op's sparse branch expression."""
+    return w.at[rows].add(-lr * vals.astype(w.dtype), mode="drop")

@@ -1,334 +1,23 @@
-"""Pallas TPU kernels for the hand-tuned hot spots.
+"""Deprecation shim: the seed's ad-hoc kernel module became the kernel tier.
 
-The reference hand-schedules fused CUDA kernels for exactly these spots —
-the LSTM/GRU recurrences (/root/reference/paddle/cuda/src/hl_cuda_lstm.cu,
-hl_gpu_lstm.cuh) and the CTC alpha recurrence (warp-ctc). The Pallas
-analogs go further than per-cell fusion: the LSTM/GRU run their WHOLE
-sequence as one kernel — grid over time, recurrent weight VMEM-resident
-across steps (lax.scan re-reads it from HBM every iteration), h/c carries
-in VMEM scratch, bf16 MXU gate matmuls with f32 accumulation. Measured
-1.22x vs the scan path on the v5e LSTM training lane (round 5).
-
-Flag ``use_pallas_rnn`` (default OFF so CPU suites avoid interpret-mode
-kernels; bench.py measures both paths). Numerics incl. all gradients are
-pinned against jnp twins (tests/test_pallas_kernels.py, interpret mode on
-CPU, native on TPU). Gradients use jax.custom_vjp: a reverse lax.scan of
-per-step vjps over the saved carries, recomputing gates.
+The two hand-tuned kernel families that lived here (whole-recurrence
+LSTM/GRU, CTC alpha) are now ``ops/pallas/rnn.py`` and ``ops/pallas/ctc.py``
+inside the first-class Pallas kernel tier (``paddle_tpu/ops/pallas/`` — see
+its package docstring for the selection/fallback contract). This module
+re-exports the old public names so existing imports keep working.
 """
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
+from .pallas.rnn import (  # noqa: F401
+    lstm_seq_pallas,
+    gru_seq_pallas,
+    _lstm_cell_jnp,
+    _lstm_step_jnp,
+    _gru_step_jnp,
+    _lstm_seq_fwd_pallas,
+    _gru_seq_fwd_pallas,
+)
+from .pallas.ctc import ctc_alpha_pallas, _NEG  # noqa: F401
 
-from jax.experimental import pallas as pl
-
-
-def _on_cpu():
-    return jax.default_backend() == "cpu"
-
-
-def _lstm_cell_jnp(gates, c_prev, h_prev, alive):
-    hdim = gates.shape[-1] // 4
-    i = jax.nn.sigmoid(gates[:, :hdim])
-    f = jax.nn.sigmoid(gates[:, hdim:2 * hdim])
-    cand = jnp.tanh(gates[:, 2 * hdim:3 * hdim])
-    o = jax.nn.sigmoid(gates[:, 3 * hdim:])
-    c = f * c_prev + i * cand
-    h = o * jnp.tanh(c)
-    return (alive * h + (1 - alive) * h_prev,
-            alive * c + (1 - alive) * c_prev)
-
-
-
-
-# ---------------------------------------------------------------------------
-# CTC alpha recurrence (the warp-ctc replacement's hot loop)
-# ---------------------------------------------------------------------------
-
-_NEG = -1e30
-
-
-def _ctc_alpha_kernel(e_ref, alpha0_ref, final0_ref, can_skip_ref,
-                      s_valid_ref, xlen_ref, ylen_ref, loss_ref):
-    """Whole-sequence CTC forward for ONE batch element: alpha stays
-    VMEM-resident across all T steps (the reference's warp-ctc keeps it in
-    shared memory per block, ctc_helper kernels). e [T, Sp] are the emit
-    log-probs at the blank-interleaved labels; masks are f32 0/1."""
-    e = e_ref[0]                          # [T, Sp]
-    can_skip = can_skip_ref[0]            # [Sp]
-    s_valid = s_valid_ref[0]
-    xlen = xlen_ref[0, 0]
-    ylen = ylen_ref[0, 0]
-    T = e.shape[0]
-    sp = e.shape[1]
-    iota = jax.lax.broadcasted_iota(jnp.int32, (sp,), 0)
-
-    last = 2 * ylen                       # index of the final blank
-    onehot_last = (iota == last).astype(e.dtype)
-    onehot_lab = (iota == jnp.maximum(last - 1, 0)).astype(e.dtype)
-
-    def final_of(alpha):
-        a_last = jnp.sum(jnp.where(onehot_last > 0, alpha, 0.0))
-        a_lab = jnp.sum(jnp.where(onehot_lab > 0, alpha, 0.0))
-        a_lab = jnp.where(ylen > 0, a_lab, _NEG)
-        return jnp.logaddexp(a_last, a_lab)
-
-    def body(t, carry):
-        alpha, final = carry
-        a1 = jnp.where(iota >= 1, jnp.roll(alpha, 1), _NEG)
-        a2 = jnp.where((iota >= 2) & (can_skip > 0),
-                       jnp.roll(alpha, 2), _NEG)
-        merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
-        lp = jax.lax.dynamic_slice_in_dim(e, t, 1, axis=0)[0]
-        nxt = jnp.where(s_valid > 0, merged + lp, _NEG)
-        alpha = jnp.where(t < xlen, nxt, alpha)
-        final = jnp.where(t == xlen - 1, final_of(alpha), final)
-        return alpha, final
-
-    alpha0 = alpha0_ref[0]
-    _, final = jax.lax.fori_loop(1, T, body,
-                                 (alpha0, final0_ref[0, 0]))
-    loss_ref[0, 0] = -final
-
-
-def ctc_alpha_pallas(e, alpha0, final0, can_skip, s_valid, x_lens, y_lens):
-    """[b, T, Sp] emit matrix -> [b, 1] loss; one program per batch row."""
-    b, T, sp = e.shape
-    f32 = e.dtype
-    return pl.pallas_call(
-        _ctc_alpha_kernel,
-        grid=(b,),
-        in_specs=[
-            pl.BlockSpec((1, T, sp), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, sp), lambda i: (i, 0)),
-            pl.BlockSpec((1, 1), lambda i: (i, 0)),
-            pl.BlockSpec((1, sp), lambda i: (i, 0)),
-            pl.BlockSpec((1, sp), lambda i: (i, 0)),
-            pl.BlockSpec((1, 1), lambda i: (i, 0)),
-            pl.BlockSpec((1, 1), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, 1), f32),
-        interpret=_on_cpu(),
-    )(e, alpha0, final0, can_skip, s_valid, x_lens, y_lens)
-
-
-# ---------------------------------------------------------------------------
-# Whole-recurrence LSTM: one kernel for the ENTIRE sequence
-# ---------------------------------------------------------------------------
-
-def _lstm_seq_kernel(x_ref, alive_ref, w_ref, h0_ref, c0_ref,
-                     hs_ref, cs_ref, h_s, c_s):
-    """Grid over time. The recurrent weight w stays VMEM-resident across
-    every grid step (XLA's lax.scan body re-reads it from HBM each
-    iteration — for hid 512 that is ~4 MB x seq_len per layer) and the h/c
-    carries live in VMEM scratch, so the whole recurrence is ONE kernel
-    launch instead of seq_len (matmul + fusion) pairs. The per-step matmul
-    runs on the MXU in bf16 with f32 accumulation (the lane's
-    default_matmul_precision contract)."""
-    t = pl.program_id(0)
-
-    @pl.when(t == 0)
-    def _():
-        h_s[...] = h0_ref[...]
-        c_s[...] = c0_ref[...]
-
-    h_prev = h_s[...]
-    c_prev = c_s[...]
-    gates = x_ref[0] + jax.lax.dot(
-        h_prev.astype(w_ref.dtype), w_ref[...],
-        preferred_element_type=jnp.float32).astype(h_prev.dtype)
-    hdim = h_prev.shape[-1]
-    alive = alive_ref[0]
-    i = jax.nn.sigmoid(gates[:, :hdim])
-    f = jax.nn.sigmoid(gates[:, hdim:2 * hdim])
-    cand = jnp.tanh(gates[:, 2 * hdim:3 * hdim])
-    o = jax.nn.sigmoid(gates[:, 3 * hdim:])
-    c = f * c_prev + i * cand
-    h = o * jnp.tanh(c)
-    h = alive * h + (1 - alive) * h_prev
-    c = alive * c + (1 - alive) * c_prev
-    h_s[...] = h
-    c_s[...] = c
-    hs_ref[0] = h
-    cs_ref[0] = c
-
-
-def _lstm_seq_fwd_pallas(x, alive, w, h0, c0):
-    """x [L, b, 4H] (projected inputs + bias), alive [L, b, 1] float,
-    w [H, 4H]; returns CARRY sequences hs/cs [L, b, H] (unmasked — the
-    caller applies the output mask)."""
-    from jax.experimental.pallas import tpu as pltpu
-
-    L, b, H4 = x.shape
-    H = H4 // 4
-    wb = w.astype(jnp.bfloat16)   # MXU operand; bf16 halves its VMEM stay
-    return pl.pallas_call(
-        _lstm_seq_kernel,
-        grid=(L,),
-        in_specs=[
-            pl.BlockSpec((1, b, H4), lambda t: (t, 0, 0)),
-            pl.BlockSpec((1, b, 1), lambda t: (t, 0, 0)),
-            pl.BlockSpec((H, H4), lambda t: (0, 0)),
-            pl.BlockSpec((b, H), lambda t: (0, 0)),
-            pl.BlockSpec((b, H), lambda t: (0, 0)),
-        ],
-        out_specs=[pl.BlockSpec((1, b, H), lambda t: (t, 0, 0)),
-                   pl.BlockSpec((1, b, H), lambda t: (t, 0, 0))],
-        out_shape=[jax.ShapeDtypeStruct((L, b, H), x.dtype),
-                   jax.ShapeDtypeStruct((L, b, H), x.dtype)],
-        scratch_shapes=[pltpu.VMEM((b, H), x.dtype),
-                        pltpu.VMEM((b, H), x.dtype)],
-        interpret=_on_cpu(),
-    )(x, alive, wb, h0, c0)
-
-
-def _lstm_step_jnp(xt, h_prev, c_prev, w, alive):
-    """One reference step on CARRIES (the jnp twin the backward
-    differentiates): the bf16-MXU gate matmul + the shared cell math.
-    Returns (h_carry, c_carry)."""
-    gates = xt + jax.lax.dot(
-        h_prev.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
-        preferred_element_type=jnp.float32).astype(h_prev.dtype)
-    return _lstm_cell_jnp(gates, c_prev, h_prev, alive)
-
-
-@jax.custom_vjp
-def lstm_seq_pallas(x, alive, w, h0, c0):
-    return _lstm_seq_fwd_pallas(x, alive, w, h0, c0)
-
-
-def _lstm_seq_fwd(x, alive, w, h0, c0):
-    hs, cs = _lstm_seq_fwd_pallas(x, alive, w, h0, c0)
-    return (hs, cs), (x, alive, w, h0, c0, hs, cs)
-
-
-def _lstm_seq_bwd(res, cts):
-    """Reverse scan of per-step jax.vjp over the SAVED carries: gates are
-    recomputed from x[t] + h[t-1] @ w (one extra matmul per step — the
-    trade XLA's scan makes by saving gates instead; recompute keeps the
-    saved-residual HBM footprint at 2 arrays)."""
-    x, alive, w, h0, c0, hs, cs = res
-    dhs, dcs = cts
-    h_prevs = jnp.concatenate([h0[None], hs[:-1]], axis=0)
-    c_prevs = jnp.concatenate([c0[None], cs[:-1]], axis=0)
-
-    def bstep(carry, inp):
-        dh_next, dc_next, dw = carry
-        xt, at, hp, cp, dh_out, dc_out = inp
-        _, vjp = jax.vjp(
-            lambda xv, hv, cv, wv: _lstm_step_jnp(xv, hv, cv, wv, at),
-            xt, hp, cp, w)
-        dxt, dhp, dcp, dwt = vjp((dh_next + dh_out, dc_next + dc_out))
-        return (dhp, dcp, dw + dwt), dxt
-
-    zero = jnp.zeros_like(h0)
-    (dh0, dc0, dw), dx = jax.lax.scan(
-        bstep, (zero, jnp.zeros_like(c0), jnp.zeros_like(w)),
-        (x, alive, h_prevs, c_prevs, dhs, dcs), reverse=True)
-    return dx, None, dw, dh0, dc0
-
-
-lstm_seq_pallas.defvjp(_lstm_seq_fwd, _lstm_seq_bwd)
-
-
-# ---------------------------------------------------------------------------
-# Whole-recurrence GRU (same pattern as lstm_seq_pallas)
-# ---------------------------------------------------------------------------
-
-def _gru_seq_kernel(x_ref, alive_ref, w_ref, h0_ref, hs_ref, h_s):
-    """Grid over time; w [H, 3H] = [W_u | W_r | W_c] VMEM-resident, h carry
-    in VMEM scratch. Gate math matches _gru_cell_jnp / the scan path
-    (gru_unit_op.h: h = u*c + (1-u)*h_prev)."""
-    t = pl.program_id(0)
-
-    @pl.when(t == 0)
-    def _():
-        h_s[...] = h0_ref[...]
-
-    h_prev = h_s[...]
-    xt = x_ref[0]
-    alive = alive_ref[0]
-    hdim = h_prev.shape[-1]
-    w = w_ref[...]
-    hb = h_prev.astype(w.dtype)
-    ur = jax.lax.dot(hb, w[:, :2 * hdim],
-                     preferred_element_type=jnp.float32).astype(h_prev.dtype)
-    u = jax.nn.sigmoid(xt[:, :hdim] + ur[:, :hdim])
-    r = jax.nn.sigmoid(xt[:, hdim:2 * hdim] + ur[:, hdim:])
-    rc = jax.lax.dot((r * h_prev).astype(w.dtype), w[:, 2 * hdim:],
-                     preferred_element_type=jnp.float32).astype(h_prev.dtype)
-    c = jnp.tanh(xt[:, 2 * hdim:] + rc)
-    h = u * c + (1.0 - u) * h_prev
-    h = alive * h + (1 - alive) * h_prev
-    h_s[...] = h
-    hs_ref[0] = h
-
-
-def _gru_seq_fwd_pallas(x, alive, w, h0):
-    from jax.experimental.pallas import tpu as pltpu
-
-    L, b, H3 = x.shape
-    H = H3 // 3
-    wb = w.astype(jnp.bfloat16)
-    return pl.pallas_call(
-        _gru_seq_kernel,
-        grid=(L,),
-        in_specs=[
-            pl.BlockSpec((1, b, H3), lambda t: (t, 0, 0)),
-            pl.BlockSpec((1, b, 1), lambda t: (t, 0, 0)),
-            pl.BlockSpec((H, H3), lambda t: (0, 0)),
-            pl.BlockSpec((b, H), lambda t: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, b, H), lambda t: (t, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((L, b, H), x.dtype),
-        scratch_shapes=[pltpu.VMEM((b, H), x.dtype)],
-        interpret=_on_cpu(),
-    )(x, alive, wb, h0)
-
-
-def _gru_step_jnp(xt, h_prev, w, alive):
-    """jnp twin of one kernel step on CARRIES (bf16 matmul recipe)."""
-    hdim = h_prev.shape[-1]
-    wb = w.astype(jnp.bfloat16)
-    ur = jax.lax.dot(h_prev.astype(jnp.bfloat16), wb[:, :2 * hdim],
-                     preferred_element_type=jnp.float32).astype(h_prev.dtype)
-    u = jax.nn.sigmoid(xt[:, :hdim] + ur[:, :hdim])
-    r = jax.nn.sigmoid(xt[:, hdim:2 * hdim] + ur[:, hdim:])
-    rc = jax.lax.dot((r * h_prev).astype(jnp.bfloat16), wb[:, 2 * hdim:],
-                     preferred_element_type=jnp.float32).astype(h_prev.dtype)
-    c = jnp.tanh(xt[:, 2 * hdim:] + rc)
-    h = u * c + (1.0 - u) * h_prev
-    return alive * h + (1 - alive) * h_prev
-
-
-@jax.custom_vjp
-def gru_seq_pallas(x, alive, w, h0):
-    return _gru_seq_fwd_pallas(x, alive, w, h0)
-
-
-def _gru_seq_fwd(x, alive, w, h0):
-    hs = _gru_seq_fwd_pallas(x, alive, w, h0)
-    return hs, (x, alive, w, h0, hs)
-
-
-def _gru_seq_bwd(res, dhs):
-    x, alive, w, h0, hs = res
-    h_prevs = jnp.concatenate([h0[None], hs[:-1]], axis=0)
-
-    def bstep(carry, inp):
-        dh_next, dw = carry
-        xt, at, hp, dh_out = inp
-        _, vjp = jax.vjp(
-            lambda xv, hv, wv: _gru_step_jnp(xv, hv, wv, at), xt, hp, w)
-        dxt, dhp, dwt = vjp(dh_next + dh_out)
-        return (dhp, dw + dwt), dxt
-
-    (dh0, dw), dx = jax.lax.scan(
-        bstep, (jnp.zeros_like(h0), jnp.zeros_like(w)),
-        (x, alive, h_prevs, dhs), reverse=True)
-    return dx, None, dw, dh0
-
-
-gru_seq_pallas.defvjp(_gru_seq_fwd, _gru_seq_bwd)
+__all__ = ["lstm_seq_pallas", "gru_seq_pallas", "ctc_alpha_pallas"]

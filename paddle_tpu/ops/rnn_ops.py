@@ -64,29 +64,31 @@ def _lstm_scan(x, lens, w, h0, c0, gate_act, cell_act, cand_act,
     diagonal cell->gate connections (math/detail/lstm_kernel.h:37-40:
     i/f see the PREVIOUS cell state, o sees the NEW one). Returns
     hidden [b, L, H], cell [b, L, H]."""
-    from ..core.flags import get_flag
+    from .pallas import use_pallas, kernel_span
 
     b, L, H4 = x.shape
     H = H4 // 4
     ga, ca, cda = _act(gate_act), _act(cell_act), _act(cand_act)
     # the Pallas fused cell implements the standard activation set (the
-    # reference's hand-scheduled hl_cuda_lstm.cu does the same)
-    use_pallas = (get_flag("use_pallas_rnn") and peepholes is None
-                  and (gate_act, cell_act, cand_act)
-                  == ("sigmoid", "tanh", "tanh"))
+    # reference's hand-scheduled hl_cuda_lstm.cu does the same); other
+    # activations / peepholes fall back to the scan with a counter bump
+    supported = (peepholes is None
+                 and (gate_act, cell_act, cand_act)
+                 == ("sigmoid", "tanh", "tanh"))
 
-    if use_pallas:
+    if use_pallas("lstm", supported):
         # whole-recurrence kernel: ONE launch for the full sequence with
         # the recurrent weight VMEM-resident across steps (see
-        # pallas_kernels.lstm_seq_pallas)
-        from .pallas_kernels import lstm_seq_pallas
-        xt = jnp.swapaxes(x, 0, 1)                   # [L, b, 4H]
-        alive = (jnp.arange(L)[:, None] < lens[None, :]) \
-            .astype(x.dtype)[..., None]              # [L, b, 1]
-        hs, cs = lstm_seq_pallas(xt, alive, w, h0, c0)
-        hs = hs * alive
-        cs = cs * alive
-        return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
+        # ops/pallas/rnn.lstm_seq_pallas)
+        from .pallas.rnn import lstm_seq_pallas
+        with kernel_span("pallas", "lstm"):
+            xt = jnp.swapaxes(x, 0, 1)               # [L, b, 4H]
+            alive = (jnp.arange(L)[:, None] < lens[None, :]) \
+                .astype(x.dtype)[..., None]          # [L, b, 1]
+            hs, cs = lstm_seq_pallas(xt, alive, w, h0, c0)
+            hs = hs * alive
+            cs = cs * alive
+            return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
 
     def step(carry, inp):
         h_prev, c_prev, t = carry
@@ -255,19 +257,19 @@ def _gru_compute(x, lens, w, bias, h0, attrs):
     if rev:
         x = _reverse_padded(x, lens)
 
-    from ..core.flags import get_flag
-    use_pallas = (get_flag("use_pallas_rnn")
-                  and attrs.get("gate_activation", "sigmoid") == "sigmoid"
-                  and attrs.get("activation", "tanh") == "tanh")
+    from .pallas import use_pallas, kernel_span
+    supported = (attrs.get("gate_activation", "sigmoid") == "sigmoid"
+                 and attrs.get("activation", "tanh") == "tanh")
 
-    if use_pallas:
-        # whole-recurrence kernel (see pallas_kernels.gru_seq_pallas)
-        from .pallas_kernels import gru_seq_pallas
-        xs = jnp.swapaxes(x, 0, 1)                   # [L, b, 3H]
-        alive = (jnp.arange(L)[:, None] < lens[None, :]) \
-            .astype(x.dtype)[..., None]              # [L, b, 1]
-        hs = gru_seq_pallas(xs, alive, w, h0) * alive
-        hs = jnp.swapaxes(hs, 0, 1)
+    if use_pallas("gru", supported):
+        # whole-recurrence kernel (see ops/pallas/rnn.gru_seq_pallas)
+        from .pallas.rnn import gru_seq_pallas
+        with kernel_span("pallas", "gru"):
+            xs = jnp.swapaxes(x, 0, 1)               # [L, b, 3H]
+            alive = (jnp.arange(L)[:, None] < lens[None, :]) \
+                .astype(x.dtype)[..., None]          # [L, b, 1]
+            hs = gru_seq_pallas(xs, alive, w, h0) * alive
+            hs = jnp.swapaxes(hs, 0, 1)
         if rev:
             hs = _reverse_padded(hs, lens)
         return hs

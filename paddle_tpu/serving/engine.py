@@ -109,6 +109,12 @@ class InferenceEngine:
                             for b in self.buckets}
         self._warmed = False
         self.hot_recompiles = 0
+        # which kernel tier this engine's executables compile with
+        # (ops/pallas tier resolution; re-sampled at warmup so a tier flip
+        # before warmup is reflected — after warmup it names what the
+        # compiled buckets actually used)
+        from ..ops.pallas import resolve_tier
+        self._kernel_tier = resolve_tier()
 
     # ------------------------------------------------------------------
     @property
@@ -183,6 +189,8 @@ class InferenceEngine:
             feed = self._normalize_dtypes(
                 {k: np.asarray(v)[:1] for k, v in sample_feed.items()})
         before = sum(s["compiles"] for s in self._per_bucket.values())
+        from ..ops.pallas import resolve_tier
+        self._kernel_tier = resolve_tier()
         with record_event("serving/warmup", kind="stage"):
             for b in self.buckets:
                 self._dispatch(feed, 1, b)
@@ -275,6 +283,7 @@ class InferenceEngine:
                 "hits": sum(s["hits"] for s in self._per_bucket.values()),
                 "hot_recompiles": self.hot_recompiles,
                 "warmed": self._warmed,
+                "kernel_tier": self._kernel_tier,
             }
 
 

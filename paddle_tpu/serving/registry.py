@@ -92,15 +92,32 @@ class ModelRegistry:
         return sorted(out)
 
     # ------------------------------------------------------------------
-    def publish(self, model, src_dir, version=None):
+    def publish(self, model, src_dir, version=None, kernel_tier=None):
         """Copy the bundle at ``src_dir`` in as ``version`` (next integer
         when None) and make it visible by writing the manifest LAST,
         atomically. Returns the published version number. Versions are
-        immutable: republishing an existing one raises."""
+        immutable: republishing an existing one raises.
+
+        ``kernel_tier`` is a CAPABILITY field recorded in the manifest:
+        which execution tier the publisher validated this bundle with
+        ("pallas"|"jnp"; default = the publisher's resolved tier, see
+        ops/pallas.resolve_tier). Serving replicas surface their own
+        compiled tier through ``InferenceEngine.stats()`` so a rollout
+        gate can compare the two."""
         if not os.path.exists(os.path.join(src_dir, MODEL_FILENAME)):
             raise ValueError(
                 f"publish: {src_dir!r} is not a save_inference_model "
                 f"bundle (no {MODEL_FILENAME!r} file)")
+        # validate BEFORE any filesystem mutation: a raise below the
+        # makedirs would leave a torn manifest-less version dir that
+        # permanently blocks this version number (immutability check)
+        if kernel_tier is None:
+            from ..ops.pallas import resolve_tier
+            kernel_tier = resolve_tier()
+        elif kernel_tier not in ("pallas", "jnp"):
+            raise ValueError(
+                f"kernel_tier capability must be 'pallas' or 'jnp', "
+                f"got {kernel_tier!r}")
         existing = self.versions(model)
         if version is None:
             version = existing[-1] + 1 if existing else 1
@@ -125,7 +142,8 @@ class ModelRegistry:
             # registry holds, not what the source held mid-copy
             files[name] = _sha256_file(os.path.join(dst, name))
         manifest = {"model": model, "version": version, "files": files,
-                    "content_hash": _content_hash(files)}
+                    "content_hash": _content_hash(files),
+                    "kernel_tier": kernel_tier}
         tmp = os.path.join(dst, VERSION_MANIFEST + ".tmp")
         with open(tmp, "w") as f:
             json.dump(manifest, f, indent=1, sort_keys=True)

@@ -1,0 +1,236 @@
+"""fused_conv2d_bn: the fuse pass, both execution tiers, and gradients.
+
+The contract under test (ops/fused_ops.py, ops/pallas/conv_bn.py,
+fluid/fusion.py):
+
+* ``fluid.fuse_conv_bn`` rewrites conv2d→batch_norm(→relu) chains into
+  fused_conv2d_bn ops, and the fused program under ``kernel_tier=jnp``
+  is BITWISE the unfused one (same jaxprs) across a training run.
+* Under ``kernel_tier=pallas`` (interpret mode on CPU) the fused Pallas
+  kernels match to float tolerance, forward AND gradients.
+* Unsupported shapes (here a 5x5 filter) silently route to the jnp twin
+  with a fallback-counter bump — never an error.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+from paddle_tpu.ops import pallas as tier
+
+from op_test import OpTest
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    fluid.set_flags({"kernel_tier": "auto"})
+    tier.reset_fallback_counts()
+
+
+def _build_net(fuse, filter_size=3, stride=1, act="relu", lr=0.05):
+    framework.reset_unique_name()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[8, 8, 3])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pad = (filter_size - 1) // 2
+        c1 = fluid.layers.conv2d(img, 6, filter_size, stride=stride,
+                                 padding=pad, bias_attr=False,
+                                 data_format="NHWC")
+        b1 = fluid.layers.batch_norm(c1, act=act, data_layout="NHWC")
+        c2 = fluid.layers.conv2d(b1, 8, 1, bias_attr=False,
+                                 data_format="NHWC")
+        b2 = fluid.layers.batch_norm(c2, act=None, data_layout="NHWC")
+        pool = fluid.layers.pool2d(b2, pool_type="avg", global_pooling=True,
+                                   data_format="NHWC")
+        logits = fluid.layers.fc(pool, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        if fuse:
+            n = fluid.fuse_conv_bn(main)
+            assert n == 2, f"expected 2 fused chains, got {n}"
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss, startup)
+    return main, startup, loss
+
+
+def _train(fuse, tier_name, steps=4, **build_kw):
+    fluid.set_flags({"kernel_tier": tier_name})
+    main, startup, loss = _build_net(fuse, **build_kw)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.normal(0, 1, (4, 8, 8, 3)).astype("float32"),
+            "label": rng.randint(0, 4, (4, 1)).astype("int64")}
+    return [float(exe.run(main, feed=feed, fetch_list=[loss],
+                          scope=scope)[0]) for _ in range(steps)]
+
+
+def test_fuse_pass_structure():
+    main, _, _ = _build_net(True)
+    types = [op.type for op in main.global_block().ops]
+    assert types.count("fused_conv2d_bn") == 2
+    assert "conv2d" not in types[:types.index("fused_conv2d_bn") + 2]
+    assert "batch_norm" not in types
+    assert types.count("fused_conv2d_bn_grad") == 2
+    # attrs folded: first chain carries the relu, second does not
+    fused = [op for op in main.global_block().ops
+             if op.type == "fused_conv2d_bn"]
+    assert fused[0].attrs["act"] == "relu"
+    assert fused[1].attrs["act"] == ""
+
+
+def test_fused_program_bitwise_under_jnp_tier():
+    base = _train(False, "jnp")
+    fused = _train(True, "jnp")
+    assert base == fused, (base, fused)
+    assert fused[-1] < fused[0], "training must reduce the loss"
+
+
+def test_fused_program_matches_under_pallas_tier():
+    """The whole training trajectory (fwd + grads + running stats) on the
+    interpret-mode Pallas kernels tracks the jnp chain."""
+    base = _train(False, "jnp", steps=5)
+    pallas = _train(True, "pallas", steps=5)
+    np.testing.assert_allclose(pallas, base, rtol=2e-4, atol=1e-5)
+    assert tier.fallback_counts() == {}, "all shapes should be eligible"
+
+
+def test_fused_program_stride2_and_no_act():
+    base = _train(False, "jnp", filter_size=1, stride=2, act=None)
+    pallas = _train(True, "pallas", filter_size=1, stride=2, act=None)
+    np.testing.assert_allclose(pallas, base, rtol=2e-4, atol=1e-5)
+
+
+def test_stride2_stays_fused_under_space_to_depth_flag():
+    """conv_space_to_depth and the fused kernels are disjoint (s2d needs
+    k>1 at stride 2; the fused path takes stride 2 only at k=1), so the
+    flag must NOT knock the 1x1/s2 downsample convs off the Pallas path —
+    the flagship lane runs with s2d on."""
+    tier.reset_fallback_counts()
+    fluid.set_flags({"conv_space_to_depth": True})
+    try:
+        base = _train(False, "jnp", filter_size=1, stride=2, act=None)
+        pallas = _train(True, "pallas", filter_size=1, stride=2, act=None)
+    finally:
+        fluid.set_flags({"conv_space_to_depth": False})
+    np.testing.assert_allclose(pallas, base, rtol=2e-4, atol=1e-5)
+    assert tier.fallback_counts() == {}, \
+        "s2d flag must not force the stride-2 1x1 fused op off Pallas"
+
+
+def test_unsupported_shape_falls_back_silently():
+    """A 5x5 filter has no fused kernel: the op must run its jnp twin
+    (exact answers) and bump the conv_bn fallback counter."""
+    tier.reset_fallback_counts()
+    base = _train(False, "jnp", filter_size=5)
+    pallas = _train(True, "pallas", filter_size=5)
+    # first chain (5x5) falls back bitwise; second (1x1) runs Pallas
+    np.testing.assert_allclose(pallas, base, rtol=2e-4, atol=1e-5)
+    assert tier.fallback_counts().get("conv_bn", 0) > 0
+
+
+def _unfused_reference(x, w, scale, bias, rm, rv, eps, momentum, act):
+    from jax import lax
+    import jax
+    import jax.numpy as jnp
+
+    z = lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "OIHW", "NHWC"))
+    m = jnp.mean(z, axis=(0, 1, 2))
+    v = jnp.var(z, axis=(0, 1, 2))
+    inv = jax.lax.rsqrt(v + eps)
+    y = z * (scale * inv) + (bias - m * scale * inv)
+    if act == "relu":
+        y = jnp.maximum(y, 0)
+    return (np.asarray(y), np.asarray(momentum * rm + (1 - momentum) * m),
+            np.asarray(momentum * rv + (1 - momentum) * v),
+            np.asarray(m), np.asarray(v))
+
+
+class TestFusedConvBnOp(OpTest):
+    """OpTest parity for the op itself under the Pallas tier (interpret
+    mode on CPU): forward outputs incl. the running-stat blend, and
+    gradient parity against the analytically-derived grads of the
+    UNFUSED chain (user_defined_grads — central differences across a
+    batch-norm are numerically hopeless at fp32)."""
+
+    def _setup(self, act="relu"):
+        rng = np.random.RandomState(7)
+        x = rng.normal(0, 1, (2, 6, 6, 3)).astype("float32")
+        w = rng.normal(0, 0.4, (5, 3, 3, 3)).astype("float32")
+        scale = rng.uniform(0.5, 1.5, 5).astype("float32")
+        bias = rng.normal(0, 0.2, 5).astype("float32")
+        rm = rng.normal(0, 0.1, 5).astype("float32")
+        rv = rng.uniform(0.5, 1.5, 5).astype("float32")
+        eps, momentum = 1e-5, 0.9
+        y, new_m, new_v, sm, sv = _unfused_reference(
+            x, w, scale, bias, rm, rv, eps, momentum, act)
+        self.op_type = "fused_conv2d_bn"
+        self.inputs = {"Input": x, "Filter": w, "Scale": scale,
+                       "Bias": bias, "Mean": rm, "Variance": rv}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1,
+                      "data_format": "NHWC", "epsilon": eps,
+                      "momentum": momentum, "act": act}
+        self.outputs = {"Output": y, "MeanOut": new_m, "VarianceOut": new_v,
+                        "SavedMean": sm, "SavedVariance": sv}
+        return x, w, scale, bias, rm, rv, eps, act
+
+    def test_forward_pallas_tier(self):
+        self._setup()
+        fluid.set_flags({"kernel_tier": "pallas"})
+        try:
+            self.check_output(atol=1e-4, rtol=1e-3)
+        finally:
+            fluid.set_flags({"kernel_tier": "auto"})
+
+    def test_forward_jnp_tier(self):
+        self._setup()
+        fluid.set_flags({"kernel_tier": "jnp"})
+        try:
+            self.check_output(atol=1e-5, rtol=1e-4)
+        finally:
+            fluid.set_flags({"kernel_tier": "auto"})
+
+    def test_grad_parity_pallas_vs_jnp_twin(self):
+        """check_grad with user_defined_grads = the jnp tier's own
+        analytic grads: pins the Pallas backward kernel against the
+        unfused chain's backward through the SAME harness."""
+        import jax
+
+        x, w, scale, bias, rm, rv, eps, act = self._setup()
+
+        def loss_fn(xv, wv, sv, bv):
+            import jax.numpy as jnp
+            from jax import lax
+            z = lax.conv_general_dilated(
+                xv, wv, (1, 1), [(1, 1), (1, 1)],
+                dimension_numbers=("NHWC", "OIHW", "NHWC"))
+            m = jnp.mean(z, axis=(0, 1, 2))
+            v = jnp.var(z, axis=(0, 1, 2))
+            inv = jax.lax.rsqrt(v + eps)
+            y = z * (sv * inv) + (bv - m * sv * inv)
+            y = jnp.maximum(y, 0)
+            # loss over Output only: the grad maker drops cotangents of
+            # the statistic outputs (like the unfused batch_norm, whose
+            # grad consumes Y@GRAD alone) — but y's own dependence on
+            # m/v flows, which is exactly what the closed-form BN grad
+            # (and the fused kernel) computes
+            return jnp.mean(y)
+
+        grads = jax.grad(loss_fn, argnums=(0, 1, 2, 3))(
+            *map(np.asarray, (x, w, scale, bias)))
+        fluid.set_flags({"kernel_tier": "pallas"})
+        try:
+            self.check_grad(["Input", "Filter", "Scale", "Bias"],
+                            ["Output"],
+                            user_defined_grads=[np.asarray(g)
+                                                for g in grads],
+                            max_relative_error=5e-3)
+        finally:
+            fluid.set_flags({"kernel_tier": "auto"})

@@ -1,0 +1,123 @@
+"""Fused embedding-lookup+sgd kernel (ops/pallas/embedding.py).
+
+The sgd op's SparseRows branch dispatches here under a Pallas tier:
+gather + rowwise update in ONE kernel, rows pre-merged, sentinels
+reordered to the grid front (the write-race pin below). Numerics are
+pinned against the jnp scatter twin — which is the sgd op's own sparse
+expression — including duplicate ids, sentinel padding rows, and the
+end-to-end is_sparse embedding training program.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+from paddle_tpu.core.sparse import SparseRows, merge_rows
+from paddle_tpu.ops import pallas as tier
+from paddle_tpu.ops.pallas.embedding import (embedding_sgd_pallas,
+                                             embedding_sgd_jnp)
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    fluid.set_flags({"kernel_tier": "auto"})
+    tier.reset_fallback_counts()
+
+
+def _rand_table(rng, v=12, d=6):
+    return jnp.asarray(rng.normal(0, 1, (v, d)).astype("float32"))
+
+
+def test_kernel_matches_scatter_twin_merged_rows():
+    rng = np.random.RandomState(0)
+    w = _rand_table(rng)
+    rows = jnp.asarray([0, 3, 7, 11], jnp.int32)
+    vals = jnp.asarray(rng.normal(0, 1, (4, 6)).astype("float32"))
+    got = embedding_sgd_pallas(w, rows, vals, 0.05)
+    want = embedding_sgd_jnp(w, rows, vals, 0.05)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_kernel_duplicates_and_sentinels_via_merge():
+    """Unmerged duplicate ids + sentinel padding, merged like the sgd op
+    does before dispatch. Regression pin for the sentinel write race: a
+    sentinel clamped to row 0 running AFTER the real row-0 update stomped
+    it with the pre-update row (hence the sentinels-first reorder)."""
+    rng = np.random.RandomState(1)
+    w = _rand_table(rng)
+    rows = jnp.asarray([1, 3, 3, 0, 7, 12, 3, 12], jnp.int32)  # 12 = pad
+    vals = jnp.asarray(rng.normal(0, 1, (8, 6)).astype("float32"))
+    m = merge_rows(SparseRows(rows, vals, 12))
+    got = embedding_sgd_pallas(w, m.rows, m.values, 0.05)
+    # the twin consumes the raw duplicates (scatter-add is linear)
+    want = embedding_sgd_jnp(w, rows, vals, 0.05)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_kernel_all_sentinels_is_identity():
+    rng = np.random.RandomState(2)
+    w = _rand_table(rng)
+    rows = jnp.full((3,), 12, jnp.int32)
+    vals = jnp.asarray(rng.normal(0, 1, (3, 6)).astype("float32"))
+    got = embedding_sgd_pallas(w, rows, vals, 0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(w))
+
+
+def test_kernel_under_jit():
+    rng = np.random.RandomState(3)
+    w = _rand_table(rng)
+    rows = jnp.asarray([2, 5], jnp.int32)
+    vals = jnp.asarray(rng.normal(0, 1, (2, 6)).astype("float32"))
+    f = jax.jit(lambda w, r, v, lr: embedding_sgd_pallas(w, r, v, lr))
+    got = f(w, rows, vals, jnp.float32(0.1))
+    want = embedding_sgd_jnp(w, rows, vals, 0.1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def _train_embedding(tier_name, steps=4):
+    fluid.set_flags({"kernel_tier": tier_name})
+    framework.reset_unique_name()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 17
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64",
+                                lod_level=1)
+        emb = fluid.layers.embedding(ids, size=[15, 8], is_sparse=True)
+        feat = fluid.layers.sequence_pool(emb, "sum")
+        pred = fluid.layers.fc(feat, size=1)
+        label = fluid.layers.data("y", shape=[1])
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, label)))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(5)
+    seqs = [np.array([[0], [4], [4], [9]], "int64"),
+            np.array([[2]], "int64"),
+            np.array([[14], [0]], "int64")]
+    feed = {"ids": seqs, "y": rng.normal(0, 1, (3, 1)).astype("float32")}
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss],
+                            scope=scope)[0]) for _ in range(steps)]
+    table = np.asarray(scope.find_var(
+        [v for v in main.global_block().vars
+         if "embedding" in v or "emb" in v][0]))
+    return losses, table
+
+
+def test_sgd_op_sparse_branch_dispatches_kernel():
+    """End to end: is_sparse embedding + SGD, jnp tier vs pallas tier —
+    same trained losses AND same final table (ragged batch with repeated
+    and sentinel-padded ids)."""
+    base_losses, base_table = _train_embedding("jnp")
+    pl_losses, pl_table = _train_embedding("pallas")
+    np.testing.assert_allclose(pl_losses, base_losses, rtol=5e-4,
+                               atol=1e-6)
+    np.testing.assert_allclose(pl_table, base_table, rtol=5e-4, atol=1e-6)
+    assert base_losses[-1] < base_losses[0]

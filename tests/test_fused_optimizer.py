@@ -1,0 +1,150 @@
+"""fused_sgd / fused_momentum / fused_adam: one op, all dense params.
+
+Contract (ops/optimizer_ops.py fused ops, ops/pallas/optimizer.py,
+fluid.optimizer ``fused=True``): under kernel_tier=jnp the fused op
+applies the per-param dense expressions verbatim — the training
+trajectory is BITWISE the per-param program's; under kernel_tier=pallas
+the whole dense update runs as one arena megakernel (interpret on CPU)
+and matches to float tolerance. SparseRows grads (is_sparse embeddings)
+ride the same fused op on its per-param branch.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+from paddle_tpu.ops import pallas as tier
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    fluid.set_flags({"kernel_tier": "auto"})
+    tier.reset_fallback_counts()
+
+
+def _make_optimizer(kind, fused):
+    if kind == "sgd":
+        return fluid.optimizer.SGD(learning_rate=0.05, fused=fused)
+    if kind == "momentum":
+        return fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                        use_nesterov=True, fused=fused)
+    return fluid.optimizer.Adam(learning_rate=0.01, fused=fused)
+
+
+def _train(kind, fused, tier_name, steps=5, sparse_emb=False):
+    fluid.set_flags({"kernel_tier": tier_name})
+    framework.reset_unique_name()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 13
+    with fluid.program_guard(main, startup):
+        if sparse_emb:
+            ids = fluid.layers.data("ids", shape=[1], dtype="int64",
+                                    lod_level=1)
+            emb = fluid.layers.embedding(ids, size=[12, 6], is_sparse=True)
+            feat = fluid.layers.sequence_pool(emb, "sum")
+        else:
+            feat = fluid.layers.data("x", shape=[6])
+        h = fluid.layers.fc(feat, size=10, act="relu")
+        pred = fluid.layers.fc(h, size=1)
+        label = fluid.layers.data("y", shape=[1])
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, label)))
+        _make_optimizer(kind, fused).minimize(loss, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(1)
+    if sparse_emb:
+        # duplicate ids in one batch exercise the merge/scatter path
+        seqs = [np.array([[1], [3], [3]], "int64"),
+                np.array([[0], [7]], "int64"),
+                np.array([[3]], "int64")]
+        feed = {"ids": seqs,
+                "y": rng.normal(0, 1, (3, 1)).astype("float32")}
+    else:
+        feed = {"x": rng.normal(0, 1, (4, 6)).astype("float32"),
+                "y": rng.normal(0, 1, (4, 1)).astype("float32")}
+    return [float(exe.run(main, feed=feed, fetch_list=[loss],
+                          scope=scope)[0]) for _ in range(steps)]
+
+
+@pytest.mark.parametrize("kind", ["sgd", "momentum", "adam"])
+def test_fused_bitwise_under_jnp_tier(kind):
+    base = _train(kind, fused=False, tier_name="jnp")
+    fused = _train(kind, fused=True, tier_name="jnp")
+    assert base == fused, (kind, base, fused)
+    assert fused[-1] < fused[0]
+
+
+@pytest.mark.parametrize("kind", ["sgd", "momentum", "adam"])
+def test_fused_pallas_megakernel_matches(kind):
+    base = _train(kind, fused=False, tier_name="jnp")
+    pallas = _train(kind, fused=True, tier_name="pallas")
+    np.testing.assert_allclose(pallas, base, rtol=5e-4, atol=1e-6)
+    assert tier.fallback_counts().get("optimizer", 0) == 0
+
+
+@pytest.mark.parametrize("kind", ["sgd", "momentum", "adam"])
+def test_fused_with_sparse_embedding_grad(kind):
+    """An is_sparse embedding's SparseRows grad takes the fused op's
+    per-param branch while the dense params fuse — trajectory matches the
+    per-param program on both tiers."""
+    base = _train(kind, fused=False, tier_name="jnp", sparse_emb=True)
+    fused = _train(kind, fused=True, tier_name="jnp", sparse_emb=True)
+    assert base == fused, (kind, base, fused)
+    pallas = _train(kind, fused=True, tier_name="pallas", sparse_emb=True)
+    np.testing.assert_allclose(pallas, base, rtol=5e-4, atol=1e-6)
+
+
+def test_fused_program_structure():
+    framework.reset_unique_name()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        pred = fluid.layers.fc(fluid.layers.fc(x, size=8), size=1)
+        label = fluid.layers.data("y", shape=[1])
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, label)))
+        fluid.optimizer.Adam(learning_rate=0.01, fused=True).minimize(
+            loss, startup)
+    types = [op.type for op in main.global_block().ops]
+    assert types.count("fused_adam") == 1
+    assert "adam" not in types
+    fused = [op for op in main.global_block().ops
+             if op.type == "fused_adam"][0]
+    assert len(fused.input("Params")) == 4          # 2x (weight + bias)
+    # ONE shared beta-power pair instead of per-param pairs
+    assert len(fused.input("Beta1Pow")) == 1
+    assert types.count("scale") == 2
+
+
+def test_unfused_optimizer_has_no_fused_op():
+    """fused=False (the default) keeps the per-param program — the
+    DistributeTranspiler contract."""
+    framework.reset_unique_name()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        pred = fluid.layers.fc(x, size=1)
+        label = fluid.layers.data("y", shape=[1])
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, label)))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss, startup)
+    types = [op.type for op in main.global_block().ops]
+    assert "fused_sgd" not in types and types.count("sgd") == 2
+
+
+def test_fused_unsupported_optimizer_raises():
+    framework.reset_unique_name()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        pred = fluid.layers.fc(x, size=1)
+        label = fluid.layers.data("y", shape=[1])
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, label)))
+        with pytest.raises(NotImplementedError, match="fused"):
+            fluid.optimizer.Adagrad(learning_rate=0.1, fused=True).minimize(
+                loss, startup)

@@ -1,0 +1,182 @@
+"""The kernel tier's selection/fallback contract (ops/pallas/__init__.py).
+
+Covers: kernel_tier flag resolution (auto|pallas|jnp), the deprecated
+use_pallas_rnn/use_pallas_ctc flags still forcing their kernels (with a
+one-time DeprecationWarning), the silent per-kernel fallback counter for
+unsupported shapes, the Executor jit-cache keying on the tier flag, and
+the kernel-tier capability surfaces (ModelRegistry manifests,
+InferenceEngine.stats()).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.ops import pallas as tier
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    fluid.set_flags({"kernel_tier": "auto", "use_pallas_rnn": False,
+                     "use_pallas_ctc": False})
+    tier.reset_fallback_counts()
+
+
+def test_auto_resolves_jnp_on_cpu():
+    fluid.set_flags({"kernel_tier": "auto"})
+    assert tier.resolve_tier() == "jnp"  # the suite runs on CPU
+    assert not tier.use_pallas("lstm")
+    assert not tier.use_pallas("conv_bn")
+
+
+def test_explicit_tiers():
+    fluid.set_flags({"kernel_tier": "pallas"})
+    assert tier.resolve_tier() == "pallas"
+    assert tier.use_pallas("gru")          # pallas = everywhere, even gru
+    fluid.set_flags({"kernel_tier": "jnp"})
+    assert tier.resolve_tier() == "jnp"
+    assert not tier.use_pallas("lstm")
+
+
+def test_invalid_tier_raises():
+    fluid.set_flags({"kernel_tier": "cuda"})
+    with pytest.raises(ValueError, match="kernel_tier"):
+        tier.resolve_tier()
+    with pytest.raises(ValueError, match="kernel_tier"):
+        tier.use_pallas("lstm")
+
+
+def test_legacy_flag_forces_pallas_with_deprecation_warning():
+    tier._warned_legacy.clear()
+    fluid.set_flags({"kernel_tier": "jnp", "use_pallas_rnn": True})
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert tier.use_pallas("lstm")       # legacy True wins over jnp
+        assert tier.use_pallas("gru")        # same flag covers gru
+        assert tier.use_pallas("lstm")
+    deps = [w for w in caught if issubclass(w.category, DeprecationWarning)]
+    assert len(deps) == 1, "deprecation warning must fire exactly once"
+    assert "use_pallas_rnn" in str(deps[0].message)
+    assert "kernel_tier" in str(deps[0].message)
+
+
+def test_unsupported_shape_falls_back_with_counter_bump():
+    fluid.set_flags({"kernel_tier": "pallas"})
+    tier.reset_fallback_counts()
+    assert not tier.use_pallas("conv_bn", supported=False)
+    assert not tier.use_pallas("conv_bn", supported=False)
+    assert not tier.use_pallas("optimizer", supported=False)
+    assert tier.fallback_counts() == {"conv_bn": 2, "optimizer": 1}
+    # a supported dispatch does not bump
+    assert tier.use_pallas("conv_bn", supported=True)
+    assert tier.fallback_counts()["conv_bn"] == 2
+    # under a jnp tier nothing asks for pallas, so nothing is a fallback
+    fluid.set_flags({"kernel_tier": "jnp"})
+    tier.reset_fallback_counts()
+    assert not tier.use_pallas("conv_bn", supported=False)
+    assert tier.fallback_counts() == {}
+
+
+def test_executor_jit_key_includes_kernel_tier():
+    from paddle_tpu.core import executor as ex
+    assert "kernel_tier" in ex._JIT_KEY_FLAGS
+    fluid.set_flags({"kernel_tier": "jnp"})
+    k1 = ex._jit_flag_key()
+    fluid.set_flags({"kernel_tier": "pallas"})
+    k2 = ex._jit_flag_key()
+    assert k1 != k2, "a tier flip must retrace (distinct jit cache keys)"
+
+
+def _save_tiny_model(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.fc(input=x, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [y], exe, main, scope=scope)
+    return d
+
+
+def test_registry_manifest_and_engine_stats_carry_kernel_tier(tmp_path):
+    from paddle_tpu.serving import InferenceEngine, ModelRegistry
+
+    model_dir = _save_tiny_model(tmp_path)
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    v = reg.publish("m", model_dir)                  # defaults to active tier
+    assert reg.manifest("m", v)["kernel_tier"] == tier.resolve_tier()
+    v2 = reg.publish("m", model_dir, kernel_tier="pallas")
+    assert reg.manifest("m", v2)["kernel_tier"] == "pallas"
+    with pytest.raises(ValueError, match="kernel_tier"):
+        reg.publish("m", model_dir, kernel_tier="cuda")
+    # the failed publish must not leave a torn version dir that bricks
+    # the next publish of that version number
+    v3 = reg.publish("m", model_dir)
+    assert v3 == v2 + 1
+    # verify() still passes: the capability field rides the manifest but
+    # the content hash covers the bundle files only
+    reg.verify("m", v2)
+
+    eng = InferenceEngine(model_dir, buckets="1,2")
+    assert eng.stats()["kernel_tier"] == tier.resolve_tier()
+    # warmup re-samples the tier: an engine warmed under jnp says so
+    fluid.set_flags({"kernel_tier": "jnp"})
+    eng.warmup()
+    st = eng.stats()
+    assert st["kernel_tier"] == "jnp"
+    assert st["warmed"]
+
+
+def test_profiler_spans_distinguish_tiers():
+    """Dispatch sites wrap in pallas/<kernel> vs jnp/<kernel> spans
+    (kind="kernel"), so chrome traces attribute tier time per op."""
+    from paddle_tpu.core import profiler
+    from paddle_tpu.ops.pallas import kernel_span
+
+    profiler.enable_profiler()
+    try:
+        with kernel_span("pallas", "conv_bn"):
+            pass
+        with kernel_span("jnp", "optimizer"):
+            pass
+        evs = profiler.events()
+    finally:
+        profiler.disable_profiler(sorted_key=None)
+    names = {(kind, name) for kind, name, *_ in evs}
+    assert ("kernel", "pallas/conv_bn") in names
+    assert ("kernel", "jnp/optimizer") in names
+
+
+def test_lstm_op_runs_under_pallas_tier():
+    """kernel_tier=pallas engages the whole-recurrence LSTM kernel through
+    the op layer (interpret on CPU) and matches the jnp tier."""
+    def run(tier_name):
+        fluid.set_flags({"kernel_tier": tier_name})
+        from paddle_tpu.fluid import framework
+        framework.reset_unique_name()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 11
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[1], dtype="int64", lod_level=1)
+            e = fluid.layers.embedding(x, size=[10, 8])
+            proj = fluid.layers.fc(e, size=8 * 4)
+            h, _ = fluid.layers.dynamic_lstm(proj, size=8 * 4)
+            pred = fluid.layers.fc(fluid.layers.sequence_last_step(h),
+                                   size=1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(2)
+        seqs = [rng.randint(0, 10, (ln, 1)).astype("int64")
+                for ln in (3, 5, 2)]
+        return exe.run(main, feed={"x": seqs}, fetch_list=[pred],
+                       scope=scope)[0]
+
+    base = run("jnp")
+    pallas = run("pallas")
+    np.testing.assert_allclose(pallas, base, rtol=5e-3, atol=1e-4)
