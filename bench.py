@@ -1034,6 +1034,141 @@ def run_fused_kernels_lane(smoke):
     return out
 
 
+def run_generation_serving_lane(n_clients=8, max_seqs=8, vocab=64, emb=128,
+                                heads=4, n_layers=4, block_size=8,
+                                num_blocks=256, max_len=128,
+                                requests_per_client=3,
+                                gen_lens=(4, 4, 4, 4, 6, 6, 28, 28),
+                                repeats=3):
+    """Tokens/sec + p99 time-to-first-token through the generation server
+    (serving/generate) at ``n_clients`` concurrent token streams,
+    CONTINUOUS batching vs STATIC (gang-scheduled) batching — the A/B
+    that isolates the join-at-step-boundary scheduler's win.
+
+    Protocol: export a tiny decoder-only LM (causal_self_attention
+    sites), serve it twice as a generative ModelServer over the
+    streaming RPC (``continuous=True``, then ``False`` with the same
+    engine geometry), and drive ``requests_per_client`` generations per
+    client with a MOSTLY-SHORT + FEW-LONG length mix. Static batching
+    gang-schedules: a round of up to ``max_seqs`` sequences runs until
+    its LONGEST member finishes, so the short members' slots idle for
+    most of the round and every next-wave request waits for the round to
+    drain before its first token. Continuous batching refills a slot the
+    moment its sequence leaves, so total decode dispatches shrink toward
+    sum(lens)/max_seqs (~2.5x fewer here) and TTFT collapses to
+    admission+prefill. The model is sized so the fixed-shape decode
+    dispatch dominates each step's wall time — on the 2-core CPU box a
+    toy-scale model is bottlenecked by per-token stream/wire handling
+    (GIL), which is identical in both configs and would mask the
+    scheduling win the lane isolates. Greedy decode, no EOS: token
+    counts are deterministic, so both configs do identical model work.
+    Zero hot-path recompiles asserted both ways (the ragged in-flight
+    mix shares ONE fixed-shape decode executable)."""
+    import tempfile
+    import shutil
+    import threading
+
+    from paddle_tpu.core.profiler import percentile
+    from paddle_tpu.serving import ModelServer
+    from paddle_tpu.serving.generate import GenClient
+    from paddle_tpu.testing.models import export_tiny_lm
+
+    tmp = tempfile.mkdtemp(prefix="pdtpu-genserving-")
+    export_tiny_lm(tmp, vocab=vocab, emb=emb, heads=heads,
+                   n_layers=n_layers, max_pos=2 * max_len, seed=11)
+    # per-(client, request) generation length: the (3i + 5j) stride
+    # decorrelates a client's next length from its last, so gang rounds
+    # can't self-sort into same-length batches — most rounds then carry
+    # a LONG member whose tail the short members' slots idle through,
+    # which is exactly the waste continuous batching reclaims by
+    # refilling slots mid-round
+    gen_lens = list(gen_lens)
+    want = [[gen_lens[(3 * i + 5 * j) % len(gen_lens)]
+             for j in range(requests_per_client)]
+            for i in range(n_clients)]
+    total_tokens = sum(sum(w) for w in want)
+
+    def one_config(continuous):
+        server = ModelServer(
+            tmp, model_kind="generative", continuous=continuous,
+            gen_opts=dict(max_seqs=max_seqs, block_size=block_size,
+                          num_blocks=num_blocks, max_len=max_len,
+                          # every lane prompt is 3 tokens: one prefill
+                          # bucket keeps warmup to 2 compiles per config
+                          prefill_buckets=(8,)))
+        server.start()
+        ttft = [[] for _ in range(n_clients)]
+        counts = [0] * n_clients
+        errs = []
+        barrier = threading.Barrier(n_clients + 1)
+
+        def client(i):
+            c = GenClient(server.address)
+            try:
+                c.health()                 # open the conn off the clock
+                barrier.wait()
+                for j, n_new in enumerate(want[i]):
+                    t0 = time.perf_counter()
+                    first = None
+                    for tok in c.generate([1 + i, 2 + j, 3], n_new):
+                        if first is None:
+                            first = time.perf_counter() - t0
+                        counts[i] += 1
+                    ttft[i].append(first)
+            except Exception as e:
+                errs.append((i, e))
+                try:
+                    barrier.abort()
+                except Exception:
+                    pass
+            finally:
+                c.close()
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(n_clients)]
+        try:
+            for t in ts:
+                t.start()
+            try:
+                barrier.wait()
+            except threading.BrokenBarrierError:
+                pass
+            t0 = time.perf_counter()
+            for t in ts:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            st = server.stats()
+        finally:
+            server.shutdown()
+        assert not errs, f"generation clients failed: {errs[:2]}"
+        assert counts == [sum(w) for w in want], \
+            f"token counts {counts} != requested {[sum(w) for w in want]}"
+        recompiles = st["engine"]["hot_recompiles"]
+        assert recompiles == 0, \
+            f"decode hot path recompiled {recompiles}x after warmup"
+        lat = [t for per in ttft for t in per if t is not None]
+        return {
+            "tokens_s": total_tokens / elapsed,
+            "ttft_p99_ms": percentile(lat, 99) * 1e3,
+            "ttft_p50_ms": percentile(lat, 50) * 1e3,
+            "steps": st["batcher"]["steps"],
+            "hot_recompiles": recompiles,
+        }
+
+    def best_of(continuous):
+        # best-of-N by tokens/sec: the lane runs on a GIL-shared 2-core
+        # box where a background stall skews any single run; the best
+        # run is the least-interfered measurement of each config
+        runs = [one_config(continuous) for _ in range(repeats)]
+        return max(runs, key=lambda r: r["tokens_s"])
+
+    try:
+        return {"static": best_of(False),
+                "continuous": best_of(True)}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _best_of(run_fn, label, repeats, **kw):
     """Best-of-N jnp and Pallas timings for one RNN lane; the shared dev
     chip shows large run-to-run variance (8.7..14.4 ms for the identical
@@ -1171,6 +1306,31 @@ def main():
         "hot_recompiles": 0,
         "failovers": fl["fleet_2"]["failovers"],
         "replica_restarts": fl["fleet_2"]["restarts"],
+    })))
+
+    # ---- generation serving lane (continuous batching + paged KV) ----
+    # smoke runs the lane defaults; the full run triples the lengths
+    # (same mostly-short + few-long shape, longer decode share)
+    gen_kw = {} if args.smoke \
+        else dict(gen_lens=(12, 12, 12, 12, 18, 18, 84, 84))
+    gen = run_generation_serving_lane(**gen_kw)
+    print(json.dumps(_rec({
+        "metric": "generation_serving" + ("_smoke" if args.smoke else ""),
+        "value": round(gen["continuous"]["tokens_s"], 1),
+        "unit": "tokens/sec, 8 concurrent GenClient streams over the "
+                "streaming RPC, continuous batching (8 decode slots)",
+        # higher-is-better speedup of continuous over static (gang)
+        # batching — the lane's own baseline (acceptance gate >= 1.3x)
+        "vs_baseline": round(gen["continuous"]["tokens_s"]
+                             / gen["static"]["tokens_s"], 4),
+        "static_tokens_s": round(gen["static"]["tokens_s"], 1),
+        "ttft_p99_ms_continuous": round(gen["continuous"]["ttft_p99_ms"],
+                                        2),
+        "ttft_p99_ms_static": round(gen["static"]["ttft_p99_ms"], 2),
+        "decode_steps_continuous": gen["continuous"]["steps"],
+        "decode_steps_static": gen["static"]["steps"],
+        # asserted zero inside the lane, both configs
+        "hot_recompiles": gen["continuous"]["hot_recompiles"],
     })))
 
     # ---- fused-kernel microbench lane (Pallas kernel tier milestone) ----

@@ -225,6 +225,29 @@ DEFINE_flag("serving_probation_probes", 2,
             "routing set — one lucky probe doesn't un-eject a flapping "
             "replica")
 
+DEFINE_flag("serving_kv_block_size", 16,
+            "tokens per KV-cache block in the generation-serving paged "
+            "arena (serving/generate/kvcache.py): each sequence's context "
+            "occupies ceil(len/block_size) blocks addressed through its "
+            "block table, so smaller blocks waste less tail capacity but "
+            "widen the table. One block is also the copy-on-write unit "
+            "for beam forks")
+
+DEFINE_flag("serving_kv_num_blocks", 256,
+            "blocks in the pre-allocated per-layer KV arena "
+            "([num_blocks, block_size, heads, head_dim] per layer, K and "
+            "V). Sizes the whole serving memory budget up front; when a "
+            "request's worst case cannot be promised from the free "
+            "blocks, admission rejects typed with CacheExhausted and the "
+            "scheduler keeps it queued")
+
+DEFINE_flag("serving_max_seqs", 8,
+            "decode slots in the generation engine's ONE fixed-shape "
+            "[max_seqs, 1] decode executable. Bounds concurrent in-flight "
+            "sequences; ragged sequences share the executable via block "
+            "tables and an active mask, so this is a capacity knob, "
+            "never a retrace trigger")
+
 # PDTPU_FLAGS=check_nan_inf=1,benchmark=0 — unknown names warn and are
 # ignored (a typo'd env var must not make the package unimportable)
 _env = os.environ.get("PDTPU_FLAGS", "")

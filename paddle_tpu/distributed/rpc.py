@@ -69,6 +69,8 @@ from multiprocessing import AuthenticationError
 
 import numpy as np
 
+from types import GeneratorType
+
 from ..core.flags import get_flag
 from ..core.profiler import record_event
 
@@ -522,6 +524,7 @@ class RpcServer:
                         # outcome as arriving after the kill that follows)
                         return
                     self._active += 1
+                gen = None
                 try:
                     if method == "__shutdown__":
                         send_msg(conn, (True, None), wire)
@@ -544,7 +547,14 @@ class RpcServer:
                     try:
                         fn = getattr(self._handler, method)
                         with record_event(f"rpc.serve/{method}", kind="rpc"):
-                            result = (True, fn(**kwargs))
+                            payload = fn(**kwargs)
+                        if isinstance(payload, GeneratorType):
+                            # STREAMING response: the handler returned a
+                            # generator — push one frame per yielded item
+                            gen, payload = payload, None
+                            result = None
+                        else:
+                            result = (True, payload)
                     except Exception as e:  # surface remote errors to caller
                         result = (False, {"code": type(e).__name__,
                                           "message": str(e),
@@ -558,12 +568,24 @@ class RpcServer:
                         rule.fired.set()
                         return
                     try:
-                        ns = send_msg(conn, result, wire)
+                        if gen is not None:
+                            ns = self._stream_response(conn, gen, wire)
+                        else:
+                            ns = send_msg(conn, result, wire)
                     except Exception:
                         return  # client vanished (or kill()ed) mid-reply
                     self.wire_stats.note(method, ns, nr,
                                          time.perf_counter() - t0)
                 finally:
+                    if gen is not None:
+                        # always unwind the handler generator — a severed
+                        # client or drop rule must cancel its work (the
+                        # generation scheduler hooks cancellation into
+                        # GeneratorExit)
+                        try:
+                            gen.close()
+                        except Exception:
+                            pass
                     with self._active_cv:
                         self._active -= 1
                         self._active_cv.notify_all()
@@ -575,6 +597,40 @@ class RpcServer:
             with self._conns_lock:
                 self._conns.discard(conn)
             conn.close()
+
+    def _stream_response(self, conn, gen, wire):
+        """Multi-frame STREAMING response (the unary codec extended, not
+        replaced): a ``("stream", None)`` header message, one
+        ``("item", value)`` message per yielded item (tensors ride the
+        framed codec zero-copy like any unary payload), and a terminal
+        ``("end", None)`` — or ``("error", {code, message, traceback})``
+        when the handler generator raises mid-stream, preserving the
+        structured RemoteError contract at any point of the stream.
+        Returns total bytes sent; send failures (client vanished) raise
+        to the caller, which severs the connection and closes the
+        generator (cancelling the work behind it)."""
+        ns = send_msg(conn, ("stream", None), wire)
+        it = iter(gen)
+        while True:
+            # advance the generator and send OUTSIDE each other's try so
+            # an OSError is attributed correctly: from send_msg = client
+            # vanished (raise to sever), from the HANDLER's own code = a
+            # remote failure that still owes the client its error frame
+            try:
+                item = next(it)
+            except StopIteration:
+                break
+            except Exception as e:
+                ns += send_msg(conn, ("error",
+                                      {"code": type(e).__name__,
+                                       "message": str(e),
+                                       "traceback":
+                                           traceback.format_exc()}),
+                               wire)
+                return ns
+            ns += send_msg(conn, ("item", item), wire)
+        ns += send_msg(conn, ("end", None), wire)
+        return ns
 
     def _wake_and_close_listener(self):
         """Kick the accept loop out of accept(2) BEFORE closing the
@@ -726,6 +782,15 @@ class RpcClient:
                 raise
             self.wire_stats.note(method, ns, nr, time.perf_counter() - t0)
         ok, payload = resp
+        if ok == "stream":
+            # a unary call() on a streaming method would leave the item
+            # frames in the pipe and desync every later call — drop the
+            # connection and point the caller at stream()
+            with self._lock:
+                self._drop_conn()
+            raise RuntimeError(
+                f"rpc method {method!r} answered with a STREAM; consume "
+                "it with RpcClient.stream(), not call()")
         if not ok:
             raise RemoteError.from_payload(method, payload)
         return payload
@@ -746,6 +811,72 @@ class RpcClient:
                 attempt += 1
                 # back off OUTSIDE the conn lock, then reconnect-and-resend
                 time.sleep(self._retry.delay_s(attempt))
+
+    def stream(self, method, **kwargs):
+        """STREAMING call: a generator yielding the server's item frames
+        as they arrive (each within the response ``timeout``), ending at
+        the terminal frame. A mid-stream handler failure raises the same
+        structured :class:`RemoteError` a unary call gets; the stream is
+        positionally intact up to it. A unary response degrades
+        gracefully to a one-item stream.
+
+        The client's connection is DEDICATED to the stream until it ends:
+        the generator holds the client lock, so concurrent streams (or
+        calls during a stream) need separate clients. Abandoning the
+        stream early (``close()``/``break``) drops the connection — the
+        unread frames can't be left to desync a reused socket — which the
+        server observes as a send failure and turns into cancellation of
+        the handler generator. No automatic retry: a generation stream is
+        stateful, so a resend could replay work; callers retry whole
+        streams if their semantics allow."""
+        self._lock.acquire()
+        clean = False
+        try:
+            if self._sock is None:
+                self._sock = self._connect()
+            try:
+                self._sock.settimeout(self._timeout)
+                ns = send_msg(self._sock, (method, kwargs), self._wire)
+                self.wire_stats.note(method, ns, 0, 0.0)
+                kind, payload = self._recv_frame()
+                if kind is True:          # unary answer: one-item stream
+                    clean = True
+                    yield payload
+                    return
+                if kind is False:
+                    clean = True
+                    raise RemoteError.from_payload(method, payload)
+                if kind != "stream":
+                    raise EOFError(
+                        f"corrupt stream header {kind!r} from {method}")
+                while True:
+                    kind, payload = self._recv_frame()
+                    if kind == "item":
+                        yield payload
+                    elif kind == "end":
+                        clean = True
+                        return
+                    elif kind == "error":
+                        clean = True
+                        raise RemoteError.from_payload(method, payload)
+                    else:
+                        raise EOFError(
+                            f"corrupt stream frame {kind!r} from {method}")
+            except TimeoutError:
+                raise TimeoutError(
+                    f"rpc stream {method} timed out waiting for the next "
+                    "frame") from None
+        finally:
+            if not clean:
+                # abandoned or severed mid-stream: unread frames would
+                # desync the next call on this socket
+                self._drop_conn()
+            self._lock.release()
+
+    def _recv_frame(self):
+        obj, nr, _wire = recv_msg(self._sock)
+        self.wire_stats.note("<stream-frame>", 0, nr, 0.0)
+        return obj
 
     def close(self):
         with self._lock:

@@ -963,3 +963,21 @@ def max_pool3d_with_index(input, pool_size, pool_stride=None, name=None):
                      outputs={"Out": [out.name], "Mask": [mask.name]},
                      attrs={"ksize": ks, "strides": st})
     return out, mask
+
+
+def causal_self_attention(q, k, v, num_heads, name=None):
+    """Causal multi-head self-attention over dense [batch, seq, hidden]
+    Q/K/V (already projected, e.g. by ``fc(num_flatten_dims=2)``). One op
+    per transformer layer — the attention site the generation serving
+    engine (serving/generate) recognizes and rewrites into its
+    prefill/paged-decode phase ops over the KV arena."""
+    if q.shape and q.shape[-1] is not None and q.shape[-1] % num_heads:
+        raise ValueError(
+            f"hidden size {q.shape[-1]} must divide num_heads {num_heads}")
+    helper = LayerHelper("causal_self_attention", name=name)
+    out = helper.create_tmp_variable(q.dtype, shape=q.shape)
+    helper.append_op("causal_self_attention",
+                     inputs={"Q": [q.name], "K": [k.name], "V": [v.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"num_heads": int(num_heads)})
+    return out

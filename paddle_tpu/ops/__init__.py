@@ -18,6 +18,7 @@ from . import (  # noqa: F401
     norm_ops,
     sequence_ops,
     rnn_ops,
+    attention_ops,
     control_flow_ops,
     crf_ops,
     ctc_ops,

@@ -1,0 +1,174 @@
+"""Attention ops: causal self-attention and its serving-time split.
+
+``causal_self_attention`` is the model-authoring op (the dense analog of
+the reference's scaled_dot_product_attention composition): one op per
+transformer layer, Q/K/V already projected by ``fc`` layers. At serving
+time the generation engine (serving/generate/decode_engine.py) clones the
+saved program and rewrites every causal_self_attention site into one of
+two phase ops over a PAGED KV arena (the layer *Ragged Paged Attention*
+assumes exists above the kernel):
+
+* ``prefill_attention`` — the same causal attention over the prompt
+  window, plus a scatter of every position's K/V rows into the arena at
+  ``SlotMapping`` (flat ``block*block_size+offset`` slots; out-of-range
+  sentinel slots — padding positions — are dropped by the scatter).
+* ``paged_attention`` — the fixed-shape ``[max_seqs, 1]`` decode step:
+  write the new token's K/V row, then attend its Q against the sequence's
+  context gathered THROUGH its block table. Ragged in-flight sequences
+  share the one executable: each row sees only its own ``ContextLens``
+  prefix, and rows with ``ContextLens == 0`` (inactive slots) write
+  nothing (sentinel slot) and emit zeros.
+
+Both phase ops are row-independent (no cross-row reductions), which is
+what makes continuous batching BITWISE equal to one-sequence-at-a-time
+decode: a sequence's logits depend only on its own tokens, block table
+and the arena rows it wrote, never on which other rows share the batch.
+
+The arena update is functional (the ops output the updated KCache/VCache
+under the SAME variable names, the optimizer-op in-place convention); the
+engine feeds the arena arrays in and fetches them back as device arrays,
+so no host round trip occurs. On TPU the natural next step is donating
+the arena buffers; at current arena sizes the copy is noise.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import data_of
+from .sequence_ops import _vjp_grad
+
+
+def _split_heads(x, num_heads):
+    b, t, e = x.shape
+    if e % num_heads:
+        raise ValueError(
+            f"attention hidden size {e} is not divisible by num_heads "
+            f"{num_heads}")
+    return x.reshape(b, t, num_heads, e // num_heads)
+
+
+def _causal_mha(q, k, v, num_heads):
+    """Plain causal multi-head attention: [b, T, E] x3 -> [b, T, E]."""
+    qh = _split_heads(q, num_heads)
+    kh = _split_heads(k, num_heads)
+    vh = _split_heads(v, num_heads)
+    d = qh.shape[-1]
+    scores = jnp.einsum("bthd,bshd->bhts", qh, kh) * (d ** -0.5)
+    t = q.shape[1]
+    causal = jnp.tril(jnp.ones((t, t), bool))
+    scores = jnp.where(causal[None, None], scores, -1e9)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", p, vh)
+    return out.reshape(q.shape)
+
+
+@register_op("causal_self_attention",
+             grad=_vjp_grad("causal_self_attention", in_slots=("Q", "K", "V")))
+def causal_self_attention(ctx):
+    """Causal MHA over a [b, T, E] window — the training/export form the
+    generation engine's program split rewrites per phase."""
+    q = data_of(ctx.input("Q"))
+    k = data_of(ctx.input("K"))
+    v = data_of(ctx.input("V"))
+    ctx.set_output("Out", _causal_mha(q, k, v, int(ctx.attr("num_heads"))))
+
+
+@register_op("causal_self_attention_grad")
+def causal_self_attention_grad(ctx):
+    q = data_of(ctx.input("Q"))
+    k = data_of(ctx.input("K"))
+    v = data_of(ctx.input("V"))
+    h = int(ctx.attr("num_heads"))
+    d = data_of(ctx.input("Out@GRAD"))
+    _, vjp = jax.vjp(lambda a, b, c: _causal_mha(a, b, c, h), q, k, v)
+    dq, dk, dv = vjp(d)
+    ctx.set_output("Q@GRAD", dq)
+    ctx.set_output("K@GRAD", dk)
+    ctx.set_output("V@GRAD", dv)
+
+
+def _scatter_rows(cache, slots, rows):
+    """Write ``rows`` [n, H, D] into the arena [nb, bs, H, D] at flat slots
+    [n] (block*block_size + offset). Out-of-range slots (the padding / idle
+    sentinel, ``num_blocks * block_size``) are DROPPED — never a wrapped or
+    clamped write into some victim sequence's block."""
+    nb, bs = cache.shape[0], cache.shape[1]
+    flat = cache.reshape((nb * bs,) + cache.shape[2:])
+    flat = flat.at[slots].set(rows, mode="drop")
+    return flat.reshape(cache.shape)
+
+
+@register_op("prefill_attention")
+def prefill_attention(ctx):
+    """Phase 1 of the serving split: causal attention over the (padded)
+    prompt window + K/V scatter into the paged arena. Padding positions map
+    to the out-of-range sentinel slot and write nothing; because padding
+    sits AFTER the real prompt and the mask is causal, every real position's
+    output is independent of the padding, so only slot mapping — not an
+    extra length mask — is needed."""
+    q = data_of(ctx.input("Q"))
+    k = data_of(ctx.input("K"))
+    v = data_of(ctx.input("V"))
+    h = int(ctx.attr("num_heads"))
+    kc = data_of(ctx.input("KCache"))
+    vc = data_of(ctx.input("VCache"))
+    slots = data_of(ctx.input("SlotMapping")).astype(jnp.int32).reshape(-1)
+    kh = _split_heads(k, h).reshape((-1,) + kc.shape[2:])
+    vh = _split_heads(v, h).reshape((-1,) + vc.shape[2:])
+    ctx.set_output("KCacheOut", _scatter_rows(kc, slots, kh))
+    ctx.set_output("VCacheOut", _scatter_rows(vc, slots, vh))
+    ctx.set_output("Out", _causal_mha(q, k, v, h))
+
+
+@register_op("paged_attention")
+def paged_attention(ctx):
+    """Phase 2 of the serving split: one decode step for every slot of the
+    fixed-shape batch. Q/K/V are [max_seqs, 1, E]; the new K/V row is
+    written at ``SlotMapping`` [max_seqs] first (sentinel = no write), then
+    each row's Q attends over the UPDATED arena gathered through its
+    ``BlockTables`` row, masked to its ``ContextLens`` prefix (which counts
+    the just-written token). Inactive rows (ContextLens == 0) output
+    zeros."""
+    q = data_of(ctx.input("Q"))
+    k = data_of(ctx.input("K"))
+    v = data_of(ctx.input("V"))
+    h = int(ctx.attr("num_heads"))
+    kc = data_of(ctx.input("KCache"))
+    vc = data_of(ctx.input("VCache"))
+    bt = data_of(ctx.input("BlockTables")).astype(jnp.int32)   # [b, P]
+    ctx_lens = data_of(ctx.input("ContextLens")).astype(jnp.int32)  # [b]
+    slots = data_of(ctx.input("SlotMapping")).astype(jnp.int32).reshape(-1)
+
+    nb, bs = kc.shape[0], kc.shape[1]
+    kh = _split_heads(k, h).reshape((-1,) + kc.shape[2:])      # [b, H, D]
+    vh = _split_heads(v, h).reshape((-1,) + vc.shape[2:])
+    kc = _scatter_rows(kc, slots, kh)
+    vc = _scatter_rows(vc, slots, vh)
+    ctx.set_output("KCacheOut", kc)
+    ctx.set_output("VCacheOut", vc)
+
+    b, p = bt.shape
+    # flat arena indices of every context position this row may see:
+    # [b, P, bs] -> [b, C]; unused table entries gather garbage that the
+    # ContextLens mask below excludes from the softmax
+    idx = (bt[:, :, None] * bs
+           + jnp.arange(bs, dtype=jnp.int32)[None, None, :]).reshape(b, -1)
+    kf = kc.reshape((nb * bs,) + kc.shape[2:])
+    vf = vc.reshape((nb * bs,) + vc.shape[2:])
+    kctx = kf[idx]                                             # [b, C, H, D]
+    vctx = vf[idx]
+    qh = _split_heads(q, h)[:, 0]                              # [b, H, D]
+    d = qh.shape[-1]
+    scores = jnp.einsum("bhd,bchd->bhc", qh, kctx) * (d ** -0.5)
+    live = jnp.arange(idx.shape[1], dtype=jnp.int32)[None, :] \
+        < ctx_lens[:, None]                                    # [b, C]
+    scores = jnp.where(live[:, None, :], scores, -1e9)
+    # a fully-masked (inactive) row softmaxes to uniform weights over
+    # garbage — finite, never NaN — and is zeroed by the active mask below
+    pw = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhc,bchd->bhd", pw, vctx).reshape(b, 1, -1)
+    active = (ctx_lens > 0)[:, None, None]
+    ctx.set_output("Out", jnp.where(active, out, 0.0))

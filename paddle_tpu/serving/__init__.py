@@ -41,7 +41,11 @@ from .client import InferClient
 from .registry import ModelRegistry
 from .fleet import FleetSupervisor
 from .router import FleetClient
+from .generate import (PagedKVCache, CacheExhausted, GenerationEngine,
+                       NoFreeSlots, ContinuousBatcher, GenClient)
 
 __all__ = ["InferenceEngine", "DynamicBatcher", "ServerOverloaded",
            "ModelServer", "InferClient", "ModelRegistry",
-           "FleetSupervisor", "FleetClient"]
+           "FleetSupervisor", "FleetClient",
+           "PagedKVCache", "CacheExhausted", "GenerationEngine",
+           "NoFreeSlots", "ContinuousBatcher", "GenClient"]

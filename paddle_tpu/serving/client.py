@@ -25,6 +25,16 @@ from ..distributed.rpc import (RemoteError, RetryPolicy, RpcClient,
 from .batcher import ServerOverloaded
 
 
+def raise_typed(e):
+    """Re-raise a :class:`RemoteError` as its typed client-side form when
+    its structured code names one (``ServerOverloaded`` today) — the ONE
+    place the wire-code -> client-type mapping lives (InferClient and
+    GenClient both route every remote failure through it)."""
+    if e.code == "ServerOverloaded":
+        raise ServerOverloaded(e.remote_message) from None
+    raise e
+
+
 class InferClient:
     """``InferClient(address)`` retries connection failures by default
     (``retry=None`` disables; pass a ``RetryPolicy`` to tune)."""
@@ -42,9 +52,7 @@ class InferClient:
         try:
             return self._rpc.call(method, **kwargs)
         except RemoteError as e:
-            if e.code == "ServerOverloaded":
-                raise ServerOverloaded(e.remote_message) from None
-            raise
+            raise_typed(e)
 
     def infer(self, feed):
         """One request; returns the fetch arrays for these rows. Raises

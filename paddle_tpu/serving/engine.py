@@ -37,13 +37,23 @@ from ..core.types import np_dtype
 
 
 def parse_buckets(spec=None):
-    """'1,2,4,8' -> sorted unique positive ints (flag default when None)."""
+    """'1,2,4,8' -> sorted unique positive ints (flag default when None).
+
+    Unsorted and duplicate entries are normalized (sorted, deduped);
+    empty specs, non-integer entries and non-positive entries raise ONE
+    typed ValueError naming the offending spec — never a raw int() parse
+    error from deep inside, and never a silently-accepted bucket list
+    whose order the bisect-based ``bucket_for`` would then misread."""
     if spec is None:
         spec = get_flag("serving_batch_buckets")
-    if isinstance(spec, str):
-        vals = [int(s) for s in spec.split(",") if s.strip()]
-    else:
-        vals = [int(b) for b in spec]
+    try:
+        if isinstance(spec, str):
+            vals = [int(s) for s in spec.split(",") if s.strip()]
+        else:
+            vals = [int(b) for b in spec]
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"serving batch buckets must be positive ints, "
+                         f"got {spec!r} ({e})") from e
     if not vals or any(b <= 0 for b in vals):
         raise ValueError(f"serving batch buckets must be positive ints, "
                          f"got {spec!r}")

@@ -1,0 +1,33 @@
+"""Generation serving: continuous batching + paged KV cache + streaming.
+
+The stateful-decode subsystem on top of the serving stack (PRs 4-5): a
+generative saved program (a decoder-only LM authored with
+``fluid.layers.causal_self_attention`` sites — see
+``testing/models.build_tiny_lm``) serves autoregressive token streams
+with the same no-hot-path-recompiles discipline the feed-forward engine
+pins, despite every in-flight sequence having a different length.
+
+* :class:`PagedKVCache` (kvcache.py) — the paged KV arena: fixed-size
+  blocks, per-sequence block tables, typed :class:`CacheExhausted`
+  admission control, block recycling, copy-on-write beam forks.
+* :class:`GenerationEngine` (decode_engine.py) — splits the saved
+  program into a per-bucket PREFILL executable and ONE fixed-shape
+  ``[max_seqs, 1]`` DECODE executable over the arena; greedy / top-k /
+  beam (the dense ``beam_search`` op) sampling host-side per sequence.
+* :class:`ContinuousBatcher` (scheduler.py) — sequences join the running
+  batch at any step boundary and leave at EOS/max-len; bounded wait
+  queue with the typed ``ServerOverloaded`` fast-reject contract.
+* :class:`GenClient` (client.py) — consumes ``ModelServer``'s streaming
+  ``generate`` RPC (multi-frame responses on the framed codec), yielding
+  tokens as they decode.
+"""
+
+from .kvcache import PagedKVCache, CacheExhausted
+from .decode_engine import (GenerationEngine, NoFreeSlots,
+                            normalize_sampling)
+from .scheduler import ContinuousBatcher, TokenStream
+from .client import GenClient
+
+__all__ = ["PagedKVCache", "CacheExhausted", "GenerationEngine",
+           "NoFreeSlots", "normalize_sampling", "ContinuousBatcher",
+           "TokenStream", "GenClient"]

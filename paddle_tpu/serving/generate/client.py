@@ -1,0 +1,71 @@
+"""GenClient: the streaming twin of InferClient.
+
+``generate()`` is a GENERATOR over the server's multi-frame streaming
+response: each frame carries the tokens one continuous-batching step
+produced for this request, so the caller sees tokens as they decode
+(time-to-first-token = admission + prefill + one sample, not the whole
+generation). Remote failures keep the typed contract:
+
+* :class:`~..batcher.ServerOverloaded` — the generation wait queue
+  rejected the request; back off (never auto-retried).
+* any other handler failure — :class:`~...distributed.rpc.RemoteError`
+  with the remote code/traceback, raised mid-stream at the exact frame
+  the server failed.
+
+Connection failures are NOT auto-retried: a generation stream is
+stateful (a blind resend would decode the prompt twice), so the caller
+owns whole-stream retries. One client supports one stream at a time
+(the connection is dedicated until the terminal frame); use one
+GenClient per concurrent stream. Abandoning the iterator cancels the
+request server-side — the scheduler frees its slot and blocks.
+"""
+
+from __future__ import annotations
+
+from ...distributed.rpc import RemoteError, RpcClient, WIRE_FRAMED
+from ..client import raise_typed
+
+
+class GenClient:
+    def __init__(self, address, timeout=None, wire=WIRE_FRAMED):
+        self._rpc = RpcClient(address, timeout=timeout, retry=None,
+                              wire=wire)
+
+    def generate(self, prompt, max_new_tokens, sampling=None):
+        """Yield generated token ids for ``prompt`` as the server decodes
+        them. ``sampling`` is the ``normalize_sampling`` dict form
+        ({"mode": "greedy"|"topk"|"beam", ...}); beam streams emit the
+        winning hypothesis once, at completion."""
+        try:
+            for frame in self._rpc.stream(
+                    "generate", prompt=[int(t) for t in prompt],
+                    max_new_tokens=int(max_new_tokens), sampling=sampling):
+                for t in frame["tokens"]:
+                    yield int(t)
+        except RemoteError as e:
+            raise_typed(e)
+
+    def _call(self, method, **kwargs):
+        try:
+            return self._rpc.call(method, **kwargs)
+        except RemoteError as e:
+            raise_typed(e)
+
+    def health(self):
+        return self._call("health")
+
+    def stats(self):
+        return self._call("stats")
+
+    def close(self):
+        self._rpc.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+__all__ = ["GenClient"]

@@ -1,0 +1,687 @@
+"""GenerationEngine: stateful autoregressive decode over a saved program.
+
+The :class:`~..engine.InferenceEngine` sibling for generative bundles. A
+generative saved program is a decoder-only LM over a token window —
+feeds ``tokens`` (``[batch, seq, 1]`` int64, plus optional ``positions``),
+one logits fetch ``[batch, seq, vocab]`` — whose attention sites are
+``causal_self_attention`` ops (fluid.layers.causal_self_attention). The
+engine SPLITS that one program into the two serving phases:
+
+* **prefill** — the program cloned with every attention site rewritten to
+  ``prefill_attention``: causal attention over the (bucket-padded) prompt
+  window that also scatters each position's K/V into the paged arena
+  (kvcache.py). One executable per prompt-length bucket, compiled at
+  :meth:`warmup`.
+* **decode** — the clone rewritten to ``paged_attention``: a fixed-shape
+  ``[max_seqs, 1]`` step over the arena. Ragged in-flight sequences share
+  this ONE executable through their block tables and context lengths;
+  idle slots ride along masked (sentinel slot, context length 0). The hot
+  path never retraces — ``stats()`` carries per-phase compile/hit
+  counters and the same ``hot_recompiles`` alarm the feed-forward engine
+  has.
+
+Sampling is host-side and PER-SEQUENCE — greedy argmax, top-k (own
+``numpy.RandomState`` seeded per request), or beam search riding the
+dense ``beam_search`` op (ops/control_flow_ops.py) with copy-on-write
+block-table forks for hypothesis reordering. Because the phase ops are
+row-independent and sampling state is per-sequence, a sequence's token
+stream is BITWISE identical whether it decodes alone or joins a running
+continuous batch — the parity contract the scheduler and tests pin.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ...core.flags import get_flag
+from ...core.profiler import record_event
+from ...core.scope import Scope
+from ..engine import parse_buckets
+from .kvcache import CacheExhausted, PagedKVCache
+
+ATTENTION_OP = "causal_self_attention"
+_SLOTS = "__kv_slots__"
+_TABLES = "__kv_block_tables__"
+_CTXLENS = "__kv_context_lens__"
+
+
+class NoFreeSlots(RuntimeError):
+    """All ``max_seqs`` decode slots are occupied: the admission-control
+    twin of :class:`CacheExhausted` for the slot dimension. The scheduler
+    keeps the request queued until a sequence finishes."""
+
+
+def _kv_name(kind, layer):
+    return f"__kv_{kind}_{layer}__"
+
+
+def normalize_sampling(sampling):
+    """Validate/default a sampling spec (a plain dict so it crosses the
+    RPC wire untouched): ``mode`` greedy | topk | beam, with ``top_k``/
+    ``temperature``/``seed`` for topk and ``beam_size`` for beam;
+    ``eos_id`` (None = run to max_new_tokens) applies to all modes."""
+    s = dict(sampling or {})
+    mode = s.pop("mode", "greedy")
+    out = {"mode": mode,
+           "eos_id": s.pop("eos_id", None),
+           "top_k": int(s.pop("top_k", 8)),
+           "temperature": float(s.pop("temperature", 1.0)),
+           "seed": int(s.pop("seed", 0)),
+           "beam_size": int(s.pop("beam_size", 4))}
+    if s:
+        raise ValueError(f"unknown sampling fields {sorted(s)}")
+    if mode not in ("greedy", "topk", "beam"):
+        raise ValueError(f"sampling mode must be greedy|topk|beam, "
+                         f"got {mode!r}")
+    if mode == "topk" and out["top_k"] <= 0:
+        raise ValueError("top_k must be positive")
+    if mode == "topk" and out["temperature"] <= 0:
+        raise ValueError("temperature must be positive")
+    if mode == "beam" and out["beam_size"] < 2:
+        raise ValueError("beam_size must be >= 2")
+    if out["eos_id"] is not None:
+        out["eos_id"] = int(out["eos_id"])
+    return out
+
+
+def _log_softmax(x):
+    x = x - x.max()
+    return x - np.log(np.exp(x).sum())
+
+
+class _Sequence:
+    """One decode slot's state (a beam hypothesis is one of these too)."""
+
+    __slots__ = ("seq_id", "slot", "next_token", "emitted", "max_new",
+                 "params", "rng", "group", "finished", "user_data")
+
+    def __init__(self, seq_id, slot, params, max_new):
+        self.seq_id = seq_id
+        self.slot = slot
+        self.params = params
+        self.max_new = max_new
+        self.next_token = 0
+        self.emitted = 0
+        self.rng = np.random.RandomState(params["seed"] & 0x7FFFFFFF)
+        self.group = None          # set for beam hypotheses
+        self.finished = False
+        self.user_data = None      # scheduler's stream handle
+
+
+class _BeamGroup:
+    """A beam request: ``beam_size`` sequences advancing in lockstep."""
+
+    __slots__ = ("seqs", "pre_ids", "pre_scores", "hist_ids",
+                 "hist_parents", "steps", "max_new", "end_id", "finished",
+                 "user_data")
+
+    def __init__(self, seqs, max_new, end_id):
+        self.seqs = seqs
+        self.max_new = max_new
+        # -1 never matches a real token: "no EOS" runs to max_new
+        self.end_id = -1 if end_id is None else int(end_id)
+        self.pre_ids = None
+        self.pre_scores = None
+        self.hist_ids = []
+        self.hist_parents = []
+        self.steps = 0
+        self.finished = False
+        self.user_data = None
+
+
+class GenerationEngine:
+    """``GenerationEngine(model_dir)`` loads a generative bundle into a
+    private scope and splits it; ``max_seqs``/``block_size``/``num_blocks``
+    default from the ``serving_max_seqs`` / ``serving_kv_block_size`` /
+    ``serving_kv_num_blocks`` flags; ``max_len`` bounds prompt+generation
+    per sequence (it sizes the block-table width); ``prefill_buckets``
+    are the prompt-length pads (default: powers of two up to ``max_len``).
+
+    Thread safety: like InferenceEngine, dispatches serialize on a lock;
+    the ContinuousBatcher drives the engine from one worker thread."""
+
+    def __init__(self, model_dir=None, program=None, feed_names=None,
+                 fetch_vars=None, executor=None, scope=None, max_seqs=None,
+                 block_size=None, num_blocks=None, max_len=128,
+                 prefill_buckets=None):
+        import paddle_tpu.fluid as fluid
+
+        self._scope = scope or Scope()
+        self._exe = executor or fluid.Executor()
+        if model_dir is not None:
+            program, feed_names, fetch_vars = fluid.io.load_inference_model(
+                model_dir, self._exe, scope=self._scope)
+        if program is None or feed_names is None or fetch_vars is None:
+            raise ValueError(
+                "GenerationEngine needs model_dir= or all of program=/"
+                "feed_names=/fetch_vars=")
+        self._feed_names = list(feed_names)
+        unknown = [n for n in self._feed_names
+                   if n not in ("tokens", "positions")]
+        if "tokens" not in self._feed_names or unknown:
+            raise ValueError(
+                "a generative bundle feeds 'tokens' (and optionally "
+                f"'positions'); this one feeds {self._feed_names}")
+        fetch_names = [v if isinstance(v, str) else v.name
+                       for v in fetch_vars]
+        if len(fetch_names) != 1:
+            raise ValueError(
+                f"a generative bundle fetches exactly its logits, "
+                f"got {fetch_names}")
+        self._logits_name = fetch_names[0]
+
+        self.max_seqs = int(max_seqs if max_seqs is not None
+                            else get_flag("serving_max_seqs"))
+        self.max_len = int(max_len)
+        if self.max_seqs <= 0 or self.max_len <= 0:
+            raise ValueError("max_seqs and max_len must be positive")
+
+        layers, heads, head_dim = self._attention_config(program)
+        self.num_layers = layers
+        self.cache = PagedKVCache(layers, heads, head_dim,
+                                  num_blocks=num_blocks,
+                                  block_size=block_size)
+        self._table_width = self.cache.blocks_for(self.max_len)
+        self._prefill_program = self._rewrite(program, "prefill_attention")
+        self._decode_program = self._rewrite(program, "paged_attention")
+        if prefill_buckets is None:
+            b, buckets = 8, []
+            while b < self.max_len:
+                buckets.append(b)
+                b *= 2
+            buckets.append(b)
+            prefill_buckets = buckets
+        self.prefill_buckets = parse_buckets(prefill_buckets)
+
+        self._slots = [None] * self.max_seqs
+        self._groups = []
+        self._next_seq_id = 0
+        self._lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._seen = set()
+        self._phase = {"prefill": {}, "decode": {}}
+        self._warmed = False
+        self.hot_recompiles = 0
+        from ...ops.pallas import resolve_tier
+        self._kernel_tier = resolve_tier()
+
+    # ------------------------------------------------------------------
+    # program split
+    # ------------------------------------------------------------------
+    def _attention_config(self, program):
+        block = program.global_block()
+        sites = [op for op in block.ops if op.type == ATTENTION_OP]
+        if not sites:
+            raise ValueError(
+                "program has no causal_self_attention sites: not a "
+                "generative bundle (use InferenceEngine for feed-forward "
+                "models)")
+        configs = set()
+        for op in sites:
+            heads = int(op.attr("num_heads"))
+            kvar = block.var(op.input("K")[0])
+            hidden = int(kvar.shape[-1])
+            configs.add((heads, hidden // heads))
+        if len(configs) != 1:
+            raise ValueError(
+                f"attention sites disagree on (heads, head_dim): "
+                f"{sorted(configs)}")
+        heads, head_dim = configs.pop()
+        return len(sites), heads, head_dim
+
+    def _rewrite(self, program, phase_op):
+        """Clone the program and rewrite every attention site into the
+        phase op, wiring the per-layer arena vars in and out under the
+        SAME names (the optimizer-op in-place convention) so the arena
+        update stays on device."""
+        from ...fluid.framework import Operator
+
+        p = program.clone(for_test=True)
+        block = p.global_block()
+        layer = 0
+        for i, op in enumerate(block.ops):
+            if op.type != ATTENTION_OP:
+                continue
+            inputs = dict(op.inputs)
+            outputs = dict(op.outputs)
+            inputs["KCache"] = [_kv_name("k", layer)]
+            inputs["VCache"] = [_kv_name("v", layer)]
+            inputs["SlotMapping"] = [_SLOTS]
+            outputs["KCacheOut"] = [_kv_name("k", layer)]
+            outputs["VCacheOut"] = [_kv_name("v", layer)]
+            if phase_op == "paged_attention":
+                inputs["BlockTables"] = [_TABLES]
+                inputs["ContextLens"] = [_CTXLENS]
+            block.ops[i] = Operator(block, phase_op, inputs, outputs,
+                                    dict(op.attrs))
+            layer += 1
+        return p
+
+    # ------------------------------------------------------------------
+    # dispatch plumbing
+    # ------------------------------------------------------------------
+    def _arena_feed(self):
+        feed = {}
+        for l in range(self.num_layers):
+            feed[_kv_name("k", l)] = self.cache.k[l]
+            feed[_kv_name("v", l)] = self.cache.v[l]
+        return feed
+
+    def _arena_fetch_names(self):
+        return [_kv_name(k, l) for l in range(self.num_layers)
+                for k in ("k", "v")]
+
+    def _dispatch(self, program, feed, phase, bucket):
+        with self._stats_lock:
+            per = self._phase[phase].setdefault(
+                bucket, {"compiles": 0, "hits": 0})
+            if (phase, bucket) in self._seen:
+                per["hits"] += 1
+            else:
+                self._seen.add((phase, bucket))
+                per["compiles"] += 1
+                if self._warmed:
+                    self.hot_recompiles += 1
+        fetch = [self._logits_name] + self._arena_fetch_names()
+        with record_event(f"serving/gen_{phase}_b{bucket}", kind="stage"):
+            outs = self._exe.run(program, feed=feed, fetch_list=fetch,
+                                 scope=self._scope, return_numpy=False)
+        for l in range(self.num_layers):
+            self.cache.k[l] = outs[1 + 2 * l]
+            self.cache.v[l] = outs[2 + 2 * l]
+        return np.asarray(outs[0], np.float32)
+
+    def _prefill_bucket(self, n):
+        import bisect
+        i = bisect.bisect_left(self.prefill_buckets, n)
+        if i == len(self.prefill_buckets):
+            raise ValueError(
+                f"prompt of {n} tokens exceeds the largest prefill "
+                f"bucket {self.prefill_buckets[-1]}")
+        return self.prefill_buckets[i]
+
+    def _run_prefill(self, seq, prompt):
+        bucket = self._prefill_bucket(len(prompt))
+        toks = np.zeros((1, bucket, 1), np.int64)
+        toks[0, :len(prompt), 0] = prompt
+        slots = np.full((1, bucket), self.cache.sentinel_slot, np.int32)
+        slots[0, :len(prompt)] = self.cache.append_slots(
+            seq.seq_id, len(prompt))
+        feed = self._arena_feed()
+        feed["tokens"] = toks
+        feed[_SLOTS] = slots
+        if "positions" in self._feed_names:
+            feed["positions"] = np.arange(bucket, dtype=np.int64) \
+                .reshape(1, bucket, 1)
+        logits = self._dispatch(self._prefill_program, feed, "prefill",
+                                bucket)
+        return logits[0, len(prompt) - 1]          # [vocab]
+
+    def _run_decode(self):
+        S, P = self.max_seqs, self._table_width
+        toks = np.zeros((S, 1, 1), np.int64)
+        pos = np.zeros((S, 1, 1), np.int64)
+        tables = np.zeros((S, P), np.int32)
+        ctx = np.zeros(S, np.int32)
+        slots = np.full(S, self.cache.sentinel_slot, np.int32)
+        for s in self._slots:
+            if s is None or s.finished:
+                continue
+            j = s.slot
+            toks[j, 0, 0] = s.next_token
+            pos[j, 0, 0] = self.cache.context_len(s.seq_id)
+            slots[j] = self.cache.append_slots(s.seq_id, 1)[0]
+            tables[j] = self.cache.block_table(s.seq_id, P)
+            ctx[j] = self.cache.context_len(s.seq_id)
+        feed = self._arena_feed()
+        feed["tokens"] = toks
+        feed[_SLOTS] = slots
+        feed[_TABLES] = tables
+        feed[_CTXLENS] = ctx
+        if "positions" in self._feed_names:
+            feed["positions"] = pos
+        logits = self._dispatch(self._decode_program, feed, "decode",
+                                self.max_seqs)
+        return logits[:, 0]                        # [max_seqs, vocab]
+
+    # ------------------------------------------------------------------
+    def warmup(self, sample_feed=None):
+        """Compile the decode executable and every prefill bucket with
+        inert feeds (sentinel slots: nothing is written to the arena).
+        Returns the number of executables compiled."""
+        del sample_feed                            # engine derives its own
+        with self._lock:
+            before = self._compiles()
+            from ...ops.pallas import resolve_tier
+            self._kernel_tier = resolve_tier()
+            with record_event("serving/gen_warmup", kind="stage"):
+                self._run_decode()
+                for b in self.prefill_buckets:
+                    toks = np.zeros((1, b, 1), np.int64)
+                    slots = np.full((1, b), self.cache.sentinel_slot,
+                                    np.int32)
+                    feed = self._arena_feed()
+                    feed["tokens"] = toks
+                    feed[_SLOTS] = slots
+                    if "positions" in self._feed_names:
+                        feed["positions"] = np.arange(b, dtype=np.int64) \
+                            .reshape(1, b, 1)
+                    self._dispatch(self._prefill_program, feed, "prefill",
+                                   b)
+            self._warmed = True
+            return self._compiles() - before
+
+    def _compiles(self):
+        return sum(s["compiles"] for per in self._phase.values()
+                   for s in per.values())
+
+    # ------------------------------------------------------------------
+    # sampling
+    # ------------------------------------------------------------------
+    def _sample(self, seq, logits):
+        p = seq.params
+        if p["mode"] == "greedy":
+            return int(np.argmax(logits))
+        k = min(p["top_k"], logits.shape[0])
+        # deterministic top-k: stable sort on (-logit, index)
+        idx = np.lexsort((np.arange(logits.shape[0]), -logits))[:k]
+        logp = _log_softmax(logits[idx].astype(np.float64)
+                            / p["temperature"])
+        probs = np.exp(logp)
+        probs /= probs.sum()
+        r = seq.rng.random_sample()
+        return int(idx[np.searchsorted(np.cumsum(probs), r,
+                                       side="right").clip(0, k - 1)])
+
+    # ------------------------------------------------------------------
+    # sequence lifecycle
+    # ------------------------------------------------------------------
+    @property
+    def active_sequences(self):
+        return sum(1 for s in self._slots if s is not None)
+
+    def _free_slots(self):
+        return [i for i, s in enumerate(self._slots) if s is None]
+
+    def _new_seq(self, slot, params, max_new):
+        seq = _Sequence(self._next_seq_id, slot, params, max_new)
+        self._next_seq_id += 1
+        return seq
+
+    def start(self, prompt, max_new_tokens, sampling=None):
+        """Admit + prefill one request. Returns ``(handle, first_tokens,
+        finished)`` — the first token(s) stream immediately (time to
+        first token = admission + prefill + one sample). Raises
+        :class:`NoFreeSlots` / :class:`CacheExhausted` typed (and admits
+        nothing) when the request cannot join the running batch."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("prompt must have at least one token")
+        max_new = int(max_new_tokens)
+        if max_new <= 0:
+            raise ValueError("max_new_tokens must be positive")
+        if len(prompt) + max_new > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
+                f"exceeds the engine's max_len {self.max_len}")
+        params = normalize_sampling(sampling)
+        # NEVER-satisfiable requests must raise ValueError (a bad request
+        # the scheduler pops and fails), not NoFreeSlots/CacheExhausted
+        # (transient capacity the strict-FIFO scheduler would wait on
+        # forever, wedging the queue behind the head)
+        beam = params["beam_size"] if params["mode"] == "beam" else 1
+        if beam > self.max_seqs:
+            raise ValueError(
+                f"beam_size {beam} exceeds the engine's {self.max_seqs} "
+                f"decode slots: this request can never be admitted")
+        headroom = 1 if params["mode"] == "beam" else 0
+        need = beam * (self.cache.blocks_for(len(prompt) + max_new)
+                       + headroom)
+        if need > self.cache.num_blocks:
+            raise ValueError(
+                f"request needs {need} KV blocks worst-case but the arena "
+                f"only has {self.cache.num_blocks}: it can never be "
+                f"admitted (raise serving_kv_num_blocks or lower "
+                f"max_new_tokens)")
+        with self._lock:
+            if params["mode"] == "beam":
+                return self._start_beam(prompt, max_new, params)
+            free = self._free_slots()
+            if not free:
+                raise NoFreeSlots(
+                    f"all {self.max_seqs} decode slots are busy")
+            slot = free[0]
+            seq = self._new_seq(slot, params, max_new)
+            self.cache.admit(seq.seq_id, len(prompt) + max_new)
+            try:
+                logits = self._run_prefill(seq, prompt)
+            except Exception:
+                self.cache.release(seq.seq_id)
+                raise
+            self._slots[slot] = seq
+            tok = self._sample(seq, logits)
+            toks, finished = self._advance(seq, tok)
+            if finished:
+                self._retire(seq)
+            return seq, toks, finished
+
+    def _advance(self, seq, tok):
+        """Apply one sampled token to a greedy/topk sequence; returns
+        (tokens_to_emit, finished). EOS is consumed, not emitted."""
+        if seq.params["eos_id"] is not None and tok == seq.params["eos_id"]:
+            seq.finished = True
+            return [], True
+        seq.emitted += 1
+        seq.next_token = tok
+        if seq.emitted >= seq.max_new:
+            seq.finished = True
+            return [tok], True
+        return [tok], False
+
+    def _start_beam(self, prompt, max_new, params):
+        B = params["beam_size"]
+        free = self._free_slots()
+        if len(free) < B:
+            raise NoFreeSlots(
+                f"beam request needs {B} slots, {len(free)} free of "
+                f"{self.max_seqs}")
+        seqs, admitted = [], []
+        try:
+            for slot in free[:B]:
+                seq = self._new_seq(slot, params, max_new)
+                self.cache.admit(seq.seq_id, len(prompt) + max_new,
+                                 cow_headroom=1)
+                admitted.append(seq)
+                seqs.append(seq)
+        except CacheExhausted:
+            for s in admitted:
+                self.cache.release(s.seq_id)
+            raise
+        group = _BeamGroup(seqs, max_new, params["eos_id"])
+        try:
+            logits = self._run_prefill(seqs[0], prompt)
+        except Exception:
+            for s in admitted:
+                self.cache.release(s.seq_id)
+            raise
+        for s in seqs[1:]:
+            self.cache.fork(seqs[0].seq_id, s.seq_id)
+        logp = _log_softmax(logits.astype(np.float64)).astype(np.float32)
+        order = np.lexsort((np.arange(logp.shape[0]), -logp))[:B]
+        group.pre_ids = order.astype(np.int64)
+        group.pre_scores = logp[order]
+        group.hist_ids.append(group.pre_ids.copy())
+        group.hist_parents.append(np.arange(B))
+        group.steps = 1
+        for s, t in zip(seqs, group.pre_ids):
+            s.group = group
+            s.next_token = int(t)
+            self._slots[s.slot] = s
+        self._groups.append(group)
+        # a beam stream emits only on completion (the winning hypothesis
+        # is unknown until the search ends)
+        if group.steps >= max_new or bool(
+                np.all(group.pre_ids == group.end_id)):
+            toks = self._finish_beam(group)
+            return group, toks, True
+        return group, [], False
+
+    # ------------------------------------------------------------------
+    def step(self):
+        """One continuous-batching decode step over every active slot:
+        a single fixed-shape dispatch, then per-sequence sampling / one
+        dense ``beam_search`` op call per beam group. Returns a list of
+        ``(handle, new_tokens, finished)`` events (handles are the
+        objects :meth:`start` returned). Finished sequences leave the
+        batch immediately — their slots and blocks are free before the
+        next step."""
+        with self._lock:
+            if self.active_sequences == 0:
+                return []
+            logits = self._run_decode()
+            events = []
+            for s in list(self._slots):
+                if s is None or s.group is not None:
+                    continue
+                tok = self._sample(s, logits[s.slot])
+                toks, finished = self._advance(s, tok)
+                if finished:
+                    self._retire(s)
+                if toks or finished:
+                    events.append((s, toks, finished))
+            for g in list(self._groups):
+                events.extend(self._beam_step(g, logits))
+            return events
+
+    def _beam_step(self, group, logits):
+        B = len(group.seqs)
+        logp = np.stack([
+            _log_softmax(logits[s.slot].astype(np.float64))
+            for s in group.seqs]).astype(np.float32)      # [B, vocab]
+        vocab = logp.shape[1]
+        k = min(B, vocab)
+        cand_idx = np.argsort(-logp, axis=1, kind="stable")[:, :k]
+        cand_scores = np.take_along_axis(logp, cand_idx, axis=1)
+        sel_ids, sel_scores, parents = self._beam_search_op(
+            group.pre_ids.reshape(1, B),
+            group.pre_scores.reshape(1, B),
+            cand_idx.reshape(1, B, k).astype(np.int64),
+            cand_scores.reshape(1, B, k),
+            B, group.end_id)
+        group.pre_ids = sel_ids.reshape(B).astype(np.int64)
+        group.pre_scores = sel_scores.reshape(B)
+        parents = parents.reshape(B)
+        group.hist_ids.append(group.pre_ids.copy())
+        group.hist_parents.append(parents.copy())
+        group.steps += 1
+        # fork hypothesis state: slot j continues from its parent's
+        # context (copy-on-write block sharing), then feeds its token
+        self.cache.reorder({
+            s.seq_id: group.seqs[int(parents[j])].seq_id
+            for j, s in enumerate(group.seqs)})
+        for j, s in enumerate(group.seqs):
+            s.next_token = int(group.pre_ids[j])
+        if group.steps >= group.max_new or bool(
+                np.all(group.pre_ids == group.end_id)):
+            toks = self._finish_beam(group)
+            return [(group, toks, True)]
+        # heartbeat: the group advanced but emits only on completion
+        return [(group, [], False)]
+
+    _beam_programs = {}
+
+    def _beam_search_op(self, pre_ids, pre_scores, ids, scores, beam,
+                        end_id):
+        """One step of the dense ``beam_search`` op, run through a tiny
+        eager program (reusing the op exactly as the book decoders do)."""
+        import paddle_tpu.fluid as fluid
+
+        key = (beam, end_id)
+        prog = self._beam_programs.get(key)
+        if prog is None:
+            prog = fluid.Program()
+            b = prog.global_block()
+            for n, dt in (("pre_ids", "int64"), ("pre_scores", "float32"),
+                          ("ids", "int64"), ("scores", "float32")):
+                b.create_var(name=n, dtype=dt, is_data=True)
+            b.append_op(
+                "beam_search",
+                inputs={"pre_ids": ["pre_ids"], "pre_scores": ["pre_scores"],
+                        "ids": ["ids"], "scores": ["scores"]},
+                outputs={"selected_ids": ["selected_ids"],
+                         "selected_scores": ["selected_scores"],
+                         "parent_idx": ["parent_idx"]},
+                attrs={"beam_size": beam, "end_id": end_id})
+            self._beam_programs[key] = prog
+        exe = fluid.Executor(mode="eager")
+        out = exe.run(prog,
+                      feed={"pre_ids": pre_ids, "pre_scores": pre_scores,
+                            "ids": ids, "scores": scores},
+                      fetch_list=["selected_ids", "selected_scores",
+                                  "parent_idx"],
+                      scope=Scope())
+        return out[0], out[1], out[2]
+
+    def _finish_beam(self, group):
+        """Backtrack the best hypothesis and retire the group. Returns
+        its tokens (EOS-trimmed) — a beam stream's single emission."""
+        j = int(np.argmax(group.pre_scores))
+        toks = []
+        for t in range(len(group.hist_ids) - 1, -1, -1):
+            toks.append(int(group.hist_ids[t][j]))
+            j = int(group.hist_parents[t][j])
+        toks.reverse()
+        if group.end_id in toks:
+            toks = toks[:toks.index(group.end_id)]
+        group.finished = True
+        for s in group.seqs:
+            s.finished = True
+            self._retire(s)
+        self._groups.remove(group)
+        return toks
+
+    def _retire(self, seq):
+        if self._slots[seq.slot] is seq:
+            self._slots[seq.slot] = None
+        self.cache.release(seq.seq_id)
+
+    def abort(self, handle):
+        """Cancel an in-flight request (client disconnected): frees its
+        slot(s) and blocks immediately."""
+        with self._lock:
+            if isinstance(handle, _BeamGroup):
+                if not handle.finished:
+                    handle.finished = True
+                    for s in handle.seqs:
+                        if not s.finished:
+                            s.finished = True
+                            self._retire(s)
+                    self._groups.remove(handle)
+            elif not handle.finished:
+                handle.finished = True
+                self._retire(handle)
+
+    # ------------------------------------------------------------------
+    def stats(self):
+        with self._stats_lock:
+            phases = {ph: {b: dict(c) for b, c in per.items()}
+                      for ph, per in self._phase.items()}
+        return {
+            "phases": phases,
+            "compiles": sum(s["compiles"] for per in phases.values()
+                            for s in per.values()),
+            "hits": sum(s["hits"] for per in phases.values()
+                        for s in per.values()),
+            "hot_recompiles": self.hot_recompiles,
+            "warmed": self._warmed,
+            "active_sequences": self.active_sequences,
+            "max_seqs": self.max_seqs,
+            "blocks_in_use": self.cache.stats()["blocks_in_use"],
+            "cache": self.cache.stats(),
+            "kernel_tier": self._kernel_tier,
+        }
+
+
+__all__ = ["GenerationEngine", "NoFreeSlots", "normalize_sampling"]

@@ -1,0 +1,246 @@
+"""Paged KV-cache arena: fixed-size blocks, per-sequence block tables.
+
+The memory manager under continuous batching (the layer *Ragged Paged
+Attention* assumes above the kernel): one pre-allocated
+``[num_blocks, block_size, heads, head_dim]`` K and V buffer per layer,
+carved into blocks a sequence's context occupies non-contiguously. A
+sequence owns a BLOCK TABLE (ordered block ids); position ``p`` of its
+context lives at flat arena slot ``table[p // block_size] * block_size +
+p % block_size``. Ragged in-flight sequences thereby share ONE
+fixed-shape decode executable — the block table, not the tensor shape,
+carries each sequence's length.
+
+Admission control is typed: a sequence is admitted only when enough free
+blocks exist to cover its WORST-CASE length (prompt + max new tokens),
+so decode can never die of allocation mid-flight; when they don't,
+:class:`CacheExhausted` rejects fast and the scheduler keeps the request
+queued (or the server surfaces backpressure). Blocks recycle to the free
+list the moment a sequence finishes.
+
+Beam search forks hypotheses COPY-ON-WRITE: a fork shares the parent's
+blocks (refcounted), and only when a hypothesis writes into a SHARED
+tail block does it draw a fresh block and copy that one block — the
+parent's blocks are never touched, so sibling hypotheses share the whole
+prompt prefix at the cost of at most one block copy per fork. Beam slots
+are admitted with one block of COW headroom on top of the worst-case
+reservation.
+
+The arena arrays themselves (``self.k[l]`` / ``self.v[l]``, jax arrays)
+are written by the phase ops (ops/attention_ops.py) — the engine feeds
+them into the dispatch and stores the functionally-updated arrays back —
+while this class owns all HOST-side accounting (free list, refcounts,
+tables, reservations) plus the device block copies COW requires.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.flags import get_flag
+
+
+class CacheExhausted(RuntimeError):
+    """Not enough free KV blocks to admit (or COW-fork) a sequence: typed
+    admission rejection — the scheduler keeps the request queued until
+    blocks recycle; a server translates sustained exhaustion into
+    queue backpressure (ServerOverloaded), never a crash."""
+
+
+class PagedKVCache:
+    """``PagedKVCache(num_layers, num_heads, head_dim)`` — block size and
+    arena block count default from the ``serving_kv_block_size`` /
+    ``serving_kv_num_blocks`` flags."""
+
+    def __init__(self, num_layers, num_heads, head_dim, num_blocks=None,
+                 block_size=None, dtype=np.float32):
+        import jax.numpy as jnp
+
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.block_size = int(block_size if block_size is not None
+                              else get_flag("serving_kv_block_size"))
+        self.num_blocks = int(num_blocks if num_blocks is not None
+                              else get_flag("serving_kv_num_blocks"))
+        if self.block_size <= 0 or self.num_blocks <= 0:
+            raise ValueError(
+                f"KV arena needs positive block_size/num_blocks, got "
+                f"{self.block_size}/{self.num_blocks}")
+        shape = (self.num_blocks, self.block_size, self.num_heads,
+                 self.head_dim)
+        self.k = [jnp.zeros(shape, dtype) for _ in range(self.num_layers)]
+        self.v = [jnp.zeros(shape, dtype) for _ in range(self.num_layers)]
+        # free list popped from the END: initialized descending so blocks
+        # allocate 0, 1, 2, ... (deterministic tests, dense arena use)
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._ref = [0] * self.num_blocks
+        self._tables = {}        # seq_id -> [block ids]
+        self._lens = {}          # seq_id -> tokens written
+        self._promised = {}      # seq_id -> admission-time block budget
+        self._promised_total = 0
+        self.cow_copies = 0
+
+    # ------------------------------------------------------------------
+    @property
+    def sentinel_slot(self):
+        """One-past-the-end flat slot: scatters to it are DROPPED by the
+        phase ops — the write-nothing encoding for padding positions and
+        inactive decode rows."""
+        return self.num_blocks * self.block_size
+
+    def blocks_for(self, n_tokens):
+        return -(-int(n_tokens) // self.block_size)
+
+    def available_blocks(self):
+        """Free blocks not yet committed to an admitted sequence's worst
+        case — what :meth:`admit` has to offer a new sequence."""
+        return len(self._free) - self._promised_unspent()
+
+    # ------------------------------------------------------------------
+    def admit(self, seq_id, max_total_len, cow_headroom=0):
+        """Reserve worst-case capacity for a new sequence; raises
+        :class:`CacheExhausted` (and changes nothing) when the arena
+        cannot promise it. ``cow_headroom`` adds blocks for beam slots
+        (a fork's copy-on-write draw happens outside table growth)."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already admitted")
+        need = self.blocks_for(max_total_len) + int(cow_headroom)
+        free_uncommitted = self.available_blocks()
+        if need > free_uncommitted:
+            raise CacheExhausted(
+                f"KV arena exhausted: sequence needs {need} blocks "
+                f"(max_total_len={max_total_len}, block_size="
+                f"{self.block_size}) but only {max(0, free_uncommitted)} "
+                f"of {self.num_blocks} are uncommitted")
+        self._tables[seq_id] = []
+        self._lens[seq_id] = 0
+        self._promised[seq_id] = need
+        self._promised_total += need
+        return need
+
+    def _promised_unspent(self):
+        # what admitted sequences may still draw: promise minus blocks
+        # they currently own (refcount-owned draws, incl. COW copies)
+        return sum(max(0, self._promised[s] - self._owned(s))
+                   for s in self._tables)
+
+    def _owned(self, seq_id):
+        # blocks this sequence is the (co-)holder of; for promise
+        # accounting the conservative count is its table length
+        return len(self._tables[seq_id])
+
+    def _draw(self, seq_id):
+        if not self._free:
+            raise CacheExhausted(
+                "KV arena free list empty (copy-on-write overdraw?); "
+                "admit beam sequences with cow_headroom >= 1")
+        b = self._free.pop()
+        self._ref[b] = 1
+        return b
+
+    # ------------------------------------------------------------------
+    def append_slots(self, seq_id, n=1):
+        """Flat arena slots for this sequence's next ``n`` token
+        positions (int32 [n]), growing the block table as needed and
+        copy-on-writing a shared tail block first. Call BEFORE the
+        dispatch that writes them."""
+        table = self._tables[seq_id]
+        pos = self._lens[seq_id]
+        if pos + n > self._promised[seq_id] * self.block_size:
+            raise CacheExhausted(
+                f"sequence {seq_id!r} exceeds its admitted budget "
+                f"({self._promised[seq_id]} blocks) at position {pos + n}")
+        slots = np.empty(n, np.int32)
+        for i in range(n):
+            p = pos + i
+            bi = p // self.block_size
+            if bi == len(table):
+                table.append(self._draw(seq_id))
+            elif self._ref[table[bi]] > 1:
+                table[bi] = self._cow(table[bi], seq_id)
+            slots[i] = table[bi] * self.block_size + p % self.block_size
+        self._lens[seq_id] = pos + n
+        return slots
+
+    def _cow(self, block, seq_id):
+        """Copy-on-write: draw a fresh block, copy the shared block's
+        contents across every layer's K and V arena, drop one reference
+        to the shared block. The shared (parent) block's bytes are never
+        modified."""
+        nb = self._draw(seq_id)
+        for l in range(self.num_layers):
+            self.k[l] = self.k[l].at[nb].set(self.k[l][block])
+            self.v[l] = self.v[l].at[nb].set(self.v[l][block])
+        self._ref[block] -= 1
+        self.cow_copies += 1
+        return nb
+
+    # ------------------------------------------------------------------
+    def context_len(self, seq_id):
+        return self._lens[seq_id]
+
+    def block_table(self, seq_id, pad_to):
+        """The sequence's block table padded with 0 to ``pad_to`` entries
+        (padded entries are masked out by ContextLens in the op)."""
+        t = self._tables[seq_id]
+        if len(t) > pad_to:
+            raise ValueError(
+                f"sequence {seq_id!r} spans {len(t)} blocks > table "
+                f"width {pad_to}")
+        out = np.zeros(pad_to, np.int32)
+        out[:len(t)] = t
+        return out
+
+    # ------------------------------------------------------------------
+    def reorder(self, mapping):
+        """Atomically rebind destination sequences to COPIES of source
+        sequences' block tables (``{dst_seq: src_seq}``) — the beam-step
+        fork. All sources are read (and their blocks ref-bumped) BEFORE
+        any destination's old table is released, so a permutation (beam
+        reorder by parent_idx) never frees a block another binding still
+        needs. Shared blocks are copy-on-written only when a destination
+        later WRITES into one."""
+        new = {d: (list(self._tables[s]), self._lens[s])
+               for d, s in mapping.items()}
+        for d, (table, _len) in new.items():
+            for b in table:
+                self._ref[b] += 1
+        for d in mapping:
+            self._release_blocks(self._tables[d])
+        for d, (table, length) in new.items():
+            self._tables[d] = table
+            self._lens[d] = length
+
+    def fork(self, src_seq, dst_seq):
+        """Share ``src``'s context into (already admitted) ``dst``."""
+        self.reorder({dst_seq: src_seq})
+
+    # ------------------------------------------------------------------
+    def _release_blocks(self, blocks):
+        for b in blocks:
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                self._free.append(b)
+
+    def release(self, seq_id):
+        """Finish a sequence: recycle its blocks (refcounted) and return
+        its reservation. Freed blocks go to the END of the free list, so
+        the next allocation reuses the most-recently-freed block."""
+        self._release_blocks(self._tables.pop(seq_id))
+        del self._lens[seq_id]
+        self._promised_total -= self._promised.pop(seq_id)
+
+    # ------------------------------------------------------------------
+    def stats(self):
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "blocks_in_use": self.num_blocks - len(self._free),
+            "blocks_free": len(self._free),
+            "blocks_promised": self._promised_total,
+            "sequences": len(self._tables),
+            "cow_copies": self.cow_copies,
+        }
+
+
+__all__ = ["PagedKVCache", "CacheExhausted"]

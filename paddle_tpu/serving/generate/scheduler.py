@@ -1,0 +1,291 @@
+"""ContinuousBatcher: step-boundary scheduling of generation requests.
+
+The :class:`~..batcher.DynamicBatcher` sibling for stateful decode — and
+the part that makes it CONTINUOUS: where the feed-forward batcher
+gang-schedules whole requests into one dispatch, here sequences JOIN the
+running batch at any step boundary (a queued prompt is prefilled the
+moment a slot and enough KV blocks free up) and LEAVE the moment they
+hit EOS or their token budget — the batch never waits for its slowest
+member, and a finished sequence's slot is refilled before the next
+decode step. ``continuous=False`` keeps the gang-scheduled behavior
+(admit a full batch, run it to completion, admit the next) as the A/B
+baseline the bench lane measures against.
+
+Backpressure keeps the DynamicBatcher's contract: a bounded wait queue
+that rejects FAST with the same typed
+:class:`~..batcher.ServerOverloaded` when full. Admission is strict
+FIFO — a head request that doesn't fit (slots or blocks) blocks the
+queue rather than being overtaken, so admission order (and therefore
+the parity-pinned token streams) is deterministic.
+
+``submit`` returns a :class:`TokenStream` — an iterator the caller
+drains as the worker emits tokens (the RPC layer turns it into
+multi-frame streaming responses). Closing a stream early cancels its
+sequence: the worker aborts it at the next step boundary and its
+slot/blocks recycle.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+
+from ...core.flags import get_flag
+from ..batcher import ServerOverloaded
+from .decode_engine import CacheExhausted, NoFreeSlots, normalize_sampling
+
+
+class _Cancelled(Exception):
+    pass
+
+
+class TokenStream:
+    """Iterator over one request's generated token ids. ``close()``
+    cancels the request (a consumer that stops reading mid-stream);
+    worker-side errors re-raise in the consumer."""
+
+    _DONE = object()
+
+    def __init__(self, batcher):
+        self._batcher = batcher
+        self._q = queue.Queue()
+        self._closed = False
+        self.first_token_s = None      # set by the worker (TTFT probe)
+
+    # worker side -------------------------------------------------------
+    def _emit(self, tokens):
+        for t in tokens:
+            self._q.put(int(t))
+
+    def _finish(self, error=None):
+        self._q.put(error if error is not None else self._DONE)
+
+    # consumer side -----------------------------------------------------
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is self._DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    def batches(self):
+        """Like iteration, but yields LISTS: one blocking wait for the
+        next token, then everything else already queued rides the same
+        batch — the frame-coalescing form the streaming RPC handler uses
+        (a consumer slower than the decode loop gets fewer, fuller
+        frames instead of a backlog of one-token messages)."""
+        while True:
+            item = self._q.get()
+            batch = []
+            while True:
+                if item is self._DONE:
+                    if batch:
+                        yield batch
+                    return
+                if isinstance(item, BaseException):
+                    if batch:
+                        yield batch
+                    raise item
+                batch.append(item)
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    break
+            yield batch
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._batcher._cancel(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class _Pending:
+    __slots__ = ("prompt", "max_new", "sampling", "stream", "submit_s")
+
+    def __init__(self, prompt, max_new, sampling, stream, submit_s):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.sampling = sampling
+        self.stream = stream
+        self.submit_s = submit_s
+
+
+class ContinuousBatcher:
+    """Drives a :class:`~.decode_engine.GenerationEngine` from one worker
+    thread: admit (continuous: whenever capacity frees; gang: only when
+    the batch drained), one decode step, route events, repeat.
+    ``capacity`` bounds the WAIT queue (default
+    ``serving_queue_capacity``)."""
+
+    def __init__(self, engine, capacity=None, continuous=True):
+        self.engine = engine
+        self.continuous = bool(continuous)
+        self.capacity = int(get_flag("serving_queue_capacity")
+                            if capacity is None else capacity)
+        self._pending = deque()
+        self._cancels = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._handles = {}            # stream -> engine handle
+        self._n_requests = 0
+        self._n_rejected = 0
+        self._n_steps = 0
+        self._n_tokens = 0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens, sampling=None):
+        """Queue one generation request; returns its :class:`TokenStream`.
+        Rejects FAST with :class:`ServerOverloaded` when ``capacity``
+        requests already wait (in-flight sequences don't count — they
+        are bounded by the engine's slots, not the queue)."""
+        import time
+        sampling = normalize_sampling(sampling)   # reject bad specs HERE
+        stream = TokenStream(self)
+        req = _Pending(list(prompt), int(max_new_tokens), sampling, stream,
+                       time.perf_counter())
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("ContinuousBatcher is closed")
+            self._n_requests += 1
+            if len(self._pending) >= self.capacity:
+                self._n_rejected += 1
+                raise ServerOverloaded(
+                    f"generation queue full ({self.capacity} requests "
+                    "waiting); back off and retry")
+            self._pending.append(req)
+            self._cv.notify_all()
+        return stream
+
+    def _cancel(self, stream):
+        with self._cv:
+            self._cancels.append(stream)
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------------
+    def _run(self):
+        while True:
+            with self._cv:
+                while (not self._pending and not self._cancels
+                       and not self._handles and not self._closed):
+                    self._cv.wait()
+                if self._closed and not self._handles:
+                    self._reject_queued_locked()
+                    return
+                self._apply_cancels_locked()
+                self._admit_locked()
+            try:
+                events = self.engine.step()
+            except Exception as e:
+                # a decode-step failure poisons every in-flight sequence
+                with self._cv:
+                    for stream, handle in list(self._handles.items()):
+                        self.engine.abort(handle)
+                        stream._finish(e)
+                    self._handles.clear()
+                continue
+            with self._cv:
+                self._route_locked(events)
+
+    def _apply_cancels_locked(self):
+        while self._cancels:
+            stream = self._cancels.popleft()
+            handle = self._handles.pop(stream, None)
+            if handle is not None:
+                self.engine.abort(handle)
+            else:
+                # not started yet: drop it from the wait queue
+                for req in list(self._pending):
+                    if req.stream is stream:
+                        self._pending.remove(req)
+                        break
+            stream._finish(_Cancelled("generation cancelled"))
+
+    def _admit_locked(self):
+        """FIFO admission. Continuous mode admits whenever the head fits;
+        gang mode opens an admission round only when the batch is empty,
+        fills it, then waits for every member to finish."""
+        if not self.continuous and self._handles:
+            return
+        import time
+        while self._pending and not self._closed:
+            req = self._pending[0]
+            try:
+                handle, first, finished = self.engine.start(
+                    req.prompt, req.max_new, req.sampling)
+            except (NoFreeSlots, CacheExhausted):
+                break                  # head blocks until capacity frees
+            except Exception as e:     # bad request (typed ValueError...)
+                self._pending.popleft()
+                req.stream._finish(e)
+                continue
+            self._pending.popleft()
+            req.stream.first_token_s = time.perf_counter() - req.submit_s
+            req.stream._emit(first)
+            self._n_tokens += len(first)
+            if finished:
+                req.stream._finish()
+            else:
+                handle.user_data = req.stream
+                self._handles[req.stream] = handle
+
+    def _route_locked(self, events):
+        if events:
+            self._n_steps += 1
+        for handle, tokens, finished in events:
+            stream = handle.user_data
+            if stream is None or stream not in self._handles:
+                continue               # cancelled mid-step
+            stream._emit(tokens)
+            self._n_tokens += len(tokens)
+            if finished:
+                del self._handles[stream]
+                stream._finish()
+
+    # ------------------------------------------------------------------
+    def _reject_queued_locked(self):
+        err = RuntimeError("ContinuousBatcher is closed; this queued "
+                           "request was rejected without being served")
+        while self._pending:
+            self._pending.popleft().stream._finish(err)
+
+    def close(self, timeout=30.0):
+        """Stop admitting, let in-flight sequences FINISH (their callers
+        get complete streams), reject still-queued requests typed, and
+        join the worker. Returns True when the worker exited in time."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._worker.join(timeout)
+        closed_clean = not self._worker.is_alive()
+        if not closed_clean:
+            with self._cv:
+                self._reject_queued_locked()
+        return closed_clean
+
+    def stats(self):
+        with self._cv:
+            return {
+                "queue_depth": len(self._pending),
+                "capacity": self.capacity,
+                "continuous": self.continuous,
+                "in_flight": len(self._handles),
+                "requests": self._n_requests,
+                "rejected": self._n_rejected,
+                "steps": self._n_steps,
+                "tokens_emitted": self._n_tokens,
+            }
+
+
+__all__ = ["ContinuousBatcher", "TokenStream"]

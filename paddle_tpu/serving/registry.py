@@ -92,7 +92,8 @@ class ModelRegistry:
         return sorted(out)
 
     # ------------------------------------------------------------------
-    def publish(self, model, src_dir, version=None, kernel_tier=None):
+    def publish(self, model, src_dir, version=None, kernel_tier=None,
+                model_kind="feedforward"):
         """Copy the bundle at ``src_dir`` in as ``version`` (next integer
         when None) and make it visible by writing the manifest LAST,
         atomically. Returns the published version number. Versions are
@@ -103,7 +104,15 @@ class ModelRegistry:
         ("pallas"|"jnp"; default = the publisher's resolved tier, see
         ops/pallas.resolve_tier). Serving replicas surface their own
         compiled tier through ``InferenceEngine.stats()`` so a rollout
-        gate can compare the two."""
+        gate can compare the two.
+
+        ``model_kind`` declares which engine class serves the bundle:
+        "feedforward" (InferenceEngine, the default — pre-upgrade
+        manifests without the field resolve to it, no migration needed)
+        or "generative" (GenerationEngine: stateful decode over the
+        bundle's causal_self_attention sites). ModelServer reads it from
+        the version dir's VERSION.json and picks the engine class;
+        :meth:`model_kind` surfaces it alongside :meth:`resolve`."""
         if not os.path.exists(os.path.join(src_dir, MODEL_FILENAME)):
             raise ValueError(
                 f"publish: {src_dir!r} is not a save_inference_model "
@@ -118,6 +127,10 @@ class ModelRegistry:
             raise ValueError(
                 f"kernel_tier capability must be 'pallas' or 'jnp', "
                 f"got {kernel_tier!r}")
+        if model_kind not in ("feedforward", "generative"):
+            raise ValueError(
+                f"model_kind must be 'feedforward' or 'generative', "
+                f"got {model_kind!r}")
         existing = self.versions(model)
         if version is None:
             version = existing[-1] + 1 if existing else 1
@@ -143,7 +156,8 @@ class ModelRegistry:
             files[name] = _sha256_file(os.path.join(dst, name))
         manifest = {"model": model, "version": version, "files": files,
                     "content_hash": _content_hash(files),
-                    "kernel_tier": kernel_tier}
+                    "kernel_tier": kernel_tier,
+                    "model_kind": model_kind}
         tmp = os.path.join(dst, VERSION_MANIFEST + ".tmp")
         with open(tmp, "w") as f:
             json.dump(manifest, f, indent=1, sort_keys=True)
@@ -169,6 +183,12 @@ class ModelRegistry:
                     f"model {model!r} has no published version {v}; "
                     f"published: {published}")
         return self.version_dir(model, v), v
+
+    def model_kind(self, model, version="latest"):
+        """The resolved version's engine-class declaration; manifests
+        published before the field existed default to "feedforward"."""
+        return self.manifest(model, version).get("model_kind",
+                                                 "feedforward")
 
     def previous(self, model, version):
         """The newest published version strictly older than ``version``

@@ -34,12 +34,29 @@ abrupt forms for tests and crash simulation.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 
 from ..core.profiler import LatencyWindow
 from ..distributed.rpc import RpcServer
 from .batcher import DynamicBatcher
 from .engine import InferenceEngine
+
+MODEL_KINDS = ("feedforward", "generative")
+
+
+def sniff_model_kind(model_dir):
+    """``model_kind`` of the bundle at ``model_dir``: read from the
+    registry's VERSION.json when the dir is a published registry version,
+    else (plain export dirs, pre-upgrade manifests) the feed-forward
+    default — no migration needed."""
+    try:
+        with open(os.path.join(model_dir, "VERSION.json")) as f:
+            kind = json.load(f).get("model_kind", "feedforward")
+    except (OSError, TypeError, ValueError):
+        return "feedforward"
+    return kind if kind in MODEL_KINDS else "feedforward"
 
 
 class _ServingHandler:
@@ -50,6 +67,9 @@ class _ServingHandler:
 
     def infer(self, feed):
         return self._server.run_infer(feed)
+
+    def generate(self, prompt, max_new_tokens, sampling=None):
+        return self._server.run_generate(prompt, max_new_tokens, sampling)
 
     def health(self):
         return self._server.health()
@@ -81,14 +101,32 @@ class ModelServer:
 
     def __init__(self, model_dir=None, engine=None, address=("127.0.0.1", 0),
                  batching=True, max_delay_ms=None, queue_capacity=None,
-                 buckets=None, fault_plan=None, version=None):
+                 buckets=None, fault_plan=None, version=None,
+                 model_kind=None, continuous=True, gen_opts=None):
+        from .generate import ContinuousBatcher, GenerationEngine
+        if model_kind is None:
+            if engine is not None:
+                model_kind = "generative" \
+                    if isinstance(engine, GenerationEngine) else "feedforward"
+            else:
+                model_kind = sniff_model_kind(model_dir)
+        if model_kind not in MODEL_KINDS:
+            raise ValueError(f"model_kind must be one of {MODEL_KINDS}, "
+                             f"got {model_kind!r}")
+        self.model_kind = model_kind
+        self._gen_opts = dict(gen_opts or {})
+        self._continuous = bool(continuous)
         if engine is None:
-            engine = InferenceEngine(model_dir, buckets=buckets)
+            if model_kind == "generative":
+                engine = GenerationEngine(model_dir, **self._gen_opts)
+            else:
+                engine = InferenceEngine(model_dir, buckets=buckets)
         self.engine = engine
         self.model_dir = model_dir
         # the reload path rebuilds engines with the SAME bucket set, so
         # the batcher's coalesce target stays valid across swaps
-        self._buckets = list(engine.buckets)
+        self._buckets = list(engine.buckets) \
+            if model_kind == "feedforward" else None
         self.batching = bool(batching)
         # _engine_lock guards the engine REFERENCE (reload swaps it);
         # dispatches read the reference under it and run outside it, so
@@ -97,10 +135,25 @@ class ModelServer:
         self._reload_lock = threading.Lock()   # serializes reloads
         self._version = version
         self._reloads = 0
-        self.batcher = DynamicBatcher(
-            self._engine_infer, max_batch=engine.max_batch,
-            max_delay_ms=max_delay_ms, capacity=queue_capacity) \
-            if self.batching else None
+        if model_kind == "generative":
+            # the scheduler IS the batching layer for stateful decode:
+            # it cannot be turned off, so reject the contradiction loud
+            # instead of reporting batching=False over a live batcher
+            if not self.batching:
+                raise ValueError(
+                    "a generative ModelServer always runs its "
+                    "ContinuousBatcher (the decode scheduler); "
+                    "batching=False is not available — use "
+                    "continuous=False for gang-scheduled batching")
+            self.batcher = ContinuousBatcher(engine,
+                                             capacity=queue_capacity,
+                                             continuous=continuous)
+        elif self.batching:
+            self.batcher = DynamicBatcher(
+                self._engine_infer, max_batch=engine.max_batch,
+                max_delay_ms=max_delay_ms, capacity=queue_capacity)
+        else:
+            self.batcher = None
         self.latency = LatencyWindow(name="serving/request", kind="rpc")
         self._rpc = RpcServer(_ServingHandler(self), address,
                               fault_plan=fault_plan)
@@ -145,10 +198,41 @@ class ModelServer:
         return self._current_engine().infer(feed, fetch_list)
 
     def run_infer(self, feed):
+        if self.model_kind != "feedforward":
+            raise RuntimeError(
+                "this server hosts a GENERATIVE model; call generate() "
+                "(GenClient), not infer()")
         with self.latency.span():
             if self.batcher is not None:
                 return self.batcher.submit(feed)
             return self._engine_infer(feed)
+
+    def run_generate(self, prompt, max_new_tokens, sampling=None):
+        """Handler for the streaming ``generate`` RPC: submit to the
+        continuous batcher and yield one ``{"tokens": [...]}`` frame per
+        scheduler emission — the RpcServer turns the generator into a
+        multi-frame streaming response. Closing the generator (client
+        vanished mid-stream, drain) cancels the sequence. The latency
+        window records TIME TO FIRST FRAME per request (the serving
+        metric a token stream has; whole-stream duration is dominated by
+        the requested generation length, not the server)."""
+        import time
+        if self.model_kind != "generative":
+            raise RuntimeError(
+                "this server hosts a FEED-FORWARD model; call infer() "
+                "(InferClient), not generate()")
+        t0 = time.perf_counter()
+        stream = self.batcher.submit(prompt, max_new_tokens, sampling)
+
+        def frames():
+            first = True
+            with stream:               # GeneratorExit -> stream.close()
+                for toks in stream.batches():
+                    if first:
+                        self.latency.record(time.perf_counter() - t0)
+                        first = False
+                    yield {"tokens": toks}
+        return frames()
 
     def reload(self, model_dir, version=None):
         """Zero-downtime hot swap to the model at ``model_dir``: build a
@@ -161,6 +245,32 @@ class ModelServer:
         (``load_inference_model``'s typed ValueError) or fails warmup.
         Returns the new serving version and the warmup compile count."""
         with self._reload_lock:
+            if self.model_kind == "generative":
+                from .generate import ContinuousBatcher, GenerationEngine
+                new_kind = sniff_model_kind(model_dir)
+                if new_kind != "generative":
+                    raise ValueError(
+                        f"cannot reload a {new_kind!r} bundle into a "
+                        "generative server (engine classes differ); "
+                        "roll a fresh replica instead")
+                new = GenerationEngine(model_dir, **self._gen_opts)
+                compiled = new.warmup()
+                new_batcher = ContinuousBatcher(
+                    new, capacity=self.batcher.capacity,
+                    continuous=self._continuous)
+                with self._engine_lock:
+                    old_batcher = self.batcher
+                    self.engine = new
+                    self.batcher = new_batcher
+                    self.model_dir = model_dir
+                    self._version = version
+                    self._reloads += 1
+                # in-flight streams keep the OLD engine/batcher through
+                # their closures; close it once they drain (non-blocking
+                # for the reload caller: sequences finish on their own)
+                threading.Thread(target=old_batcher.close,
+                                 daemon=True).start()
+                return {"version": version, "compiles": compiled}
             new = InferenceEngine(model_dir, buckets=self._buckets)
             compiled = new.warmup()          # off the hot path: old engine
             with self._engine_lock:          # still answers during this
@@ -175,6 +285,7 @@ class ModelServer:
         out = {"status": "serving" if self._serving else "stopped",
                "warmed": engine.stats()["warmed"],
                "batching": self.batching,
+               "model_kind": self.model_kind,
                "version": self._version,
                "queue_depth": 0}
         if self.batcher is not None:
@@ -185,6 +296,7 @@ class ModelServer:
         out = {"engine": self._current_engine().stats(),
                "latency": self.latency.snapshot(),
                "wire": self._rpc.wire_stats.snapshot(),
+               "model_kind": self.model_kind,
                "version": self._version,
                "reloads": self._reloads}
         if self.batcher is not None:

@@ -124,3 +124,54 @@ def seq_feed(batch, vocab=12, min_len=2, max_len=7, seed=0):
     lens = [int(rng.randint(min_len, max_len)) for _ in range(batch)]
     seqs = [rng.randint(0, vocab, (ln, 1)).astype("int64") for ln in lens]
     return {"src": list(seqs), "tgt": list(seqs)}
+
+
+def build_tiny_lm(vocab=32, emb=16, heads=2, n_layers=2, max_pos=256,
+                  seed=7):
+    """Decoder-only LM at toy scale — the generative-serving test/bench
+    model: token + learned position embeddings, ``n_layers`` pre-LN-free
+    transformer blocks (fc q/k/v -> causal_self_attention -> residual +
+    layer_norm -> 2x fc MLP -> residual + layer_norm), vocab logits head.
+    Feeds ``tokens``/``positions`` [b, seq, 1] int64, fetches logits
+    [b, seq, vocab] — exactly the generative-bundle convention
+    serving/generate documents. Returns (main, startup, logits_var)."""
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        tokens = fluid.layers.data("tokens", shape=[-1, 1], dtype="int64")
+        positions = fluid.layers.data("positions", shape=[-1, 1],
+                                      dtype="int64")
+        x = fluid.layers.elementwise_add(
+            fluid.layers.embedding(tokens, size=[vocab, emb]),
+            fluid.layers.embedding(positions, size=[max_pos, emb]))
+        for _ in range(n_layers):
+            q = fluid.layers.fc(x, size=emb, num_flatten_dims=2)
+            k = fluid.layers.fc(x, size=emb, num_flatten_dims=2)
+            v = fluid.layers.fc(x, size=emb, num_flatten_dims=2)
+            a = fluid.layers.causal_self_attention(q, k, v, num_heads=heads)
+            x = fluid.layers.layer_norm(
+                fluid.layers.elementwise_add(x, a), begin_norm_axis=2)
+            h = fluid.layers.fc(x, size=emb * 2, num_flatten_dims=2,
+                                act="relu")
+            h = fluid.layers.fc(h, size=emb, num_flatten_dims=2)
+            x = fluid.layers.layer_norm(
+                fluid.layers.elementwise_add(x, h), begin_norm_axis=2)
+        logits = fluid.layers.fc(x, size=vocab, num_flatten_dims=2)
+    return main, startup, logits
+
+
+def export_tiny_lm(dirname, scope=None, **kw):
+    """Build + init + save_inference_model a tiny LM bundle at
+    ``dirname``; returns the scope holding its parameters (for reference
+    full-window runs in parity tests)."""
+    import paddle_tpu.fluid as fluid
+
+    main, startup, logits = build_tiny_lm(**kw)
+    exe = fluid.Executor()
+    scope = scope or fluid.Scope()
+    exe.run(startup, scope=scope)
+    fluid.io.save_inference_model(dirname, ["tokens", "positions"],
+                                  [logits], exe, main, scope=scope)
+    return main, scope, logits

@@ -1,0 +1,534 @@
+"""Generation-serving subsystem tests: the paged KV arena's admission /
+recycle / copy-on-write contracts, the prefill+paged-decode program
+split against a full-window reference decode, the continuous-vs-
+sequential BITWISE parity pin (greedy and beam), the streaming RPC
+framing (item frames, terminal frames, mid-stream RemoteError,
+cancellation on abandon), the ContinuousBatcher's typed backpressure,
+and the registry's model_kind manifest field driving ModelServer's
+engine-class choice.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import rpc
+from paddle_tpu.serving import (CacheExhausted, ContinuousBatcher,
+                                GenClient, GenerationEngine, ModelRegistry,
+                                ModelServer, NoFreeSlots, PagedKVCache,
+                                ServerOverloaded)
+from paddle_tpu.testing.models import export_tiny_lm
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+VOCAB = 17
+
+
+@pytest.fixture(scope="module")
+def lm_bundle(tmp_path_factory):
+    """One exported tiny LM shared by the module (module-scoped: the
+    bundle is immutable on disk; every engine loads it into its own
+    private scope)."""
+    d = str(tmp_path_factory.mktemp("genlm") / "model")
+    main, scope, logits = export_tiny_lm(d, vocab=VOCAB, emb=8, heads=2,
+                                         n_layers=2, max_pos=64, seed=3)
+    return d
+
+
+def _engine(d, **kw):
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("prefill_buckets", (8,))
+    return GenerationEngine(d, **kw)
+
+
+def _drain(eng, handle, first, finished):
+    toks = list(first)
+    while not finished:
+        stepped = eng.step()
+        assert stepped, "engine.step() stalled with an active sequence"
+        for h, ts, f in stepped:
+            if h is handle:
+                toks += ts
+                finished = f
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# PagedKVCache: admission, recycle, copy-on-write
+# ---------------------------------------------------------------------------
+
+def test_kvcache_exhaustion_typed_and_admission_is_atomic():
+    c = PagedKVCache(1, 1, 4, num_blocks=4, block_size=4)
+    c.admit("a", 8)                      # 2 blocks promised
+    c.admit("b", 8)                      # 2 more
+    with pytest.raises(CacheExhausted):
+        c.admit("c", 4)                  # nothing uncommitted left
+    # the failed admit changed NOTHING: a and b still fit their budgets
+    assert c.stats()["sequences"] == 2
+    assert np.array_equal(c.append_slots("a", 8), np.arange(8))
+    with pytest.raises(CacheExhausted):
+        c.append_slots("a", 1)           # over its admitted budget
+    c.release("a")
+    c.admit("c", 8)                      # freed blocks re-admit
+
+def test_kvcache_recycle_then_realloc_reuses_freed_blocks():
+    c = PagedKVCache(1, 1, 4, num_blocks=8, block_size=4)
+    c.admit("a", 8)
+    used = {int(s) // 4 for s in c.append_slots("a", 8)}
+    assert c.stats()["blocks_in_use"] == 2
+    c.release("a")
+    assert c.stats()["blocks_in_use"] == 0
+    c.admit("b", 8)
+    slots = c.append_slots("b", 8)
+    # the most-recently-freed blocks come back first: b reuses a's
+    assert {int(s) // 4 for s in slots} == {0, 1} == used
+
+def test_kvcache_cow_fork_leaves_parent_blocks_bitwise_intact():
+    import jax.numpy as jnp
+    c = PagedKVCache(1, 2, 4, num_blocks=8, block_size=4)
+    c.admit("p", 8, cow_headroom=1)
+    slots = c.append_slots("p", 6)       # blocks 0 (full) + 1 (half)
+    rows = np.random.RandomState(0).normal(
+        0, 1, (6, 2, 4)).astype(np.float32)
+    flat = c.k[0].reshape(-1, 2, 4)
+    c.k[0] = flat.at[slots].set(rows).reshape(c.k[0].shape)
+    before = np.asarray(c.k[0]).copy()
+
+    c.admit("q", 8, cow_headroom=1)
+    c.fork("p", "q")
+    assert c.context_len("q") == 6
+    # q writes its next token: the shared tail block must COW, and the
+    # parent's blocks must be bit-for-bit untouched
+    q_slot = c.append_slots("q", 1)[0]
+    assert q_slot // 4 not in {0, 1}     # a fresh block, not p's tail
+    assert c.cow_copies == 1
+    c.k[0] = c.k[0].reshape(-1, 2, 4).at[q_slot].set(
+        np.ones((2, 4), np.float32) * 9).reshape(c.k[0].shape)
+    after = np.asarray(c.k[0])
+    np.testing.assert_array_equal(before[0], after[0])
+    np.testing.assert_array_equal(before[1], after[1])
+    # the COW copy carried the shared prefix content into q's new block
+    np.testing.assert_array_equal(
+        after.reshape(-1, 2, 4)[(q_slot // 4) * 4 + 1], rows[5])
+
+def test_kvcache_reorder_is_atomic_for_permutations():
+    c = PagedKVCache(1, 1, 2, num_blocks=8, block_size=2)
+    for s in ("a", "b"):
+        c.admit(s, 4, cow_headroom=1)
+    c.append_slots("a", 3)
+    c.append_slots("b", 1)
+    ta, tb = c.block_table("a", 4).copy(), c.block_table("b", 4).copy()
+    c.reorder({"a": "b", "b": "a"})      # swap
+    assert np.array_equal(c.block_table("a", 4), tb)
+    assert np.array_equal(c.block_table("b", 4), ta)
+    assert c.context_len("a") == 1 and c.context_len("b") == 3
+    c.release("a")
+    c.release("b")
+    assert c.stats()["blocks_in_use"] == 0
+
+
+# ---------------------------------------------------------------------------
+# GenerationEngine: split correctness + compile-once + parity pins
+# ---------------------------------------------------------------------------
+
+def _reference_greedy(bundle_dir, prompt, max_new):
+    """Full-window teacher-forced argmax decode straight through the
+    ORIGINAL saved program — the unsplit ground truth."""
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    program, feeds, fetches = fluid.io.load_inference_model(
+        bundle_dir, exe, scope=scope)
+    toks, out = list(prompt), []
+    for _ in range(max_new):
+        T = len(toks)
+        feed = {"tokens": np.asarray(toks, np.int64).reshape(1, T, 1),
+                "positions": np.arange(T, dtype=np.int64).reshape(1, T, 1)}
+        lg = exe.run(program, feed=feed, fetch_list=fetches,
+                     scope=scope)[0]
+        t = int(np.argmax(lg[0, -1]))
+        out.append(t)
+        toks.append(t)
+    return out
+
+def test_engine_greedy_matches_full_window_reference(lm_bundle):
+    eng = _engine(lm_bundle)
+    compiled = eng.warmup()
+    assert compiled == 2                 # one decode + one prefill bucket
+    h, first, fin = eng.start([1, 2, 3], 6)
+    toks = _drain(eng, h, first, fin)
+    assert toks == _reference_greedy(lm_bundle, [1, 2, 3], 6)
+    st = eng.stats()
+    assert st["warmed"] and st["hot_recompiles"] == 0
+    assert st["compiles"] == 2 and st["hits"] >= 6
+    # everything retired: slots and blocks all recycled
+    assert st["active_sequences"] == 0 and st["blocks_in_use"] == 0
+
+def test_engine_admission_errors_are_typed(lm_bundle):
+    eng = _engine(lm_bundle, max_seqs=2, num_blocks=4)
+    eng.warmup()
+    with pytest.raises(ValueError, match="max_len"):
+        eng.start([1], 99)
+    h, first, fin = eng.start([1, 2], 10)    # holds 3 of the 4 blocks
+    assert not fin
+    with pytest.raises(CacheExhausted):
+        eng.start([3], 10)               # needs 3, only 1 uncommitted
+    h2, _, fin2 = eng.start([3], 2)      # 1 block: fits; slots now full
+    with pytest.raises(NoFreeSlots):
+        eng.start([4], 2)
+    eng.abort(h)
+    if not fin2:
+        eng.abort(h2)
+    assert eng.stats()["active_sequences"] == 0
+    eng.start([3], 4)                    # capacity recycled
+
+def _run_engine_requests(eng, requests, sequential):
+    """Drive requests through the engine one-at-a-time (sequential) or
+    all-in-flight (continuous); returns each request's token stream."""
+    if sequential:
+        return [_drain(eng, *eng.start(p, m, s)) for p, m, s in requests]
+    streams = [[] for _ in requests]
+    live = {}
+    for i, (p, m, s) in enumerate(requests):
+        h, first, fin = eng.start(p, m, s)
+        streams[i] += first
+        if not fin:
+            live[id(h)] = i
+    while live:
+        for h, ts, f in eng.step():
+            i = live.get(id(h))
+            if i is None:
+                continue
+            streams[i] += ts
+            if f:
+                del live[id(h)]
+    return streams
+
+def test_parity_continuous_vs_sequential_greedy_topk_beam(lm_bundle):
+    """THE acceptance pin: joining a running ragged batch changes no
+    sequence's tokens — greedy, seeded top-k and beam all produce
+    bitwise-identical streams whether decoded alone or continuously
+    batched, with zero hot-path recompiles either way."""
+    requests = [
+        ([1, 2], 5, None),
+        ([5], 7, {"mode": "topk", "top_k": 4, "seed": 11}),
+        ([7, 8, 9, 10], 4, {"mode": "beam", "beam_size": 2,
+                            "eos_id": 0}),
+        ([2, 4, 6], 6, {"mode": "topk", "top_k": 3, "seed": 5,
+                        "temperature": 0.7}),
+    ]
+    eng = _engine(lm_bundle, max_seqs=5)
+    eng.warmup()
+    seq_streams = _run_engine_requests(eng, requests, sequential=True)
+    cont_streams = _run_engine_requests(eng, requests, sequential=False)
+    assert seq_streams == cont_streams
+    st = eng.stats()
+    assert st["hot_recompiles"] == 0
+    assert st["active_sequences"] == 0 and st["blocks_in_use"] == 0
+    # same engine, same seeds, fresh run: topk reproduces exactly
+    again = _run_engine_requests(eng, requests, sequential=False)
+    assert again == cont_streams
+
+def test_beam_decode_emits_best_hypothesis_once(lm_bundle):
+    eng = _engine(lm_bundle)
+    eng.warmup()
+    h, first, fin = eng.start([1, 2, 3], 5,
+                              {"mode": "beam", "beam_size": 3})
+    assert first == [] and not fin       # beams emit only on completion
+    toks = _drain(eng, h, first, fin)
+    assert len(toks) == 5
+    assert all(0 <= t < VOCAB for t in toks)
+    assert eng.stats()["active_sequences"] == 0
+    assert eng.stats()["blocks_in_use"] == 0
+
+
+# ---------------------------------------------------------------------------
+# ContinuousBatcher: step-boundary admission + typed backpressure
+# ---------------------------------------------------------------------------
+
+def test_batcher_queues_past_capacity_and_completes_fifo(lm_bundle):
+    eng = _engine(lm_bundle, max_seqs=2)
+    eng.warmup()
+    b = ContinuousBatcher(eng, capacity=8)
+    try:
+        streams = [b.submit([1 + i, 2], 4 + i % 3) for i in range(6)]
+        outs = [list(s) for s in streams]
+        for i, o in enumerate(outs):
+            assert len(o) == 4 + i % 3, (i, o)
+        st = b.stats()
+        assert st["requests"] == 6 and st["rejected"] == 0
+        assert st["in_flight"] == 0 and st["queue_depth"] == 0
+    finally:
+        assert b.close()
+    assert eng.stats()["hot_recompiles"] == 0
+
+def test_batcher_overload_rejects_fast_typed(lm_bundle):
+    eng = _engine(lm_bundle, max_seqs=1)
+    eng.warmup()
+    b = ContinuousBatcher(eng, capacity=1)
+    try:
+        s1 = b.submit([1, 2], 20)        # occupies the only slot
+        deadline = time.monotonic() + 10
+        while b.stats()["in_flight"] == 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        b.submit([3], 4)                 # fills the wait queue
+        with pytest.raises(ServerOverloaded):
+            b.submit([4], 4)
+        assert b.stats()["rejected"] == 1
+        assert len(list(s1)) == 20
+    finally:
+        b.close()
+
+def test_batcher_cancel_frees_capacity(lm_bundle):
+    eng = _engine(lm_bundle, max_seqs=1)
+    eng.warmup()
+    b = ContinuousBatcher(eng, capacity=4)
+    try:
+        s1 = b.submit([1, 2], 25)
+        it = iter(s1)
+        next(it)                         # stream is live
+        s2 = b.submit([3], 3)            # queued behind it
+        s1.close()                       # cancel mid-generation
+        assert len(list(s2)) == 3        # the queued request got the slot
+        assert eng.stats()["active_sequences"] == 0
+    finally:
+        b.close()
+
+def test_never_satisfiable_requests_raise_valueerror_not_capacity(lm_bundle):
+    """A request that can NEVER be admitted (beam wider than the slot
+    count, worst case bigger than the whole arena) must be a typed
+    bad-request, not a transient capacity error the strict-FIFO
+    scheduler would wait on forever with the queue wedged behind it."""
+    eng = _engine(lm_bundle, max_seqs=2, num_blocks=4)
+    eng.warmup()
+    with pytest.raises(ValueError, match="decode slots"):
+        eng.start([1], 4, {"mode": "beam", "beam_size": 3})
+    with pytest.raises(ValueError, match="never be admitted"):
+        eng.start([1, 2], 28)            # needs 8 blocks, arena has 4
+    # through the batcher: the bad request fails ITS stream and the
+    # queue keeps serving everyone behind it
+    b = ContinuousBatcher(eng)
+    try:
+        bad = b.submit([1], 4, {"mode": "beam", "beam_size": 3})
+        good = b.submit([2, 3], 4)
+        with pytest.raises(ValueError, match="decode slots"):
+            list(bad)
+        assert len(list(good)) == 4
+    finally:
+        b.close()
+
+
+def test_batcher_rejects_malformed_sampling_without_queueing(lm_bundle):
+    eng = _engine(lm_bundle)
+    eng.warmup()
+    b = ContinuousBatcher(eng)
+    try:
+        with pytest.raises(ValueError, match="mode"):
+            b.submit([1], 4, {"mode": "nucleus"})
+        assert b.stats()["queue_depth"] == 0
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# streaming RPC framing (transport-level, no model)
+# ---------------------------------------------------------------------------
+
+class _StreamHandler:
+    def __init__(self):
+        self.closed_early = False
+
+    def count(self, n, fail_at=None, width=4, exc=RuntimeError):
+        def gen():
+            try:
+                for i in range(int(n)):
+                    if fail_at is not None and i == fail_at:
+                        raise exc(f"boom at {i}")
+                    yield {"i": i, "arr": np.full((width,), i, np.float32)}
+            except GeneratorExit:
+                self.closed_early = True
+                raise
+        return gen()
+
+    def unary(self, x):
+        return x + 1
+
+def test_rpc_streaming_frames_and_midstream_error():
+    h = _StreamHandler()
+    server = rpc.RpcServer(h)
+    server.serve_in_thread()
+    try:
+        c = rpc.RpcClient(server.address)
+        items = list(c.stream("count", n=4))
+        assert [it["i"] for it in items] == [0, 1, 2, 3]
+        np.testing.assert_array_equal(items[2]["arr"],
+                                      np.full((4,), 2, np.float32))
+        # the SAME connection serves unary calls after a clean stream
+        assert c.call("unary", x=4) == 5
+        # mid-stream handler failure: items up to it arrive, then the
+        # structured RemoteError (code preserved)
+        got = []
+        with pytest.raises(rpc.RemoteError) as ei:
+            for it in c.stream("count", n=4, fail_at=2):
+                got.append(it["i"])
+        assert got == [0, 1] and ei.value.code == "RuntimeError"
+        assert "boom at 2" in ei.value.remote_message
+        # an OSError raised by the HANDLER's own code is a remote
+        # failure owed its error frame — not "client vanished" (which
+        # only a send failure is) — so it crosses structured too
+        with pytest.raises(rpc.RemoteError) as ei:
+            list(c.stream("count", n=4, fail_at=1, exc=OSError))
+        assert ei.value.code == "OSError"
+        # ... and the connection still serves afterwards
+        assert c.call("unary", x=1) == 2
+        c.close()
+    finally:
+        server.kill()
+
+def test_rpc_stream_abandon_cancels_the_handler_generator():
+    h = _StreamHandler()
+    server = rpc.RpcServer(h)
+    server.serve_in_thread()
+    try:
+        c = rpc.RpcClient(server.address)
+        # enough frames/bytes that the server cannot outrun the socket
+        # buffers: it must still be streaming when the client abandons
+        s = c.stream("count", n=1_000_000, width=512)
+        assert next(s)["i"] == 0
+        s.close()                        # abandon mid-stream
+        deadline = time.monotonic() + 10
+        while not h.closed_early:
+            assert time.monotonic() < deadline, \
+                "server generator was never closed"
+            time.sleep(0.01)
+        # the abandoned stream dropped the conn; the client reconnects
+        assert c.call("unary", x=0) == 1
+        c.close()
+    finally:
+        server.kill()
+
+def test_rpc_unary_call_on_streaming_method_raises_typed():
+    server = rpc.RpcServer(_StreamHandler())
+    server.serve_in_thread()
+    try:
+        c = rpc.RpcClient(server.address)
+        with pytest.raises(RuntimeError, match="stream"):
+            c.call("count", n=3)
+        # stream() on a unary method degrades to a one-item stream
+        assert list(c.stream("unary", x=1)) == [2]
+        c.close()
+    finally:
+        server.kill()
+
+
+# ---------------------------------------------------------------------------
+# ModelServer + GenClient end to end, registry model_kind
+# ---------------------------------------------------------------------------
+
+def _gen_server(model_dir, **kw):
+    kw.setdefault("model_kind", "generative")
+    kw.setdefault("gen_opts", dict(max_seqs=4, block_size=4, num_blocks=64,
+                                   max_len=32, prefill_buckets=(8,)))
+    server = ModelServer(model_dir, **kw)
+    server.start()
+    return server
+
+def test_generate_streams_over_the_wire(lm_bundle):
+    server = _gen_server(lm_bundle)
+    try:
+        with GenClient(server.address) as c:
+            toks = list(c.generate([1, 2, 3], 6))
+            assert toks == _reference_greedy(lm_bundle, [1, 2, 3], 6)
+            beam = list(c.generate([1, 2, 3], 4,
+                                   {"mode": "beam", "beam_size": 2}))
+            assert len(beam) == 4
+            health = c.health()
+            assert health["model_kind"] == "generative" and health["warmed"]
+            st = c.stats()
+            assert st["engine"]["hot_recompiles"] == 0
+            assert st["engine"]["active_sequences"] == 0
+            assert st["batcher"]["tokens_emitted"] >= 10
+            # the feed-forward surface is closed off, typed
+            with pytest.raises(rpc.RemoteError, match="GENERATIVE"):
+                c._rpc.call("infer", feed={"x": np.zeros((1, 2))})
+    finally:
+        assert server.shutdown()
+
+def test_generate_overload_is_typed_across_the_wire(lm_bundle):
+    server = _gen_server(
+        lm_bundle, queue_capacity=1,
+        gen_opts=dict(max_seqs=1, block_size=4, num_blocks=64, max_len=32,
+                      prefill_buckets=(8,)))
+    try:
+        import threading
+        c1, c2, c3 = (GenClient(server.address) for _ in range(3))
+        try:
+            g1 = c1.generate([1, 2], 25)
+            next(g1)                     # slot taken
+            g2_out = []
+            t2 = threading.Thread(
+                target=lambda: g2_out.extend(c2.generate([3], 3)))
+            t2.start()                   # queued behind g1 (capacity 1)
+            deadline = time.monotonic() + 10
+            while server.batcher.stats()["queue_depth"] == 0:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            with pytest.raises(ServerOverloaded):
+                list(c3.generate([4], 3))
+            assert len(list(g1)) == 24   # 25 minus the one consumed
+            t2.join(30)
+            assert g2_out and len(g2_out) == 3
+        finally:
+            for c in (c1, c2, c3):
+                c.close()
+    finally:
+        server.shutdown()
+
+def test_generative_server_rejects_batching_false(lm_bundle):
+    with pytest.raises(ValueError, match="batching=False"):
+        ModelServer(lm_bundle, model_kind="generative", batching=False,
+                    gen_opts=dict(max_seqs=2, block_size=4, num_blocks=64,
+                                  max_len=32, prefill_buckets=(8,)))
+
+
+def test_registry_model_kind_field_and_server_engine_pick(lm_bundle,
+                                                          tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    with pytest.raises(ValueError, match="model_kind"):
+        reg.publish("lm", lm_bundle, model_kind="diffusion")
+    v = reg.publish("lm", lm_bundle, model_kind="generative")
+    assert reg.model_kind("lm", v) == "generative"
+    assert reg.manifest("lm", v)["model_kind"] == "generative"
+    path, _ = reg.resolve("lm", v)
+
+    # ModelServer picks the engine class from the manifest alone
+    server = ModelServer(path, gen_opts=dict(
+        max_seqs=2, block_size=4, num_blocks=64, max_len=32,
+        prefill_buckets=(8,)))
+    try:
+        assert server.model_kind == "generative"
+        assert isinstance(server.engine, GenerationEngine)
+        server.start()
+        with GenClient(server.address) as c:
+            assert len(list(c.generate([1, 2], 3))) == 3
+    finally:
+        server.shutdown()
+
+    # a pre-upgrade manifest (no model_kind field) defaults feedforward
+    mpath = os.path.join(path, "VERSION.json")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    manifest.pop("model_kind")
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    assert reg.model_kind("lm", v) == "feedforward"
+    from paddle_tpu.serving.server import sniff_model_kind
+    assert sniff_model_kind(path) == "feedforward"
+    assert sniff_model_kind(str(tmp_path)) == "feedforward"  # no manifest

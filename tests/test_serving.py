@@ -115,6 +115,17 @@ def test_engine_rejects_bad_feeds(tmp_path):
         InferenceEngine(d, buckets="0,4")
 
 
+def test_parse_buckets_normalizes_and_rejects_typed():
+    from paddle_tpu.serving.engine import parse_buckets
+    # unsorted and duplicate specs normalize (bucket_for bisects, so an
+    # unsorted list would silently misroute batches)
+    assert parse_buckets("8,2,4,2,1") == [1, 2, 4, 8]
+    assert parse_buckets([16, 4, 4, 1]) == [1, 4, 16]
+    for bad in ("", "4,,0", "0,4", "-2,4", "a,b", [3, -1]):
+        with pytest.raises(ValueError, match="buckets"):
+            parse_buckets(bad)
+
+
 # ---------------------------------------------------------------------------
 # DynamicBatcher: coalescing, routing, backpressure, error fan-out
 # ---------------------------------------------------------------------------
