@@ -111,11 +111,14 @@ LAYOUT = "NHWC"
 
 def _rec(d):
     """Stamp every lane record with the ACTIVE kernel tier (what the
-    kernel_tier flag resolved to for this process) so bench JSON rows are
-    attributable to the lowering tier that produced them."""
+    kernel_tier flag resolved to for this process) and the executor_verify
+    flag, so bench JSON rows are attributable to the lowering tier AND the
+    verification mode that produced them."""
+    from paddle_tpu.core.flags import get_flag
     from paddle_tpu.ops.pallas import resolve_tier
     out = dict(d)
     out.setdefault("kernel_tier", resolve_tier())
+    out.setdefault("executor_verify", bool(get_flag("executor_verify")))
     return out
 
 
@@ -1441,6 +1444,11 @@ def main():
     # numerics are the pre-tier baseline bitwise)
     from paddle_tpu.ops.pallas import resolve_tier
     fuse = resolve_tier() == "pallas"
+    # the flagship runs WITH executor_verify on: the once-per-program-
+    # version contract (fluid/analysis, memoized through _ProgramAnalysis)
+    # means verification must add ZERO steady-state overhead — asserted
+    # below by pinning the verify-call counter across the measured steps
+    set_flags({"executor_verify": True})
     main_prog, startup, avg_loss = build(batch, image_size, class_dim,
                                          fuse=fuse)
 
@@ -1481,6 +1489,8 @@ def main():
         if warmup and not args.bn_bf16_stats:
             assert np.isfinite(v[0]), f"non-finite loss {v[0]}"
 
+        from paddle_tpu.fluid.analysis import verify_calls
+        verifies_before = verify_calls()
         t0 = time.perf_counter()
         for i in range(steps):
             v = exe.run(main_prog, feed=feeds[i % n_bufs],
@@ -1488,6 +1498,12 @@ def main():
                         return_numpy=False)
         loss_v = np.asarray(v[0])
         elapsed = time.perf_counter() - t0
+        # steady state: the program version is stable, so the memoized
+        # verifier must not have run even once during the measured window
+        assert verify_calls() == verifies_before, (
+            "executor_verify re-verified mid-steady-state "
+            f"({verify_calls() - verifies_before} extra calls) — the "
+            "once-per-program-version contract is broken")
 
     if not args.bn_bf16_stats:
         assert np.isfinite(loss_v), f"non-finite loss {loss_v}"

@@ -238,13 +238,20 @@ class _ProgramAnalysis:
     does dict lookups only (the reference caches the analog Prepare work in
     its ExecutorPrepareContext, framework/executor.cc:271)."""
 
-    __slots__ = ("version", "free", "written", "persistable_written")
+    __slots__ = ("version", "free", "written", "persistable_written",
+                 "verified")
 
     def __init__(self, version, free, written, persistable_written):
         self.version = version
         self.free = free
         self.written = written
         self.persistable_written = persistable_written
+        # executor_verify memo: the (feed names, fetch names) surfaces the
+        # program at THIS version has passed verify_program under.
+        # Fetch-clobber (PTL010) depends on the fetch set, so each distinct
+        # surface verifies once; the steady-state hot path pays one set
+        # lookup, and a version bump rebuilds the analysis and re-verifies.
+        self.verified = set()
 
 
 # program -> _ProgramAnalysis for block 0. Keyed by the program OBJECT via
@@ -267,6 +274,37 @@ def _analyze_program(program):
     cached = _ProgramAnalysis(program._version, free, written, persistable)
     _ANALYSIS_CACHE[program] = cached
     return cached
+
+
+def _maybe_verify(program, analysis, feed_names, fetch_names=(), scope=None):
+    """executor_verify flag: verify once per (program version, feed/fetch
+    surface) through the analysis cache — zero steady-state cost (one set
+    lookup, no verifier run). Scope-bound free reads (reader vars,
+    tensor-array arenas seeded via ``scope.set``) are dataflow roots just
+    like feeds: the executor binds them at dispatch, so a program that
+    legitimately reads them must not be rejected as use-before-def. (The
+    memo keys on the feed/fetch surface, not the scope contents — a name
+    that LEAVES the scope between runs keeps the first run's verdict until
+    the program version bumps.) Raises the typed ProgramVerifyError naming
+    the executor as the rejecting stage."""
+    from .flags import get_flag
+    verified = analysis.verified
+    # default (flag off, nothing memoized): one attr read + one flag lookup,
+    # no frozenset construction on the hot path
+    if not verified and not get_flag("executor_verify"):
+        return
+    key = (frozenset(feed_names), frozenset(fetch_names))
+    if key in verified:
+        return
+    if not get_flag("executor_verify"):
+        return
+    roots = set(feed_names)
+    if scope is not None:
+        roots.update(n for n in analysis.free if scope.has_var(n))
+    from ..fluid.analysis import verify_program
+    verify_program(program, feed_names=roots, fetch_names=fetch_names,
+                   pass_name="executor")
+    verified.add(key)
 
 
 def _collect_free_inputs(program, block_idx):
@@ -391,6 +429,8 @@ class Executor:
         # op, e.g. a fill; if an op truly reads it first, _run_ops raises a
         # clean error.)
         analysis = _analyze_program(program)
+        _maybe_verify(program, analysis, tuple(feed_vals), tuple(fetch_names),
+                      scope=scope)
         state_in = [n for n in analysis.free
                     if n not in feed_vals and scope.has_var(n)]
         state_out = [n for n in analysis.written
@@ -491,6 +531,8 @@ class Executor:
             scope.set(_RNG_KEY, jax.random.PRNGKey(program.random_seed or 0))
 
         analysis = _analyze_program(program)
+        _maybe_verify(program, analysis, tuple(stacked), tuple(fetch_names),
+                      scope=scope)
         feed_keys = set(stacked)
         state_in = [n for n in analysis.free
                     if n not in feed_keys and scope.has_var(n)]

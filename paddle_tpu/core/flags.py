@@ -248,6 +248,28 @@ DEFINE_flag("serving_max_seqs", 8,
             "tables and an active mask, so this is a capacity knob, "
             "never a retrace trigger")
 
+DEFINE_flag("verify_passes", False,
+            "make every program-transforming pass (append_backward, "
+            "DistributeTranspiler, memory_optimize/release_memory, "
+            "fuse_conv_bn, the GenerationEngine prefill/decode rewrite, "
+            "save_inference_model's prune) run fluid.analysis."
+            "verify_program over its OUTPUT program and raise a typed "
+            "ProgramVerifyError naming the pass on structural damage — "
+            "the reference's build-time InferShape/arity net "
+            "(op_registry.h), applied at every IR rewrite instead of an "
+            "opaque XLA trace error later. Off by default (passes are "
+            "already verified by their suites); tests/book runs with it on")
+
+DEFINE_flag("executor_verify", False,
+            "verify each program at Executor.run dispatch, once per "
+            "(program version, feed/fetch surface), memoized through the "
+            "_ProgramAnalysis cache so the steady-state hot path pays one "
+            "set lookup; scope-bound free reads (readers, arenas) count "
+            "as dataflow roots. Catches hand-mutated programs that never "
+            "went through a verifying pass; bench.py stamps this flag "
+            "into lane records and the flagship lane asserts the "
+            "once-per-version contract")
+
 # PDTPU_FLAGS=check_nan_inf=1,benchmark=0 — unknown names warn and are
 # ignored (a typo'd env var must not make the package unimportable)
 _env = os.environ.get("PDTPU_FLAGS", "")

@@ -42,6 +42,19 @@ class OpSpec:
 
 
 @dataclasses.dataclass
+class SlotSpec:
+    """Declared slot arity of an op type — the analog of the reference's
+    OpProto input/output declarations (framework.proto:34, enforced at
+    OpDesc construction by op_registry.h). ``inputs``/``outputs`` map slot
+    name -> arity marker: "1" exactly one var, "?" zero or one, "+" one or
+    more, "*" any number. Slots not listed are unknown names (an arity
+    error); ops without a SlotSpec are not arity-checked (the verifier's
+    shadow infer_shape still catches most slot damage for them)."""
+    inputs: dict
+    outputs: dict
+
+
+@dataclasses.dataclass
 class OpInfo:
     type: str
     # forward(ctx) -> None; reads ctx.input/attr, writes ctx.set_output
@@ -56,6 +69,9 @@ class OpInfo:
     # ops whose outputs alias an input in-place in the reference (optimizer ops
     # write ParamOut == Param). The functional lowering just rebinds the name.
     in_place: bool = False
+    # declared slot arity, consumed by fluid.analysis.verify_program; filled
+    # in post-registration via register_slots (fluid/analysis/slots.py)
+    slots: Optional[SlotSpec] = None
 
 
 _REGISTRY: dict[str, OpInfo] = {}
@@ -79,6 +95,16 @@ def register_op(type, *, infer_shape=None, grad=None, is_control_flow=False,
                                  in_place=in_place)
         return fn
     return deco
+
+
+def register_slots(type, inputs=None, outputs=None):
+    """Attach a declared SlotSpec to an already-registered op type (the
+    verifier's arity contract). Kept separate from register_op so the spec
+    catalogue can live beside the verifier (fluid/analysis/slots.py) and
+    grow without touching every op module; re-registration replaces."""
+    info = get_op_info(type)
+    info.slots = SlotSpec(inputs=dict(inputs or {}), outputs=dict(outputs or {}))
+    return info.slots
 
 
 def get_op_info(type) -> OpInfo:

@@ -16,6 +16,8 @@ from ..core.lod import LoDArray, pack_sequences, flat_to_lodarray, \
     lodarray_to_flat
 from .. import ops as _ops  # registers all op lowerings
 
+from . import analysis  # static analysis (also installs SlotSpec catalogue)
+from .analysis import (ProgramVerifyError, lint_program, verify_program)
 from . import layers
 from . import nets
 from . import optimizer
@@ -58,5 +60,6 @@ __all__ = [
     "fuse_conv_bn",
     "DistributeTranspiler", "SimpleDistributeTranspiler",
     "WeightNormParamAttr", "average", "recordio_writer", "executor",
-    "LoDTensor",
+    "LoDTensor", "analysis", "ProgramVerifyError", "lint_program",
+    "verify_program",
 ]

@@ -1,0 +1,27 @@
+"""paddle_tpu.fluid.analysis — static analysis over Program IR.
+
+The build-time validity net the reference gets from C++ op registration
+(InferShape + slot checks per op, op_registry.h), rebuilt as a standalone
+subsystem over this framework's Python IR:
+
+* :func:`verify_program` — structural verifier (PTL0xx errors): registry +
+  slot arity, def-before-use dataflow with parent-block recursion, shadow
+  re-inference of shapes/dtypes, in-place and grad-pairing contracts,
+  fetch-clobber protection. Raises :class:`ProgramVerifyError`.
+* :func:`lint_program` — quality rules (PTL1xx warnings): dead ops, unused
+  vars, WAW hazards, sparse-grad densification, fp16 boundaries, retrace
+  hazards.
+* wiring: every program-transforming pass verifies its output under the
+  ``verify_passes`` flag; the Executor verifies once per program version
+  under ``executor_verify``; OpTest and ``load_inference_model`` verify
+  unconditionally. ``tools/lint_program.py`` is the CLI over saved bundles.
+"""
+
+from . import slots  # installs the SlotSpec catalogue onto the registry
+from .diagnostics import Diagnostic, ProgramVerifyError, ERROR, WARNING
+from .lint import lint_program
+from .verify import verify_calls, verify_pass_output, verify_program
+
+__all__ = ["Diagnostic", "ProgramVerifyError", "ERROR", "WARNING",
+           "lint_program", "verify_calls", "verify_pass_output",
+           "verify_program"]

@@ -114,20 +114,25 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
                     "dynamic_lstm/StaticRNN instead of an inference-only "
                     "While) or mark its inputs stop_gradient=True.")
             continue
+        specs = info.grad(op)
         # outputs whose grad was never produced (unused forward outputs, e.g.
         # softmax_with_cross_entropy's Softmax when only Loss is used): feed
         # zeros, mirroring the reference's fill_zeros_like insertion
-        # (backward.py _append_backward_ops_).
+        # (backward.py _append_backward_ops_) — but only for grads some grad
+        # spec actually CONSUMES (a zero-fill nothing reads is dead work the
+        # PTL101 dead-op lint would rightly flag)
+        spec_inputs = {n for spec in specs
+                       for names in spec.inputs.values() for n in names}
         for slot, names in op.outputs.items():
             for n in names:
                 g = grad_var_name(n)
-                if g not in produced:
+                if g not in produced and g in spec_inputs:
                     _create_grad_var(block, n, g)
                     block.append_op("fill_zeros_like",
                                     inputs={"X": [n]}, outputs={"Out": [g]})
                     produced.add(g)
 
-        for spec in info.grad(op):
+        for spec in specs:
             # rename-and-sum for repeated gradients (backward.py:117);
             # overwrite_outputs specs (in-place loop state) replace instead
             renames = []  # (canonical, tmp) pairs, possibly repeated names
@@ -174,6 +179,11 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
         g = grad_var_name(p.name)
         if g in produced:
             result.append((p, block.var(g)))
+
+    # verify_passes: the appended-grad program must still be structurally
+    # valid (fluid/analysis; raises ProgramVerifyError naming this pass)
+    from .analysis import verify_pass_output
+    verify_pass_output(program, "append_backward")
     return result
 
 

@@ -158,6 +158,12 @@ class DistributeTranspiler:
             keep.append(op)
         tblock.ops[:] = keep
         self._trainer_program._bump_version()
+        # verify_passes: stripping optimize/accumulator ops must leave a
+        # structurally valid trainer program (a dropped var or orphaned
+        # grad surfaces here, naming this pass, instead of at XLA trace)
+        from .analysis import verify_pass_output
+        verify_pass_output(self._trainer_program, "DistributeTranspiler",
+                           startup_program=self._startup)
         return self
 
     @staticmethod
@@ -226,6 +232,8 @@ class DistributeTranspiler:
         block.ops[:] = [op for i, op in enumerate(block.ops)
                         if i in keep_set]
         pruned._bump_version()
+        from .analysis import verify_pass_output
+        verify_pass_output(pruned, "DistributeTranspiler.get_startup_program")
         return pruned
 
     def trainer_client(self):

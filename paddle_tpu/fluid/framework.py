@@ -243,8 +243,48 @@ class Block:
         if name is None:
             name = unique_name("tmp")
         if name in self.vars:
-            return self.vars[name]
+            # A colliding create_var returns the existing var — but ONLY
+            # when the caller's explicit kwargs agree with it. Silently
+            # ignoring a conflicting shape/dtype/persistable redefinition
+            # is exactly the var-aliasing bug class the verifier exists to
+            # catch downstream; fail at the source instead.
+            v = self.vars[name]
+            conflicts = []
+            if kw.get("shape") is not None and v.shape is not None:
+                new_shape = tuple(int(s) for s in kw["shape"])
+                # -1 is the documented batch wildcard (same rule the
+                # verifier's _shape_compatible uses): (-1, 10) and (32, 10)
+                # are two annotations of one var, not a redefinition
+                if len(new_shape) != len(v.shape) or not all(
+                        a == b or a == -1 or b == -1
+                        for a, b in zip(new_shape, v.shape)):
+                    conflicts.append(f"shape {v.shape} -> {new_shape}")
+            if "dtype" in kw and kw["dtype"] is not None \
+                    and v.dtype is not None \
+                    and getattr(v, "_dtype_explicit", True) \
+                    and convert_dtype(kw["dtype"]) != v.dtype:
+                # a var first declared WITHOUT a dtype stored the float32
+                # default — a later get-or-create naming its true dtype is
+                # a refinement, not a conflict (_dtype_explicit, stamped
+                # below, records which it was)
+                conflicts.append(
+                    f"dtype {v.dtype} -> {convert_dtype(kw['dtype'])}")
+            if "persistable" in kw \
+                    and bool(kw["persistable"]) != bool(v.persistable):
+                conflicts.append(
+                    f"persistable {v.persistable} -> {kw['persistable']}")
+            if conflicts:
+                raise ValueError(
+                    f"create_var: {name!r} already exists in block "
+                    f"{self.idx} with conflicting metadata "
+                    f"({'; '.join(conflicts)}); redefining a var under the "
+                    "same name silently aliases two different tensors — "
+                    "use a unique name or matching metadata")
+            return v
         v = Variable(self, name, **kw)
+        # whether the dtype annotation was caller-supplied or the float32
+        # default — the conflict guard above only trusts explicit ones
+        v._dtype_explicit = kw.get("dtype") is not None
         self.vars[name] = v
         self.program._bump_version()
         return v

@@ -85,6 +85,11 @@ def fuse_conv_bn(program):
     if fused:
         block.ops[:] = new_ops
         program._bump_version()
+        # verify_passes: the rewritten chain must still be a valid program
+        # (a broken single-consumer assumption — some op still reading the
+        # now-gone conv intermediate — is exactly a PTL003/PTL004 find)
+        from .analysis import verify_pass_output
+        verify_pass_output(program, "fuse_conv_bn")
     return fused
 
 

@@ -150,6 +150,20 @@ def _prune_program(program, feed_names, fetch_names):
             needed.update(op.input_arg_names())
     keep = set(keep)
     block.ops = [op for i, op in enumerate(block.ops) if i in keep]
+    # drop var declarations nothing references — their producers/consumers
+    # were just pruned (the @GRAD/tmp surface of the training graph), so
+    # keeping them ships dead metadata in every bundle (the PTL102
+    # unused-var lint). Persistables stay (save/load_persistables key on
+    # them) as do data vars (a pruned-away feed like `label` keeps its
+    # declaration so feeding it remains optional, not an error).
+    referenced = set(feed_names) | set(fetch_names)
+    for b in pruned.blocks:
+        for op in b.ops:
+            referenced.update(op.input_arg_names())
+            referenced.update(op.output_arg_names())
+    for b in pruned.blocks:
+        b.vars = {n: v for n, v in b.vars.items()
+                  if n in referenced or v.persistable or v.is_data}
     return pruned
 
 
@@ -158,6 +172,12 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     program = main_program or default_main_program()
     fetch_names = [v if isinstance(v, str) else v.name for v in target_vars]
     pruned = _prune_program(program, feeded_var_names, fetch_names)
+    # verify_passes: the pruned program must still compute the fetches from
+    # the feeds (an over-aggressive prune is a PTL004/PTL010 find here,
+    # not a corrupt bundle discovered at serving load)
+    from .analysis import verify_pass_output
+    verify_pass_output(pruned, "save_inference_model",
+                       feed_names=feeded_var_names, fetch_names=fetch_names)
     os.makedirs(dirname, exist_ok=True)
     meta = pruned.to_dict()
     meta["feed_var_names"] = list(feeded_var_names)
@@ -189,6 +209,21 @@ def load_inference_model(dirname, executor, scope=None):
             f"{MODEL_FILENAME!r} ({type(e).__name__}: {e}); re-export the "
             "model with save_inference_model") from e
     program = Program.from_dict(meta)
+    # unconditional (not verify_passes-gated): a bundle passes through
+    # filesystems and registries between export and load — verify catches
+    # a semantically corrupt __model__ (hand-edited, version-skewed ops,
+    # truncated var list) that content hashing cannot, before persistables
+    # stream in. Cheap: once per load, never on the serve path.
+    from .analysis import ProgramVerifyError, verify_program
+    try:
+        verify_program(program, feed_names=meta["feed_var_names"],
+                       fetch_names=meta["fetch_var_names"],
+                       pass_name="load_inference_model")
+    except ProgramVerifyError as e:
+        raise ValueError(
+            f"load_inference_model: {dirname!r} holds a structurally "
+            f"invalid {MODEL_FILENAME!r} (re-export the model with "
+            f"save_inference_model):\n{e}") from e
     load_persistables(executor, dirname, program, scope=scope)
     feed_names = meta["feed_var_names"]
     fetch_vars = [program.global_block().var(n)

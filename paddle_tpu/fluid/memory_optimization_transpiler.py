@@ -68,6 +68,16 @@ def _liveness(ops):
     return uses, defs, live_in, live_out
 
 
+def _protected_names(skip_opt_set, fetch_list):
+    """The user-declared fetch-protection surface (without the control-flow
+    barrier names _build_skip_set adds): what the post-pass verify treats
+    as fetch targets for the PTL010 clobber check."""
+    names = set(skip_opt_set or ())
+    names.update(f if isinstance(f, str) else f.name
+                 for f in fetch_list or ())
+    return sorted(names)
+
+
 def _build_skip_set(program, block, skip_opt_set, fetch_list):
     skip = set(skip_opt_set or ())
     for f in fetch_list or ():
@@ -161,6 +171,12 @@ def memory_optimize(program, print_log=False, level=0, skip_opt_set=None,
             if _optimizable(block, name, skip):
                 pool.append((name, block.var(name)))
     program._bump_version()
+    # verify_passes: name-level reuse must never clobber a protected fetch
+    # or break dataflow — verify the rewritten program with the protected
+    # names as fetch targets so PTL010 guards exactly this pass's contract
+    from .analysis import verify_pass_output
+    verify_pass_output(program, "memory_optimize",
+                       fetch_names=_protected_names(skip_opt_set, fetch_list))
     return renames
 
 
@@ -192,4 +208,7 @@ def release_memory(program, skip_opt_set=None, fetch_list=None):
                             outputs={})
             inserted += 1
     program._bump_version()
+    from .analysis import verify_pass_output
+    verify_pass_output(program, "release_memory",
+                       fetch_names=_protected_names(skip_opt_set, fetch_list))
     return inserted

@@ -235,17 +235,30 @@ class GenerationEngine:
         """Clone the program and rewrite every attention site into the
         phase op, wiring the per-layer arena vars in and out under the
         SAME names (the optimizer-op in-place convention) so the arena
-        update stays on device."""
+        update stays on device. Arena/slot vars are DECLARED in the clone
+        (dtype-annotated, ``is_data`` — they are fed every dispatch), so
+        the rewritten program is self-describing and verifiable."""
         from ...fluid.framework import Operator
 
         p = program.clone(for_test=True)
         block = p.global_block()
+
+        def _declare(name, dtype):
+            if not block.has_var(name):
+                block.create_var(name=name, dtype=dtype, is_data=True)
+
+        _declare(_SLOTS, "int32")
+        if phase_op == "paged_attention":
+            _declare(_TABLES, "int32")
+            _declare(_CTXLENS, "int32")
         layer = 0
         for i, op in enumerate(block.ops):
             if op.type != ATTENTION_OP:
                 continue
             inputs = dict(op.inputs)
             outputs = dict(op.outputs)
+            for kind in ("k", "v"):
+                _declare(_kv_name(kind, layer), "float32")
             inputs["KCache"] = [_kv_name("k", layer)]
             inputs["VCache"] = [_kv_name("v", layer)]
             inputs["SlotMapping"] = [_SLOTS]
@@ -257,6 +270,13 @@ class GenerationEngine:
             block.ops[i] = Operator(block, phase_op, inputs, outputs,
                                     dict(op.attrs))
             layer += 1
+        # verify_passes: the per-phase clone-rewrite is a transform pass
+        # like any other — a mis-wired arena var fails HERE naming the
+        # phase, not as an undefined name inside the compiled step
+        from ...fluid.analysis import verify_pass_output
+        verify_pass_output(
+            p, f"GenerationEngine._rewrite({phase_op})",
+            feed_names=list(self._feed_names))
         return p
 
     # ------------------------------------------------------------------

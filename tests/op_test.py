@@ -65,6 +65,12 @@ class OpTest:
                     names.append(sub_name)
                 out_slots[slot] = names
             block.append_op(self.op_type, in_slots, out_slots, dict(self.attrs))
+        # unconditional verify (not flag-gated): every OpTest program runs
+        # through the structural verifier, so a test declaring slots that
+        # disagree with the op's registered SlotSpec fails with a PTL
+        # diagnostic instead of a KeyError inside the lowering
+        from paddle_tpu.fluid.analysis import verify_program
+        verify_program(main, feed_names=list(feed))
         return main, startup, feed
 
     def _out_entries(self):

@@ -1,0 +1,48 @@
+"""infer_shape-coverage gate (the test_flags_doc.py shape: run the repo
+tool as a subprocess, gate tier-1 on its exit code): a newly registered
+forward op must carry an ``infer_shape`` rule — or be explicitly
+grandfathered in ``tools/op_inventory.py``'s INFER_SHAPE_EXEMPT — so it
+cannot dodge the verifier's shadow-inference pass; stale exemptions fail
+too, so the grandfather list only ratchets down."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "op_inventory.py")
+
+
+def test_every_forward_op_has_infer_shape_or_exemption():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, TOOL, "--check"],
+                       capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+
+
+def test_checker_actually_detects_dodging():
+    """Pin the detection path, not just the happy path: an op missing
+    infer_shape that is NOT exempted must fail the check."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import op_inventory as mod
+    finally:
+        sys.path.pop(0)
+    import paddle_tpu.ops  # noqa: F401
+    from paddle_tpu.core.registry import _REGISTRY
+
+    # the exemption list must be a strict subset of the registry (no typos)
+    fwd = {k for k in _REGISTRY if not k.endswith("_grad")}
+    assert mod.INFER_SHAPE_EXEMPT <= fwd
+
+    # simulate a dodging op: drop one exemption and assert check_infer_shape
+    # would flag it (same code path, in-process)
+    victim = sorted(mod.INFER_SHAPE_EXEMPT)[0]
+    assert _REGISTRY[victim].infer_shape is None
+    old = mod.INFER_SHAPE_EXEMPT
+    mod.INFER_SHAPE_EXEMPT = old - {victim}
+    try:
+        assert mod.check_infer_shape() == 1
+    finally:
+        mod.INFER_SHAPE_EXEMPT = old

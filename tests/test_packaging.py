@@ -29,6 +29,7 @@ def test_wheel_builds_with_all_subpackages(tmp_path):
     names = set(zipfile.ZipFile(tmp_path / wheels[0]).namelist())
     # every user-facing subpackage ships
     for mod in ("paddle_tpu/__init__.py", "paddle_tpu/fluid/__init__.py",
+                "paddle_tpu/fluid/analysis/__init__.py",
                 "paddle_tpu/v2/__init__.py", "paddle_tpu/ops/__init__.py",
                 "paddle_tpu/ops/pallas/__init__.py",
                 "paddle_tpu/parallel/__init__.py",

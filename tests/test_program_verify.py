@@ -1,0 +1,600 @@
+"""Mutation tests for the program verifier + lint framework (ISSUE 8).
+
+Strategy: build KNOWN-GOOD programs (book-model slices, a transpiled
+trainer split, a fused conv-bn program, a decode-engine clone), assert
+they verify SILENTLY, then programmatically corrupt them — drop a var,
+swap slot names, break an in_place pair, mis-shape an output, orphan a
+grad, clobber a fetch — and assert each defect class is caught with its
+stable PTL code, naming the offending op index and block.
+
+Also pins the wiring: verify_passes makes a transform raise a typed
+ProgramVerifyError naming the pass; executor_verify verifies once per
+program version through the analysis cache; load_inference_model rejects
+a structurally corrupt bundle; Block.create_var raises on a conflicting
+redefinition; the lint CLI round-trips over a saved bundle.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.analysis import (ProgramVerifyError, lint_program,
+                                       verify_program)
+from paddle_tpu.fluid.analysis import diagnostics as D
+from paddle_tpu.fluid.analysis.verify import verify_calls
+from paddle_tpu.testing.models import (build_mlp, build_convnet_slice,
+                                       build_tiny_lm, mlp_feed)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _codes(diags):
+    return {d.code for d in diags}
+
+
+def _find(diags, code):
+    hits = [d for d in diags if d.code == code]
+    assert hits, f"expected a {code} diagnostic, got " \
+                 f"{[str(d) for d in diags]}"
+    return hits[0]
+
+
+def _verify_errors(program, **kw):
+    return [d for d in verify_program(program, raise_on_error=False, **kw)
+            if d.severity == D.ERROR]
+
+
+# ---------------------------------------------------------------------------
+# clean programs verify silently
+# ---------------------------------------------------------------------------
+
+def test_clean_mlp_with_backward_and_optimizer():
+    main, startup, _loss = build_mlp()
+    assert verify_program(main, startup_program=startup) == []
+    assert verify_program(startup) == []
+
+
+def test_clean_convnet_and_fused_variant():
+    main, startup, _loss = build_convnet_slice(bottleneck=True)
+    assert _verify_errors(main, startup_program=startup) == []
+    # fused rewrite under verify_passes: must not raise
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        img = fluid.layers.data("img", shape=[8, 8, 3])
+        c = fluid.layers.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                                bias_attr=False, data_format="NHWC")
+        b = fluid.layers.batch_norm(c, act=None, data_layout="NHWC")
+        out = fluid.layers.relu(b)
+    fluid.set_flags({"verify_passes": True})
+    try:
+        assert fluid.fuse_conv_bn(main2) == 1
+    finally:
+        fluid.set_flags({"verify_passes": False})
+    assert _verify_errors(main2, fetch_names=[out.name]) == []
+
+
+def test_clean_transpiled_trainer_and_pserver_startup():
+    main, startup, _loss = build_mlp(opt="momentum")
+    t = fluid.DistributeTranspiler()
+    fluid.set_flags({"verify_passes": True})
+    try:
+        t.transpile(0, program=main, startup_program=startup,
+                    pservers="127.0.0.1:6174,127.0.0.1:6175", trainers=1)
+        trainer = t.get_trainer_program()
+        pstartup = t.get_startup_program("127.0.0.1:6174")
+    finally:
+        fluid.set_flags({"verify_passes": False})
+    assert _verify_errors(trainer, startup_program=startup) == []
+    assert _verify_errors(pstartup) == []
+
+
+def test_clean_decode_engine_clones(tmp_path):
+    from paddle_tpu.serving.generate.decode_engine import GenerationEngine
+    from paddle_tpu.testing.models import export_tiny_lm
+    export_tiny_lm(str(tmp_path / "lm"))
+    fluid.set_flags({"verify_passes": True})
+    try:
+        eng = GenerationEngine(str(tmp_path / "lm"), max_seqs=2, max_len=32,
+                               block_size=4, num_blocks=32)
+    finally:
+        fluid.set_flags({"verify_passes": False})
+    # the rewritten per-phase programs verify standalone too
+    feeds = ["tokens", "positions"]
+    assert _verify_errors(eng._prefill_program, feed_names=feeds) == []
+    assert _verify_errors(eng._decode_program, feed_names=feeds) == []
+
+
+def test_clean_memory_optimized_program():
+    main, startup, loss = build_mlp(depth=2)
+    fluid.set_flags({"verify_passes": True})
+    try:
+        fluid.memory_optimize(main, fetch_list=[loss.name])
+        fluid.release_memory(main, fetch_list=[loss.name])
+    finally:
+        fluid.set_flags({"verify_passes": False})
+    assert _verify_errors(main, fetch_names=[loss.name]) == []
+
+
+def test_clean_accuracy_and_tensor_array_arena():
+    """The two spec mismatches the book conftest surfaced: accuracy's
+    reference-mandated 'Out' input slot, and write_to_array's lazy-
+    allocating Array read (an arena allocation site, not use-before-def —
+    but ONLY when the op rebinds the same name as its output)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred = fluid.layers.fc(x, size=3, act="softmax")
+        fluid.layers.accuracy(input=pred, label=label)
+        i = fluid.layers.fill_constant(shape=(), dtype="int64", value=0)
+        arr = fluid.layers.array_write(pred, i, cap=4)
+        fluid.layers.array_read(arr, i)
+    assert _verify_errors(main, startup_program=startup) == []
+
+    # break the rebinding: Array read lands in a DIFFERENT output name —
+    # no longer a lazy arena, so the uninitialized read is a real PTL004
+    block = main.global_block()
+    wop = next(op for op in block.ops if op.type == "write_to_array")
+    block.create_var(name="arr_detached", dtype=pred.dtype)
+    wop.outputs["Out"] = ["arr_detached"]
+    d = _find(_verify_errors(main, startup_program=startup), D.USE_BEFORE_DEF)
+    assert d.op_type == "write_to_array"
+
+
+# ---------------------------------------------------------------------------
+# defect classes: each caught with its stable code + provenance
+# ---------------------------------------------------------------------------
+
+def test_mutation_unknown_op_type_PTL001():
+    main, _s, _l = build_mlp()
+    block = main.global_block()
+    victim = next(i for i, op in enumerate(block.ops) if op.type == "mul")
+    block.ops[victim].type = "totally_bogus_op"
+    d = _find(_verify_errors(main), D.UNKNOWN_OP)
+    assert d.op_idx == victim and d.block_idx == 0
+    assert "totally_bogus_op" in d.message
+
+
+def test_mutation_dropped_var_PTL003():
+    main, _s, _l = build_mlp()
+    block = main.global_block()
+    # the transpiler-bug class: a var silently dropped from the block
+    victim_op = next(i for i, op in enumerate(block.ops)
+                     if op.type == "mul")
+    name = block.ops[victim_op].input("Y")[0]  # the fc weight
+    del block.vars[name]
+    d = _find(_verify_errors(main), D.UNDEFINED_VAR)
+    assert d.var == name and d.op_idx == victim_op and d.block_idx == 0
+
+
+def test_mutation_swapped_slot_names_PTL002():
+    main, startup, _l = build_convnet_slice()
+    block = main.global_block()
+    i, op = next((i, op) for i, op in enumerate(block.ops)
+                 if op.type == "conv2d")
+    op.inputs["X"] = op.inputs.pop("Input")  # wrong slot name
+    errs = _verify_errors(main, startup_program=startup)
+    d = _find(errs, D.SLOT_ARITY)
+    assert d.op_idx == i and d.op_type == "conv2d"
+    assert "'X'" in d.message or "'Input'" in d.message
+
+
+def test_mutation_slot_arity_overflow_PTL002():
+    main, _s, _l = build_mlp()
+    block = main.global_block()
+    i, op = next((i, op) for i, op in enumerate(block.ops)
+                 if op.type == "mul")
+    op.inputs["X"] = op.inputs["X"] * 2  # two vars in an arity-1 slot
+    d = _find(_verify_errors(main), D.SLOT_ARITY)
+    assert d.op_idx == i and "holds 2 vars" in d.message
+
+
+def test_mutation_use_before_def_PTL004():
+    main = fluid.Program()
+    block = main.global_block()
+    block.create_var(name="a", shape=(2, 2), dtype="float32")
+    block.create_var(name="b", shape=(2, 2), dtype="float32")
+    # 'a' is neither data, persistable, fed, nor produced first
+    block.append_op("relu", {"X": ["a"]}, {"Out": ["b"]})
+    d = _find(_verify_errors(main), D.USE_BEFORE_DEF)
+    assert d.var == "a" and d.op_idx == 0 and d.block_idx == 0
+
+
+def test_mutation_misshaped_output_PTL006():
+    main, startup, _l = build_convnet_slice()
+    block = main.global_block()
+    i, op = next((i, op) for i, op in enumerate(block.ops)
+                 if op.type == "conv2d")
+    out = block.var(op.output("Output")[0])
+    out.shape = tuple([out.shape[0]] + [s + 1 for s in out.shape[1:]])
+    errs = _verify_errors(main, startup_program=startup)
+    # localized to the producing op (the grad-twin check also fires, with
+    # block-level provenance; the producer diagnostic is the precise one)
+    d = next(d for d in errs if d.code == D.SHAPE_MISMATCH
+             and d.op_type == "conv2d")
+    assert d.op_idx == i and d.block_idx == 0
+
+
+def test_mutation_wrong_dtype_PTL007():
+    main, startup, _l = build_convnet_slice()
+    block = main.global_block()
+    i, op = next((i, op) for i, op in enumerate(block.ops)
+                 if op.type == "conv2d")
+    block.var(op.output("Output")[0]).dtype = "int64"
+    d = _find(_verify_errors(main, startup_program=startup),
+              D.DTYPE_MISMATCH)
+    assert d.op_idx == i and d.op_type == "conv2d"
+
+
+def test_mutation_broken_in_place_pair_PTL008():
+    main, _s, _l = build_mlp(opt="momentum")
+    block = main.global_block()
+    i, op = next((i, op) for i, op in enumerate(block.ops)
+                 if op.type == "momentum")
+    # the update is written to a FRESH name: state never advances
+    block.create_var(name="detached_out", shape=block.var(
+        op.output("ParamOut")[0]).shape, dtype="float32")
+    op.outputs["ParamOut"] = ["detached_out"]
+    d = _find(_verify_errors(main), D.IN_PLACE_BROKEN)
+    assert d.op_idx == i and d.var == "detached_out"
+
+
+def test_mutation_orphaned_grad_var_PTL009():
+    main, _s, _l = build_mlp()
+    block = main.global_block()
+    block.create_var(name="ghost@GRAD", shape=(3, 3), dtype="float32")
+    d = _find(_verify_errors(main), D.GRAD_ORPHAN)
+    assert d.var == "ghost@GRAD" and "ghost" in d.message
+
+
+def test_mutation_grad_shape_disagrees_with_twin_PTL006():
+    main, _s, _l = build_mlp()
+    block = main.global_block()
+    gname = next(n for n in block.vars
+                 if n.endswith("@GRAD") and block.var(n).shape is not None
+                 and len(block.var(n).shape) >= 2)
+    block.var(gname).shape = tuple(s + 1 for s in block.var(gname).shape)
+    errs = _verify_errors(main)
+    assert any(d.code == D.SHAPE_MISMATCH and d.var == gname for d in errs)
+
+
+def test_mutation_fetch_clobber_PTL010():
+    main, _s, loss, logits = build_mlp(return_logits=True)
+    block = main.global_block()
+    # a later op reuses a fetched intermediate's name without reading it —
+    # the unprotected-memory_optimize bug class (logits IS consumed by the
+    # loss op, then "reused" as scratch)
+    clobber_idx = len(block.ops)
+    block.append_op("relu", {"X": [loss.name]}, {"Out": [logits.name]})
+    d = _find(_verify_errors(main, fetch_names=[logits.name]),
+              D.FETCH_CLOBBER)
+    assert d.op_idx == clobber_idx and d.var == logits.name
+
+
+# ---------------------------------------------------------------------------
+# lint rules
+# ---------------------------------------------------------------------------
+
+def test_lint_dead_op_PTL101():
+    main, _s, loss = build_mlp()
+    block = main.global_block()
+    i = len(block.ops)
+    block.create_var(name="nobody_reads_me", shape=(4,), dtype="float32")
+    block.append_op("relu", {"X": [loss.name]},
+                    {"Out": ["nobody_reads_me"]})
+    diags = lint_program(main, fetch_names=[loss.name])
+    d = _find(diags, D.DEAD_OP)
+    assert d.op_idx == i
+    # fetched outputs are NOT dead
+    assert not any(d2.code == D.DEAD_OP and d2.op_idx != i for d2 in diags)
+
+
+def test_lint_unused_var_PTL102():
+    main, _s, loss = build_mlp()
+    main.global_block().create_var(name="decorative", shape=(4,),
+                                   dtype="float32")
+    d = _find(lint_program(main, fetch_names=[loss.name]), D.UNUSED_VAR)
+    assert d.var == "decorative"
+
+
+def test_lint_write_after_write_PTL103():
+    main = fluid.Program()
+    block = main.global_block()
+    x = block.create_var(name="x", shape=(2,), dtype="float32",
+                         is_data=True)
+    block.create_var(name="t", shape=(2,), dtype="float32")
+    block.append_op("relu", {"X": ["x"]}, {"Out": ["t"]})
+    block.append_op("sigmoid", {"X": ["x"]}, {"Out": ["t"]})  # WAW
+    d = _find(lint_program(main, fetch_names=["t"]), D.WRITE_AFTER_WRITE)
+    assert d.op_idx == 1 and d.var == "t"
+    del x
+
+
+def test_lint_sparse_grad_densified_PTL104():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[64, 8], is_sparse=True)
+        loss = fluid.layers.mean(emb)
+        fluid.append_backward(loss)
+    block = main.global_block()
+    table = next(op.input("W")[0] for op in block.ops
+                 if op.type == "lookup_table")
+    gname = fluid.grad_var_name(table)
+    # densifying consumer on the sparse-grad path (e.g. a weight-decay
+    # scale): the O(touched-rows) wire contract silently becomes O(table)
+    block.append_op("scale", {"X": [gname]}, {"Out": [gname]},
+                    {"scale": 0.99})
+    d = _find(lint_program(main, fetch_names=[loss.name]),
+              D.SPARSE_DENSIFIED)
+    assert d.op_type == "scale" and d.var == gname
+
+
+def test_lint_fp16_boundary_PTL105():
+    main = fluid.Program()
+    block = main.global_block()
+    block.create_var(name="half", shape=(4,), dtype="float16", is_data=True)
+    block.create_var(name="full", shape=(4,), dtype="float32", is_data=True)
+    block.create_var(name="mix", shape=(4,), dtype="float32")
+    block.append_op("elementwise_add", {"X": ["half"], "Y": ["full"]},
+                    {"Out": ["mix"]})
+    d = _find(lint_program(main, fetch_names=["mix"]), D.FP16_BOUNDARY)
+    assert d.op_idx == 0
+
+
+def test_lint_retrace_hazard_PTL106():
+    main = fluid.Program()
+    block = main.global_block()
+    block.create_var(name="x", shape=(-1, 784), dtype="float32",
+                     is_data=True)
+    block.create_var(name="y", shape=(32, 784), dtype="float32")
+    # a concrete batch size baked into the attr over a -1-batch input
+    block.append_op("reshape", {"X": ["x"]}, {"Out": ["y"]},
+                    {"shape": [32, 784]})
+    d = _find(lint_program(main, fetch_names=["y"]), D.RETRACE_HAZARD)
+    assert d.op_idx == 0 and "32" in d.message
+
+
+def test_lint_clean_program_is_quiet():
+    main, _s, loss = build_mlp()
+    assert lint_program(main, fetch_names=[loss.name]) == []
+
+
+# ---------------------------------------------------------------------------
+# wiring: flags, executor cache, typed errors, CLI
+# ---------------------------------------------------------------------------
+
+def test_verify_error_names_the_pass_and_carries_codes():
+    main, _s, _l = build_mlp()
+    block = main.global_block()
+    block.ops[0].type = "bogus"
+    with pytest.raises(ProgramVerifyError) as ei:
+        verify_program(main, pass_name="unit_test_pass")
+    e = ei.value
+    assert e.pass_name == "unit_test_pass"
+    assert "unit_test_pass" in str(e) and D.UNKNOWN_OP in e.codes
+    assert isinstance(e, ValueError)
+
+
+def test_executor_verify_once_per_version():
+    main, startup, loss = build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    fluid.set_flags({"executor_verify": True})
+    try:
+        exe.run(startup)
+        base = verify_calls()
+        exe.run(main, feed=mlp_feed(4), fetch_list=[loss.name])
+        assert verify_calls() == base + 1
+        for _ in range(3):  # steady state: memoized through the cache
+            exe.run(main, feed=mlp_feed(4), fetch_list=[loss.name])
+        assert verify_calls() == base + 1
+        # a mutation bumps the version -> exactly one re-verify
+        main.global_block().append_op("relu", {"X": [loss.name]},
+                                      {"Out": [loss.name + "_r"]})
+        main.global_block().create_var(name=loss.name + "_r",
+                                       shape=loss.shape, dtype="float32")
+        exe.run(main, feed=mlp_feed(4), fetch_list=[loss.name])
+        assert verify_calls() == base + 2
+    finally:
+        fluid.set_flags({"executor_verify": False})
+
+
+def test_executor_verify_rejects_corrupt_program_typed():
+    main, startup, loss = build_mlp()
+    del main.global_block().vars[loss.name]
+    exe = fluid.Executor(fluid.CPUPlace())
+    fluid.set_flags({"executor_verify": True})
+    try:
+        exe.run(startup)
+        with pytest.raises(ProgramVerifyError) as ei:
+            exe.run(main, feed=mlp_feed(4), fetch_list=[loss.name])
+        assert ei.value.pass_name == "executor"
+    finally:
+        fluid.set_flags({"executor_verify": False})
+
+
+def test_executor_verify_scope_bound_state_is_root():
+    """Scope-seeded non-persistable state (readers, tensor arrays bound via
+    scope.set) is part of the Executor's input surface: executor_verify must
+    treat it as a dataflow root, not reject the program with PTL004."""
+    import numpy as np
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        b = main.global_block()
+        b.create_var(name="r")
+        b.create_var(name="img")
+        b.create_var(name="lbl")
+        b.append_op("read", {"Reader": ["r"]}, {"Out": ["img", "lbl"]}, {})
+    exe = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    scope = fluid.Scope()
+    scope.set("r", iter([(np.zeros((2, 3), "float32"),
+                          np.zeros((2, 1), "int64"))]))
+    fluid.set_flags({"executor_verify": True})
+    try:
+        img, _ = exe.run(main, fetch_list=["img", "lbl"], scope=scope,
+                         use_program_cache=False)
+    finally:
+        fluid.set_flags({"executor_verify": False})
+    assert img.shape == (2, 3)
+
+
+def test_executor_verify_per_fetch_surface():
+    """The verify memo keys on the feed/fetch surface, not just the program
+    version: a fetch-clobber (PTL010) reachable only through a SECOND
+    fetch set must still be caught after the first surface verified clean."""
+    main, startup, loss = build_mlp()
+    block = main.global_block()
+    # tmp is consumed (relu reads it), then clobbered by a later op that
+    # does not read it — fetching tmp returns the unrelated redefinition
+    block.create_var(name="tmp", shape=loss.shape, dtype="float32")
+    block.create_var(name="tmp_use", shape=loss.shape, dtype="float32")
+    block.append_op("relu", {"X": [loss.name]}, {"Out": ["tmp"]})
+    block.append_op("relu", {"X": ["tmp"]}, {"Out": ["tmp_use"]})
+    block.append_op("relu", {"X": [loss.name]}, {"Out": ["tmp"]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    fluid.set_flags({"executor_verify": True})
+    try:
+        exe.run(startup)
+        # first surface: fetching the loss is clean and gets memoized
+        exe.run(main, feed=mlp_feed(4), fetch_list=[loss.name])
+        with pytest.raises(ProgramVerifyError) as ei:
+            exe.run(main, feed=mlp_feed(4), fetch_list=["tmp"])
+        assert D.FETCH_CLOBBER in ei.value.codes
+    finally:
+        fluid.set_flags({"executor_verify": False})
+
+
+def test_verify_passes_flag_rejects_backward_over_corrupt_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        h = fluid.layers.fc(x, size=4)
+        loss = fluid.layers.mean(h)
+    # corrupt BEFORE the pass: backward's output inherits the damage and
+    # the pass-exit verify must name append_backward
+    del main.global_block().vars[x.name]
+    fluid.set_flags({"verify_passes": True})
+    try:
+        with pytest.raises(ProgramVerifyError) as ei:
+            with fluid.program_guard(main, startup):
+                fluid.append_backward(loss)
+        assert ei.value.pass_name == "append_backward"
+    finally:
+        fluid.set_flags({"verify_passes": False})
+
+
+def test_load_inference_model_rejects_structurally_corrupt_bundle(tmp_path):
+    main, startup, loss, logits = build_mlp(return_logits=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    d = str(tmp_path / "m")
+    fluid.io.save_inference_model(d, ["img"], [logits], exe, main,
+                                  scope=scope)
+    # clean bundle loads
+    fluid.io.load_inference_model(d, exe, scope=fluid.Scope())
+    # semantically corrupt the __model__: op type version-skew
+    meta = json.load(open(os.path.join(d, "__model__")))
+    meta["blocks"][0]["ops"][0]["type"] = "op_from_the_future"
+    json.dump(meta, open(os.path.join(d, "__model__"), "w"))
+    with pytest.raises(ValueError, match="structurally invalid"):
+        fluid.io.load_inference_model(d, exe, scope=fluid.Scope())
+
+
+def test_create_var_conflicting_redefinition_raises():
+    main = fluid.Program()
+    block = main.global_block()
+    block.create_var(name="v", shape=(2, 3), dtype="float32")
+    # agreeing (or silent) re-creates return the existing var
+    assert block.create_var(name="v") is block.var("v")
+    assert block.create_var(name="v", shape=(2, 3)) is block.var("v")
+    with pytest.raises(ValueError, match="conflicting metadata"):
+        block.create_var(name="v", shape=(9, 9))
+    with pytest.raises(ValueError, match="conflicting metadata"):
+        block.create_var(name="v", dtype="int64")
+    with pytest.raises(ValueError, match="conflicting metadata"):
+        block.create_var(name="v", persistable=True)
+
+
+def test_create_var_redefinition_wildcard_and_refinement_allowed():
+    """Annotations the codebase itself deems compatible are NOT conflicts:
+    -1 is the documented batch wildcard (same rule as the verifier's
+    _shape_compatible), and a var first declared without a dtype (stored
+    float32 default) may be get-or-created later naming its true dtype."""
+    main = fluid.Program()
+    block = main.global_block()
+    block.create_var(name="w", shape=(32, 10), dtype="float32")
+    assert block.create_var(name="w", shape=(-1, 10)) is block.var("w")
+    # but a conflicting concrete dim under the wildcard still raises
+    with pytest.raises(ValueError, match="conflicting metadata"):
+        block.create_var(name="w", shape=(-1, 11))
+    block.create_var(name="ids")  # dtype defaulted
+    assert block.create_var(name="ids", dtype="int64") is block.var("ids")
+    # explicit float32 vs int64 IS a conflict
+    block.create_var(name="x2", dtype="float32")
+    with pytest.raises(ValueError, match="conflicting metadata"):
+        block.create_var(name="x2", dtype="int64")
+
+
+def test_optest_harness_rejects_wrong_slots():
+    from op_test import OpTest
+
+    class BadSlotTest(OpTest):
+        op_type = "relu"
+        inputs = {"Input": np.random.rand(2, 2).astype("float32")}
+        outputs = {"Out": np.zeros((2, 2), "float32")}
+
+    with pytest.raises(ProgramVerifyError):
+        BadSlotTest().check_output()
+
+
+def test_lint_cli_roundtrip(tmp_path):
+    main, startup, loss, logits = build_mlp(return_logits=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    d = str(tmp_path / "bundle")
+    fluid.io.save_inference_model(d, ["img"], [logits], exe, main,
+                                  scope=scope)
+    tool = os.path.join(REPO, "tools", "lint_program.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, tool, d], capture_output=True,
+                       text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # not just error-free: the prune drops unreferenced var declarations,
+    # so a freshly exported bundle carries no PTL102 lint noise either
+    assert "0 finding(s), 0 error(s)" in r.stdout, r.stdout
+
+    # corrupt: drop a var from the serialized form -> PTL003, exit 1
+    meta = json.load(open(os.path.join(d, "__model__")))
+    kept = [v for v in meta["blocks"][0]["vars"]
+            if v["name"] != logits.name]
+    assert len(kept) < len(meta["blocks"][0]["vars"])
+    meta["blocks"][0]["vars"] = kept
+    json.dump(meta, open(os.path.join(d, "__model__"), "w"))
+    r = subprocess.run([sys.executable, tool, d, "--json"],
+                       capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 1, r.stdout + r.stderr
+    findings = json.loads(r.stdout)
+    assert any(f["code"] == D.UNDEFINED_VAR for f in findings)
+
+    # unreadable input -> exit 2
+    r = subprocess.run([sys.executable, tool, str(tmp_path / "nope")],
+                       capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 2
+
+
+def test_at_least_eight_distinct_defect_classes():
+    """The acceptance-criteria meta-pin: the mutation suite above covers
+    >= 8 distinct PTL codes across verifier + lint."""
+    covered = {D.UNKNOWN_OP, D.SLOT_ARITY, D.UNDEFINED_VAR,
+               D.USE_BEFORE_DEF, D.SHAPE_MISMATCH, D.DTYPE_MISMATCH,
+               D.IN_PLACE_BROKEN, D.GRAD_ORPHAN, D.FETCH_CLOBBER,
+               D.DEAD_OP, D.UNUSED_VAR, D.WRITE_AFTER_WRITE,
+               D.SPARSE_DENSIFIED, D.FP16_BOUNDARY, D.RETRACE_HAZARD}
+    assert len(covered) >= 8

@@ -13,6 +13,113 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# ---------------------------------------------------------------------------
+# --check: registered forward ops allowed to lack an infer_shape rule.
+#
+# The verifier's shadow-inference pass (fluid/analysis/verify.py) re-runs
+# every REGISTERED infer_shape; an op without one is invisible to it. This
+# grandfather list freezes the debt at the PR-8 inventory and RATCHETS
+# DOWN: a new op missing infer_shape fails --check (it must either register
+# a rule or be added here with review), and a listed op that GAINS a rule
+# (or disappears) also fails until removed — the list can only shrink.
+# ``*_grad`` ops are exempt categorically: their output shapes are the
+# forward twins' (backward._create_grad_var copies them), which the
+# verifier checks directly via grad-pairing (PTL009/PTL006).
+# ---------------------------------------------------------------------------
+INFER_SHAPE_EXEMPT = {
+    'accuracy', 'adadelta', 'adagrad',
+    'adam', 'adamax', 'argmax',
+    'array_length', 'assign_value', 'auc',
+    'average_accumulates', 'batch_gather', 'beam_search',
+    'beam_search_decode', 'bilinear_tensor_product', 'bipartite_match',
+    'box_coder', 'cast', 'causal_self_attention',
+    'channel_close', 'channel_create', 'channel_recv',
+    'channel_send', 'chunk_eval', 'concat',
+    'conditional_block', 'conv3d', 'cos_sim',
+    'create_double_buffer_reader', 'create_multi_pass_reader',
+    'create_recordio_file_reader',
+    'create_shuffle_reader', 'crf_decoding', 'cross_entropy',
+    'ctc_align', 'decayed_adagrad', 'delete_var',
+    'detection_map', 'dynamic_recurrent', 'edit_distance',
+    'equal', 'fill', 'fill_constant',
+    'fill_constant_batch_size_like', 'ftrl', 'fused_adam',
+    'fused_momentum', 'fused_sgd', 'gather',
+    'gaussian_random', 'gaussian_random_batch_size_like', 'get_places',
+    'go', 'greater_equal', 'greater_than',
+    'gru_unit', 'hsigmoid', 'huber_loss',
+    'ifelse_merge', 'im2sequence', 'increment',
+    'iou_similarity', 'is_empty', 'l1_norm',
+    'less_equal', 'less_than', 'linear_chain_crf',
+    'load', 'load_combine', 'lod_array_length',
+    'lod_reset', 'logical_and', 'logical_not',
+    'logical_or', 'logical_xor', 'lookup_table',
+    'lstm_unit', 'matmul', 'max_pool2d_with_index',
+    'max_pool3d_with_index', 'max_sequence_len', 'mean',
+    'mine_hard_examples', 'modified_huber_loss', 'momentum',
+    'mul', 'multiclass_nms', 'multiplex',
+    'nce', 'not_equal', 'one_hot',
+    'paged_attention', 'pool3d', 'positive_negative_pair',
+    'precision_recall', 'prefill_attention', 'prior_box',
+    'proximal_adagrad', 'proximal_gd', 'read',
+    'read_from_array', 'recurrent', 'reduce_max',
+    'reduce_mean', 'reduce_min', 'reduce_prod',
+    'reduce_sum', 'reshape', 'rmsprop',
+    'roi_pool', 'row_conv', 'save',
+    'save_combine', 'scatter', 'sequence_concat',
+    'sequence_erase', 'sequence_reshape', 'sequence_slice',
+    'sgd', 'shape', 'smooth_l1_loss',
+    'softmax_with_cross_entropy', 'split', 'split_ids',
+    'split_selected_rows', 'spp', 'squared_l2_distance',
+    'squared_l2_norm', 'sum', 'target_assign',
+    'top_k', 'transpose', 'uniform_random',
+    'uniform_random_batch_size_like', 'unpool', 'warpctc',
+    'while', 'write_to_array',
+}
+
+
+def check_infer_shape():
+    """--check mode (no reference checkout needed): every registered
+    forward op either registers infer_shape or is in the frozen exemption
+    list; stale exemptions fail too so the list only ratchets down.
+    Wired into tier-1 via tests/test_op_inventory_check.py."""
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    import paddle_tpu.ops  # noqa: F401  (registers everything)
+    from paddle_tpu.core.registry import _REGISTRY
+
+    fwd = {k: v for k, v in _REGISTRY.items() if not k.endswith("_grad")}
+    missing = {k for k, v in fwd.items() if v.infer_shape is None}
+    dodging = sorted(missing - INFER_SHAPE_EXEMPT)
+    stale = sorted(n for n in INFER_SHAPE_EXEMPT
+                   if n not in fwd or fwd[n].infer_shape is not None)
+    rc = 0
+    if dodging:
+        print(f"op_inventory --check: {len(dodging)} op(s) registered "
+              "WITHOUT infer_shape and not in INFER_SHAPE_EXEMPT — the "
+              "verifier's shadow-inference pass cannot see them. Register "
+              "an infer_shape rule (preferred) or add to the exemption "
+              "list with review:")
+        for n in dodging:
+            print(f"  MISSING infer_shape: {n}")
+        rc = 1
+    if stale:
+        print(f"op_inventory --check: {len(stale)} stale INFER_SHAPE_EXEMPT "
+              "entrie(s) (op now has infer_shape, or is gone) — remove "
+              "them so the list only shrinks:")
+        for n in stale:
+            print(f"  STALE exemption: {n}")
+        rc = 1
+    if rc == 0:
+        with_rule = sum(1 for v in fwd.values() if v.infer_shape is not None)
+        print(f"op_inventory --check: OK — {with_rule}/{len(fwd)} forward "
+              f"ops carry infer_shape, {len(INFER_SHAPE_EXEMPT)} "
+              "grandfathered (ratchet-down list)")
+    return rc
+
+
 DISPOSITIONS = {
     "lod_rank_table": "redesigned: scan recurrence + reader bucketing",
     "shrink_rnn_memory": "redesigned: scan recurrence + reader bucketing",
@@ -57,7 +164,15 @@ def main():
                     help="a PDTPU_OP_COVERAGE dispatch log from a suite "
                          "run: additionally report registered ops that "
                          "NEVER DISPATCHED (stronger than word-match)")
+    ap.add_argument("--check", action="store_true",
+                    help="infer_shape coverage gate (no reference checkout "
+                         "needed): fail on registered forward ops missing "
+                         "infer_shape outside the frozen INFER_SHAPE_EXEMPT "
+                         "list, and on stale exemptions")
     args = ap.parse_args()
+
+    if args.check:
+        return check_infer_shape()
 
     import jax
     try:
