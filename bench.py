@@ -878,6 +878,146 @@ def run_fleet_serving_lane(n_clients=8, min_requests_per_client=30,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_online_learning_lane(n_clients=4, n_pservers=2, n_replicas=2,
+                             feature_dim=16, batch=16,
+                             publish_every_steps=15, min_serve_s=0.5,
+                             min_rollouts=2, startup_timeout=240.0,
+                             chaos_timeout=240.0):
+    """The end-to-end online-learning chaos lane
+    (paddle_tpu/online/): a StreamingTrainer consumes an unbounded
+    synthetic stream against supervised pserver shards, the
+    CheckpointFreezer publishes barrier-consistent cuts every
+    ``publish_every_steps`` steps, and the RolloutController drives
+    canary-gated rolling reloads onto a supervised serving fleet —
+    while ``n_clients`` FleetClients hammer infer THE WHOLE TIME and,
+    after the first rollout, one pserver shard AND one serving replica
+    are SIGKILLed. Asserts ZERO failed infer requests, >=
+    ``min_rollouts`` served-version advances (monotonic), and both
+    killed children supervisor-restarted. The headline number is the
+    publish-to-served lag: how fresh the fleet's model is relative to
+    the trainer's stream."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed import RetryPolicy
+    from paddle_tpu.online import OnlineLearningLoop
+    from paddle_tpu.serving import FleetClient
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data("x", shape=[feature_dim])
+        y = fluid.layers.data("y", shape=[1])
+        pred = fluid.layers.fc(x, size=1, act=None)
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss, startup)
+
+    w_true = np.random.RandomState(0).normal(
+        0, 1, (feature_dim, 1)).astype("float32")
+
+    def reader():
+        r = np.random.RandomState(1)
+        while True:
+            X = r.normal(0, 1, (batch, feature_dim)).astype("float32")
+            yield {"x": X, "y": X @ w_true}
+
+    root = tempfile.mkdtemp(prefix="pdtpu-online-")
+    loop = OnlineLearningLoop(
+        main_p, startup, reader, ["x"], [pred],
+        registry_root=os.path.join(root, "registry"), model="lin",
+        n_pservers=n_pservers, n_replicas=n_replicas,
+        publish_every_steps=publish_every_steps, min_serve_s=min_serve_s,
+        rollout_poll_s=0.2, buckets="1,2", max_delay_ms=1.0,
+        checkpoint_dir=os.path.join(root, "ckpt"))
+    errs = []
+    infers = [0]
+    lat = []
+    served_seen = []
+    stop = threading.Event()
+
+    def hammer(i):
+        fc = FleetClient(loop.fleet.addresses,
+                         retry=RetryPolicy(max_retries=10,
+                                           backoff_base_s=0.05,
+                                           backoff_max_s=0.5))
+        X = np.zeros((1, feature_dim), np.float32)
+        try:
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    fc.infer({"x": X})
+                    lat.append(time.perf_counter() - t0)
+                    infers[0] += 1
+                except Exception as e:
+                    errs.append(repr(e))
+        finally:
+            fc.close()
+
+    try:
+        loop.start(wait_ready_s=startup_timeout)
+        ts = [threading.Thread(target=hammer, args=(i,))
+              for i in range(n_clients)]
+        t_traffic = time.perf_counter()
+        for t in ts:
+            t.start()
+        killed = False
+        deadline = time.monotonic() + chaos_timeout
+        while time.monotonic() < deadline:
+            st = loop.stats()
+            served_seen.append(st["served_version"])
+            if st["rollout"]["rollouts"] >= 1 and not killed:
+                loop.pservers.kill(1)      # SIGKILL a pserver shard
+                loop.fleet.kill(1)         # SIGKILL a serving replica
+                killed = True
+            if killed and st["rollout"]["rollouts"] >= min_rollouts:
+                break
+            time.sleep(0.4)
+        stop.set()
+        elapsed = time.perf_counter() - t_traffic
+        for t in ts:
+            t.join(30.0)
+        st = loop.stats()
+        assert not errs, f"infer requests failed under chaos: {errs[:3]}"
+        assert st["rollout"]["rollouts"] >= min_rollouts, st["rollout"]
+        assert all(b >= a for a, b in zip(served_seen, served_seen[1:])), \
+            f"served version regressed: {served_seen}"
+        assert killed, "chaos never fired (no rollout happened)"
+        assert sum(c["restart_count"]
+                   for c in st["pserver_children"]) >= 1, \
+            "killed pserver shard never restarted"
+        assert sum(c["restart_count"] for c in st["fleet_children"]) >= 1, \
+            "killed serving replica never restarted"
+        lag = st["rollout"]["publish_to_served"]
+        frz = st["freezer"]
+        from paddle_tpu.core.profiler import percentile
+        return {
+            "publish_to_served_p50_ms": round(lag["p50_ms"], 1),
+            "publish_to_served_p99_ms": round(lag["p99_ms"], 1),
+            "freeze_p50_ms": round(frz["freeze_latency"]["p50_ms"], 1),
+            "freeze_p99_ms": round(frz["freeze_latency"]["p99_ms"], 1),
+            "rollouts": st["rollout"]["rollouts"],
+            "published_versions": len(st["published_versions"]),
+            "served_version": st["served_version"],
+            "trainer_steps": st["trainer"]["global_step"],
+            "trainer_steps_s": round(
+                st["trainer"]["global_step"] / elapsed, 1),
+            "infer_qps": round(infers[0] / elapsed, 1),
+            "infer_p99_ms": round(percentile(lat, 99) * 1e3, 2),
+            "failed_infers": len(errs),
+            "pserver_restarts": [c["restart_count"]
+                                 for c in st["pserver_children"]],
+            "replica_restarts": [c["restart_count"]
+                                 for c in st["fleet_children"]],
+        }
+    finally:
+        stop.set()
+        loop.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_fused_kernels_lane(smoke):
     """A/B microbench for the two new kernel-tier families against their
     jnp twins, measured OUTSIDE the Program machinery so the numbers
@@ -1309,6 +1449,25 @@ def main():
         "hot_recompiles": 0,
         "failovers": fl["fleet_2"]["failovers"],
         "replica_restarts": fl["fleet_2"]["restarts"],
+    })))
+
+    # ---- online-learning chaos lane (streaming trainer -> consistent
+    # freeze/publish -> canary-gated rollout, under a pserver-shard AND
+    # serving-replica SIGKILL, live traffic throughout) ----
+    ol_kw = dict(publish_every_steps=12, min_serve_s=0.5) \
+        if args.smoke else dict(publish_every_steps=50, min_serve_s=2.0,
+                                min_rollouts=3)
+    ol = run_online_learning_lane(**ol_kw)
+    print(json.dumps(_rec({
+        "metric": "online_learning" + ("_smoke" if args.smoke else ""),
+        "value": ol["publish_to_served_p50_ms"],
+        "unit": "ms publish-to-served lag p50 (freeze cut -> registry "
+                "publish -> canary-gated rollout onto the live fleet), "
+                "under a pserver-shard + serving-replica SIGKILL",
+        # asserted inside the lane: zero failed infer requests, served
+        # version advanced monotonically across >= min_rollouts rollouts,
+        # both SIGKILLed children supervisor-restarted
+        **ol,
     })))
 
     # ---- generation serving lane (continuous batching + paged KV) ----

@@ -270,6 +270,42 @@ DEFINE_flag("executor_verify", False,
             "into lane records and the flagship lane asserts the "
             "once-per-version contract")
 
+DEFINE_flag("online_publish_every_steps", 100,
+            "how many global steps the online StreamingTrainer trains "
+            "between freeze/publish triggers (online/trainer.py). 0 "
+            "disables the step trigger; the time trigger "
+            "(online_publish_every_s) still applies. The trigger fires at "
+            "a step BOUNDARY (after the push acked on every shard), which "
+            "is what makes the freezer's cut barrier-consistent")
+
+DEFINE_flag("online_publish_every_s", 0.0,
+            "wall-clock publish trigger for the online StreamingTrainer: "
+            "freeze/publish when this many seconds elapsed since the last "
+            "successful freeze request, checked at step boundaries. 0.0 "
+            "(default) disables the time trigger — step cadence "
+            "(online_publish_every_steps) drives publishes alone")
+
+DEFINE_flag("online_min_serve_s", 2.0,
+            "rollout hysteresis: the RolloutController will not start a "
+            "new rolling_reload until the currently served version has "
+            "been serving this long — a flapping trainer publishing "
+            "every few steps cannot churn the fleet; intermediate "
+            "versions are skipped (the controller always rolls to the "
+            "newest published version)")
+
+DEFINE_flag("online_rollout_poll_ms", 250.0,
+            "how often the online RolloutController polls the "
+            "ModelRegistry for a newer published version than the fleet "
+            "is serving")
+
+DEFINE_flag("online_registry_keep", 0,
+            "when > 0, the RolloutController garbage-collects the "
+            "registry after each successful rollout via "
+            "ModelRegistry.gc(keep_latest=N) — old version dirs are "
+            "pruned, but never the currently-served, pinned, latest, or "
+            "rollback-target (previous) versions. 0 (default) disables "
+            "gc: every published version is retained")
+
 # PDTPU_FLAGS=check_nan_inf=1,benchmark=0 — unknown names warn and are
 # ignored (a typo'd env var must not make the package unimportable)
 _env = os.environ.get("PDTPU_FLAGS", "")

@@ -159,6 +159,11 @@ class ChildSupervisor:
         self._ctx = mp.get_context(mp_start_method)
         self.addresses = [(host, free_port()) for _ in range(n_children)]
         self.restarts = [0] * n_children
+        # wall-clock of each child's most recent RESTART (None until its
+        # first one) — the observability surface OnlineLearningLoop.stats
+        # aggregates; wall-clock (not monotonic) so operators can line it
+        # up against logs across processes
+        self.last_restart_at = [None] * n_children
         self._max_restarts = int(max_restarts)
         self._hb_method = str(heartbeat_method)
         self._interval = float(heartbeat_interval_s)
@@ -238,6 +243,7 @@ class ChildSupervisor:
                     self._procs[i] = None  # crash-looping: give the child up
                     continue
                 self.restarts[i] += 1
+                self.last_restart_at[i] = time.time()
                 try:
                     self._spawn(i)
                 except Exception as e:
@@ -253,6 +259,24 @@ class ChildSupervisor:
                     self._procs[i] = None
 
     # ---- operator surface ----
+    def child_stats(self):
+        """Per-child supervision counters: ``[{address, alive,
+        restart_count, last_restart_at, gave_up}]`` — ``gave_up`` marks a
+        crash-looping child the supervisor stopped restarting
+        (max_restarts). What OnlineLearningLoop.stats surfaces for both
+        the pserver and serving-fleet supervisors."""
+        out = []
+        for i in range(len(self.addresses)):
+            p = self._procs[i]
+            out.append({
+                "address": tuple(self.addresses[i]),
+                "alive": p is not None and p.is_alive(),
+                "restart_count": self.restarts[i],
+                "last_restart_at": self.last_restart_at[i],
+                "gave_up": p is None,
+            })
+        return out
+
     def child_alive(self, i):
         """Is child ``i`` a live process (a crash-looping child the
         supervisor gave up on reports False forever)?"""

@@ -183,6 +183,14 @@ class ParameterServer:
         # wire counters of the RpcServer fronting this shard (serve() and
         # PServerProgram attach it) — surfaced through stats()
         self._wire_stats = None
+        # consistent-cut snapshot store (online CheckpointFreezer):
+        # tag -> frozen copy of (round, params). Bounded FIFO so a freezer
+        # that dies between prepare and release cannot leak server memory
+        # without bound; entries are private copies, so fetch can
+        # serialize them OUTSIDE the lock with no torn bytes
+        self._snapshots = {}
+        self._snapshot_order = []
+        self._snapshot_cap = 4
 
     def attach_wire_stats(self, wire_stats):
         self._wire_stats = wire_stats
@@ -422,6 +430,67 @@ class ParameterServer:
             # sendrecv byte accounting, queryable by trainers and tools
             out["wire"] = self._wire_stats.snapshot()
         return out
+
+    # ---- consistent-cut snapshots (the online-learning freeze path) ----
+    def snapshot_prepare(self, tag):
+        """Freeze a private copy of this shard's params AT ITS CURRENT
+        SYNC ROUND, keyed by ``tag``, and return ``{"round", "names"}``.
+        The copy happens under the apply lock (one memcpy of the shard),
+        so a concurrent push can never tear it; the caller (online
+        CheckpointFreezer via ParamClient.snapshot_prepare) prepares the
+        SAME tag on every shard and verifies the returned rounds agree —
+        a barrier-consistent cut, taken between a single trainer's step
+        boundaries where no push is in flight. The heavy transfer happens
+        later through :meth:`snapshot_fetch`, OFF the training hot path.
+
+        The store is bounded (oldest tag evicted) so a freezer that
+        crashed between prepare and release cannot grow server memory.
+
+        Re-preparing a LIVE tag answers from the stored cut (same round,
+        no re-copy): the freezer's client retries on connection failures,
+        and a resend whose first attempt landed must see the original
+        answer, not an error — prepare is idempotent per tag, like push
+        under its seq dedup."""
+        with self._lock:
+            snap = self._snapshots.get(tag)
+            if snap is not None:
+                return {"round": snap["round"],
+                        "names": sorted(snap["params"])}
+            while len(self._snapshot_order) >= self._snapshot_cap:
+                old = self._snapshot_order.pop(0)
+                self._snapshots.pop(old, None)
+            self._snapshots[tag] = {
+                "round": self._round,
+                "params": {n: v.copy() for n, v in self._params.items()},
+            }
+            self._snapshot_order.append(tag)
+            return {"round": self._round, "names": sorted(self._params)}
+
+    def snapshot_fetch(self, tag, names=None):
+        """Return the frozen cut ``{"round", "params": {name: array}}``.
+        The arrays are the prepare-time private copies — nothing mutates
+        them, so serializing the response outside the lock is safe and
+        the bytes are bitwise the prepare-instant state."""
+        with self._lock:
+            snap = self._snapshots.get(tag)
+            if snap is None:
+                raise ValueError(
+                    f"unknown snapshot tag {tag!r} on this shard (never "
+                    "prepared, already released, or evicted — or the "
+                    "shard restarted since prepare; re-cut)")
+            params = snap["params"]
+            if names is not None:
+                params = {n: params[n] for n in names}
+            return {"round": snap["round"], "params": params}
+
+    def snapshot_release(self, tag):
+        """Drop the frozen cut; returns True when the tag existed.
+        Unknown tags are a no-op (release is the cleanup path of failed
+        cuts, which must be safe to over-call)."""
+        with self._lock:
+            if tag in self._snapshot_order:
+                self._snapshot_order.remove(tag)
+            return self._snapshots.pop(tag, None) is not None
 
     # ---- checkpoint / restore (the Go pserver's crash contract) ----
     def save_checkpoint(self, path=None):
@@ -779,16 +848,28 @@ class ParamClient:
                 f"shard(s): {detail}")
         return out
 
-    def push(self, grads):
+    def allocate_seq(self):
+        """Claim the next push sequence number WITHOUT sending. A caller
+        that must re-push the same gradients after a partial failure (a
+        shard died after its peers applied) pushes with the SAME seq:
+        shards that already applied answer from the dedup table, the
+        restarted shard applies — exactly-once per shard, and the
+        shards' sync rounds stay in lockstep (the online trainer's
+        step-retry contract; a fresh seq would double-apply on the
+        surviving shards)."""
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
+
+    def push(self, grads, seq=None):
         wire_dtype = self._wire_dtype()   # read + validate once per push
         by_client = {}
         for n, g in grads.items():
             self._client_for(n)  # raise the friendly error on misuse
             by_client.setdefault(self._placement[n], {})[n] = \
                 self._wire_grad(n, g, wire_dtype)
-        with self._seq_lock:
-            self._seq += 1
-            seq = self._seq
+        if seq is None:
+            seq = self.allocate_seq()
         return self._fanout("push", {
             idx: dict(grads=shard, trainer_id=self._trainer_id, seq=seq)
             for idx, shard in by_client.items()})
@@ -806,6 +887,58 @@ class ParamClient:
         for part in shards.values():
             params.update(part)
         return params
+
+    # ---- consistent-cut snapshots (online CheckpointFreezer) ----
+    def _all_shards(self, **kwargs):
+        return {idx: dict(kwargs) for idx in range(len(self._clients))}
+
+    def snapshot_prepare(self, tag):
+        """Prepare the cut ``tag`` on EVERY shard concurrently and return
+        ``{shard_idx: round}``. The prepares are cheap in-memory copies;
+        call this between step boundaries (no push in flight) and check
+        the returned rounds all agree before trusting the cut (a
+        disagreement is a torn cut — release the tag and re-cut). Any
+        shard failure aggregates through the usual fan-out error path;
+        the caller should release the tag."""
+        out = self._fanout("snapshot_prepare", self._all_shards(tag=tag))
+        return {idx: r["round"] for idx, r in out.items()}
+
+    def snapshot_fetch(self, tag):
+        """Pull the frozen cut from every shard (parallel, the pull
+        fan-out path) -> ``(params, rounds)`` where params maps EVERY
+        placed param name to its prepare-instant array."""
+        shards = self._fanout("snapshot_fetch", self._all_shards(tag=tag))
+        params, rounds = {}, {}
+        for idx, res in shards.items():
+            params.update(res["params"])
+            rounds[idx] = res["round"]
+        return params, rounds
+
+    def snapshot_release(self, tag, wait=False):
+        """Best-effort release on every shard — the cleanup path of a
+        failed cut. Per-shard errors (shard restarted and lost the tag;
+        shard briefly down) are swallowed, and by default the calls run
+        on a background thread: release is invoked from the trainer's
+        thread precisely when a shard is down, and waiting out the
+        client RetryPolicy's budget there would stall training for
+        seconds per failed cut. An unreleased snapshot is bounded
+        server-side by the store cap, so fire-and-forget is safe.
+        ``wait=True`` runs the calls inline (operator/test usage that
+        needs the tags gone on return)."""
+        import threading
+
+        def _release(clients=list(self._clients)):
+            for c in clients:
+                try:
+                    c.call("snapshot_release", tag=tag)
+                except Exception:
+                    pass
+
+        if wait:
+            _release()
+        else:
+            threading.Thread(target=_release, daemon=True,
+                             name=f"snapshot-release-{tag}").start()
 
     def wire_stats(self):
         """Aggregate client-side wire counters (rpc.WireStats) across the

@@ -236,14 +236,28 @@ class DistributeTranspiler:
         verify_pass_output(pruned, "DistributeTranspiler.get_startup_program")
         return pruned
 
-    def trainer_client(self):
+    def trainer_client(self, retry=None, rpc_timeout=None, endpoints=None):
         """The send/recv half of the reference trainer program: a
-        ParamClient over every endpoint with the transpiler's placement."""
+        ParamClient over every endpoint with the transpiler's placement.
+        ``retry`` (rpc.RetryPolicy) makes the client reconnect-and-resend
+        through pserver restarts — what a long-lived streaming trainer
+        under a PserverSupervisor wants; ``endpoints`` substitutes the
+        ACTUAL serve addresses when the transpile-time ones were
+        placeholders (the supervisor allocates ports at spawn) — the
+        count must match, placement is derived from names alone."""
         from ..distributed.param_server import ParamClient, parse_endpoint
-        return ParamClient([parse_endpoint(e) for e in self.endpoints],
+        if endpoints is None:
+            endpoints = self.endpoints
+        elif len(endpoints) != len(self.endpoints):
+            raise ValueError(
+                f"endpoints count {len(endpoints)} != transpiled pserver "
+                f"count {len(self.endpoints)}: the round-robin placement "
+                "would disagree with the servers'")
+        return ParamClient([parse_endpoint(e) for e in endpoints],
                            trainer_id=self.trainer_id,
                            param_names=[p for p, _ in self.params_grads],
-                           sparse_param_names=self.sparse_param_names)
+                           sparse_param_names=self.sparse_param_names,
+                           retry=retry, rpc_timeout=rpc_timeout)
 
 
 class SimpleDistributeTranspiler(DistributeTranspiler):

@@ -1,0 +1,35 @@
+"""Online learning: continuous training that publishes into a live
+serving fleet without dropping a request.
+
+The missing subsystem between this repo's training plane and serving
+plane — the end-to-end loop the original Paddle v2 Go/etcd pserver
+cluster was famous for (PAPER.md): a model trains on an unbounded
+stream, periodically freezes into a versioned inference bundle, and
+rolls onto a replica fleet that keeps answering throughout.
+
+* :class:`StreamingTrainer` (trainer.py) — pull/step/push forever over
+  a ``reader``-package stream; publish triggers fire at step boundaries
+  (``online_publish_every_steps`` / ``online_publish_every_s``) without
+  stalling the hot path; pserver restarts are ridden through.
+* :class:`CheckpointFreezer` (freezer.py) — barrier-consistent cuts of
+  the sharded pserver state (every shard at the same sync round — never
+  a torn mix), stitched through ``save_inference_model`` and published
+  with lineage metadata (global step, parent version, freeze round).
+* :class:`RolloutController` (rollout.py) — registry watcher driving
+  canary-gated ``rolling_reload`` with min-serve-time hysteresis,
+  permanent quarantine of canary-rejected versions, and optional
+  registry gc.
+* :class:`OnlineLearningLoop` (loop.py) — the whole supervised process
+  tree under one start/stats/stop, chaos-tolerant by construction: a
+  pserver shard and a serving replica can be SIGKILLed mid-loop with
+  zero failed infer requests and a monotonically advancing served
+  version.
+"""
+
+from .freezer import CheckpointFreezer, FreezeError
+from .loop import OnlineLearningLoop
+from .rollout import RolloutController
+from .trainer import StreamingTrainer
+
+__all__ = ["StreamingTrainer", "CheckpointFreezer", "FreezeError",
+           "RolloutController", "OnlineLearningLoop"]

@@ -1,0 +1,251 @@
+"""OnlineLearningLoop: the end-to-end continuous-learning process tree.
+
+The "millions of users" loop the original Paddle v2 etcd/Go stack was
+built for, assembled from this repo's production pieces: a supervised
+pserver fleet holds the parameters (checkpointed, restart-on-crash), a
+StreamingTrainer consumes an unbounded reader and pushes gradients, a
+CheckpointFreezer periodically takes a barrier-consistent cut and
+publishes it to the ModelRegistry, and a RolloutController drives
+canary-gated ``rolling_reload`` onto a supervised serving fleet — which
+answers live inference traffic THE WHOLE TIME.
+
+Supervision tree (everything under one object, one ``stop()``):
+
+    OnlineLearningLoop
+    ├── PserverSupervisor        n_pservers forked shards, per-shard
+    │                            checkpoints, restart-on-crash
+    ├── StreamingTrainer         in-process thread; retry-riding client
+    ├── CheckpointFreezer        cut + stitch/publish worker thread
+    ├── FleetSupervisor          n_replicas spawned ModelServers,
+    │                            restart from the registry's current
+    │                            version
+    └── RolloutController        registry watcher -> rolling_reload
+
+Chaos contract (pinned by the tier-1 e2e test and the bench lane): with
+a pserver shard AND a serving replica SIGKILLed mid-loop, zero infer
+requests fail (the FleetClient fails over; the supervisors restart the
+children), the served version keeps advancing monotonically, and a
+published-but-corrupt version is rolled back by the canary gate without
+the fleet ever serving it.
+
+Startup publishes version 1 (the freshly initialized params) BEFORE the
+serving fleet boots, so replicas always have a version to load — and a
+crash-restarting replica loads whatever is current by then.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class OnlineLearningLoop:
+    """Wire and supervise the full streaming-train -> publish -> rollout
+    loop for one model.
+
+        main, startup = build_model()        # optimizer.minimize applied
+        loop = OnlineLearningLoop(
+            main, startup, reader,
+            infer_feed_names=["x"], infer_targets=[y_pred],
+            registry_root=root, model="ranker",
+            n_pservers=2, n_replicas=2)
+        loop.start()
+        ... FleetClient(loop.fleet.addresses) serves throughout ...
+        loop.stats()
+        loop.stop()
+
+    ``main_program`` must carry optimize ops (``optimizer.minimize``) —
+    the transpiler lifts the rule server-side and strips them from the
+    trainer program; the SAME program exports the inference bundle
+    (``save_inference_model`` prunes to the fetch path).
+    """
+
+    def __init__(self, main_program, startup_program, reader,
+                 infer_feed_names, infer_targets, registry_root,
+                 model="model", n_pservers=2, n_replicas=None,
+                 sync_mode=True, publish_every_steps=None,
+                 publish_every_s=None, min_serve_s=None,
+                 rollout_poll_s=None, registry_keep=None,
+                 buckets=None, max_delay_ms=None, checkpoint_dir=None,
+                 checkpoint_every=1, trainer_retry=None, extra_fetch=(),
+                 prefetch=2, fleet_kwargs=None):
+        from ..serving.registry import ModelRegistry
+
+        self._main = main_program
+        self._startup = startup_program
+        self._reader = reader
+        self._feed_names = list(infer_feed_names)
+        self._targets = [t if isinstance(t, str) else t.name
+                         for t in infer_targets]
+        self.registry = registry_root if isinstance(registry_root,
+                                                    ModelRegistry) \
+            else ModelRegistry(registry_root)
+        self.model = model
+        self._n_pservers = int(n_pservers)
+        self._n_replicas = n_replicas
+        self._sync_mode = bool(sync_mode)
+        self._pub_steps = publish_every_steps
+        self._pub_s = publish_every_s
+        self._min_serve_s = min_serve_s
+        self._rollout_poll_s = rollout_poll_s
+        self._registry_keep = registry_keep
+        self._buckets = buckets
+        self._max_delay_ms = max_delay_ms
+        self._ckpt_dir = checkpoint_dir
+        self._ckpt_every = int(checkpoint_every)
+        self._retry = trainer_retry
+        self._extra_fetch = extra_fetch
+        self._prefetch = prefetch
+        self._fleet_kwargs = dict(fleet_kwargs or {})
+        self.pservers = None
+        self.fleet = None
+        self.trainer = None
+        self.freezer = None
+        self.rollout = None
+        self.client = None
+        self._exe = None
+        self._scope = None
+        self._started = False
+
+    # ------------------------------------------------------------------
+    def start(self, wait_ready_s=240.0):
+        """Boot the tree bottom-up: pservers -> init params -> publish
+        v1 -> serving fleet -> rollout watcher -> trainer. Returns the
+        initially served version."""
+        import paddle_tpu.fluid as fluid
+        from ..distributed.launch import PserverSupervisor
+        from ..distributed.rpc import RetryPolicy
+        from ..serving.fleet import FleetSupervisor
+        from .freezer import CheckpointFreezer
+        from .rollout import RolloutController
+        from .trainer import StreamingTrainer
+
+        if self._started:
+            raise RuntimeError("loop already started")
+        self._started = True
+
+        # transpile against placeholder endpoints — placement derives
+        # from sorted param names + shard COUNT, so the real supervisor
+        # addresses substitute at client construction
+        t = fluid.DistributeTranspiler()
+        t.transpile(0, program=self._main,
+                    pservers=",".join(f"127.0.0.1:{i + 1}"
+                                      for i in range(self._n_pservers)),
+                    trainers=1, startup_program=self._startup,
+                    sync_mode=self._sync_mode)
+        self._transpiler = t
+
+        self.pservers = PserverSupervisor(
+            n_servers=self._n_pservers, checkpoint_dir=self._ckpt_dir,
+            optimizer=t.optimizer, opt_kwargs=t.opt_kwargs,
+            mode="sync" if self._sync_mode else "async", fan_in=1,
+            checkpoint_every=self._ckpt_every)
+        try:
+            if not self.pservers.wait_ready(wait_ready_s):
+                raise RuntimeError("pserver shards never became ready")
+
+            self._exe = fluid.Executor()
+            self._scope = fluid.Scope()
+            self._exe.run(self._startup, scope=self._scope)
+            retry = self._retry or RetryPolicy(max_retries=8,
+                                               backoff_base_s=0.05,
+                                               backoff_max_s=1.0)
+            self.client = t.trainer_client(retry=retry,
+                                           endpoints=self.pservers.addresses)
+            self.client.init_params(
+                {p: np.asarray(self._scope.find_var(p))
+                 for p, _g in t.params_grads})
+
+            self.freezer = CheckpointFreezer(
+                self.client, self.registry, self.model, self._main,
+                self._feed_names, self._targets, executor=self._exe,
+                template_scope=self._scope)
+            # v1: the initialized params — the fleet needs something to
+            # serve before the first training-driven publish lands
+            self.freezer.request_freeze(0, wait=True, timeout=wait_ready_s)
+
+            self.fleet = FleetSupervisor(
+                self.registry, self.model, version="latest",
+                n_replicas=self._n_replicas, buckets=self._buckets,
+                max_delay_ms=self._max_delay_ms, **self._fleet_kwargs)
+            if not self.fleet.wait_ready(wait_ready_s):
+                raise RuntimeError("serving fleet never became ready")
+
+            self.rollout = RolloutController(
+                self.registry, self.model, self.fleet,
+                poll_interval_s=self._rollout_poll_s,
+                min_serve_s=self._min_serve_s,
+                rollout_timeout_s=wait_ready_s,
+                registry_keep=self._registry_keep)
+            self.rollout.start()
+
+            self.trainer = StreamingTrainer(
+                self._exe, self._scope, t.get_trainer_program(),
+                t.params_grads, self.client, self._reader,
+                freezer=self.freezer,
+                publish_every_steps=self._pub_steps,
+                publish_every_s=self._pub_s,
+                extra_fetch=self._extra_fetch, prefetch=self._prefetch)
+            self.trainer.start()
+        except Exception:
+            self.stop()               # resets _started: retryable
+            raise
+        return self.fleet.version
+
+    # ------------------------------------------------------------------
+    def stats(self):
+        """One aggregated observability surface: every component's
+        counters plus the supervisors' per-child restart stats — what an
+        operator (and the bench lane) watches the loop through."""
+        out = {"model": self.model, "started": self._started}
+        if self.trainer is not None:
+            out["trainer"] = self.trainer.stats()
+        if self.freezer is not None:
+            out["freezer"] = self.freezer.stats()
+        if self.rollout is not None:
+            out["rollout"] = self.rollout.stats()
+        if self.fleet is not None:
+            out["served_version"] = self.fleet.version
+            out["fleet_children"] = self.fleet.child_stats()
+        if self.pservers is not None:
+            out["pserver_children"] = self.pservers.child_stats()
+        try:
+            out["published_versions"] = self.registry.versions(self.model)
+        except ValueError:
+            out["published_versions"] = []
+        return out
+
+    def stop(self):
+        """Tear the tree down top-down (trainer first so nothing pushes
+        into stopping shards; fleet before pservers so no component is
+        surprised). Idempotent, and resets the started flag: a stopped
+        loop can be start()ed again from scratch (every component is
+        rebuilt there)."""
+        if self.trainer is not None:
+            self.trainer.stop()
+            self.trainer = None
+        if self.rollout is not None:
+            self.rollout.stop()
+            self.rollout = None
+        if self.freezer is not None:
+            self.freezer.close()
+            self.freezer = None
+        if self.fleet is not None:
+            self.fleet.stop()
+            self.fleet = None
+        if self.client is not None:
+            self.client.close()
+            self.client = None
+        if self.pservers is not None:
+            self.pservers.stop()
+            self.pservers = None
+        self._started = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+__all__ = ["OnlineLearningLoop"]

@@ -39,13 +39,13 @@ from .batcher import DynamicBatcher, ServerOverloaded
 from .server import ModelServer
 from .client import InferClient
 from .registry import ModelRegistry
-from .fleet import FleetSupervisor
+from .fleet import CanaryFailed, FleetSupervisor
 from .router import FleetClient
 from .generate import (PagedKVCache, CacheExhausted, GenerationEngine,
                        NoFreeSlots, ContinuousBatcher, GenClient)
 
 __all__ = ["InferenceEngine", "DynamicBatcher", "ServerOverloaded",
            "ModelServer", "InferClient", "ModelRegistry",
-           "FleetSupervisor", "FleetClient",
+           "FleetSupervisor", "CanaryFailed", "FleetClient",
            "PagedKVCache", "CacheExhausted", "GenerationEngine",
            "NoFreeSlots", "ContinuousBatcher", "GenClient"]

@@ -37,8 +37,30 @@ import time
 
 from ..core.flags import get_flag
 from ..distributed.launch import ChildSupervisor
-from ..distributed.rpc import RpcClient
+from ..distributed.rpc import RemoteError, RpcClient
 from .registry import ModelRegistry
+
+
+class CanaryFailed(RuntimeError):
+    """``rolling_reload``'s canary (replica 0) REJECTED the target
+    version and was rolled back — the TARGET IS BAD (corrupt bundle,
+    failed warmup), not the fleet: N−1 replicas never saw it. Raised
+    only when the canary ANSWERED with a structured RemoteError (it
+    processed the reload and refused); a canary that is merely
+    unreachable (crashed / killed mid-reload) raises a plain
+    RuntimeError instead — that says nothing about the bundle. Typed so
+    an automated rollout driver (online.RolloutController) can mark the
+    version bad and never retry it, while transient failures (plain
+    RuntimeError, canary unreachable or mid-fleet after the canary
+    passed) stay retryable.
+    ``version`` carries the rejected target, ``rolled_back_to`` the
+    version the canary was restored to (None when there was nothing to
+    roll back to)."""
+
+    def __init__(self, message, version=None, rolled_back_to=None):
+        super().__init__(message)
+        self.version = version
+        self.rolled_back_to = rolled_back_to
 
 
 def _replica_child(address, model_dir, version, cfg, fault_plan=None):
@@ -212,9 +234,25 @@ class FleetSupervisor(ChildSupervisor):
             if err is not None:
                 if i == 0:
                     self._rollback_canary(prev, wait_timeout)
+                    if isinstance(err, RemoteError):
+                        # the canary ANSWERED with a structured error —
+                        # it processed the reload and rejected the bundle
+                        # (corrupt files, failed warmup): the TARGET is
+                        # bad. Typed so rollout drivers quarantine it.
+                        raise CanaryFailed(
+                            f"rolling_reload: canary (replica 0) rejected "
+                            f"version {target}; rolled back to {prev}: "
+                            f"{type(err).__name__}: {err}",
+                            version=target, rolled_back_to=prev) from err
+                    # connection-level failure (canary crashed / was
+                    # killed mid-reload, connect refused during its
+                    # restart): says nothing about the bundle — plain
+                    # RuntimeError, retryable once the supervisor
+                    # restarts the replica
                     raise RuntimeError(
-                        f"rolling_reload: canary (replica 0) failed for "
-                        f"version {target}; rolled back to {prev}: "
+                        f"rolling_reload: canary (replica 0) unreachable "
+                        f"during rollout to {target} (rolled back to "
+                        f"{prev}); target not condemned — retry: "
                         f"{type(err).__name__}: {err}") from err
                 raise RuntimeError(
                     f"rolling_reload: replica {i} failed after the canary "
@@ -252,4 +290,4 @@ class FleetSupervisor(ChildSupervisor):
         return out
 
 
-__all__ = ["FleetSupervisor"]
+__all__ = ["FleetSupervisor", "CanaryFailed"]

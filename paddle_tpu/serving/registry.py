@@ -25,6 +25,7 @@ import hashlib
 import json
 import os
 import shutil
+import time
 
 from ..fluid.io import MODEL_FILENAME
 
@@ -92,8 +93,18 @@ class ModelRegistry:
         return sorted(out)
 
     # ------------------------------------------------------------------
+    def _all_version_dirs(self, model):
+        """EVERY numeric version dir, published or torn — what the
+        auto-increment must step over: a freezer that crashed mid-copy
+        leaves a manifest-less dir, and handing its number out again
+        would wedge every subsequent publish on the immutability check."""
+        d = self.model_dir(model)
+        if not os.path.isdir(d):
+            return []
+        return sorted(int(n) for n in os.listdir(d) if n.isdigit())
+
     def publish(self, model, src_dir, version=None, kernel_tier=None,
-                model_kind="feedforward"):
+                model_kind="feedforward", lineage=None):
         """Copy the bundle at ``src_dir`` in as ``version`` (next integer
         when None) and make it visible by writing the manifest LAST,
         atomically. Returns the published version number. Versions are
@@ -112,7 +123,15 @@ class ModelRegistry:
         or "generative" (GenerationEngine: stateful decode over the
         bundle's causal_self_attention sites). ModelServer reads it from
         the version dir's VERSION.json and picks the engine class;
-        :meth:`model_kind` surfaces it alongside :meth:`resolve`."""
+        :meth:`model_kind` surfaces it alongside :meth:`resolve`.
+
+        ``lineage`` is an optional dict of provenance the publisher wants
+        recorded in the manifest (the online freezer stamps
+        ``global_step``/``parent_version``/``freeze_round``); every
+        manifest additionally records ``published_at`` (wall-clock), the
+        timestamp the rollout controller computes publish-to-served lag
+        from. Lineage is metadata only — resolution and verification
+        never read it."""
         if not os.path.exists(os.path.join(src_dir, MODEL_FILENAME)):
             raise ValueError(
                 f"publish: {src_dir!r} is not a save_inference_model "
@@ -131,19 +150,43 @@ class ModelRegistry:
             raise ValueError(
                 f"model_kind must be 'feedforward' or 'generative', "
                 f"got {model_kind!r}")
-        existing = self.versions(model)
-        if version is None:
-            version = existing[-1] + 1 if existing else 1
-        version = int(version)
-        if version <= 0:
-            raise ValueError(f"version must be a positive int, "
-                             f"got {version}")
-        dst = self.version_dir(model, version)
-        if version in existing or os.path.exists(dst):
+        if lineage is not None and not isinstance(lineage, dict):
             raise ValueError(
-                f"version {version} of model {model!r} already exists "
-                "(published versions are immutable; publish a new one)")
-        os.makedirs(dst)
+                f"lineage must be a dict of provenance fields, "
+                f"got {type(lineage).__name__}")
+        auto = version is None
+        if not auto:
+            version = int(version)
+            if version <= 0:
+                raise ValueError(f"version must be a positive int, "
+                                 f"got {version}")
+        # the makedirs IS the claim on the version number: concurrent
+        # publishers (a freezer worker racing an operator publish) both
+        # computing the same auto-increment cannot both create the dir,
+        # so the loser re-derives the next number instead of failing —
+        # only an EXPLICIT version collides into the immutability error
+        for _attempt in range(64):
+            if auto:
+                # next number past EVERY existing dir, torn ones included
+                # — a crash mid-publish must not permanently wedge
+                # auto-increment on its abandoned manifest-less dir
+                all_dirs = self._all_version_dirs(model)
+                version = all_dirs[-1] + 1 if all_dirs else 1
+            dst = self.version_dir(model, version)
+            try:
+                os.makedirs(dst)
+                break
+            except FileExistsError:
+                if not auto:
+                    raise ValueError(
+                        f"version {version} of model {model!r} already "
+                        "exists (published versions are immutable; "
+                        "publish a new one)") from None
+        else:
+            raise RuntimeError(
+                f"publish: could not claim a version number for "
+                f"{model!r} after 64 attempts (pathological publish "
+                "contention)")
         files = {}
         for name in sorted(os.listdir(src_dir)):
             src = os.path.join(src_dir, name)
@@ -157,7 +200,10 @@ class ModelRegistry:
         manifest = {"model": model, "version": version, "files": files,
                     "content_hash": _content_hash(files),
                     "kernel_tier": kernel_tier,
-                    "model_kind": model_kind}
+                    "model_kind": model_kind,
+                    "published_at": time.time()}
+        if lineage:
+            manifest["lineage"] = dict(lineage)
         tmp = os.path.join(dst, VERSION_MANIFEST + ".tmp")
         with open(tmp, "w") as f:
             json.dump(manifest, f, indent=1, sort_keys=True)
@@ -207,6 +253,98 @@ class ModelRegistry:
                 f"registry version {model!r}/{v} holds a corrupt "
                 f"{VERSION_MANIFEST!r} ({type(e).__name__}: {e}); "
                 "republish the version") from e
+
+    def gc(self, model, keep_latest=2, pinned=(), torn_ttl_s=3600.0):
+        """Retention: delete old published version dirs, keeping the
+        newest ``keep_latest`` versions and NEVER deleting
+
+        * the latest published version (what ``resolve("latest")`` and a
+          crash-restarting replica load),
+        * its :meth:`previous` (the rollback target a failed canary
+          needs), or
+        * any version in ``pinned`` (the caller's currently-served /
+          must-keep set — the registry cannot know what a fleet is
+          serving, so the rollout controller passes it).
+
+        Deletion is manifest-first: the VERSION.json is unlinked before
+        the dir is removed, so a crash mid-gc leaves a TORN (invisible)
+        version, never a corrupt resolvable one. Returns the sorted list
+        of deleted version numbers. Typed ValueErrors on bad args;
+        pinned versions that no longer exist are ignored (gc must be
+        idempotent across restarts).
+
+        Torn (manifest-less) dirs — abandoned by a publisher that
+        crashed mid-copy — are swept too once older than ``torn_ttl_s``
+        seconds (dir mtime): they hold full-size bundle copies no other
+        API can reach, and without the sweep repeated publisher crashes
+        grow the registry without bound. The TTL protects an IN-FLIGHT
+        publish (a fresh manifest-less dir is a publish in progress,
+        not garbage); 0 sweeps every torn dir immediately — only safe
+        when no publisher can be running concurrently."""
+        try:
+            keep_latest = int(keep_latest)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"keep_latest must be a positive int, "
+                f"got {keep_latest!r}") from None
+        if keep_latest < 1:
+            raise ValueError(
+                f"keep_latest must be >= 1 (the latest version is never "
+                f"deleted), got {keep_latest}")
+        try:
+            pinned = {int(v) for v in pinned}
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"pinned must be an iterable of version ints, "
+                f"got {pinned!r}") from None
+        try:
+            torn_ttl_s = float(torn_ttl_s)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"torn_ttl_s must be a non-negative number of seconds, "
+                f"got {torn_ttl_s!r}") from None
+        if torn_ttl_s < 0:
+            raise ValueError(
+                f"torn_ttl_s must be >= 0, got {torn_ttl_s}")
+        published = self.versions(model)
+        deleted = self._sweep_torn(model, set(published), torn_ttl_s)
+        if not published:
+            return sorted(deleted)
+        latest = published[-1]
+        protected = set(published[-keep_latest:]) | {latest} | pinned
+        prev = self.previous(model, latest)
+        if prev is not None:
+            protected.add(prev)
+        for v in published:
+            if v in protected:
+                continue
+            vdir = self.version_dir(model, v)
+            try:
+                os.unlink(os.path.join(vdir, VERSION_MANIFEST))
+            except FileNotFoundError:
+                pass      # already torn: finish removing the remains
+            shutil.rmtree(vdir, ignore_errors=True)
+            deleted.append(v)
+        return sorted(deleted)
+
+    def _sweep_torn(self, model, published, ttl_s):
+        """Delete manifest-less version dirs older than ``ttl_s`` —
+        abandoned publishes only; a fresh torn dir is an in-flight
+        publish and must survive. Returns the swept version numbers."""
+        cutoff = time.time() - ttl_s
+        swept = []
+        for v in self._all_version_dirs(model):
+            if v in published:
+                continue
+            vdir = self.version_dir(model, v)
+            try:
+                if os.path.getmtime(vdir) > cutoff:
+                    continue
+            except OSError:
+                continue       # raced a concurrent delete
+            shutil.rmtree(vdir, ignore_errors=True)
+            swept.append(v)
+        return swept
 
     def verify(self, model, version):
         """Re-hash the stored files against the manifest; raises ValueError

@@ -1,0 +1,802 @@
+"""Online-learning loop: consistent pserver cuts (snapshot API, torn-cut
+rejection, bitwise freeze reproducibility for dense + sparse rowwise
+params), registry retention gc + numeric latest ordering, the rollout
+controller's hysteresis/quarantine/monotonicity, supervisor child stats,
+and the end-to-end chaos contract — streaming-train -> publish ->
+rolling_reload across multiple versions while a pserver shard and a
+serving replica are SIGKILLed mid-loop, with zero failed infer requests
+and a monotonically advancing served version.
+"""
+
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import ParamClient, RetryPolicy
+from paddle_tpu.distributed.param_server import serve
+from paddle_tpu.distributed.rpc import RemoteError, RpcClient
+from paddle_tpu.online import (CheckpointFreezer, OnlineLearningLoop,
+                               RolloutController, StreamingTrainer)
+from paddle_tpu.serving import CanaryFailed, FleetClient, ModelRegistry
+
+
+# ---------------------------------------------------------------------------
+# pserver consistent-cut snapshot API
+# ---------------------------------------------------------------------------
+
+def test_snapshot_prepare_fetch_release_and_eviction():
+    """The shard-side cut: prepare copies params at the current round;
+    the copy is immutable while training keeps pushing (fetch is bitwise
+    the prepare instant); release frees; unknown tags raise typed across
+    the wire; the bounded store evicts the oldest tag."""
+    ps, rpc = serve(optimizer="sgd", opt_kwargs={"lr": 0.5}, mode="sync",
+                    fan_in=1)
+    rpc.serve_in_thread()
+    c = ParamClient([rpc.address])
+    w0 = np.arange(8, dtype=np.float32)
+    c.init_params({"w": w0})
+    rounds = c.snapshot_prepare("cut1")
+    assert rounds == {0: 0}
+    # keep training: the frozen copy must not move
+    for _ in range(3):
+        c.push({"w": np.ones(8, np.float32)})
+    params, fetch_rounds = c.snapshot_fetch("cut1")
+    assert fetch_rounds == {0: 0}
+    np.testing.assert_array_equal(params["w"], w0)
+    assert params["w"].dtype == np.float32
+    # live state moved on
+    assert not np.array_equal(c.pull()["w"], w0)
+    # wait=True: the default is fire-and-forget (the freezer calls it
+    # from the trainer thread while a shard may be down); asserting the
+    # tag is gone needs the inline mode
+    c.snapshot_release("cut1", wait=True)
+    with pytest.raises(RemoteError, match="unknown snapshot tag"):
+        c.snapshot_fetch("cut1")
+    # re-preparing a LIVE tag is an idempotent REPLAY — the retrying
+    # client resends on a connection drop after the first attempt
+    # landed, and must get the ORIGINAL cut back (same round, no
+    # re-copy), even after the live round moved on
+    r2 = c.snapshot_prepare("cut2")
+    c.push({"w": np.ones(8, np.float32)})
+    assert c.snapshot_prepare("cut2") == r2
+    c.snapshot_release("cut2")
+    c.snapshot_release("cut2")          # no-op, no raise
+    # bounded store: cap + 1 prepares evict the oldest
+    for i in range(ps._snapshot_cap + 1):
+        c.snapshot_prepare(f"e{i}")
+    with pytest.raises(RemoteError, match="unknown snapshot tag"):
+        c.snapshot_fetch("e0")
+    c.snapshot_fetch(f"e{ps._snapshot_cap}")   # newest still there
+    c.close()
+    rpc.shutdown()
+
+
+def test_freezer_rejects_torn_cut_and_cuts_consistently():
+    """Two shards: a cut taken at a step boundary has EQUAL rounds and
+    publishes; a cut taken while the shards' rounds disagree (one shard
+    saw a push the other did not — the torn-mix case) is rejected and
+    released, never published."""
+    ps_a, rpc_a = serve(optimizer="sgd", opt_kwargs={"lr": 0.1},
+                        mode="sync", fan_in=1)
+    ps_b, rpc_b = serve(optimizer="sgd", opt_kwargs={"lr": 0.1},
+                        mode="sync", fan_in=1)
+    rpc_a.serve_in_thread()
+    rpc_b.serve_in_thread()
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1])
+        pred = fluid.layers.fc(x, size=1, act=None,
+                               param_attr=fluid.ParamAttr(name="w"),
+                               bias_attr=fluid.ParamAttr(name="b"))
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss, startup)
+    t = fluid.DistributeTranspiler()
+    t.transpile(0, program=main, pservers="127.0.0.1:1,127.0.0.1:2",
+                trainers=1, startup_program=startup)
+    client = t.trainer_client(endpoints=[rpc_a.address, rpc_b.address])
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    client.init_params({p: np.asarray(scope.find_var(p))
+                        for p, _ in t.params_grads})
+    reg = ModelRegistry(os.path.join(_tmp(), "reg"))
+    frz = CheckpointFreezer(client, reg, "m", main, ["x"], [pred],
+                            executor=exe, template_scope=scope)
+    try:
+        # boundary cut: rounds agree, publish lands with lineage
+        client.push({"w": np.ones((4, 1), np.float32),
+                     "b": np.ones((1,), np.float32)})
+        v = frz.request_freeze(1, wait=True, timeout=60)
+        m = reg.manifest("m", v)
+        assert m["lineage"]["freeze_round"] == 1
+        assert m["lineage"]["global_step"] == 1
+        assert m["lineage"]["parent_version"] is None
+        assert m["published_at"] > 0
+        # desync: push to ONE shard directly (what a cut mid-push fanout
+        # would observe) — shard rounds now disagree
+        direct = RpcClient(rpc_a.address)
+        direct.call("push", grads={"b": np.ones((1,), np.float32)})
+        direct.close()
+        assert frz.request_freeze(2) is None
+        st = frz.stats()
+        assert st["failures"].get("torn") == 1
+        assert "rounds disagree" in st["last_error"]
+        assert reg.versions("m") == [v]     # nothing torn was published
+    finally:
+        frz.close()
+        client.close()
+        rpc_a.shutdown()
+        rpc_b.shutdown()
+
+
+def _tmp():
+    import tempfile
+    return tempfile.mkdtemp(prefix="pdtpu-online-test-")
+
+
+def test_same_seq_repush_resyncs_partially_applied_step():
+    """The trainer's push-retry contract: a push that applied on one
+    shard but not the other (the shard died mid-fanout) is re-sent with
+    the SAME sequence number — the shard that applied answers from the
+    dedup table (no double apply), the other applies, and the shards'
+    sync rounds come back into lockstep, so the next freeze cut is
+    consistent instead of torn forever."""
+    _psa, rpc_a = serve(optimizer="sgd", opt_kwargs={"lr": 1.0},
+                        mode="sync", fan_in=1)
+    _psb, rpc_b = serve(optimizer="sgd", opt_kwargs={"lr": 1.0},
+                        mode="sync", fan_in=1)
+    rpc_a.serve_in_thread()
+    rpc_b.serve_in_thread()
+    c = ParamClient([rpc_a.address, rpc_b.address])
+    # round-robin over sorted names: "a" -> shard0, "b" -> shard1
+    c.init_params({"a": np.zeros(4, np.float32),
+                   "b": np.zeros(4, np.float32)})
+    g = {"a": np.ones(4, np.float32), "b": np.ones(4, np.float32)}
+    c.push(g)                                   # both shards at round 1
+    # simulate the partial step: shard0 applies seq 2, shard1 never saw it
+    seq = c.allocate_seq()
+    direct = RpcClient(rpc_a.address)
+    direct.call("push", grads={"a": np.ones(4, np.float32)},
+                trainer_id=0, seq=seq)
+    direct.close()
+    assert c.snapshot_prepare("desync") == {0: 2, 1: 1}   # torn state
+    c.snapshot_release("desync")
+    # the retry: SAME grads, SAME seq — resyncs instead of double-applying
+    c.push(g, seq=seq)
+    rounds = c.snapshot_prepare("resync")
+    assert rounds == {0: 2, 1: 2}
+    params, _ = c.snapshot_fetch("resync")
+    c.snapshot_release("resync")
+    # shard0 applied seq 2 exactly ONCE (lr=1.0: value == -rounds)
+    np.testing.assert_array_equal(params["a"],
+                                  np.full(4, -2.0, np.float32))
+    np.testing.assert_array_equal(params["b"],
+                                  np.full(4, -2.0, np.float32))
+    c.close()
+    rpc_a.shutdown()
+    rpc_b.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# bitwise freeze reproducibility (dense + sparse rowwise-optimizer params)
+# ---------------------------------------------------------------------------
+
+def test_freeze_bitwise_matches_pserver_checkpoint_dense_and_sparse():
+    """Publish at step S, keep training, then restore the published
+    bundle: every param must match the pserver checkpoint taken at the
+    same sync round BITWISE — including the embedding table updated
+    through the sparse rowwise-adam path (rows mutate in place
+    server-side, which is exactly what a torn or lazy copy would
+    corrupt)."""
+    root = _tmp()
+    ckpt = os.path.join(root, "shard0.ckpt")
+    ps, rpc = serve(optimizer="adam", opt_kwargs={"lr": 0.05}, mode="sync",
+                    fan_in=1, checkpoint_path=ckpt, checkpoint_every=1)
+    rpc.serve_in_thread()
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        y = fluid.layers.data("y", shape=[1])
+        emb = fluid.layers.embedding(ids, size=[32, 6], is_sparse=True)
+        h = fluid.layers.reshape(emb, [-1, 6])
+        pred = fluid.layers.fc(h, size=1, act=None)
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss, startup)
+    t = fluid.DistributeTranspiler()
+    t.transpile(0, program=main, pservers="127.0.0.1:1", trainers=1,
+                startup_program=startup)
+    assert t.sparse_param_names, "embedding table should be marked sparse"
+    table = t.sparse_param_names[0]
+    client = t.trainer_client(endpoints=[rpc.address])
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    client.init_params({p: np.asarray(scope.find_var(p))
+                        for p, _ in t.params_grads})
+    reg = ModelRegistry(os.path.join(root, "reg"))
+    frz = CheckpointFreezer(client, reg, "m", main, ["ids"], [pred],
+                            executor=exe, template_scope=scope)
+    trainer_prog = t.get_trainer_program()
+    fetch = [g for _p, g in t.params_grads]
+    rng = np.random.RandomState(3)
+
+    def step():
+        for n, v in client.pull().items():
+            scope.set(n, v)
+        ids_batch = rng.randint(0, 32, (8, 1)).astype(np.int64)
+        feed = {"ids": ids_batch,
+                "y": rng.normal(0, 1, (8, 1)).astype(np.float32)}
+        fetched = exe.run(trainer_prog, feed=feed, fetch_list=fetch,
+                          scope=scope)
+        client.push({p: f if hasattr(f, "rows") else np.asarray(f)
+                     for (p, _g), f in zip(t.params_grads, fetched)})
+
+    try:
+        for _ in range(5):
+            step()
+        # the table took the rowwise path: per-row adam step counter
+        assert np.ndim(ps._opt_state[table]["t"]) == 1, \
+            "sparse rowwise optimizer never engaged"
+        v = frz.request_freeze(5, wait=True, timeout=60)
+        # trainer quiescent + checkpoint_every=1: the on-disk checkpoint
+        # is the round-5 state — the independent ground truth
+        saved = os.path.join(root, "saved.ckpt")
+        shutil.copyfile(ckpt, saved)
+        for _ in range(5):
+            step()               # keep training: live params move on
+        import pickle
+        with open(saved, "rb") as f:
+            want = pickle.load(f)["params"]
+        assert want[table].dtype == np.float32
+        bundle_dir, _ = reg.resolve("m", v)
+        for p, _g in t.params_grads:
+            got = np.load(os.path.join(bundle_dir, p + ".npy"))
+            assert got.dtype == want[p].dtype, p
+            assert np.array_equal(got, want[p]), \
+                f"{p} not bitwise equal to the round-5 checkpoint"
+        # and the live state really did move past the cut
+        live = client.pull()
+        assert not np.array_equal(live[table], want[table])
+        # the restored bundle LOADS and serves (full restore path)
+        scope2 = fluid.Scope()
+        prog2, feeds2, fetches2 = fluid.io.load_inference_model(
+            bundle_dir, exe, scope=scope2)
+        out = exe.run(prog2, feed={"ids": np.zeros((2, 1), np.int64)},
+                      fetch_list=fetches2, scope=scope2)[0]
+        assert np.asarray(out).shape == (2, 1)
+    finally:
+        frz.close()
+        client.close()
+        rpc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# registry retention gc + numeric latest ordering
+# ---------------------------------------------------------------------------
+
+def _fake_bundle(root, name="bundle", content=b"model-bytes"):
+    d = os.path.join(root, name)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "__model__"), "wb") as f:
+        f.write(content)
+    return d
+
+
+def test_registry_gc_never_deletes_protected_versions():
+    root = _tmp()
+    reg = ModelRegistry(os.path.join(root, "reg"))
+    src = _fake_bundle(root)
+    for _ in range(6):
+        reg.publish("m", src)
+    # keep_latest=2 -> {5, 6}; previous(6)=5 already kept; pinned 2 kept
+    deleted = reg.gc("m", keep_latest=2, pinned={2})
+    assert deleted == [1, 3, 4]
+    assert reg.versions("m") == [2, 5, 6]
+    # keep_latest=1 still keeps latest AND its rollback target
+    deleted = reg.gc("m", keep_latest=1)
+    assert deleted == [2]
+    assert reg.versions("m") == [5, 6]
+    assert reg.previous("m", 6) == 5       # rollback target survives
+    # idempotent; nothing left to delete
+    assert reg.gc("m", keep_latest=1) == []
+    assert reg.versions("m") == [5, 6]
+    # a pinned version that no longer exists is ignored (idempotency
+    # across restarts), an unknown model is a no-op
+    assert reg.gc("m", keep_latest=1, pinned={3}) == []
+    assert reg.gc("ghost", keep_latest=1) == []
+
+
+def test_registry_gc_typed_errors():
+    reg = ModelRegistry(os.path.join(_tmp(), "reg"))
+    with pytest.raises(ValueError, match="keep_latest must be >= 1"):
+        reg.gc("m", keep_latest=0)
+    with pytest.raises(ValueError, match="keep_latest must be a positive"):
+        reg.gc("m", keep_latest="lots")
+    with pytest.raises(ValueError, match="pinned must be an iterable"):
+        reg.gc("m", keep_latest=2, pinned=["not-a-version"])
+    with pytest.raises(ValueError, match="one plain path component"):
+        reg.gc("../escape", keep_latest=2)
+
+
+def test_registry_latest_is_numeric_and_torn_dirs_are_skipped():
+    """v10 sorts after v9 (numeric, not lexicographic — '10' < '9' as
+    strings), a half-published dir is never latest, and auto-increment
+    steps over a torn dir instead of wedging every later publish."""
+    root = _tmp()
+    reg = ModelRegistry(os.path.join(root, "reg"))
+    src = _fake_bundle(root)
+    reg.publish("m", src, version=9)
+    reg.publish("m", src, version=10)
+    assert reg.versions("m") == [9, 10]
+    _path, v = reg.resolve("m", "latest")
+    assert v == 10                        # not the lexicographic max "9"
+    assert reg.previous("m", 10) == 9
+    # torn publish at 11 (freezer crashed mid-copy: dir, no manifest)
+    torn = os.path.join(reg.model_dir("m"), "11")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "__model__"), "wb") as f:
+        f.write(b"half")
+    _path, v = reg.resolve("m", "latest")
+    assert v == 10                        # torn dir is invisible
+    # auto-increment skips the torn number — publishes keep flowing
+    v_new = reg.publish("m", src)
+    assert v_new == 12
+    _path, v = reg.resolve("m", "latest")
+    assert v == 12
+    # lineage must be a dict when given
+    with pytest.raises(ValueError, match="lineage must be a dict"):
+        reg.publish("m", src, lineage=["not", "a", "dict"])
+
+
+def test_registry_gc_sweeps_abandoned_torn_dirs_only():
+    """Torn (manifest-less) dirs hold full-size bundle copies no other
+    API can reach; gc sweeps them once older than torn_ttl_s, but a
+    FRESH torn dir is an in-flight publish and must survive."""
+    root = _tmp()
+    reg = ModelRegistry(os.path.join(root, "reg"))
+    src = _fake_bundle(root)
+    for _ in range(3):
+        reg.publish("m", src)
+    # abandoned publish: torn dir with an old mtime
+    old = os.path.join(reg.model_dir("m"), "90")
+    os.makedirs(old)
+    with open(os.path.join(old, "__model__"), "wb") as f:
+        f.write(b"half")
+    past = time.time() - 7200
+    os.utime(old, (past, past))
+    # in-flight publish: torn dir, fresh mtime
+    fresh = os.path.join(reg.model_dir("m"), "91")
+    os.makedirs(fresh)
+    deleted = reg.gc("m", keep_latest=2)
+    assert deleted == [1, 90]
+    assert not os.path.exists(old)
+    assert os.path.isdir(fresh)            # TTL protects in-flight
+    assert reg.versions("m") == [2, 3]
+    # ttl=0 sweeps even fresh torn dirs (offline maintenance)
+    assert reg.gc("m", keep_latest=2, torn_ttl_s=0) == [91]
+    assert not os.path.exists(fresh)
+    with pytest.raises(ValueError, match="torn_ttl_s must be >= 0"):
+        reg.gc("m", torn_ttl_s=-1)
+    with pytest.raises(ValueError, match="torn_ttl_s must be a non-neg"):
+        reg.gc("m", torn_ttl_s="soon")
+
+
+def test_rolling_reload_classifies_canary_reject_vs_unreachable():
+    """CanaryFailed is reserved for a canary that ANSWERED and rejected
+    the bundle (structured RemoteError) — an unreachable canary (killed
+    mid-reload, connect refused during its restart) raises a plain
+    RuntimeError so rollout drivers retry instead of permanently
+    quarantining a good version. Both paths roll the canary back and
+    never advance the fleet version."""
+    from paddle_tpu.serving.fleet import FleetSupervisor
+
+    sup = FleetSupervisor.__new__(FleetSupervisor)   # no children needed
+    sup._version_lock = threading.Lock()
+    sup._version = 1
+    sup.addresses = [("127.0.0.1", 1), ("127.0.0.1", 2)]
+    sup.model = "m"
+
+    class _Reg:
+        def resolve(self, model, version):
+            return "/fake/path", int(version)
+
+    sup.registry = _Reg()
+    sup._await_replica = lambda i, deadline, target_version=None: None
+    rollbacks = []
+    sup._rollback_canary = lambda prev, t: rollbacks.append(prev)
+
+    sup._reload_replica = lambda i, path, version, timeout: RemoteError(
+        "reload", "ValueError", "corrupt bundle")
+    with pytest.raises(CanaryFailed) as ei:
+        sup.rolling_reload(2)
+    assert ei.value.version == 2 and ei.value.rolled_back_to == 1
+    assert rollbacks == [1]
+
+    sup._reload_replica = lambda i, path, version, timeout: \
+        ConnectionError("canary died mid-reload")
+    with pytest.raises(RuntimeError, match="not condemned") as ei:
+        sup.rolling_reload(2)
+    assert not isinstance(ei.value, CanaryFailed)
+    assert rollbacks == [1, 1]
+    assert sup.version == 1                # never advanced either way
+
+
+def test_trainer_cadence_retries_after_failed_async_stitch():
+    """An ACCEPTED cut whose async stitch later fails must make the next
+    step boundary publish-due immediately — the cadence reset at
+    acceptance was provisional, and waiting a full cadence would double
+    served-model staleness exactly when shards are crash-restarting."""
+    from paddle_tpu.online.freezer import FreezeError, _Job
+
+    tr = StreamingTrainer(None, None, None, params_grads=[], client=None,
+                          reader=None, freezer=object(),
+                          publish_every_steps=100, publish_every_s=0.0)
+    now = time.monotonic()
+    assert not tr._publish_due(1, now)
+    job = _Job("t", 0, 5)
+    tr._pending_job = job
+    assert not tr._publish_due(1, now)     # still stitching: not due
+    job.resolve(version=7)
+    assert not tr._publish_due(1, now)     # published: cadence stands
+    failed = _Job("t2", 0, 6)
+    tr._pending_job = failed
+    failed.resolve(error=FreezeError("shard restarted mid-fetch"))
+    assert tr._publish_due(1, now)         # failed async: due NOW
+    assert tr._pending_job is None
+    assert not tr._publish_due(1, now)     # consumed: back on cadence
+    # the ordinary triggers still fire
+    assert tr._publish_due(100, now)
+
+
+# ---------------------------------------------------------------------------
+# RolloutController: hysteresis, quarantine, monotonic targets
+# ---------------------------------------------------------------------------
+
+class _FakeFleet:
+    """Duck-typed FleetSupervisor: records rollout targets, fails the
+    canary for quarantined targets."""
+
+    def __init__(self, version=1, fail_versions=()):
+        self.version = version
+        self.calls = []
+        self.fail_versions = set(fail_versions)
+
+    def rolling_reload(self, version, wait_timeout=None):
+        self.calls.append(version)
+        if version in self.fail_versions:
+            raise CanaryFailed(f"canary rejected {version}",
+                               version=version,
+                               rolled_back_to=self.version)
+        self.version = version
+        return version
+
+
+def test_rollout_controller_hysteresis_skips_to_newest():
+    """Three versions published in a burst roll out as ONE reload to the
+    newest — the min-serve hysteresis absorbs the flapping."""
+    root = _tmp()
+    reg = ModelRegistry(os.path.join(root, "reg"))
+    src = _fake_bundle(root)
+    for _ in range(4):
+        reg.publish("m", src)            # v1..v4
+    sup = _FakeFleet(version=1)
+    ctl = RolloutController(reg, "m", sup, poll_interval_s=0.05,
+                            min_serve_s=0.4, rollout_timeout_s=5.0)
+    ctl.start()
+    try:
+        deadline = time.monotonic() + 20.0
+        while sup.version != 4 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sup.version == 4
+        # hysteresis: one rollout straight to the newest, 2 and 3 skipped
+        assert sup.calls == [4]
+        st = ctl.stats()
+        assert st["rollouts"] == 1 and st["served_version"] == 4
+        assert st["publish_to_served"]["count"] == 1
+    finally:
+        ctl.stop()
+
+
+def test_rollout_controller_quarantines_canary_failures():
+    """A canary-rejected version is marked bad forever: the controller
+    rolls back past it to nothing (keeps serving), then advances when a
+    NEWER good version lands — the served version never regresses."""
+    root = _tmp()
+    reg = ModelRegistry(os.path.join(root, "reg"))
+    src = _fake_bundle(root)
+    reg.publish("m", src)                # v1
+    reg.publish("m", src)                # v2 — will fail its canary
+    sup = _FakeFleet(version=1, fail_versions={2})
+    ctl = RolloutController(reg, "m", sup, poll_interval_s=0.05,
+                            min_serve_s=0.0, rollout_timeout_s=5.0)
+    ctl.start()
+    try:
+        deadline = time.monotonic() + 20.0
+        while not ctl.stats()["rollbacks"] and time.monotonic() < deadline:
+            time.sleep(0.05)
+        st = ctl.stats()
+        assert st["rollbacks"] == 1 and st["bad_versions"] == [2]
+        assert sup.version == 1          # still serving the good version
+        time.sleep(0.3)
+        assert sup.calls.count(2) == 1   # never retried
+        v3 = reg.publish("m", src)       # a newer good version heals it
+        deadline = time.monotonic() + 20.0
+        while sup.version != v3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sup.version == v3
+        assert ctl.stats()["bad_versions"] == [2]
+        assert 2 not in sup.calls[sup.calls.index(v3):]
+    finally:
+        ctl.stop()
+
+
+def test_rollout_controller_reconverges_mixed_fleet():
+    """A transient failure AFTER the canary passed advances the
+    supervisor's version but can leave an alive-but-stale replica (its
+    reload RPC failed; it kept serving the old engine). The forward-only
+    filter sees served == target and nothing newer — the controller must
+    re-drive rolling_reload AT the served version until every replica
+    reports it."""
+    root = _tmp()
+    reg = ModelRegistry(os.path.join(root, "reg"))
+    src = _fake_bundle(root)
+    reg.publish("m", src)                # v1
+    reg.publish("m", src)                # v2
+
+    class _MixedFleet(_FakeFleet):
+        """First reload of v2: canary passes (version advances) but
+        replica 1 fails transiently, leaving it on v1."""
+
+        def __init__(self):
+            super().__init__(version=1)
+            self.addresses = [("127.0.0.1", 1), ("127.0.0.1", 2)]
+            self.replica_versions = [1, 1]
+            self.failed_once = False
+
+        def replica_health(self, i):
+            return {"status": "serving", "warmed": True,
+                    "version": self.replica_versions[i]}
+
+        def rolling_reload(self, version, wait_timeout=None):
+            self.calls.append(version)
+            self.replica_versions[0] = version    # canary passes
+            self.version = version                # supervisor advances
+            if not self.failed_once:
+                self.failed_once = True
+                raise RuntimeError(
+                    "rolling_reload: replica 1 failed after the canary "
+                    "passed — fleet is mixed-version")
+            self.replica_versions[1] = version
+            return version
+
+    sup = _MixedFleet()
+    ctl = RolloutController(reg, "m", sup, poll_interval_s=0.05,
+                            min_serve_s=0.0, rollout_timeout_s=5.0)
+    ctl.start()
+    try:
+        deadline = time.monotonic() + 20.0
+        while sup.replica_versions != [2, 2] \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sup.replica_versions == [2, 2], sup.replica_versions
+        st = ctl.stats()
+        assert st["converge_repairs"] == 1
+        assert st["errors"] >= 1             # the transient was counted
+        assert sup.calls == [2, 2]           # rollout, then the repair
+        time.sleep(0.3)
+        assert sup.calls == [2, 2]           # converged: no more drives
+    finally:
+        ctl.stop()
+
+
+def test_rollout_controller_gc_after_rollout_pins_served():
+    root = _tmp()
+    reg = ModelRegistry(os.path.join(root, "reg"))
+    src = _fake_bundle(root)
+    for _ in range(5):
+        reg.publish("m", src)            # v1..v5
+    sup = _FakeFleet(version=1)
+    ctl = RolloutController(reg, "m", sup, poll_interval_s=0.05,
+                            min_serve_s=0.0, rollout_timeout_s=5.0,
+                            registry_keep=2)
+    ctl.start()
+    try:
+        deadline = time.monotonic() + 20.0
+        while sup.version != 5 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        time.sleep(0.2)                  # let the post-rollout gc run
+        assert reg.versions("m") == [4, 5]   # keep 2: served + rollback
+        assert ctl.stats()["gc_deleted"] == 3
+    finally:
+        ctl.stop()
+
+
+# ---------------------------------------------------------------------------
+# supervisor observability
+# ---------------------------------------------------------------------------
+
+def _echo_child(address, token):
+    from paddle_tpu.distributed.rpc import RpcServer
+
+    class H:
+        def stats(self):
+            return {"token": token, "pid": os.getpid()}
+
+    RpcServer(H(), tuple(address)).serve_forever()
+
+
+def test_child_supervisor_exposes_restart_stats():
+    from paddle_tpu.distributed.launch import ChildSupervisor
+
+    class _Echo(ChildSupervisor):
+        def _child_spec(self, i):
+            return _echo_child, (self.addresses[i], i)
+
+    with _Echo(2, heartbeat_interval_s=0.1) as sup:
+        assert sup.wait_ready(20.0)
+        before = time.time()
+        stats = sup.child_stats()
+        assert [s["restart_count"] for s in stats] == [0, 0]
+        assert [s["last_restart_at"] for s in stats] == [None, None]
+        assert all(s["alive"] and not s["gave_up"] for s in stats)
+        assert stats[0]["address"] == tuple(sup.addresses[0])
+        sup.kill(0)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if sup.child_stats()[0]["restart_count"] == 1:
+                break
+            time.sleep(0.05)
+        s0 = sup.child_stats()[0]
+        assert s0["restart_count"] == 1
+        assert s0["last_restart_at"] is not None \
+            and s0["last_restart_at"] >= before
+        assert sup.child_stats()[1]["restart_count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end chaos contract
+# ---------------------------------------------------------------------------
+
+def test_loop_stop_resets_started_flag(tmp_path):
+    """A cleanly stopped loop is restartable: stop() resets the started
+    flag (start() rebuilds every component), and stats() stops reporting
+    a torn-down loop as started."""
+    loop = OnlineLearningLoop(None, None, None, [], [],
+                              registry_root=str(tmp_path / "reg"))
+    loop._started = True                 # as if start() had run
+    loop.stop()                          # idempotent teardown of nothing
+    st = loop.stats()
+    assert st["started"] is False
+    loop.stop()                          # still idempotent
+
+
+def test_online_loop_end_to_end_chaos(tmp_path):
+    """THE acceptance case: the full loop (2 pserver shards, streaming
+    trainer, freezer, 2 serving replicas, rollout controller) runs while
+    (a) a pserver shard is SIGKILLed, (b) a serving replica is SIGKILLed,
+    and (c) a corrupt version is published into the registry mid-loop —
+    with ZERO failed infer requests, a monotonically advancing served
+    version across >= 2 rollouts, the corrupt version rolled back by the
+    canary gate and quarantined, and both killed children restarted by
+    their supervisors."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6])
+        y = fluid.layers.data("y", shape=[1])
+        pred = fluid.layers.fc(x, size=1, act=None)
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss, startup)
+
+    w_true = np.random.RandomState(0).normal(0, 1, (6, 1)) \
+        .astype(np.float32)
+
+    def reader():
+        r = np.random.RandomState(1)
+        while True:
+            X = r.normal(0, 1, (16, 6)).astype(np.float32)
+            yield {"x": X, "y": X @ w_true}
+
+    loop = OnlineLearningLoop(
+        main, startup, reader, ["x"], [pred],
+        registry_root=str(tmp_path / "reg"), model="lin",
+        n_pservers=2, n_replicas=2, publish_every_steps=15,
+        min_serve_s=0.5, rollout_poll_s=0.2, buckets="1,2",
+        max_delay_ms=1.0, checkpoint_dir=str(tmp_path / "ckpt"))
+    errs = []
+    served_seen = []
+    infers = [0]
+    stop = threading.Event()
+
+    def hammer():
+        fc = FleetClient(loop.fleet.addresses,
+                         retry=RetryPolicy(max_retries=10,
+                                           backoff_base_s=0.05,
+                                           backoff_max_s=0.5))
+        X = np.zeros((1, 6), np.float32)
+        try:
+            while not stop.is_set():
+                try:
+                    out = fc.infer({"x": X})
+                    infers[0] += 1
+                    assert np.asarray(out[0]).shape == (1, 1)
+                except Exception as e:
+                    errs.append(repr(e))
+        finally:
+            fc.close()
+
+    try:
+        v0 = loop.start(wait_ready_s=240.0)
+        assert v0 == 1
+        ht = threading.Thread(target=hammer)
+        ht.start()
+        killed = False
+        poisoned = 0
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            st = loop.stats()
+            served_seen.append(st["served_version"])
+            rollouts = st["rollout"]["rollouts"]
+            if rollouts >= 1 and not killed:
+                # chaos: SIGKILL one pserver shard AND one replica
+                loop.pservers.kill(1)
+                loop.fleet.kill(1)
+                killed = True
+            if killed and not st["rollout"]["rollbacks"] and poisoned < 40:
+                # corrupt publishes mid-loop: the canary must reject one.
+                # The controller always targets the NEWEST version, and
+                # the trainer keeps publishing good ones on top — so keep
+                # re-poisoning until a poll catches a bad version as the
+                # newest (each later good publish shadows the previous
+                # bad one; that shadowing is itself by design)
+                bad = tmp_path / "bad"
+                bad.mkdir(exist_ok=True)
+                (bad / "__model__").write_text("not a model")
+                loop.registry.publish("lin", str(bad))
+                poisoned += 1
+            if rollouts >= 2 and poisoned \
+                    and loop.rollout.stats()["rollbacks"] >= 1:
+                break
+            time.sleep(0.4)
+        stop.set()
+        ht.join(30.0)
+        st = loop.stats()
+        # zero failed infer requests through both kills + the rollback
+        assert not errs, f"infer requests failed: {errs[:3]}"
+        assert infers[0] > 0
+        # served version advanced monotonically, >= 2 rollouts
+        assert st["rollout"]["rollouts"] >= 2, st["rollout"]
+        assert all(b >= a for a, b in zip(served_seen, served_seen[1:])), \
+            f"served version regressed: {served_seen}"
+        assert st["served_version"] > 1
+        # the corrupt version was canary-rejected, rolled back, and
+        # quarantined — and the loop kept advancing past it
+        ro = st["rollout"]
+        assert ro["rollbacks"] >= 1 and ro["bad_versions"], ro
+        assert st["served_version"] not in ro["bad_versions"]
+        # both SIGKILLed children were restarted by their supervisors
+        assert sum(c["restart_count"]
+                   for c in st["pserver_children"]) >= 1
+        assert sum(c["restart_count"] for c in st["fleet_children"]) >= 1
+        # the trainer rode through the shard kill and kept stepping
+        assert st["trainer"]["global_step"] > 30
+        # freezes kept publishing with lineage: steps strictly advance
+        versions = st["published_versions"]
+        assert len(versions) >= 3
+        steps = [loop.registry.manifest("lin", v)["lineage"]["global_step"]
+                 for v in versions
+                 if "lineage" in loop.registry.manifest("lin", v)]
+        assert steps == sorted(steps)
+    finally:
+        stop.set()
+        loop.stop()
