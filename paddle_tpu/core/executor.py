@@ -36,8 +36,54 @@ from .profiler import profiler_enabled, record_event
 from .lod import LoDArray, flat_to_lodarray, pack_sequences
 from .scope import Scope, global_scope
 from .types import np_dtype
+from ..obs.metrics import REGISTRY as _METRICS
 
 _RNG_KEY = "__rng_key__"
+
+# ---------------------------------------------------------------------------
+# obs_op_metrics flag: executor counters in the obs.metrics registry.
+# Deliberately NOT in _JIT_KEY_FLAGS — flipping the flag must never
+# retrace (the hooks are host-side only); when off, the hot path pays one
+# flag lookup per run(). Eager dispatches get REAL per-op wall time;
+# jit runs count each block-0 op once per step from the cached
+# _ProgramAnalysis op inventory (single ops have no host-visible duration
+# inside a compiled step). The retrace counter counts compiled-function
+# (re)builds unconditionally — compiles are already expensive, and a
+# steady-state training loop must keep it flat.
+# ---------------------------------------------------------------------------
+
+_M_OP_DISPATCHES = _METRICS.counter(
+    "paddle_tpu_executor_op_dispatches",
+    "op dispatches by op type (obs_op_metrics flag; jit steps count "
+    "each top-level op once per run from the cached program inventory)",
+    labels=("op_type",))
+_M_OP_SECONDS = _METRICS.counter(
+    "paddle_tpu_executor_op_seconds",
+    "cumulative per-op-type eager DISPATCH wall time in seconds — timed "
+    "around the op forward only, independent of co-enabled debug flags; "
+    "the async tail is not awaited (obs_op_metrics flag; control-flow "
+    "ops include their sub-blocks)", labels=("op_type",))
+_M_STEPS = _METRICS.counter(
+    "paddle_tpu_executor_steps",
+    "Executor.run dispatches, by executor mode (obs_op_metrics flag)",
+    labels=("mode",))
+_M_RETRACES = _METRICS.counter(
+    "paddle_tpu_executor_retraces",
+    "compiled step-function (re)builds — one per trace/retrace event, "
+    "flat in steady state", labels=("kind",))
+
+# op_type -> (dispatch child, seconds child); lazy so only op types that
+# actually dispatch create series
+_OP_CHILDREN: dict = {}
+
+
+def _op_children(op_type):
+    mc = _OP_CHILDREN.get(op_type)
+    if mc is None:
+        mc = _OP_CHILDREN[op_type] = (
+            _M_OP_DISPATCHES.labels(op_type=op_type),
+            _M_OP_SECONDS.labels(op_type=op_type))
+    return mc
 
 
 class Place:
@@ -188,18 +234,38 @@ def _run_ops(block, env, exec_state):
     record = registry.record_dispatch \
         if registry.dispatch_coverage_enabled() else (lambda t: None)
     if not getattr(exec_state, "_tracing", False) and \
-            (get_flag("check_nan_inf") or get_flag("benchmark")):
-        # eager-path debug modes: per-op NaN/Inf host sweep (jit covers
-        # this via debug_nans/debug_infs around dispatch) and/or per-op
-        # wall timing (reference --benchmark, executor.cc:321-324)
+            (get_flag("check_nan_inf") or get_flag("benchmark")
+             or get_flag("obs_op_metrics")):
+        # eager-path debug/metering modes: per-op NaN/Inf host sweep (jit
+        # covers this via debug_nans/debug_infs around dispatch), per-op
+        # wall timing (reference --benchmark, executor.cc:321-324), and
+        # obs_op_metrics dispatch/wall-time counters (real op times here;
+        # control-flow ops recurse through run_sub_block, so their time
+        # includes their sub-blocks')
         import time as _time
         bench = get_flag("benchmark")
         check = get_flag("check_nan_inf")
+        opm = get_flag("obs_op_metrics")
+        prof = profiler_enabled()
         for op in block.ops:
-            t0 = _time.perf_counter() if bench else 0.0
+            t0 = _time.perf_counter() if (bench or opm) else 0.0
             info = registry.get_op_info(op.type)
-            info.forward(ExecContext(op, block, env, exec_state))
+            if prof:
+                # metering must not suppress the per-op profiler spans
+                # the plain branches below record
+                with record_event(op.type, kind="op"):
+                    info.forward(ExecContext(op, block, env, exec_state))
+            else:
+                info.forward(ExecContext(op, block, env, exec_state))
             record(op.type)
+            if opm:
+                # timed BEFORE the check/bench extras below, so the
+                # counter means the same thing regardless of which debug
+                # flags ride along (eager dispatch time; the async tail
+                # is not awaited)
+                disp, secs = _op_children(op.type)
+                disp.inc()
+                secs.inc(_time.perf_counter() - t0)
             if check:
                 _check_op_outputs_finite(op, env)
             if bench:
@@ -239,13 +305,21 @@ class _ProgramAnalysis:
     its ExecutorPrepareContext, framework/executor.cc:271)."""
 
     __slots__ = ("version", "free", "written", "persistable_written",
-                 "verified")
+                 "verified", "op_inventory", "_op_metric_children")
 
-    def __init__(self, version, free, written, persistable_written):
+    def __init__(self, version, free, written, persistable_written,
+                 op_inventory=()):
         self.version = version
         self.free = free
         self.written = written
         self.persistable_written = persistable_written
+        # block-0 op-type inventory ((op_type, count), ...): what a jit
+        # step dispatches per run. obs_op_metrics rides this instead of
+        # re-walking the block — registry children resolve lazily ONCE
+        # per analysis and are cached here, so a metered steady-state
+        # run() pays len(inventory) counter incs, no dict walks.
+        self.op_inventory = op_inventory
+        self._op_metric_children = None
         # executor_verify memo: the (feed names, fetch names) surfaces the
         # program at THIS version has passed verify_program under.
         # Fetch-clobber (PTL010) depends on the fetch set, so each distinct
@@ -271,9 +345,24 @@ def _analyze_program(program):
     block = program.global_block()
     persistable = frozenset(
         n for n in written if block.has_var(n) and block.var(n).persistable)
-    cached = _ProgramAnalysis(program._version, free, written, persistable)
+    inventory: dict = {}
+    for op in block.ops:
+        inventory[op.type] = inventory.get(op.type, 0) + 1
+    cached = _ProgramAnalysis(program._version, free, written, persistable,
+                              tuple(sorted(inventory.items())))
     _ANALYSIS_CACHE[program] = cached
     return cached
+
+
+def _note_jit_ops(analysis):
+    """obs_op_metrics, jit path: count each block-0 op once for this step
+    from the cached inventory (children resolved once per analysis)."""
+    children = analysis._op_metric_children
+    if children is None:
+        children = analysis._op_metric_children = tuple(
+            (_op_children(t)[0], n) for t, n in analysis.op_inventory)
+    for child, n in children:
+        child.inc(n)
 
 
 def _maybe_verify(program, analysis, feed_names, fetch_names=(), scope=None):
@@ -431,6 +520,13 @@ class Executor:
         analysis = _analyze_program(program)
         _maybe_verify(program, analysis, tuple(feed_vals), tuple(fetch_names),
                       scope=scope)
+        from .flags import get_flag
+        if get_flag("obs_op_metrics"):
+            # jit: per-step op-type counts from the cached inventory
+            # (eager dispatches are timed per op inside _run_ops instead)
+            _M_STEPS.labels(mode=self.mode).inc()
+            if self.mode != "eager" and use_program_cache:
+                _note_jit_ops(analysis)
         state_in = [n for n in analysis.free
                     if n not in feed_vals and scope.has_var(n)]
         state_out = [n for n in analysis.written
@@ -598,6 +694,7 @@ class Executor:
         fn = self._cache.get(key)
         if fn is not None:
             return fn
+        _M_RETRACES.labels(kind="jit_scan").inc()
 
         block = program.global_block()
         exec_state = self
@@ -636,6 +733,7 @@ class Executor:
         fn = self._cache.get(key)
         if fn is not None:
             return fn
+        _M_RETRACES.labels(kind="jit_step").inc()
 
         block = program.global_block()
 

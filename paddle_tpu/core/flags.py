@@ -306,6 +306,21 @@ DEFINE_flag("online_registry_keep", 0,
             "rollback-target (previous) versions. 0 (default) disables "
             "gc: every published version is retained")
 
+DEFINE_flag("obs_op_metrics", False,
+            "executor observability hooks: per-op-type dispatch/wall-time "
+            "counters (eager: real per-op time; jit: per-step op-type "
+            "counts riding the cached _ProgramAnalysis op inventory) and "
+            "per-step dispatch counters into the obs.metrics registry. "
+            "Deliberately NOT in the executor's _JIT_KEY_FLAGS: flipping "
+            "it never retraces — the hooks are host-side only, off the "
+            "hot path when disabled (one flag lookup per run)")
+
+DEFINE_flag("obs_metrics_window", 2048,
+            "default sample-window capacity of obs.metrics Histogram "
+            "children (each wraps a core.profiler.LatencyWindow ring of "
+            "this many recent observations for p50/p99 readout); "
+            "families may override per-histogram via window=")
+
 # PDTPU_FLAGS=check_nan_inf=1,benchmark=0 — unknown names warn and are
 # ignored (a typo'd env var must not make the package unimportable)
 _env = os.environ.get("PDTPU_FLAGS", "")

@@ -20,19 +20,72 @@ timeline.py emits: ph="X" complete events with pid/tid/ts/dur.
 
 from __future__ import annotations
 
+import contextvars
 import json
 import threading
 import time
+import uuid
 from contextlib import contextmanager
 
 _lock = threading.Lock()
 _enabled = False
-_events: list[tuple[str, str, float, float, int]] = []  # (kind, name, t0, t1, tid)
+# (kind, name, t0, t1, tid, trace_id)
+_events: list[tuple[str, str, float, float, int, str | None]] = []
 _t_origin = 0.0
+# wall-clock instant corresponding to _t_origin: per-process perf_counter
+# origins are incomparable, so merged cross-process timelines
+# (tools/merge_traces.py) align on this epoch anchor instead
+_epoch_origin = 0.0
 
 
 def _now():
     return time.perf_counter()
+
+
+# ---------------------------------------------------------------------------
+# distributed trace ids (the request-correlation half of the obs plane)
+# ---------------------------------------------------------------------------
+# A trace id is generated at a client edge (InferClient / GenClient /
+# FleetClient / ParamClient — all via RpcClient), carried in the RPC
+# request header, and restored server-side into this contextvar, so
+# profiler spans recorded on BOTH sides of the wire carry the same id and
+# tools/merge_traces.py can stitch one request into one connected track.
+
+_TRACE_ID: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "pdtpu_trace_id", default=None)
+
+
+def new_trace_id():
+    """A fresh 16-hex request/trace id."""
+    return uuid.uuid4().hex[:16]
+
+
+def current_trace_id():
+    """The trace id bound to the current context (None outside one)."""
+    return _TRACE_ID.get()
+
+
+def set_trace_id(trace_id):
+    """Bind ``trace_id`` to the current context; returns the reset token
+    (the RPC server binds the wire-carried id around each handler call)."""
+    return _TRACE_ID.set(trace_id)
+
+
+def reset_trace_id(token):
+    _TRACE_ID.reset(token)
+
+
+@contextmanager
+def trace_context(trace_id=None):
+    """Ensure a trace id for the block: reuse the current one, else bind
+    ``trace_id`` (or a fresh id). Yields the active id — the client-edge
+    entry point."""
+    tid = trace_id or _TRACE_ID.get() or new_trace_id()
+    token = _TRACE_ID.set(tid)
+    try:
+        yield tid
+    finally:
+        _TRACE_ID.reset(token)
 
 
 def profiler_enabled():
@@ -43,10 +96,11 @@ def enable_profiler(state="All"):
     """Start recording (reference EnableProfiler, profiler.h:153). ``state``
     kept for API parity — host spans are recorded either way; device detail
     comes from the jax_trace context manager."""
-    global _enabled, _t_origin
+    global _enabled, _t_origin, _epoch_origin
     with _lock:
         _events.clear()
         _t_origin = _now()
+        _epoch_origin = time.time()
         _enabled = True
 
 
@@ -82,7 +136,8 @@ def record_event(name, kind="op"):
         with _lock:
             if _enabled:
                 _events.append(
-                    (kind, name, t0, t1, threading.get_ident()))
+                    (kind, name, t0, t1, threading.get_ident(),
+                     _TRACE_ID.get()))
 
 
 def events():
@@ -95,7 +150,7 @@ def summarize(evs=None, sorted_key=None):
     the reference's printed profiling report (profiler.cc PrintProfiler)."""
     evs = events() if evs is None else evs
     agg: dict[str, list[float]] = {}
-    for kind, name, t0, t1, _tid in evs:
+    for kind, name, t0, t1, _tid, *_rest in evs:
         agg.setdefault(name, []).append((t1 - t0) * 1e3)
     rows = []
     for name, durs in agg.items():
@@ -201,23 +256,37 @@ class LatencyWindow:
             out["max_ms"] = durs[-1] * 1e3
         return out
 
+    def reset(self):
+        """Drop every sample and zero the count (test hygiene and
+        forked-child registry resets — see obs.metrics)."""
+        with self._lock:
+            self._durs = []
+            self._next = 0
+            self.count = 0
+
 
 def export_chrome_tracing(path, evs=None):
     """Write chrome://tracing 'Complete' events (ph="X"), the exact schema of
     the reference's tools/timeline.py:40-134 _ChromeTraceFormatter."""
     evs = events() if evs is None else evs
     trace = []
-    for kind, name, t0, t1, tid in evs:
+    for kind, name, t0, t1, tid, *rest in evs:
+        trace_id = rest[0] if rest else None
         trace.append({
             "ph": "X", "cat": kind, "name": name,
             "pid": 0, "tid": tid,
             "ts": int((t0 - _t_origin) * 1e6),
             "dur": max(1, int((t1 - t0) * 1e6)),
-            "args": {},
+            "args": {} if trace_id is None else {"trace_id": trace_id},
         })
     meta = [{"ph": "M", "pid": 0, "name": "process_name",
              "args": {"name": "paddle_tpu host"}}]
     with open(path, "w") as f:
         json.dump({"traceEvents": meta + trace,
-                   "displayTimeUnit": "ms"}, f)
+                   "displayTimeUnit": "ms",
+                   # wall-clock anchor of ts=0: lets merge_traces.py align
+                   # files exported by DIFFERENT processes (perf_counter
+                   # origins are per-process) onto one timeline
+                   "otherData": {
+                       "epoch_origin_us": int(_epoch_origin * 1e6)}}, f)
     return path

@@ -27,6 +27,13 @@ ENV_COORD = "PDTPU_COORDINATOR"
 ENV_NPROC = "PDTPU_NUM_PROCESSES"
 ENV_RANK = "PDTPU_PROCESS_ID"
 
+from ..obs.metrics import REGISTRY as _METRICS  # noqa: E402
+
+_M_RESTARTS = _METRICS.counter(
+    "paddle_tpu_supervisor_restarts",
+    "child restarts performed by a ChildSupervisor, per supervisor "
+    "class and child index", labels=("supervisor", "child"))
+
 
 def free_port():
     with socket.socket() as s:
@@ -158,12 +165,25 @@ class ChildSupervisor:
 
         self._ctx = mp.get_context(mp_start_method)
         self.addresses = [(host, free_port()) for _ in range(n_children)]
-        self.restarts = [0] * n_children
+        # per-child restart counters in the obs.metrics registry, labeled
+        # by a process-unique supervisor instance id (concrete class +
+        # sequence: "FleetSupervisor-3") and child index; the
+        # ``restarts`` property and child_stats() derive from these
+        # children, and distinct supervisors never share a series
+        from ..obs.metrics import next_instance
+        self.obs_instance = next_instance(type(self).__name__)
+        self._m_restarts = [
+            _M_RESTARTS.labels(supervisor=self.obs_instance,
+                               child=str(i)) for i in range(n_children)]
         # wall-clock of each child's most recent RESTART (None until its
         # first one) — the observability surface OnlineLearningLoop.stats
         # aggregates; wall-clock (not monotonic) so operators can line it
         # up against logs across processes
         self.last_restart_at = [None] * n_children
+        # WHY the child was last restarted ("exited code N" vs
+        # "wedged: no heartbeat") — a dead child with no reason is
+        # undebuggable in a fleet; surfaced via child_stats()
+        self.last_restart_reason = [None] * n_children
         self._max_restarts = int(max_restarts)
         self._hb_method = str(heartbeat_method)
         self._interval = float(heartbeat_interval_s)
@@ -184,6 +204,13 @@ class ChildSupervisor:
             self._spawn(i)
         self._monitor = threading.Thread(target=self._watch, daemon=True)
         self._monitor.start()
+
+    @property
+    def restarts(self):
+        """Per-child restart counts — derived from the registry counters
+        (``paddle_tpu_supervisor_restarts``); indexable like the list it
+        replaced."""
+        return [int(c.value) for c in self._m_restarts]
 
     # ---- subclass hook ----
     def _child_spec(self, i):
@@ -225,6 +252,7 @@ class ChildSupervisor:
                 p = self._procs[i]
                 if self._stop.is_set() or p is None:
                     continue
+                wedged = False
                 if p.is_alive():
                     if self._heartbeat_ok(i):
                         self._hb_failures[i] = 0
@@ -236,13 +264,20 @@ class ChildSupervisor:
                     if self._hb_failures[i] < self._hb_misses_allowed:
                         continue
                     p.terminate()  # alive but not answering: wedged
+                    wedged = True
                 p.join()
+                reason = "wedged: no heartbeat" if wedged \
+                    else f"exited code {p.exitcode}"
+                self.last_restart_reason[i] = reason
+                print(f"[{self.obs_instance}] child {i} "
+                      f"{self.addresses[i]} {reason}", file=sys.stderr,
+                      flush=True)
                 if self._stop.is_set():
                     return
                 if self.restarts[i] >= self._max_restarts:
                     self._procs[i] = None  # crash-looping: give the child up
                     continue
-                self.restarts[i] += 1
+                self._m_restarts[i].inc()
                 self.last_restart_at[i] = time.time()
                 try:
                     self._spawn(i)
@@ -273,6 +308,7 @@ class ChildSupervisor:
                 "alive": p is not None and p.is_alive(),
                 "restart_count": self.restarts[i],
                 "last_restart_at": self.last_restart_at[i],
+                "last_restart_reason": self.last_restart_reason[i],
                 "gave_up": p is None,
             })
         return out

@@ -32,6 +32,8 @@ executors pushing fetched gradients.
 
 from __future__ import annotations
 
+import contextvars
+import functools
 import os
 import pickle
 import threading
@@ -40,6 +42,7 @@ import warnings
 import numpy as np
 
 from ..core.flags import get_flag
+from ..core.profiler import trace_context
 from .rpc import RpcServer, RpcClient, SparseGrad
 
 
@@ -815,6 +818,10 @@ class ParamClient:
         shards — a lock-order inversion between trainers) and aggregate ALL
         shard failures into one diagnosable error; a single failure keeps
         its original type."""
+        with trace_context():
+            return self._fanout_traced(method, requests)
+
+    def _fanout_traced(self, method, requests):
         if len(requests) == 1:
             (idx, kwargs), = requests.items()
             return {idx: self._clients[idx].call(method, **kwargs)}
@@ -825,8 +832,13 @@ class ParamClient:
             self._pool = ThreadPoolExecutor(
                 max_workers=len(self._clients),
                 thread_name_prefix="param-client")
-        futures = {idx: self._pool.submit(self._clients[idx].call, method,
-                                          **kwargs)
+        # each per-shard call runs under a COPY of this context, so the
+        # fan-out's one trace id (trace_context in _fanout) reaches every
+        # shard — pool threads do not inherit contextvars by themselves
+        futures = {idx: self._pool.submit(
+                       contextvars.copy_context().run,
+                       functools.partial(self._clients[idx].call, method,
+                                         **kwargs))
                    for idx, kwargs in requests.items()}
         out, errors = {}, []
         for idx, fut in futures.items():

@@ -60,6 +60,7 @@ import hmac
 import os
 import pickle
 import random
+import re
 import socket
 import struct
 import threading
@@ -72,9 +73,15 @@ import numpy as np
 from types import GeneratorType
 
 from ..core.flags import get_flag
-from ..core.profiler import record_event
+from ..core.profiler import (current_trace_id, new_trace_id, record_event,
+                             reset_trace_id, set_trace_id, trace_context)
+from ..obs.metrics import REGISTRY as _METRICS
 
 AUTHKEY = b"paddle-tpu-rpc"
+
+# identifier-shaped method names only reach the registry's method label
+# (see WireStats.note); anything else funnels into "__other__"
+_NAME_OK_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]{0,63}$")
 
 _MAGIC = b"PDTPU-RPC-1."          # handshake hello prefix (12 bytes)
 _WELCOME = b"WELCOME!"
@@ -342,18 +349,56 @@ def _client_handshake(sock):
         raise AuthenticationError("server rejected the digest")
 
 
-class WireStats:
-    """Bytes + call-latency counters for one endpoint (client or server).
-    ``snapshot()`` is cheap and picklable, so a server's counters travel
-    inside ``stats()`` responses."""
+# process-wide wire accounting (obs.metrics plane): every WireStats mirrors
+# its per-endpoint counters into these role-labeled aggregates, so the
+# built-in ``metrics`` scrape sees total wire traffic without per-endpoint
+# label cardinality (a router's connection pool alone holds dozens of
+# clients); the per-endpoint detail stays on each WireStats.snapshot().
+_WIRE_BYTES_SENT = _METRICS.counter(
+    "paddle_tpu_wire_bytes_sent",
+    "bytes written to RPC sockets, by endpoint role", labels=("role",))
+_WIRE_BYTES_RECV = _METRICS.counter(
+    "paddle_tpu_wire_bytes_recv",
+    "bytes read from RPC sockets, by endpoint role", labels=("role",))
+_WIRE_CALLS = _METRICS.counter(
+    "paddle_tpu_wire_calls",
+    "RPC calls noted by WireStats, by role and method",
+    labels=("role", "method"))
+_WIRE_CALL_SECONDS = _METRICS.histogram(
+    "paddle_tpu_wire_call_seconds",
+    "RPC call latency windows, by role and method",
+    labels=("role", "method"), span_kind="rpc")
 
-    def __init__(self):
+
+class WireStats:
+    """Bytes + call-latency counters for one endpoint. ``role`` labels the
+    process-wide registry mirror ("client"/"server"); ``snapshot()`` keeps
+    the exact per-endpoint view (cheap and picklable, so a server's
+    counters travel inside ``stats()`` responses)."""
+
+    def __init__(self, role="client"):
         self._lock = threading.Lock()
+        self.role = str(role)
         self.bytes_sent = 0
         self.bytes_recv = 0
         self._calls = {}   # method -> [count, total_s, max_s]
+        self._m_sent = _WIRE_BYTES_SENT.labels(role=self.role)
+        self._m_recv = _WIRE_BYTES_RECV.labels(role=self.role)
+        self._m_methods = {}  # method -> (calls child, seconds child)
+
+    # process-wide method-label cardinality bound: method names arrive
+    # off the WIRE on the server side, so a misbehaving peer calling
+    # arbitrary names must not grow unbounded (scrape-visible, never
+    # reclaimed) registry series — past the cap, or for a non-identifier
+    # name, the registry mirror funnels into the "__other__" label (the
+    # per-endpoint ``snapshot()`` keeps exact names; it dies with the
+    # endpoint)
+    _METHOD_LABEL_CAP = 64
 
     def note(self, method, sent, recvd, seconds):
+        # coerce at the source: numpy byte counts from buffer walkers
+        # must never leak into snapshot()/stats() payloads
+        sent, recvd, seconds = int(sent), int(recvd), float(seconds)
         with self._lock:
             self.bytes_sent += sent
             self.bytes_recv += recvd
@@ -361,6 +406,20 @@ class WireStats:
             rec[0] += 1
             rec[1] += seconds
             rec[2] = max(rec[2], seconds)
+            mc = self._m_methods.get(method)
+            if mc is None:
+                label = method if isinstance(method, str) \
+                    and _NAME_OK_RE.match(method) \
+                    and len(self._m_methods) < self._METHOD_LABEL_CAP \
+                    else "__other__"
+                mc = self._m_methods[method] = (
+                    _WIRE_CALLS.labels(role=self.role, method=label),
+                    _WIRE_CALL_SECONDS.labels(role=self.role,
+                                              method=label))
+        self._m_sent.inc(sent)
+        self._m_recv.inc(recvd)
+        mc[0].inc()
+        mc[1].observe(seconds)
 
     def snapshot(self):
         with self._lock:
@@ -370,6 +429,26 @@ class WireStats:
                 "calls": {m: {"count": c, "total_s": t, "max_s": mx}
                           for m, (c, t, mx) in self._calls.items()},
             }
+
+
+def _parse_request(req):
+    """Unpack a request message: the legacy 2-tuple ``(method, kwargs)``
+    or the current 3-tuple ``(method, kwargs, meta)`` where ``meta``
+    carries the trace id (``{"trace": ...}``). An absent meta field means
+    a legacy peer — fully served, no migration."""
+    method, kwargs = req[0], req[1]
+    meta = req[2] if len(req) > 2 and isinstance(req[2], dict) else {}
+    return method, kwargs, meta
+
+
+def _builtin_metrics():
+    """The built-in ``metrics`` RPC every RpcServer answers (unless its
+    handler defines its own): a JSON-safe snapshot of this process's
+    obs.metrics registry — the per-process scrape endpoint
+    ``tools/metrics_dump.py`` and ``FleetSupervisor.fleet_metrics()``
+    read."""
+    from ..obs import metrics as _m
+    return _m.json_safe(_m.REGISTRY.snapshot())
 
 
 class RemoteError(RuntimeError):
@@ -462,7 +541,7 @@ class RpcServer:
         self._active = 0
         self._active_cv = threading.Condition()
         self._drain_finalized = False
-        self.wire_stats = WireStats()
+        self.wire_stats = WireStats(role="server")
 
     @property
     def address(self):
@@ -511,11 +590,12 @@ class RpcServer:
         try:
             while not self._stop.is_set():
                 try:
-                    (method, kwargs), nr, wire = recv_msg(conn)
+                    req, nr, wire = recv_msg(conn)
+                    method, kwargs, meta = _parse_request(req)
                 except Exception:
                     # EOF/OSError: client vanished or kill() severed us;
-                    # decode errors: a corrupt stream is unrecoverable
-                    # mid-connection either way
+                    # decode/shape errors: a corrupt stream is
+                    # unrecoverable mid-connection either way
                     return
                 with self._active_cv:
                     if self._drain_finalized:
@@ -525,6 +605,11 @@ class RpcServer:
                         return
                     self._active += 1
                 gen = None
+                # restore the client's trace id (wire meta) into the
+                # contextvar for the whole handling of this request, so
+                # server-side profiler spans share the caller's id
+                trace_tok = set_trace_id(meta["trace"]) \
+                    if meta.get("trace") else None
                 try:
                     if method == "__shutdown__":
                         send_msg(conn, (True, None), wire)
@@ -545,7 +630,14 @@ class RpcServer:
                         return
                     t0 = time.perf_counter()
                     try:
-                        fn = getattr(self._handler, method)
+                        if method == "metrics" \
+                                and not hasattr(self._handler, "metrics"):
+                            # built-in scrape surface: every RpcServer
+                            # answers the obs.metrics registry snapshot;
+                            # a handler-defined metrics method wins
+                            fn = _builtin_metrics
+                        else:
+                            fn = getattr(self._handler, method)
                         with record_event(f"rpc.serve/{method}", kind="rpc"):
                             payload = fn(**kwargs)
                         if isinstance(payload, GeneratorType):
@@ -577,6 +669,8 @@ class RpcServer:
                     self.wire_stats.note(method, ns, nr,
                                          time.perf_counter() - t0)
                 finally:
+                    if trace_tok is not None:
+                        reset_trace_id(trace_tok)
                     if gen is not None:
                         # always unwind the handler generator — a severed
                         # client or drop rule must cancel its work (the
@@ -765,12 +859,17 @@ class RpcClient:
 
     def _call_once(self, method, kwargs):
         t0 = time.perf_counter()
+        # carry the active trace id in the request header (meta field);
+        # call()/stream() ensure one exists, making every RpcClient a
+        # client edge of the distributed trace
+        tid = current_trace_id()
+        msg = (method, kwargs, {"trace": tid}) if tid else (method, kwargs)
         with self._lock:
             if self._sock is None:
                 self._sock = self._connect()
             try:
                 self._sock.settimeout(self._timeout)
-                ns = send_msg(self._sock, (method, kwargs), self._wire)
+                ns = send_msg(self._sock, msg, self._wire)
                 resp, nr, _wire = recv_msg(self._sock)
             except TimeoutError:
                 self._drop_conn()
@@ -797,20 +896,28 @@ class RpcClient:
 
     def call(self, method, **kwargs):
         attempt = 0
-        while True:
-            try:
-                with record_event(f"rpc.client/{method}", kind="rpc"):
-                    return self._call_once(method, kwargs)
-            except TimeoutError:
-                # a response timeout is ambiguous (the call may have
-                # applied) and bounded by its own deadline — never retried
-                raise
-            except self._RETRYABLE:
-                if self._retry is None or attempt >= self._retry.max_retries:
+        # client edge of the distributed trace: reuse the caller's trace
+        # id (FleetClient/ParamClient bind one spanning failovers and
+        # shard fan-outs) or mint a fresh one for this call — the id rides
+        # the request header and every retry resend keeps it
+        with trace_context():
+            while True:
+                try:
+                    with record_event(f"rpc.client/{method}", kind="rpc"):
+                        return self._call_once(method, kwargs)
+                except TimeoutError:
+                    # a response timeout is ambiguous (the call may have
+                    # applied) and bounded by its own deadline — never
+                    # retried
                     raise
-                attempt += 1
-                # back off OUTSIDE the conn lock, then reconnect-and-resend
-                time.sleep(self._retry.delay_s(attempt))
+                except self._RETRYABLE:
+                    if self._retry is None \
+                            or attempt >= self._retry.max_retries:
+                        raise
+                    attempt += 1
+                    # back off OUTSIDE the conn lock, then
+                    # reconnect-and-resend
+                    time.sleep(self._retry.delay_s(attempt))
 
     def stream(self, method, **kwargs):
         """STREAMING call: a generator yielding the server's item frames
@@ -829,6 +936,10 @@ class RpcClient:
         the handler generator. No automatic retry: a generation stream is
         stateful, so a resend could replay work; callers retry whole
         streams if their semantics allow."""
+        # a generator must not enter trace_context (the contextvar would
+        # leak into the consumer between yields); compute the id once and
+        # send it explicitly — the server side restores it per request
+        tid = current_trace_id() or new_trace_id()
         self._lock.acquire()
         clean = False
         try:
@@ -836,7 +947,8 @@ class RpcClient:
                 self._sock = self._connect()
             try:
                 self._sock.settimeout(self._timeout)
-                ns = send_msg(self._sock, (method, kwargs), self._wire)
+                ns = send_msg(self._sock, (method, kwargs,
+                                           {"trace": tid}), self._wire)
                 self.wire_stats.note(method, ns, 0, 0.0)
                 kind, payload = self._recv_frame()
                 if kind is True:          # unary answer: one-item stream

@@ -1,0 +1,34 @@
+"""paddle_tpu.obs — the unified observability plane.
+
+One substrate for every signal the framework emits, replacing the
+reference's two disjoint generations (Fluid ``platform/profiler`` spans
+vs the legacy v2 ``Stat`` counter registry) with three coordinated
+pieces:
+
+* :mod:`.metrics` — the process-wide :data:`~.metrics.REGISTRY` of named
+  ``Counter``/``Gauge``/``Histogram`` families (stable
+  ``paddle_tpu_<subsystem>_<name>`` naming, README metrics-table
+  enforced) every subsystem's ad-hoc counters migrated into; scraped by
+  ``RpcServer``'s built-in ``metrics`` method, aggregated fleet-wide by
+  ``FleetSupervisor.fleet_metrics()`` / ``OnlineLearningLoop.stats()``,
+  rendered by ``tools/metrics_dump.py`` (JSON or Prometheus text).
+* :mod:`.trace` — cross-process trace-id propagation: ids generated at
+  client edges, carried in the RPC header, restored server-side, so
+  ``tools/merge_traces.py`` can stitch one request across processes.
+* :func:`~.metrics.json_safe` — the wire-safety coercion every
+  ``stats()``/``health()`` payload passes through.
+"""
+
+from . import metrics, trace
+from .metrics import (Counter, Gauge, Histogram, REGISTRY, json_safe,
+                      merge_snapshots, next_instance, prometheus_text,
+                      scrape)
+from .trace import (current_trace_id, new_trace_id, set_trace_id,
+                    reset_trace_id, trace_context)
+
+__all__ = [
+    "metrics", "trace", "REGISTRY", "Counter", "Gauge", "Histogram",
+    "json_safe", "merge_snapshots", "next_instance", "prometheus_text",
+    "scrape", "current_trace_id", "new_trace_id", "set_trace_id",
+    "reset_trace_id", "trace_context",
+]

@@ -1,0 +1,26 @@
+"""Distributed trace-id propagation: the request-correlation half of the
+observability plane.
+
+The primitives live in ``core.profiler`` (the recorder must read the
+contextvar without importing this package — import-cycle hygiene); this
+module is the public face:
+
+* a trace id is GENERATED at a client edge — every ``rpc.RpcClient`` call
+  ensures one, which covers ``InferClient``, ``GenClient``,
+  ``FleetClient`` (one id per fleet request, spanning failovers) and
+  ``ParamClient`` (one id per push/pull fan-out, spanning shards);
+* it is CARRIED in the RPC request header (both codecs; a header without
+  the field is a legacy peer — no migration needed);
+* it is RESTORED server-side into the contextvar around the handler call,
+  so profiler spans on both sides of the wire carry the same id;
+* ``tools/merge_traces.py`` stitches the per-process chrome traces into
+  one timeline where spans sharing a trace id form one connected track.
+"""
+
+from __future__ import annotations
+
+from ..core.profiler import (current_trace_id, new_trace_id,
+                             reset_trace_id, set_trace_id, trace_context)
+
+__all__ = ["current_trace_id", "new_trace_id", "reset_trace_id",
+           "set_trace_id", "trace_context"]

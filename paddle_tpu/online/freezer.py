@@ -46,7 +46,21 @@ import time
 
 import numpy as np
 
-from ..core.profiler import LatencyWindow
+from ..obs.metrics import (REGISTRY as _METRICS, json_safe,
+                           next_instance)
+
+# freeze outcomes in the obs.metrics registry: published / skipped_busy /
+# failed_<phase> (prepare, torn, stitch) — stats() derives its historical
+# counters dict from these children
+_M_FREEZES = _METRICS.counter(
+    "paddle_tpu_online_freezes",
+    "CheckpointFreezer cut outcomes (published, skipped_busy, "
+    "failed_prepare, failed_torn, failed_stitch), per instance",
+    labels=("instance", "outcome"))
+_M_FREEZE_SECONDS = _METRICS.histogram(
+    "paddle_tpu_online_freeze_seconds",
+    "freeze stitch+publish latency window, per instance",
+    labels=("instance",), span_name="online/freeze", span_kind="online")
 
 
 class FreezeError(RuntimeError):
@@ -137,21 +151,32 @@ class CheckpointFreezer:
         self._cut_seq = 0
         self._jobs = queue.Queue(maxsize=1)
         self._stats_lock = threading.Lock()
-        self._published = 0
-        self._skipped = 0
-        self._failures = {}          # phase -> count
         self._last_error = None
         self._last_publish = None    # {"version", "step", "round", "at"}
-        self.latency = LatencyWindow(name="online/freeze", kind="online")
+        # outcome counters + stitch latency in the obs.metrics registry
+        self.obs_instance = next_instance("freezer")
+        self._m_outcome = {}         # outcome -> counter child (lazy)
+        self.latency = _M_FREEZE_SECONDS.labels(instance=self.obs_instance)
         self._stop = threading.Event()
         self._worker = threading.Thread(target=self._drain, daemon=True,
                                         name="checkpoint-freezer")
         self._worker.start()
 
     # ------------------------------------------------------------------
+    def _count(self, outcome):
+        child = self._m_outcome.get(outcome)
+        if child is None:
+            child = self._m_outcome[outcome] = _M_FREEZES.labels(
+                instance=self.obs_instance, outcome=outcome)
+        child.inc()
+
+    def _outcome_value(self, outcome):
+        child = self._m_outcome.get(outcome)
+        return int(child.value) if child is not None else 0
+
     def _record_failure(self, phase, err):
+        self._count(f"failed_{phase}")
         with self._stats_lock:
-            self._failures[phase] = self._failures.get(phase, 0) + 1
             self._last_error = f"{phase}: {type(err).__name__}: {err}"
 
     def request_freeze(self, global_step, wait=False, timeout=None):
@@ -193,8 +218,7 @@ class CheckpointFreezer:
                     self._jobs.put_nowait(job)
                 except queue.Full:
                     self._client.snapshot_release(tag)
-                    with self._stats_lock:
-                        self._skipped += 1
+                    self._count("skipped_busy")
                     err = FreezeError("freeze skipped: a previous cut is "
                                       "still stitching")
         if err is not None:
@@ -222,8 +246,8 @@ class CheckpointFreezer:
             try:
                 with self.latency.span():
                     version = self._stitch(job)
+                self._count("published")
                 with self._stats_lock:
-                    self._published += 1
                     self._last_publish = {"version": version,
                                           "step": job.step,
                                           "round": job.round,
@@ -272,14 +296,25 @@ class CheckpointFreezer:
 
     # ------------------------------------------------------------------
     def stats(self):
+        # the historical shape, derived from the registry outcome
+        # children (failures keyed by phase, zero-count phases omitted)
+        failures = {}
+        for outcome in list(self._m_outcome):
+            if outcome.startswith("failed_"):
+                n = self._outcome_value(outcome)
+                if n:
+                    failures[outcome[len("failed_"):]] = n
         with self._stats_lock:
-            return {"published": self._published,
-                    "skipped_busy": self._skipped,
-                    "failures": dict(self._failures),
-                    "last_error": self._last_error,
-                    "last_publish": dict(self._last_publish)
-                    if self._last_publish else None,
-                    "freeze_latency": self.latency.snapshot()}
+            last_error = self._last_error
+            last_publish = dict(self._last_publish) \
+                if self._last_publish else None
+        return json_safe({"published": self._outcome_value("published"),
+                          "skipped_busy": self._outcome_value(
+                              "skipped_busy"),
+                          "failures": failures,
+                          "last_error": last_error,
+                          "last_publish": last_publish,
+                          "freeze_latency": self.latency.snapshot()})
 
     def close(self, timeout=30.0):
         """Let an in-flight stitch finish, then stop the worker. Never

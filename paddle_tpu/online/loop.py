@@ -192,10 +192,19 @@ class OnlineLearningLoop:
         return self.fleet.version
 
     # ------------------------------------------------------------------
-    def stats(self):
+    def stats(self, fleet_metrics=True, scrape_timeout=1.0):
         """One aggregated observability surface: every component's
         counters plus the supervisors' per-child restart stats — what an
-        operator (and the bench lane) watches the loop through."""
+        operator (and the bench lane) watches the loop through.
+
+        ``fleet_metrics=True`` additionally scrapes the built-in
+        ``metrics`` RPC of every pserver shard and serving replica and
+        merges those registry snapshots with this process's own (the
+        trainer/freezer/rollout counters live HERE) into one fleet-wide
+        view under ``"metrics"`` — unreachable children (mid-restart)
+        are skipped, never waited on past ``scrape_timeout``."""
+        from ..obs import metrics as _m
+
         out = {"model": self.model, "started": self._started}
         if self.trainer is not None:
             out["trainer"] = self.trainer.stats()
@@ -212,7 +221,17 @@ class OnlineLearningLoop:
             out["published_versions"] = self.registry.versions(self.model)
         except ValueError:
             out["published_versions"] = []
-        return out
+        if fleet_metrics:
+            addrs = []
+            if self.fleet is not None:
+                addrs += [tuple(a) for a in self.fleet.addresses]
+            if self.pservers is not None:
+                addrs += [tuple(a) for a in self.pservers.addresses]
+            scraped = _m.scrape(addrs, timeout=scrape_timeout) \
+                if addrs else {}
+            out["metrics"] = _m.merge_snapshots(
+                [_m.REGISTRY.snapshot()] + list(scraped.values()))
+        return _m.json_safe(out)
 
     def stop(self):
         """Tear the tree down top-down (trainer first so nothing pushes

@@ -41,8 +41,26 @@ import threading
 import time
 
 from ..core.flags import get_flag
-from ..core.profiler import LatencyWindow
+from ..obs.metrics import (REGISTRY as _METRICS, json_safe,
+                           next_instance)
 from ..serving.fleet import CanaryFailed
+
+# rollout outcomes in the obs.metrics registry: ok / canary_failed
+# (quarantined) / error (transient) / converge_repair — stats() derives
+# its counters from these children
+_M_ROLLOUTS = _METRICS.counter(
+    "paddle_tpu_online_rollouts",
+    "RolloutController outcomes (ok, canary_failed, error, "
+    "converge_repair), per instance", labels=("instance", "outcome"))
+_M_GC_DELETED = _METRICS.counter(
+    "paddle_tpu_online_registry_gc_deleted",
+    "registry versions garbage-collected after rollouts, per instance",
+    labels=("instance",))
+_M_PUBLISH_TO_SERVED = _METRICS.histogram(
+    "paddle_tpu_online_publish_to_served_seconds",
+    "publish-to-served lag window (manifest published_at -> rollout "
+    "complete), per instance", labels=("instance",),
+    span_name="online/publish_to_served", span_kind="online")
 
 
 class RolloutController:
@@ -73,16 +91,22 @@ class RolloutController:
         self._keep = int(registry_keep)
         self._bad = set()
         self._lock = threading.Lock()
-        self._rollouts = 0
-        self._rollbacks = 0
-        self._errors = 0
-        self._converge_repairs = 0
         self._needs_converge = False
-        self._gc_deleted = 0
         self._last_error = None
         self._last_rollout_t = None
-        self.publish_to_served = LatencyWindow(name="online/publish_to_served",
-                                               kind="online")
+        # outcome counters + lag window in the obs.metrics registry
+        self.obs_instance = next_instance("rollout")
+        self._m_ok = _M_ROLLOUTS.labels(instance=self.obs_instance,
+                                        outcome="ok")
+        self._m_canary = _M_ROLLOUTS.labels(instance=self.obs_instance,
+                                            outcome="canary_failed")
+        self._m_errors = _M_ROLLOUTS.labels(instance=self.obs_instance,
+                                            outcome="error")
+        self._m_converge = _M_ROLLOUTS.labels(instance=self.obs_instance,
+                                              outcome="converge_repair")
+        self._m_gc = _M_GC_DELETED.labels(instance=self.obs_instance)
+        self.publish_to_served = _M_PUBLISH_TO_SERVED.labels(
+            instance=self.obs_instance)
         self._stop = threading.Event()
         self._thread = None
 
@@ -143,12 +167,11 @@ class RolloutController:
             return
         try:
             self._sup.rolling_reload(served, wait_timeout=self._timeout)
-            with self._lock:
-                self._converge_repairs += 1
+            self._m_converge.inc()
             self._needs_converge = False
         except Exception as e:
+            self._m_errors.inc()
             with self._lock:
-                self._errors += 1
                 self._last_error = f"converge: {type(e).__name__}: {e}"
 
     def _poll(self):
@@ -161,9 +184,9 @@ class RolloutController:
         try:
             self._sup.rolling_reload(target, wait_timeout=self._timeout)
         except CanaryFailed as e:
+            self._m_canary.inc()
             with self._lock:
                 self._bad.add(target)
-                self._rollbacks += 1
                 self._last_error = f"CanaryFailed: {e}"
             return
         except Exception as e:
@@ -172,8 +195,8 @@ class RolloutController:
             # crashed replicas restart onto the current version, and
             # _maybe_reconverge re-drives any alive-but-stale replica
             # the restart path would never touch
+            self._m_errors.inc()
             with self._lock:
-                self._errors += 1
                 self._last_error = f"{type(e).__name__}: {e}"
             self._needs_converge = True
             return
@@ -186,8 +209,8 @@ class RolloutController:
                 lag = max(0.0, time.time() - float(published_at))
         except ValueError:
             pass
+        self._m_ok.inc()
         with self._lock:
-            self._rollouts += 1
             self._last_rollout_t = now
             if lag is not None:
                 self.publish_to_served.record(lag)
@@ -196,8 +219,7 @@ class RolloutController:
                 deleted = self._registry.gc(self._model,
                                             keep_latest=self._keep,
                                             pinned={target})
-                with self._lock:
-                    self._gc_deleted += len(deleted)
+                self._m_gc.inc(len(deleted))
             except Exception as e:
                 with self._lock:
                     self._last_error = f"gc: {type(e).__name__}: {e}"
@@ -207,22 +229,26 @@ class RolloutController:
             try:
                 self._poll()
             except Exception as e:      # the watcher must never die
+                self._m_errors.inc()
                 with self._lock:
-                    self._errors += 1
                     self._last_error = f"{type(e).__name__}: {e}"
 
     # ------------------------------------------------------------------
     def stats(self):
         with self._lock:
-            return {"served_version": self._sup.version,
-                    "rollouts": self._rollouts,
-                    "rollbacks": self._rollbacks,
-                    "bad_versions": sorted(self._bad),
-                    "errors": self._errors,
-                    "converge_repairs": self._converge_repairs,
-                    "gc_deleted": self._gc_deleted,
-                    "last_error": self._last_error,
-                    "publish_to_served": self.publish_to_served.snapshot()}
+            bad = sorted(self._bad)
+            last_error = self._last_error
+        # counters derived from this instance's registry children
+        return json_safe(
+            {"served_version": self._sup.version,
+             "rollouts": int(self._m_ok.value),
+             "rollbacks": int(self._m_canary.value),
+             "bad_versions": bad,
+             "errors": int(self._m_errors.value),
+             "converge_repairs": int(self._m_converge.value),
+             "gc_deleted": int(self._m_gc.value),
+             "last_error": last_error,
+             "publish_to_served": self.publish_to_served.snapshot()})
 
 
 __all__ = ["RolloutController"]

@@ -41,7 +41,26 @@ import time
 import numpy as np
 
 from ..core.flags import get_flag
-from ..core.profiler import LatencyWindow
+from ..obs.metrics import (REGISTRY as _METRICS, json_safe,
+                           next_instance)
+
+_M_STEPS = _METRICS.counter(
+    "paddle_tpu_online_trainer_steps",
+    "global steps completed by a StreamingTrainer (push acked on every "
+    "shard), per instance", labels=("instance",))
+_M_STEP_FAILURES = _METRICS.counter(
+    "paddle_tpu_online_trainer_step_failures",
+    "dropped batches (pull/run failures; push retries are separate), "
+    "per instance", labels=("instance",))
+_M_PUSH_RETRIES = _METRICS.counter(
+    "paddle_tpu_online_trainer_push_retries",
+    "same-seq push retries riding out shard restarts, per instance",
+    labels=("instance",))
+_M_STEP_SECONDS = _METRICS.histogram(
+    "paddle_tpu_online_train_step_seconds",
+    "StreamingTrainer full-step latency window, per instance",
+    labels=("instance",), span_name="online/train_step",
+    span_kind="online")
 
 
 class _Stopped(Exception):
@@ -90,16 +109,24 @@ class StreamingTrainer:
         self._fetch = [g for _p, g in self._pg] + self._extra
         self._prefetch = int(prefetch)
         self._step = 0
-        self._step_failures = 0
-        self._push_retries = 0
         self._reader_failed = False
         self._publish_requests = 0
         self._publish_accepted = 0
         self._pending_job = None     # last ACCEPTED cut, until resolved
         self._last_error = None
         self._last_extra = {}
-        self.step_latency = LatencyWindow(name="online/train_step",
-                                          kind="online")
+        # step/failure/retry counters + step latency live in the
+        # obs.metrics registry under this trainer's instance label
+        # (stats() derives from them; _step stays local — it is loop
+        # control state, mirrored into the counter at each boundary)
+        self.obs_instance = next_instance("trainer")
+        self._m_steps = _M_STEPS.labels(instance=self.obs_instance)
+        self._m_step_failures = _M_STEP_FAILURES.labels(
+            instance=self.obs_instance)
+        self._m_push_retries = _M_PUSH_RETRIES.labels(
+            instance=self.obs_instance)
+        self.step_latency = _M_STEP_SECONDS.labels(
+            instance=self.obs_instance)
         self._stop = threading.Event()
         self._thread = None
 
@@ -140,7 +167,7 @@ class StreamingTrainer:
             try:
                 return self._client.push(grads, seq=seq)
             except Exception as e:
-                self._push_retries += 1
+                self._m_push_retries.inc()
                 self._last_error = f"push(seq={seq}): " \
                                    f"{type(e).__name__}: {e}"
                 if self._stop.wait(0.25):
@@ -213,13 +240,14 @@ class StreamingTrainer:
                         n: np.asarray(fetched[base + i]).tolist()
                         for i, n in enumerate(self._extra)}
                 self._step += 1
+                self._m_steps.inc()
                 steps_since_pub += 1
             except _Stopped:
                 break
             except Exception as e:
                 # pull/run failure (restarting shard): count, drop the
                 # batch, back off a beat, continue
-                self._step_failures += 1
+                self._m_step_failures.inc()
                 self._last_error = f"{type(e).__name__}: {e}"
                 if self._stop.wait(0.05):
                     break
@@ -246,16 +274,17 @@ class StreamingTrainer:
 
     # ------------------------------------------------------------------
     def stats(self):
-        return {"global_step": self._step,
-                "running": self.running(),
-                "step_failures": self._step_failures,
-                "push_retries": self._push_retries,
-                "reader_failed": self._reader_failed,
-                "publish_requests": self._publish_requests,
-                "publish_accepted": self._publish_accepted,
-                "last_error": self._last_error,
-                "last_extra": dict(self._last_extra),
-                "step_latency": self.step_latency.snapshot()}
+        return json_safe(
+            {"global_step": self._step,
+             "running": self.running(),
+             "step_failures": int(self._m_step_failures.value),
+             "push_retries": int(self._m_push_retries.value),
+             "reader_failed": self._reader_failed,
+             "publish_requests": self._publish_requests,
+             "publish_accepted": self._publish_accepted,
+             "last_error": self._last_error,
+             "last_extra": dict(self._last_extra),
+             "step_latency": self.step_latency.snapshot()})
 
 
 __all__ = ["StreamingTrainer"]

@@ -33,12 +33,12 @@ per op.
 
 from __future__ import annotations
 
-import threading
 import warnings
 from contextlib import contextmanager
 
 from ...core.flags import get_flag
 from ...core.profiler import record_event
+from ...obs.metrics import REGISTRY as _METRICS
 
 # kernels that default to Pallas under kernel_tier=auto on TPU — the
 # measured-to-win set (lstm 1.22x on v5e; gru measured 0.98-1.08x across
@@ -56,8 +56,12 @@ _LEGACY_FLAGS = {
 
 _warned_legacy: set = set()
 
-_fallback_lock = threading.Lock()
-_fallbacks: dict = {}
+# pallas->jnp silent-fallback counter, in the obs.metrics registry
+# (fallback_counts() derives its historical dict from this family)
+_M_FALLBACKS = _METRICS.counter(
+    "paddle_tpu_pallas_fallbacks",
+    "unsupported shapes routed pallas->jnp silently, per kernel family",
+    labels=("kernel",))
 
 
 def _legacy_forced(kernel):
@@ -123,19 +127,25 @@ def use_pallas(kernel, supported=True):
 
 
 def record_fallback(kernel):
-    with _fallback_lock:
-        _fallbacks[kernel] = _fallbacks.get(kernel, 0) + 1
+    _M_FALLBACKS.labels(kernel=kernel).inc()
 
 
 def fallback_counts():
-    """{kernel: times an unsupported shape routed pallas->jnp}."""
-    with _fallback_lock:
-        return dict(_fallbacks)
+    """{kernel: times an unsupported shape routed pallas->jnp} — derived
+    from the ``paddle_tpu_pallas_fallbacks`` registry counter; kernels
+    with zero fallbacks are omitted (the historical dict shape)."""
+    out = {}
+    for key, child in _M_FALLBACKS.children().items():
+        n = int(child.value)
+        if n:
+            out[key[0]] = n
+    return out
 
 
 def reset_fallback_counts():
-    with _fallback_lock:
-        _fallbacks.clear()
+    """TEST hygiene: zero the fallback counters (scrape consumers treat
+    counters as monotonic — do not call outside tests)."""
+    _M_FALLBACKS.reset()
 
 
 @contextmanager

@@ -26,6 +26,20 @@ from collections import deque
 import numpy as np
 
 from ..core.flags import get_flag
+from ..obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
+
+_M_REQUESTS = _METRICS.counter(
+    "paddle_tpu_batcher_requests",
+    "requests submitted to a DynamicBatcher, per instance",
+    labels=("instance",))
+_M_REJECTED = _METRICS.counter(
+    "paddle_tpu_batcher_rejected",
+    "requests rejected with ServerOverloaded (queue full), per instance",
+    labels=("instance",))
+_M_BATCHES = _METRICS.counter(
+    "paddle_tpu_batcher_batches",
+    "coalesced batches dispatched by a DynamicBatcher, per instance",
+    labels=("instance",))
 
 
 class ServerOverloaded(RuntimeError):
@@ -71,11 +85,13 @@ class DynamicBatcher:
         self._pending = deque()
         self._cv = threading.Condition()
         self._closed = False
-        # counters (under _cv): total/rejected requests, per-batch-size
-        # histogram of dispatched row counts
-        self._n_requests = 0
-        self._n_rejected = 0
-        self._n_batches = 0
+        # request/reject/batch counters live in the obs.metrics registry
+        # under this batcher's instance label (stats() derives from them);
+        # the per-batch-size histogram stays local (under _cv)
+        self.obs_instance = next_instance("batcher")
+        self._m_requests = _M_REQUESTS.labels(instance=self.obs_instance)
+        self._m_rejected = _M_REJECTED.labels(instance=self.obs_instance)
+        self._m_batches = _M_BATCHES.labels(instance=self.obs_instance)
         self._batch_hist = {}
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
@@ -105,9 +121,9 @@ class DynamicBatcher:
         with self._cv:
             if self._closed:
                 raise RuntimeError("DynamicBatcher is closed")
-            self._n_requests += 1
+            self._m_requests.inc()
             if len(self._pending) >= self.capacity:
-                self._n_rejected += 1
+                self._m_rejected.inc()
                 raise ServerOverloaded(
                     f"serving queue full ({self.capacity} requests "
                     "waiting); back off and retry")
@@ -146,7 +162,7 @@ class DynamicBatcher:
                     r = self._pending.popleft()
                     batch.append(r)
                     total += r.n
-                self._n_batches += 1
+                self._m_batches.inc()
                 self._batch_hist[total] = \
                     self._batch_hist.get(total, 0) + 1
             self._dispatch(batch, total)
@@ -189,16 +205,19 @@ class DynamicBatcher:
     # ------------------------------------------------------------------
     def stats(self):
         with self._cv:
-            return {
-                "queue_depth": len(self._pending),
-                "capacity": self.capacity,
-                "max_batch": self.max_batch,
-                "max_delay_ms": self.max_delay_s * 1e3,
-                "requests": self._n_requests,
-                "rejected": self._n_rejected,
-                "batches": self._n_batches,
-                "batch_size_hist": dict(sorted(self._batch_hist.items())),
-            }
+            depth = len(self._pending)
+            hist = dict(sorted(self._batch_hist.items()))
+        # counters derived from this instance's obs.metrics children
+        return json_safe({
+            "queue_depth": depth,
+            "capacity": self.capacity,
+            "max_batch": self.max_batch,
+            "max_delay_ms": self.max_delay_s * 1e3,
+            "requests": int(self._m_requests.value),
+            "rejected": int(self._m_rejected.value),
+            "batches": int(self._m_batches.value),
+            "batch_size_hist": hist,
+        })
 
     def close(self, timeout=30.0):
         """Stop admitting requests, FLUSH everything already queued (their

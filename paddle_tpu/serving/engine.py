@@ -34,6 +34,24 @@ from ..core.flags import get_flag
 from ..core.profiler import record_event
 from ..core.scope import Scope
 from ..core.types import np_dtype
+from ..obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
+
+# obs plane: the engine's compile/hit/hot-recompile counters live in the
+# process-wide metrics registry (stable names, scraped by the built-in
+# ``metrics`` RPC); each engine instance owns its own labeled children and
+# stats() derives the historical dict shape from them
+_M_COMPILES = _METRICS.counter(
+    "paddle_tpu_engine_compiles",
+    "InferenceEngine executable compiles, per engine instance and bucket",
+    labels=("instance", "bucket"))
+_M_HITS = _METRICS.counter(
+    "paddle_tpu_engine_hits",
+    "InferenceEngine trace-cache hits, per engine instance and bucket",
+    labels=("instance", "bucket"))
+_M_HOT = _METRICS.counter(
+    "paddle_tpu_engine_hot_recompiles",
+    "compiles observed AFTER warmup (the no-recompile alarm)",
+    labels=("instance",))
 
 
 def parse_buckets(spec=None):
@@ -115,10 +133,17 @@ class InferenceEngine:
         # far: a new signature is a compile, a seen one is a trace-cache
         # hit — exactly the jit cache's keying (shape+dtype avals)
         self._seen = set()
-        self._per_bucket = {b: {"compiles": 0, "hits": 0}
+        # counters live in the obs.metrics registry under this engine's
+        # instance label; stats() derives the per-bucket dict from them
+        self.obs_instance = next_instance("engine")
+        self._m_compiles = {b: _M_COMPILES.labels(instance=self.obs_instance,
+                                                  bucket=str(b))
                             for b in self.buckets}
+        self._m_hits = {b: _M_HITS.labels(instance=self.obs_instance,
+                                          bucket=str(b))
+                        for b in self.buckets}
+        self._m_hot = _M_HOT.labels(instance=self.obs_instance)
         self._warmed = False
-        self.hot_recompiles = 0
         # which kernel tier this engine's executables compile with
         # (ops/pallas tier resolution; re-sampled at warmup so a tier flip
         # before warmup is reflected — after warmup it names what the
@@ -198,14 +223,14 @@ class InferenceEngine:
         else:
             feed = self._normalize_dtypes(
                 {k: np.asarray(v)[:1] for k, v in sample_feed.items()})
-        before = sum(s["compiles"] for s in self._per_bucket.values())
+        before = sum(c.value for c in self._m_compiles.values())
         from ..ops.pallas import resolve_tier
         self._kernel_tier = resolve_tier()
         with record_event("serving/warmup", kind="stage"):
             for b in self.buckets:
                 self._dispatch(feed, 1, b)
         self._warmed = True
-        return sum(s["compiles"] for s in self._per_bucket.values()) - before
+        return int(sum(c.value for c in self._m_compiles.values()) - before)
 
     # ------------------------------------------------------------------
     def infer(self, feed, fetch_list=None):
@@ -252,12 +277,12 @@ class InferenceEngine:
                             for k, a in padded.items())))
         with self._stats_lock:
             if sig in self._seen:
-                self._per_bucket[bucket]["hits"] += 1
+                self._m_hits[bucket].inc()
             else:
                 self._seen.add(sig)
-                self._per_bucket[bucket]["compiles"] += 1
+                self._m_compiles[bucket].inc()
                 if self._warmed:
-                    self.hot_recompiles += 1
+                    self._m_hot.inc()
         with self._lock:
             with record_event(f"serving/infer_b{bucket}", kind="stage"):
                 outs = self._exe.run(self._program, feed=padded,
@@ -282,19 +307,28 @@ class InferenceEngine:
         return trimmed
 
     # ------------------------------------------------------------------
+    @property
+    def hot_recompiles(self):
+        """Compiles observed after warmup — derived from this engine's
+        registry counter (the dict shape callers read is unchanged)."""
+        return int(self._m_hot.value)
+
     def stats(self):
-        with self._stats_lock:
-            return {
-                "buckets": list(self.buckets),
-                "per_bucket": {b: dict(s)
-                               for b, s in self._per_bucket.items()},
-                "compiles": sum(s["compiles"]
-                                for s in self._per_bucket.values()),
-                "hits": sum(s["hits"] for s in self._per_bucket.values()),
-                "hot_recompiles": self.hot_recompiles,
-                "warmed": self._warmed,
-                "kernel_tier": self._kernel_tier,
-            }
+        # the historical dict shape, DERIVED from this instance's
+        # obs.metrics children (the registry is the source of truth; the
+        # built-in ``metrics`` RPC reports the same numbers)
+        per_bucket = {b: {"compiles": int(self._m_compiles[b].value),
+                          "hits": int(self._m_hits[b].value)}
+                      for b in self.buckets}
+        return json_safe({
+            "buckets": list(self.buckets),
+            "per_bucket": per_bucket,
+            "compiles": sum(s["compiles"] for s in per_bucket.values()),
+            "hits": sum(s["hits"] for s in per_bucket.values()),
+            "hot_recompiles": self.hot_recompiles,
+            "warmed": self._warmed,
+            "kernel_tier": self._kernel_tier,
+        })
 
 
 __all__ = ["InferenceEngine", "parse_buckets"]

@@ -289,5 +289,25 @@ class FleetSupervisor(ChildSupervisor):
                 c.close()
         return out
 
+    def fleet_metrics(self, timeout=2.0, include_local=True):
+        """Fleet-wide obs.metrics scrape: the built-in ``metrics`` RPC
+        from every replica (index -> registry snapshot, None when
+        unreachable) plus this supervisor process's OWN registry
+        (restart counters, router/client series) when ``include_local``,
+        merged per :func:`paddle_tpu.obs.metrics.merge_snapshots`
+        (counters/gauges sum; histogram percentiles take the
+        conservative max). What ``tools/metrics_dump.py --fleet`` and
+        ``OnlineLearningLoop.stats()`` read."""
+        from ..obs import metrics as _m
+
+        scraped = _m.scrape(self.addresses, timeout=timeout)
+        replicas = {i: scraped.get(tuple(a))
+                    for i, a in enumerate(self.addresses)}
+        snaps = list(replicas.values())
+        if include_local:
+            snaps.append(_m.REGISTRY.snapshot())
+        return _m.json_safe({"replicas": replicas,
+                             "merged": _m.merge_snapshots(snaps)})
+
 
 __all__ = ["FleetSupervisor", "CanaryFailed"]

@@ -38,8 +38,22 @@ import numpy as np
 from ...core.flags import get_flag
 from ...core.profiler import record_event
 from ...core.scope import Scope
+from ...obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
 from ..engine import parse_buckets
 from .kvcache import CacheExhausted, PagedKVCache
+
+_M_COMPILES = _METRICS.counter(
+    "paddle_tpu_genengine_compiles",
+    "GenerationEngine executable compiles, per instance/phase/bucket",
+    labels=("instance", "phase", "bucket"))
+_M_HITS = _METRICS.counter(
+    "paddle_tpu_genengine_hits",
+    "GenerationEngine trace-cache hits, per instance/phase/bucket",
+    labels=("instance", "phase", "bucket"))
+_M_HOT = _METRICS.counter(
+    "paddle_tpu_genengine_hot_recompiles",
+    "generation compiles observed AFTER warmup (the no-recompile alarm)",
+    labels=("instance",))
 
 ATTENTION_OP = "causal_self_attention"
 _SLOTS = "__kv_slots__"
@@ -201,9 +215,13 @@ class GenerationEngine:
         self._lock = threading.Lock()
         self._stats_lock = threading.Lock()
         self._seen = set()
+        # per-(phase, bucket) compile/hit counters live in the
+        # obs.metrics registry under this engine's instance label;
+        # stats() derives the historical phases dict from them
+        self.obs_instance = next_instance("genengine")
         self._phase = {"prefill": {}, "decode": {}}
+        self._m_hot = _M_HOT.labels(instance=self.obs_instance)
         self._warmed = False
-        self.hot_recompiles = 0
         from ...ops.pallas import resolve_tier
         self._kernel_tier = resolve_tier()
 
@@ -295,15 +313,20 @@ class GenerationEngine:
 
     def _dispatch(self, program, feed, phase, bucket):
         with self._stats_lock:
-            per = self._phase[phase].setdefault(
-                bucket, {"compiles": 0, "hits": 0})
+            per = self._phase[phase].get(bucket)
+            if per is None:
+                per = self._phase[phase][bucket] = (
+                    _M_COMPILES.labels(instance=self.obs_instance,
+                                       phase=phase, bucket=str(bucket)),
+                    _M_HITS.labels(instance=self.obs_instance,
+                                   phase=phase, bucket=str(bucket)))
             if (phase, bucket) in self._seen:
-                per["hits"] += 1
+                per[1].inc()
             else:
                 self._seen.add((phase, bucket))
-                per["compiles"] += 1
+                per[0].inc()
                 if self._warmed:
-                    self.hot_recompiles += 1
+                    self._m_hot.inc()
         fetch = [self._logits_name] + self._arena_fetch_names()
         with record_event(f"serving/gen_{phase}_b{bucket}", kind="stage"):
             outs = self._exe.run(program, feed=feed, fetch_list=fetch,
@@ -394,8 +417,9 @@ class GenerationEngine:
             return self._compiles() - before
 
     def _compiles(self):
-        return sum(s["compiles"] for per in self._phase.values()
-                   for s in per.values())
+        with self._stats_lock:
+            return int(sum(c.value for per in self._phase.values()
+                           for c, _h in per.values()))
 
     # ------------------------------------------------------------------
     # sampling
@@ -684,11 +708,19 @@ class GenerationEngine:
                 self._retire(handle)
 
     # ------------------------------------------------------------------
+    @property
+    def hot_recompiles(self):
+        """Compiles observed after warmup — derived from this engine's
+        registry counter."""
+        return int(self._m_hot.value)
+
     def stats(self):
         with self._stats_lock:
-            phases = {ph: {b: dict(c) for b, c in per.items()}
+            phases = {ph: {b: {"compiles": int(c.value),
+                               "hits": int(h.value)}
+                           for b, (c, h) in per.items()}
                       for ph, per in self._phase.items()}
-        return {
+        return json_safe({
             "phases": phases,
             "compiles": sum(s["compiles"] for per in phases.values()
                             for s in per.values()),
@@ -701,7 +733,7 @@ class GenerationEngine:
             "blocks_in_use": self.cache.stats()["blocks_in_use"],
             "cache": self.cache.stats(),
             "kernel_tier": self._kernel_tier,
-        }
+        })
 
 
 __all__ = ["GenerationEngine", "NoFreeSlots", "normalize_sampling"]

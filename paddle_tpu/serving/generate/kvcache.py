@@ -37,6 +37,20 @@ from __future__ import annotations
 import numpy as np
 
 from ...core.flags import get_flag
+from ...obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
+
+_M_BLOCKS_IN_USE = _METRICS.gauge(
+    "paddle_tpu_kvcache_blocks_in_use",
+    "KV arena blocks currently allocated, per cache instance",
+    labels=("instance",))
+_M_REJECTS = _METRICS.counter(
+    "paddle_tpu_kvcache_rejects",
+    "CacheExhausted rejections (admission, budget, COW overdraw), "
+    "per cache instance", labels=("instance",))
+_M_COW = _METRICS.counter(
+    "paddle_tpu_kvcache_cow_copies",
+    "copy-on-write block copies taken by beam forks, per cache instance",
+    labels=("instance",))
 
 
 class CacheExhausted(RuntimeError):
@@ -78,7 +92,12 @@ class PagedKVCache:
         self._lens = {}          # seq_id -> tokens written
         self._promised = {}      # seq_id -> admission-time block budget
         self._promised_total = 0
-        self.cow_copies = 0
+        # arena accounting in the obs.metrics registry (stats() derives
+        # its counters from these children)
+        self.obs_instance = next_instance("kvcache")
+        self._m_in_use = _M_BLOCKS_IN_USE.labels(instance=self.obs_instance)
+        self._m_rejects = _M_REJECTS.labels(instance=self.obs_instance)
+        self._m_cow = _M_COW.labels(instance=self.obs_instance)
 
     # ------------------------------------------------------------------
     @property
@@ -107,6 +126,7 @@ class PagedKVCache:
         need = self.blocks_for(max_total_len) + int(cow_headroom)
         free_uncommitted = self.available_blocks()
         if need > free_uncommitted:
+            self._m_rejects.inc()
             raise CacheExhausted(
                 f"KV arena exhausted: sequence needs {need} blocks "
                 f"(max_total_len={max_total_len}, block_size="
@@ -131,11 +151,13 @@ class PagedKVCache:
 
     def _draw(self, seq_id):
         if not self._free:
+            self._m_rejects.inc()
             raise CacheExhausted(
                 "KV arena free list empty (copy-on-write overdraw?); "
                 "admit beam sequences with cow_headroom >= 1")
         b = self._free.pop()
         self._ref[b] = 1
+        self._m_in_use.set(self.num_blocks - len(self._free))
         return b
 
     # ------------------------------------------------------------------
@@ -147,6 +169,7 @@ class PagedKVCache:
         table = self._tables[seq_id]
         pos = self._lens[seq_id]
         if pos + n > self._promised[seq_id] * self.block_size:
+            self._m_rejects.inc()
             raise CacheExhausted(
                 f"sequence {seq_id!r} exceeds its admitted budget "
                 f"({self._promised[seq_id]} blocks) at position {pos + n}")
@@ -172,7 +195,7 @@ class PagedKVCache:
             self.k[l] = self.k[l].at[nb].set(self.k[l][block])
             self.v[l] = self.v[l].at[nb].set(self.v[l][block])
         self._ref[block] -= 1
-        self.cow_copies += 1
+        self._m_cow.inc()
         return nb
 
     # ------------------------------------------------------------------
@@ -221,6 +244,7 @@ class PagedKVCache:
             self._ref[b] -= 1
             if self._ref[b] == 0:
                 self._free.append(b)
+        self._m_in_use.set(self.num_blocks - len(self._free))
 
     def release(self, seq_id):
         """Finish a sequence: recycle its blocks (refcounted) and return
@@ -231,8 +255,19 @@ class PagedKVCache:
         self._promised_total -= self._promised.pop(seq_id)
 
     # ------------------------------------------------------------------
+    @property
+    def cow_copies(self):
+        """COW copies taken so far — derived from the registry counter."""
+        return int(self._m_cow.value)
+
+    @property
+    def exhausted_rejects(self):
+        """CacheExhausted rejections — derived from the registry counter
+        (admission, per-sequence budget, and COW-overdraw alike)."""
+        return int(self._m_rejects.value)
+
     def stats(self):
-        return {
+        return json_safe({
             "num_blocks": self.num_blocks,
             "block_size": self.block_size,
             "blocks_in_use": self.num_blocks - len(self._free),
@@ -240,7 +275,8 @@ class PagedKVCache:
             "blocks_promised": self._promised_total,
             "sequences": len(self._tables),
             "cow_copies": self.cow_copies,
-        }
+            "exhausted_rejects": self.exhausted_rejects,
+        })
 
 
 __all__ = ["PagedKVCache", "CacheExhausted"]

@@ -32,8 +32,18 @@ import threading
 from collections import deque
 
 from ...core.flags import get_flag
+from ...obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
 from ..batcher import ServerOverloaded
 from .decode_engine import CacheExhausted, NoFreeSlots, normalize_sampling
+
+_GEN_REQUESTS = _METRICS.counter(
+    "paddle_tpu_genbatcher_requests",
+    "generation requests submitted to a ContinuousBatcher, per instance",
+    labels=("instance",))
+_GEN_REJECTED = _METRICS.counter(
+    "paddle_tpu_genbatcher_rejected",
+    "generation requests rejected with ServerOverloaded (wait queue "
+    "full), per instance", labels=("instance",))
 
 
 class _Cancelled(Exception):
@@ -137,8 +147,11 @@ class ContinuousBatcher:
         self._cv = threading.Condition()
         self._closed = False
         self._handles = {}            # stream -> engine handle
-        self._n_requests = 0
-        self._n_rejected = 0
+        # request/overload counters in the obs.metrics registry (stats()
+        # derives from them); step/token counts stay local (under _cv)
+        self.obs_instance = next_instance("genbatcher")
+        self._m_requests = _GEN_REQUESTS.labels(instance=self.obs_instance)
+        self._m_rejected = _GEN_REJECTED.labels(instance=self.obs_instance)
         self._n_steps = 0
         self._n_tokens = 0
         self._worker = threading.Thread(target=self._run, daemon=True)
@@ -158,9 +171,9 @@ class ContinuousBatcher:
         with self._cv:
             if self._closed:
                 raise RuntimeError("ContinuousBatcher is closed")
-            self._n_requests += 1
+            self._m_requests.inc()
             if len(self._pending) >= self.capacity:
-                self._n_rejected += 1
+                self._m_rejected.inc()
                 raise ServerOverloaded(
                     f"generation queue full ({self.capacity} requests "
                     "waiting); back off and retry")
@@ -276,16 +289,17 @@ class ContinuousBatcher:
 
     def stats(self):
         with self._cv:
-            return {
+            out = {
                 "queue_depth": len(self._pending),
                 "capacity": self.capacity,
                 "continuous": self.continuous,
                 "in_flight": len(self._handles),
-                "requests": self._n_requests,
-                "rejected": self._n_rejected,
+                "requests": int(self._m_requests.value),
+                "rejected": int(self._m_rejected.value),
                 "steps": self._n_steps,
                 "tokens_emitted": self._n_tokens,
             }
+        return json_safe(out)
 
 
 __all__ = ["ContinuousBatcher", "TokenStream"]

@@ -35,12 +35,34 @@ import threading
 import time
 
 from ..core.flags import get_flag
-from ..core.profiler import LatencyWindow
+from ..core.profiler import trace_context
 from ..distributed.rpc import RetryPolicy, RpcClient
+from ..obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
 from .batcher import ServerOverloaded
 from .client import InferClient
 
 _CONN_ERRORS = (EOFError, ConnectionError, BrokenPipeError, OSError)
+
+_M_REQUESTS = _METRICS.counter(
+    "paddle_tpu_router_requests",
+    "requests routed through a FleetClient, per instance",
+    labels=("instance",))
+_M_FAILOVERS = _METRICS.counter(
+    "paddle_tpu_router_failovers",
+    "connection-failure failovers to another replica, per instance",
+    labels=("instance",))
+_M_SPILLOVERS = _METRICS.counter(
+    "paddle_tpu_router_spillovers",
+    "ServerOverloaded spillovers to the next replica, per instance",
+    labels=("instance",))
+_M_EJECTIONS = _METRICS.counter(
+    "paddle_tpu_router_ejections",
+    "replicas ejected from the routing set, per instance",
+    labels=("instance",))
+_M_FLEET_SECONDS = _METRICS.histogram(
+    "paddle_tpu_fleet_request_seconds",
+    "FleetClient end-to-end request latency window, per instance",
+    labels=("instance",), span_name="fleet/request", span_kind="rpc")
 
 
 class _Replica:
@@ -101,11 +123,15 @@ class FleetClient:
         self._retry = retry or None
         self._replicas = [_Replica(a, timeout) for a in addresses]
         self._lock = threading.Lock()
-        self.latency = LatencyWindow(name="fleet/request", kind="rpc")
-        self._requests = 0
-        self._failovers = 0
-        self._spillovers = 0
-        self._ejections = 0
+        # router counters + latency window live in the obs.metrics
+        # registry under this router's instance label
+        self.obs_instance = next_instance("router")
+        self.latency = _M_FLEET_SECONDS.labels(instance=self.obs_instance)
+        self._m_requests = _M_REQUESTS.labels(instance=self.obs_instance)
+        self._m_failovers = _M_FAILOVERS.labels(instance=self.obs_instance)
+        self._m_spillovers = _M_SPILLOVERS.labels(
+            instance=self.obs_instance)
+        self._m_ejections = _M_EJECTIONS.labels(instance=self.obs_instance)
         if probe_interval_ms is None:
             probe_interval_ms = get_flag("serving_probe_interval_ms")
         self._probe_interval_s = float(probe_interval_ms) / 1e3
@@ -146,11 +172,11 @@ class FleetClient:
 
     def _eject(self, r):
         with self._lock:
-            self._failovers += 1
+            self._m_failovers.inc()
             if r.healthy:
                 r.healthy = False
                 r.ejections += 1
-                self._ejections += 1
+                self._m_ejections.inc()
             r.consec_ok = 0
             # pooled idle connections point at the dead incarnation; drop
             # them so a re-admitted replica starts on fresh sockets
@@ -162,9 +188,12 @@ class FleetClient:
         only when every available replica rejected it, connection errors
         only when the whole fleet stayed unreachable through the retry
         budget."""
-        with self._lock:
-            self._requests += 1
-        with self.latency.span():
+        self._m_requests.inc()
+        # ONE trace id for the whole fleet request: every failover /
+        # spillover attempt below reuses it (the per-attempt InferClient
+        # calls pick it up from the context), so the merged chrome trace
+        # shows the request as one connected track across replicas
+        with trace_context(), self.latency.span():
             attempt = 0
             while True:
                 overload = None
@@ -183,8 +212,7 @@ class FleetClient:
                         broken = False
                         return out
                     except ServerOverloaded as e:
-                        with self._lock:
-                            self._spillovers += 1
+                        self._m_spillovers.inc()
                         broken = False   # replica alive; conn still good
                         overload = e
                     except TimeoutError:
@@ -244,10 +272,10 @@ class FleetClient:
             reps = [{"address": f"{r.address[0]}:{r.address[1]}",
                      "healthy": r.healthy, "inflight": r.inflight,
                      "ejections": r.ejections} for r in self._replicas]
-            counters = {"requests": self._requests,
-                        "failovers": self._failovers,
-                        "spillovers": self._spillovers,
-                        "ejections": self._ejections}
+        counters = {"requests": int(self._m_requests.value),
+                    "failovers": int(self._m_failovers.value),
+                    "spillovers": int(self._m_spillovers.value),
+                    "ejections": int(self._m_ejections.value)}
         engine = {"compiles": 0, "hits": 0, "hot_recompiles": 0}
         versions = set()
         if include_server_stats:
@@ -274,7 +302,7 @@ class FleetClient:
             out["engine"] = engine
             out["versions"] = sorted(versions,
                                      key=lambda v: (v is None, v))
-        return out
+        return json_safe(out)
 
     def close(self):
         self._stop.set()

@@ -38,10 +38,18 @@ import json
 import os
 import threading
 
-from ..core.profiler import LatencyWindow
 from ..distributed.rpc import RpcServer
+from ..obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
 from .batcher import DynamicBatcher
 from .engine import InferenceEngine
+
+# per-request serving latency (time-to-first-frame for generative):
+# a registry histogram (LatencyWindow-backed) per server instance —
+# spans still land in chrome traces under "serving/request"
+_M_REQUEST_SECONDS = _METRICS.histogram(
+    "paddle_tpu_serving_request_seconds",
+    "ModelServer per-request latency window (p50/p99), per instance",
+    labels=("instance",), span_name="serving/request", span_kind="rpc")
 
 MODEL_KINDS = ("feedforward", "generative")
 
@@ -154,7 +162,8 @@ class ModelServer:
                 max_delay_ms=max_delay_ms, capacity=queue_capacity)
         else:
             self.batcher = None
-        self.latency = LatencyWindow(name="serving/request", kind="rpc")
+        self.obs_instance = next_instance("server")
+        self.latency = _M_REQUEST_SECONDS.labels(instance=self.obs_instance)
         self._rpc = RpcServer(_ServingHandler(self), address,
                               fault_plan=fault_plan)
         self._serving = False
@@ -290,7 +299,7 @@ class ModelServer:
                "queue_depth": 0}
         if self.batcher is not None:
             out["queue_depth"] = self.batcher.stats()["queue_depth"]
-        return out
+        return json_safe(out)
 
     def stats(self):
         out = {"engine": self._current_engine().stats(),
@@ -301,7 +310,7 @@ class ModelServer:
                "reloads": self._reloads}
         if self.batcher is not None:
             out["batcher"] = self.batcher.stats()
-        return out
+        return json_safe(out)
 
     # ------------------------------------------------------------------
     def shutdown(self, drain=True, timeout=30.0):

@@ -1,0 +1,609 @@
+"""Unified observability plane: the process-wide metrics registry (every
+legacy ``stats()`` dict is now derived from it), cross-process trace-id
+propagation through the RPC header (both codecs, legacy peers served
+unchanged), the built-in ``metrics`` scrape surface +
+``tools/metrics_dump.py``, chrome-trace stitching via
+``tools/merge_traces.py``, the executor ``obs_op_metrics`` hooks (which
+must never retrace), and the ``check_metrics_doc`` README ratchet.
+"""
+
+import json
+import multiprocessing as mp
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import obs
+from paddle_tpu.core import profiler as prof
+from paddle_tpu.core.profiler import LatencyWindow
+from paddle_tpu.distributed import rpc as rpcmod
+from paddle_tpu.distributed.param_server import ParamClient, serve
+from paddle_tpu.distributed.rpc import RpcClient, RpcServer
+from paddle_tpu.obs import metrics as obsm
+from paddle_tpu.serving import DynamicBatcher, InferClient, InferenceEngine, \
+    ModelServer
+from paddle_tpu.serving.generate.kvcache import PagedKVCache
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+def _export_model(tmp_path, dim=6, hidden=8, classes=3, seed=0, n=16):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[dim])
+        h = fluid.layers.fc(input=x, size=hidden, act="relu")
+        y = fluid.layers.fc(input=h, size=classes, act="softmax")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [y], exe, main, scope=scope)
+    rng = np.random.RandomState(seed)
+    xs = rng.normal(0, 1, (n, dim)).astype("float32")
+    want = exe.run(main, feed={"x": xs}, fetch_list=[y], scope=scope)[0]
+    return d, xs, want
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_registry_counter_gauge_histogram_semantics():
+    reg = obsm.MetricsRegistry()
+    c = reg.counter("paddle_tpu_test_hits", "hits", labels=("site",))
+    c.labels(site="a").inc()
+    c.labels(site="a").inc(2)
+    c.labels(site="b").inc()
+    assert c.labels(site="a").value == 3
+    assert c.total() == 4
+    with pytest.raises(ValueError, match=">= 0"):
+        c.labels(site="a").inc(-1)
+    with pytest.raises(ValueError, match="labels"):
+        c.labels(wrong="x")
+
+    g = reg.gauge("paddle_tpu_test_depth")
+    g.child().set(5)
+    g.child().dec(2)
+    assert g.child().value == 3
+
+    h = reg.histogram("paddle_tpu_test_seconds", window=8)
+    for v in (0.001, 0.002, 0.003):
+        h.child().observe(v)
+    snap = h.child().snapshot()
+    assert snap["count"] == 3 and snap["p99_ms"] >= snap["p50_ms"] > 0
+
+    # re-registering the same (type, labels) returns the SAME family;
+    # any mismatch is the naming drift this plane exists to kill
+    assert reg.counter("paddle_tpu_test_hits", labels=("site",)) is c
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("paddle_tpu_test_hits", labels=("site",))
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("paddle_tpu_test_hits", labels=("other",))
+    with pytest.raises(ValueError, match="snake_case"):
+        reg.counter("Not-A-Name")
+
+    snap = reg.snapshot()
+    assert snap["paddle_tpu_test_hits"]["type"] == "counter"
+    assert snap["paddle_tpu_test_hits"]["values"][0]["labels"] == \
+        {"site": "a"}
+    json.dumps(obsm.json_safe(snap))
+    totals = reg.totals()
+    assert totals["paddle_tpu_test_hits"] == 4
+    assert totals["paddle_tpu_test_seconds"] == 3   # histogram: obs count
+
+
+def test_merge_snapshots_and_prometheus_text():
+    reg1, reg2 = obsm.MetricsRegistry(), obsm.MetricsRegistry()
+    for reg, n in ((reg1, 2), (reg2, 5)):
+        reg.counter("paddle_tpu_test_reqs", "rq",
+                    labels=("i",)).labels(i="x").inc(n)
+        h = reg.histogram("paddle_tpu_test_lat", window=8)
+        h.child().observe(0.001 * n)
+    merged = obsm.merge_snapshots(
+        [reg1.snapshot(), None, reg2.snapshot()])     # None = unreachable
+    (val,) = merged["paddle_tpu_test_reqs"]["values"]
+    assert val["value"] == 7                          # counters SUM
+    (lat,) = merged["paddle_tpu_test_lat"]["values"]
+    assert lat["count"] == 2
+    assert lat["p99_ms"] == pytest.approx(5.0)        # conservative max
+
+    txt = obsm.prometheus_text(merged)
+    assert "# TYPE paddle_tpu_test_reqs counter" in txt
+    assert 'paddle_tpu_test_reqs{i="x"} 7' in txt
+    assert "# TYPE paddle_tpu_test_lat summary" in txt
+    assert "paddle_tpu_test_lat_count 2" in txt
+    assert 'quantile="0.99"' in txt
+
+
+def test_json_safe_coerces_numpy_and_exotics():
+    nasty = {
+        np.int64(3): np.float32(1.5),
+        "arr": np.arange(4, dtype=np.int32).reshape(2, 2),
+        "b": np.bool_(True),
+        "t": (np.int16(1), [np.float64(2.0)]),
+        "s": {np.str_("x")},
+        "bytes": b"ok",
+        "err": ValueError("boom"),
+        "none": None,
+    }
+    safe = obs.json_safe(nasty)
+    out = json.loads(json.dumps(safe))
+    assert out["arr"] == [[0, 1], [2, 3]]
+    assert out["b"] is True and out["3"] == 1.5   # json stringifies keys
+    assert safe[3] == 1.5                         # ...but json_safe kept int
+    assert out["t"] == [1, [2.0]]
+    assert out["s"] == ["x"]
+    assert out["bytes"] == "ok"
+    assert "boom" in out["err"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: LatencyWindow under concurrent writers
+# ---------------------------------------------------------------------------
+
+def test_latency_window_concurrent_hammer():
+    """8 writers hammering a capacity-64 ring through wraparound: no
+    sample lost or duplicated (count is exact), the window stays at
+    capacity, and concurrent snapshots never see torn state."""
+    win = LatencyWindow(capacity=64)
+    N, T = 500, 8
+    stop = threading.Event()
+    snap_errs = []
+
+    def write():
+        for i in range(N):
+            win.record(0.001 + (i % 7) * 1e-4)
+
+    def snap():
+        while not stop.is_set():
+            s = win.snapshot()
+            try:
+                assert 0 <= s["window"] <= 64
+                assert s["p99_ms"] >= s["p50_ms"] >= 0.0
+            except AssertionError as e:
+                snap_errs.append(e)
+
+    ts = [threading.Thread(target=write) for _ in range(T)]
+    reader = threading.Thread(target=snap)
+    reader.start()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    stop.set()
+    reader.join()
+    assert not snap_errs
+    s = win.snapshot()
+    assert s["count"] == N * T        # every record landed exactly once
+    assert s["window"] == 64          # ring stayed at capacity
+    assert win.count == N * T
+
+
+# ---------------------------------------------------------------------------
+# trace-id propagation across the wire
+# ---------------------------------------------------------------------------
+
+class _Echo:
+    def ping(self):
+        return {"tid": prof.current_trace_id()}
+
+
+@pytest.mark.parametrize("wire", ["framed", "pickle"])
+def test_trace_id_reaches_server_side_profiler_spans(wire):
+    """A client-generated trace id must appear in SERVER-side profiler
+    events (the rpc.serve span runs under the restored contextvar) for
+    both codecs."""
+    srv = RpcServer(_Echo(), ("127.0.0.1", 0))
+    srv.serve_in_thread()
+    c = RpcClient(srv.address, wire=wire)
+    try:
+        prof.enable_profiler()
+        with prof.trace_context() as tid:
+            out = c.call("ping")
+        evs = prof.events()
+    finally:
+        prof.disable_profiler()
+        c.close()
+        srv.shutdown()
+    assert out["tid"] == tid          # handler saw the propagated id
+    server_spans = [e for e in evs if e[1] == "rpc.serve/ping"]
+    client_spans = [e for e in evs if e[1] == "rpc.client/ping"]
+    assert server_spans and client_spans
+    assert server_spans[0][5] == tid  # (kind, name, t0, t1, os_tid, trace)
+    assert client_spans[0][5] == tid
+
+
+@pytest.mark.parametrize("wire", ["framed", "pickle"])
+def test_legacy_header_without_trace_field_round_trips(wire):
+    """A legacy peer sends the old 2-tuple ``(method, kwargs)`` — the
+    server must serve it unchanged (no trace id bound)."""
+    srv = RpcServer(_Echo(), ("127.0.0.1", 0))
+    srv.serve_in_thread()
+    s = socket.create_connection(srv.address, timeout=10.0)
+    try:
+        rpcmod._client_handshake(s)
+        rpcmod.send_msg(s, ("ping", {}), wire)       # legacy header
+        resp, _n, _wire = rpcmod.recv_msg(s)
+        ok, payload = resp
+        assert ok is True
+        assert payload == {"tid": None}
+    finally:
+        s.close()
+        srv.shutdown()
+
+
+def test_param_client_fanout_shares_one_trace_id():
+    """One push/pull fan-out = ONE trace id across every shard (the
+    per-shard pool threads run under a copied context)."""
+    servers = []
+    try:
+        for _ in range(2):
+            ps, rpc = serve(optimizer="sgd", opt_kwargs={"lr": 0.1},
+                            mode="async")
+            rpc.serve_in_thread()
+            servers.append((ps, rpc))
+        pc = ParamClient([rpc.address for _ps, rpc in servers])
+        pc.init_params({"w_a": np.zeros((2, 2), np.float32),
+                        "w_b": np.ones((2, 2), np.float32)})
+        prof.enable_profiler()
+        try:
+            pc.push({"w_a": np.ones((2, 2), np.float32),
+                     "w_b": np.ones((2, 2), np.float32)})
+            pc.pull()
+            evs = prof.events()
+        finally:
+            prof.disable_profiler()
+        push_ids = {e[5] for e in evs if e[1] == "rpc.serve/push"}
+        pull_ids = {e[5] for e in evs if e[1] == "rpc.serve/pull"}
+        assert len(push_ids) == 1 and None not in push_ids
+        assert len(pull_ids) == 1 and None not in pull_ids
+        assert push_ids != pull_ids   # separate fan-outs, separate traces
+        pc.close()
+    finally:
+        for _ps, rpc in servers:
+            rpc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# cross-process trace stitching (tools/merge_traces.py)
+# ---------------------------------------------------------------------------
+
+def _trace_server_main(addr_file, trace_file):
+    import json as _json
+    import threading as _threading
+
+    from paddle_tpu.core import profiler as _prof
+    from paddle_tpu.distributed.rpc import RpcServer as _RpcServer
+
+    done = _threading.Event()
+
+    class H:
+        def ping(self):
+            with _prof.record_event("server/work", kind="stage"):
+                return {"tid": _prof.current_trace_id()}
+
+        def export(self):
+            _prof.disable_profiler()
+            _prof.export_chrome_tracing(trace_file)
+            done.set()
+            return trace_file
+
+    _prof.enable_profiler()
+    srv = _RpcServer(H(), ("127.0.0.1", 0))
+    srv.serve_in_thread()
+    with open(addr_file, "w") as f:
+        _json.dump(list(srv.address), f)
+    done.wait(180)
+    srv.shutdown()
+
+
+def test_merge_traces_stitches_one_request_across_processes(tmp_path):
+    """A client call into a SEPARATE server process leaves two chrome
+    trace files; merge_traces aligns their wall-clock epochs onto one
+    timeline and links the spans sharing the trace id into one connected
+    track (flow events)."""
+    addr_file = str(tmp_path / "addr.json")
+    server_trace = str(tmp_path / "server.json")
+    client_trace = str(tmp_path / "client.json")
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_trace_server_main,
+                    args=(addr_file, server_trace), daemon=True)
+    p.start()
+    try:
+        deadline = time.monotonic() + 180.0
+        while not os.path.exists(addr_file):
+            assert time.monotonic() < deadline, "server never bound"
+            assert p.is_alive(), "server process died during startup"
+            time.sleep(0.1)
+        with open(addr_file) as f:
+            addr = tuple(json.load(f))
+        c = RpcClient(addr, timeout=60.0)
+        prof.enable_profiler()
+        try:
+            with prof.trace_context() as tid:
+                out = c.call("ping")
+        finally:
+            prof.disable_profiler()
+        assert out["tid"] == tid
+        prof.export_chrome_tracing(client_trace)
+        c.call("export")
+        c.close()
+        p.join(60.0)
+    finally:
+        if p.is_alive():
+            p.terminate()
+            p.join(10.0)
+
+    out_path = str(tmp_path / "merged.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "merge_traces.py"),
+         "-o", out_path, client_trace, server_trace],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out_path) as f:
+        merged = json.load(f)
+    assert tid in merged["otherData"]["trace_ids"]
+    spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"
+             and (e.get("args") or {}).get("trace_id") == tid]
+    pids = {e["pid"] for e in spans}
+    assert pids == {0, 1}, spans       # both processes contributed spans
+    names = {e["name"] for e in spans}
+    assert "rpc.client/ping" in names and "rpc.serve/ping" in names
+    assert "server/work" in names      # handler-internal span linked too
+    flows = [e for e in merged["traceEvents"]
+             if e.get("ph") in ("s", "t", "f") and e.get("id") == tid]
+    assert [f["ph"] for f in flows][0] == "s"
+    assert [f["ph"] for f in flows][-1] == "f"
+    assert {f["pid"] for f in flows} == {0, 1}   # the connected track
+    # timestamps landed on ONE clock: every span fits a tight window
+    ts = [e["ts"] for e in spans] + [e["ts"] + e.get("dur", 0)
+                                     for e in spans]
+    assert max(ts) - min(ts) < 120e6   # µs — same epoch, not perf_counter
+
+
+# ---------------------------------------------------------------------------
+# scrape surface: builtin metrics RPC == stats(), CLI dump
+# ---------------------------------------------------------------------------
+
+def test_model_server_metrics_rpc_matches_stats_and_cli(tmp_path):
+    d, xs, _want = _export_model(tmp_path)
+    server = ModelServer(d, buckets="1,2,4", max_delay_ms=1.0)
+    server.start()
+    try:
+        with InferClient(server.address) as c:
+            for n in (1, 2, 4):
+                c.infer({"x": xs[:n]})
+            st = c.stats()
+        rc = RpcClient(server.address)
+        try:
+            snap = rc.call("metrics")
+        finally:
+            rc.close()
+
+        # stats() is DERIVED from the registry: the engine's instance
+        # children report the same compiles/hits the dict shape does
+        inst = server.engine.obs_instance
+        for metric, key in (("paddle_tpu_engine_compiles", "compiles"),
+                            ("paddle_tpu_engine_hits", "hits")):
+            got = sum(v["value"] for v in snap[metric]["values"]
+                      if v["labels"]["instance"] == inst)
+            assert got == st["engine"][key], (metric, got, st["engine"])
+        binst = server.batcher.obs_instance
+        got = sum(v["value"]
+                  for v in snap["paddle_tpu_batcher_requests"]["values"]
+                  if v["labels"]["instance"] == binst)
+        assert got == st["batcher"]["requests"] == 3
+        # per-request latency histogram == stats()["latency"]
+        lat = [v for v in
+               snap["paddle_tpu_serving_request_seconds"]["values"]
+               if v["labels"]["instance"] == server.obs_instance]
+        assert lat and lat[0]["count"] == st["latency"]["count"] == 3
+        json.dumps(snap)
+
+        # every stats()/health() surface the server exposes is wire-safe
+        json.dumps(server.stats())
+        json.dumps(server.health())
+        json.dumps(server.engine.stats())
+        json.dumps(server.batcher.stats())
+
+        # the CLI against the LIVE endpoint reports the same counters
+        host, port = server.address
+        r = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "metrics_dump.py"),
+             f"{host}:{port}"],
+            capture_output=True, text=True, timeout=180)
+        assert r.returncode == 0, r.stdout + r.stderr
+        dumped = json.loads(r.stdout)
+        got = sum(v["value"]
+                  for v in dumped["paddle_tpu_engine_compiles"]["values"]
+                  if v["labels"]["instance"] == inst)
+        assert got == st["engine"]["compiles"]
+        r = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "metrics_dump.py"),
+             f"{host}:{port}", "--format", "prom"],
+            capture_output=True, text=True, timeout=180)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "# TYPE paddle_tpu_engine_compiles counter" in r.stdout
+    finally:
+        server.shutdown()
+
+
+def test_wire_method_label_cardinality_is_bounded():
+    """Method names arrive off the wire server-side: past the per-endpoint
+    cap (or for non-identifier names) the registry mirror funnels into
+    "__other__" instead of growing scrape-visible series without bound;
+    the per-endpoint snapshot keeps exact names."""
+    ws = rpcmod.WireStats(role="client")
+    for i in range(ws._METHOD_LABEL_CAP + 10):
+        ws.note(f"m{i}", 1, 1, 0.001)
+    ws.note('x"} 1\nforged 9', 1, 1, 0.001)    # non-identifier name
+    labels = {key for key, _mc in ws._m_methods.items()}
+    assert len(labels) == ws._METHOD_LABEL_CAP + 11   # exact, per endpoint
+    fam = obsm.REGISTRY.get("paddle_tpu_wire_calls")
+    other = fam.labels(role="client", method="__other__")
+    assert other.value >= 11                  # overflow + forged funneled
+    assert len(ws.snapshot()["calls"]) == ws._METHOD_LABEL_CAP + 11
+
+
+def test_prometheus_text_escapes_label_values():
+    snap = {"paddle_tpu_test_esc": {
+        "type": "counter", "help": "", "labels": ["m"],
+        "values": [{"labels": {"m": 'x"} 1\nforged 9'}, "value": 1}]}}
+    txt = obsm.prometheus_text(snap)
+    assert '\\"' in txt and "\\n" in txt
+    # no forged bare line made it through
+    assert not any(line.startswith("forged")
+                   for line in txt.splitlines())
+
+
+def test_more_stats_surfaces_are_json_serializable():
+    cache = PagedKVCache(num_blocks=8, block_size=4, num_layers=1,
+                         num_heads=1, head_dim=4)
+    cache.admit("s1", max_total_len=8)
+    json.dumps(cache.stats())
+    ws = rpcmod.WireStats()
+    ws.note("push", np.int64(100), np.int64(200), 0.001)
+    json.dumps(ws.snapshot())
+    json.dumps(obsm.json_safe(obsm.REGISTRY.snapshot()))
+
+
+def _fork_child_totals(path):
+    import json as _json
+
+    from paddle_tpu.obs import metrics as _m
+    with open(path, "w") as f:
+        _json.dump(_m.REGISTRY.totals(), f)
+
+
+def test_forked_child_registry_starts_from_zero(tmp_path):
+    """A fork-started child (pserver shards, master) must NOT inherit the
+    parent's counter values — its built-in ``metrics`` scrape would
+    report the parent's series frozen at fork time and fleet merges
+    would double-count them (os.register_at_fork reset)."""
+    fam = obsm.REGISTRY.counter("paddle_tpu_test_fork_reset")
+    fam.child().inc(7)
+    # hammer the registry from background threads WHILE forking: a fork
+    # can land while a parent thread holds a counter/registry lock, and
+    # the child's reset hook must replace those locks, never acquire
+    # them (acquiring deadlocked forked supervisor children)
+    stop = threading.Event()
+
+    def hammer():
+        h = obsm.REGISTRY.histogram("paddle_tpu_test_fork_lat", window=16)
+        while not stop.is_set():
+            fam.child().inc()
+            h.child().observe(0.001)
+            obsm.REGISTRY.totals()
+
+    ts = [threading.Thread(target=hammer, daemon=True) for _ in range(2)]
+    for t in ts:
+        t.start()
+    try:
+        for i in range(5):
+            out = str(tmp_path / f"child{i}.json")
+            p = mp.get_context("fork").Process(target=_fork_child_totals,
+                                               args=(out,))
+            p.start()
+            p.join(30)
+            assert p.exitcode == 0, \
+                f"forked child {i} wedged (exitcode {p.exitcode})"
+            with open(out) as f:
+                child = json.load(f)
+            assert child.get("paddle_tpu_test_fork_reset", 0) == 0
+    finally:
+        stop.set()
+        for t in ts:
+            t.join()
+    assert fam.child().value >= 7            # parent untouched by resets
+
+
+# ---------------------------------------------------------------------------
+# executor obs_op_metrics hooks
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _op_metrics_flag():
+    yield
+    fluid.set_flags({"obs_op_metrics": False})
+
+
+def test_executor_op_metrics_count_without_retracing(_op_metrics_flag):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        h = fluid.layers.fc(x, size=3)
+        loss = fluid.layers.mean(h)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": np.ones((2, 4), "float32")}
+    exe.run(main, feed=feed, fetch_list=[loss])   # compile BEFORE metering
+
+    t0 = obsm.REGISTRY.totals()
+    fluid.set_flags({"obs_op_metrics": True})
+    for _ in range(3):
+        exe.run(main, feed=feed, fetch_list=[loss])
+    fluid.set_flags({"obs_op_metrics": False})
+    t1 = obsm.REGISTRY.totals()
+
+    steps = t1["paddle_tpu_executor_steps"] - \
+        t0.get("paddle_tpu_executor_steps", 0)
+    disp = t1["paddle_tpu_executor_op_dispatches"] - \
+        t0.get("paddle_tpu_executor_op_dispatches", 0)
+    assert steps == 3
+    assert disp == 3 * len(main.global_block().ops)
+    # THE pin: flipping the flag + metered steps caused ZERO retraces
+    # (obs_op_metrics is not in the jit key; counting rides the cached
+    # analysis, not the traced function)
+    assert t1.get("paddle_tpu_executor_retraces", 0) == \
+        t0.get("paddle_tpu_executor_retraces", 0)
+
+    # eager mode records real wall time per op type
+    exe2 = fluid.Executor(fluid.CPUPlace(), mode="eager")
+    fluid.set_flags({"obs_op_metrics": True})
+    exe2.run(main, feed=feed, fetch_list=[loss])
+    fluid.set_flags({"obs_op_metrics": False})
+    t2 = obsm.REGISTRY.totals()
+    assert t2["paddle_tpu_executor_op_dispatches"] - \
+        t1["paddle_tpu_executor_op_dispatches"] == \
+        len(main.global_block().ops)
+    assert t2["paddle_tpu_executor_op_seconds"] > \
+        t1.get("paddle_tpu_executor_op_seconds", 0)
+
+    # off again: a run adds nothing
+    exe.run(main, feed=feed, fetch_list=[loss])
+    t3 = obsm.REGISTRY.totals()
+    assert t3["paddle_tpu_executor_steps"] == t2["paddle_tpu_executor_steps"]
+
+
+# ---------------------------------------------------------------------------
+# docs ratchet: tools/check_metrics_doc.py
+# ---------------------------------------------------------------------------
+
+def test_check_metrics_doc_gate_is_green():
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "check_metrics_doc.py")],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "all documented" in r.stdout
+
+
+def test_check_metrics_doc_detects_drift():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_doc", os.path.join(TOOLS, "check_metrics_doc.py"))
+    cmd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cmd)
+    doc = "| `paddle_tpu_engine_compiles` | counter | i | x |\n" \
+          "| `not_a_metric_flag_row` | `False` | y |\n"
+    parsed = cmd.documented_metrics(doc)
+    assert parsed == {"paddle_tpu_engine_compiles"}   # flags rows ignored
+    # a registered name with no row == drift the gate must flag
+    assert "paddle_tpu_engine_hits" not in parsed

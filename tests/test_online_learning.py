@@ -8,6 +8,7 @@ serving replica are SIGKILLed mid-loop, with zero failed infer requests
 and a monotonically advancing served version.
 """
 
+import json
 import os
 import shutil
 import threading
@@ -744,7 +745,11 @@ def test_online_loop_end_to_end_chaos(tmp_path):
         poisoned = 0
         deadline = time.monotonic() + 240.0
         while time.monotonic() < deadline:
-            st = loop.stats()
+            # tight poll: skip the fleet-wide metrics scrape (4 sockets
+            # per call against mid-restart children would throttle the
+            # poll cadence the poison/rollback race depends on); the
+            # final stats() below exercises the full scrape
+            st = loop.stats(fleet_metrics=False)
             served_seen.append(st["served_version"])
             rollouts = st["rollout"]["rollouts"]
             if rollouts >= 1 and not killed:
@@ -788,6 +793,15 @@ def test_online_loop_end_to_end_chaos(tmp_path):
         assert sum(c["restart_count"]
                    for c in st["pserver_children"]) >= 1
         assert sum(c["restart_count"] for c in st["fleet_children"]) >= 1
+        # fleet-wide obs merge rode along: the loop process contributed
+        # its trainer counters, the scraped replicas their engine
+        # counters, and the WHOLE aggregated surface is wire-safe
+        fm = st["metrics"]
+        assert sum(v["value"]
+                   for v in fm["paddle_tpu_online_trainer_steps"]
+                   ["values"]) > 0
+        assert "paddle_tpu_engine_compiles" in fm
+        json.dumps(st)
         # the trainer rode through the shard kill and kept stepping
         assert st["trainer"]["global_step"] > 30
         # freezes kept publishing with lineage: steps strictly advance

@@ -7,6 +7,7 @@ replicas ready, failed-canary rollback, and crash-failover-rejoin under a
 deterministic FaultPlan.
 """
 
+import json
 import os
 import threading
 import time
@@ -462,6 +463,17 @@ def test_fleet_rolling_reload_keeps_n_minus_1_ready_and_rolls_back(
             out = fc.infer({"x": xs[:3]})
             np.testing.assert_allclose(out[0], wantB[:3], rtol=1e-5,
                                        atol=1e-6)
+
+            # fleet-wide obs scrape: every replica answers the built-in
+            # ``metrics`` RPC, and the merged view carries at least the
+            # per-replica engine compile counts replica_stats reported
+            fm = sup.fleet_metrics()
+            assert all(s is not None for s in fm["replicas"].values())
+            eng = fm["merged"]["paddle_tpu_engine_compiles"]
+            merged_compiles = sum(v["value"] for v in eng["values"])
+            assert merged_compiles >= sum(st["engine"]["compiles"]
+                                          for st in stats.values())
+            json.dumps(fm)     # the whole scrape is wire-safe
 
             # ---- failed canary: corrupt v3 rolls back, fleet untouched
             bad_src = tmp_path / "bad"

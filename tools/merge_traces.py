@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Stitch per-process chrome traces into ONE cross-process timeline.
+
+``core.profiler.export_chrome_tracing`` writes one trace file per
+process, each with its own ``perf_counter`` origin — incomparable across
+processes — but stamped with a wall-clock anchor
+(``otherData.epoch_origin_us``) and, since the obs plane, a ``trace_id``
+on every span recorded under a propagated request id. This tool:
+
+* loads N trace files, gives each its own pid (named after the file or
+  ``--label``), and shifts every timestamp onto the EARLIEST file's
+  epoch so all processes share one clock;
+* emits chrome flow events (``ph`` s/t/f) linking the spans that share a
+  trace id, so a single client infer through the fleet — or one trainer
+  push/apply round across pserver shards — renders as one connected
+  track in chrome://tracing / Perfetto;
+* with ``--trace ID`` keeps only that request's spans (plus metadata).
+
+    python tools/merge_traces.py -o merged.json client.json server.json
+    python tools/merge_traces.py -o one_req.json --trace 3f2a... *.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load(path):
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):          # bare event-array form
+        doc = {"traceEvents": doc}
+    return doc
+
+
+def _epoch_us(doc):
+    return int((doc.get("otherData") or {}).get("epoch_origin_us", 0))
+
+
+def merge_trace_files(paths, labels=None, trace=None):
+    """Merge chrome trace files into one document (returned as a dict).
+
+    ``labels`` names each file's process lane (defaults to the file
+    basename); ``trace`` filters to one trace id. Spans sharing a trace
+    id are linked with flow events across processes."""
+    docs = [_load(p) for p in paths]
+    labels = list(labels or [])
+    while len(labels) < len(paths):
+        p = paths[len(labels)]
+        labels.append(os.path.splitext(os.path.basename(p))[0])
+
+    epochs = [_epoch_us(d) for d in docs]
+    known = [e for e in epochs if e]
+    base = min(known) if known else 0
+
+    events = []
+    by_trace = {}          # trace_id -> [(ts, pid, tid)]
+    for pid, (doc, epoch, label) in enumerate(zip(docs, epochs, labels)):
+        shift = (epoch - base) if epoch else 0
+        events.append({"ph": "M", "pid": pid, "name": "process_name",
+                       "args": {"name": label}})
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") == "M":
+                continue               # per-file metadata replaced above
+            tid = (ev.get("args") or {}).get("trace_id")
+            if trace is not None and tid != trace:
+                continue
+            out = dict(ev)
+            out["pid"] = pid
+            out["ts"] = int(ev.get("ts", 0)) + shift
+            events.append(out)
+            if tid is not None:
+                by_trace.setdefault(tid, []).append(
+                    (out["ts"], pid, out.get("tid", 0)))
+
+    # flow events: one arrow chain per trace id that spans >1 recorded
+    # span — the visible "connected track" (bp:e binds each step to its
+    # enclosing slice)
+    flows = []
+    for tid, points in sorted(by_trace.items()):
+        if len(points) < 2:
+            continue
+        points.sort()
+        for i, (ts, pid, thread) in enumerate(points):
+            ph = "s" if i == 0 else ("f" if i == len(points) - 1 else "t")
+            ev = {"ph": ph, "cat": "trace", "name": f"trace/{tid}",
+                  "id": tid, "pid": pid, "tid": thread, "ts": ts}
+            if ph == "f":
+                ev["bp"] = "e"
+            flows.append(ev)
+
+    return {"traceEvents": events + flows, "displayTimeUnit": "ms",
+            "otherData": {"epoch_origin_us": base,
+                          "merged_from": [str(p) for p in paths],
+                          "trace_ids": sorted(by_trace)}}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("inputs", nargs="+", metavar="trace.json",
+                    help="per-process chrome trace files "
+                         "(core.profiler.export_chrome_tracing output)")
+    ap.add_argument("-o", "--output", required=True,
+                    help="merged chrome trace to write")
+    ap.add_argument("--label", action="append", default=[],
+                    help="process-lane name for the Nth input "
+                         "(repeatable; default: file basename)")
+    ap.add_argument("--trace", default=None,
+                    help="keep only spans carrying this trace id")
+    args = ap.parse_args(argv)
+
+    merged = merge_trace_files(args.inputs, labels=args.label,
+                               trace=args.trace)
+    with open(args.output, "w") as f:
+        json.dump(merged, f)
+    n_spans = sum(1 for e in merged["traceEvents"]
+                  if e.get("ph") not in ("M", "s", "t", "f"))
+    print(f"merge_traces: {len(args.inputs)} files -> {args.output} "
+          f"({n_spans} spans, {len(merged['otherData']['trace_ids'])} "
+          "trace ids linked)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

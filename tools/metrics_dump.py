@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Dump the obs.metrics registry of live paddle_tpu processes.
+
+Every ``RpcServer`` (ModelServer replicas, pserver shards, the decode
+server) answers a built-in ``metrics`` method with a JSON-safe snapshot
+of its process-wide registry; this CLI scrapes one or many of them and
+renders the result:
+
+    python tools/metrics_dump.py 127.0.0.1:7000
+    python tools/metrics_dump.py 127.0.0.1:7000 127.0.0.1:7001 --merged
+    python tools/metrics_dump.py 127.0.0.1:7000 --format prom
+
+``--format json`` (default) prints the snapshot dict (per-address when
+several addresses are given, one merged fleet view with ``--merged``);
+``--format prom`` prints Prometheus text exposition (counters/gauges
+verbatim, histograms as quantile summaries in seconds). Unreachable
+endpoints render as null (json) / are skipped (prom, with a comment), and
+the exit code is 1 when NO endpoint answered.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_address(s):
+    host, _, port = s.rpartition(":")
+    if not host or not port.isdigit():
+        raise argparse.ArgumentTypeError(
+            f"address {s!r} is not host:port")
+    return host, int(port)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("addresses", nargs="+", type=parse_address,
+                    metavar="host:port",
+                    help="RpcServer endpoints to scrape (any paddle_tpu "
+                         "server: ModelServer, pserver shard, ...)")
+    ap.add_argument("--format", choices=("json", "prom"), default="json",
+                    help="output format (default json)")
+    ap.add_argument("--merged", action="store_true",
+                    help="merge all endpoints into one fleet-wide "
+                         "snapshot (counters sum; histogram p50/p99 take "
+                         "the conservative max)")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="per-endpoint scrape timeout, seconds")
+    ap.add_argument("--indent", type=int, default=2,
+                    help="json indent (default 2)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.obs import metrics as m
+
+    scraped = m.scrape(args.addresses, timeout=args.timeout)
+    by_addr = {f"{h}:{p}": snap for (h, p), snap in scraped.items()}
+    reached = [s for s in by_addr.values() if s is not None]
+    if not reached:
+        print("metrics_dump: no endpoint answered", file=sys.stderr)
+        return 1
+
+    merged = len(args.addresses) == 1 or args.merged
+    if args.format == "prom":
+        snap = m.merge_snapshots(reached) if merged else None
+        if snap is not None:
+            sys.stdout.write(m.prometheus_text(snap))
+        else:
+            for addr, s in by_addr.items():
+                if s is None:
+                    sys.stdout.write(f"# {addr}: unreachable\n")
+                    continue
+                sys.stdout.write(f"# ==== {addr} ====\n")
+                sys.stdout.write(m.prometheus_text(s))
+        return 0
+
+    if len(args.addresses) == 1:
+        out = next(iter(by_addr.values()))
+    elif args.merged:
+        out = m.merge_snapshots(reached)
+    else:
+        out = by_addr
+    json.dump(m.json_safe(out), sys.stdout, indent=args.indent or None)
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
