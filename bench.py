@@ -1406,6 +1406,173 @@ def run_generation_serving_lane(n_clients=8, max_seqs=8, vocab=64, emb=128,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_shared_prefix_serving_lane(n_clients=8, max_seqs=8, vocab=64,
+                                   emb=256, heads=4, n_layers=4,
+                                   block_size=16, num_blocks=240,
+                                   max_len=400, prefix_len=368,
+                                   suffix_len=16, gen_len=2,
+                                   requests_per_client=3, repeats=3,
+                                   cache_blocks=None):
+    """TTFT p50/p99 + tokens/sec for the "one system prompt x a million
+    users" traffic shape: every request is a LONG shared prefix
+    (``prefix_len`` tokens — 23 full KV blocks here) plus a short
+    per-user suffix, at ``n_clients`` concurrent GenClient streams.
+
+    Two configs on identical geometry: COLD (prefix cache disabled —
+    every request re-prefills the whole 512-token bucket, the PR-7
+    behavior) vs WARM (``prefix_cache_blocks`` on; one priming request
+    off the clock registers the shared blocks, then every measured
+    request attaches to them and prefills only its 16-token tail through
+    the chunked executable). The win is the prefill work itself —
+    bucket-512 causal attention + FFN vs bucket-16 — which is exactly
+    what collapses at planet scale, so it is measurable on the CPU box
+    (smoke measured 3.5x TTFT p99, 3.7x tokens/sec).
+
+    Interleaved best-of-N windows (cold, warm, cold, warm ...) so a
+    2-core-box scheduling stall can't land on one config only; best run
+    per config = lowest TTFT p99 (the gated headline). Asserted
+    in-lane: zero hot-path recompiles in BOTH configs, every token
+    accounted for, the warm config's prefix-hit counter actually moved,
+    and the >= 2x TTFT p99 gate."""
+    import tempfile
+    import shutil
+    import threading
+
+    from paddle_tpu.core.profiler import percentile
+    from paddle_tpu.serving import ModelServer
+    from paddle_tpu.serving.generate import GenClient
+    from paddle_tpu.testing.models import export_tiny_lm
+
+    tmp = tempfile.mkdtemp(prefix="pdtpu-sharedprefix-")
+    export_tiny_lm(tmp, vocab=vocab, emb=emb, heads=heads,
+                   n_layers=n_layers, max_pos=2 * max_len, seed=13)
+    prefix = [(7 * i) % (vocab - 2) + 1 for i in range(prefix_len)]
+    top_bucket = 8
+    while top_bucket < prefix_len + suffix_len:
+        top_bucket *= 2
+    if cache_blocks is None:
+        # the whole shared chain plus one block of slack
+        cache_blocks = prefix_len // block_size + 1
+
+    def suffix(i, j):
+        return [(3 * i + 5 * j + k) % (vocab - 2) + 1
+                for k in range(suffix_len)]
+
+    total_tokens = n_clients * requests_per_client * gen_len
+
+    def one_config(cached):
+        server = ModelServer(
+            tmp, model_kind="generative",
+            gen_opts=dict(max_seqs=max_seqs, block_size=block_size,
+                          num_blocks=num_blocks, max_len=max_len,
+                          prefill_buckets=(suffix_len, top_bucket),
+                          prefix_cache_blocks=cache_blocks if cached
+                          else 0))
+        server.start()
+        ttft, counts, errs = [], [0] * n_clients, []
+        barrier = threading.Barrier(n_clients + 1)
+        try:
+            if cached:
+                # prime the cache off the clock: ONE request registers
+                # the shared-prefix blocks every measured request attaches
+                with GenClient(server.address) as pc:
+                    assert len(list(pc.generate(
+                        prefix + suffix(97, 97), gen_len))) == gen_len
+                st0 = server.stats()["engine"]["cache"]
+                assert st0["blocks_cached"] >= prefix_len // block_size, \
+                    f"priming registered nothing: {st0}"
+
+            def client(i):
+                c = GenClient(server.address)
+                try:
+                    c.health()
+                    barrier.wait()
+                    for j in range(requests_per_client):
+                        t0 = time.perf_counter()
+                        first, n = None, 0
+                        for tok in c.generate(prefix + suffix(i, j),
+                                              gen_len):
+                            if first is None:
+                                first = time.perf_counter() - t0
+                            n += 1
+                        counts[i] += n
+                        ttft.append(first)
+                except Exception as e:
+                    errs.append((i, e))
+                    try:
+                        barrier.abort()
+                    except Exception:
+                        pass
+                finally:
+                    c.close()
+
+            ts = [threading.Thread(target=client, args=(i,))
+                  for i in range(n_clients)]
+            for t in ts:
+                t.start()
+            try:
+                barrier.wait()
+            except threading.BrokenBarrierError:
+                pass
+            t0 = time.perf_counter()
+            for t in ts:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            st = server.stats()
+        finally:
+            server.shutdown()
+        assert not errs, f"shared-prefix clients failed: {errs[:2]}"
+        assert counts == [requests_per_client * gen_len] * n_clients, \
+            f"token counts {counts}"
+        recompiles = st["engine"]["hot_recompiles"]
+        assert recompiles == 0, \
+            f"hot path recompiled {recompiles}x (cached={cached})"
+        cache = st["engine"]["cache"]
+        if cached:
+            assert cache["prefix_hits"] > 0, \
+                f"warm config never hit the prefix cache: {cache}"
+        return {
+            "tokens_s": total_tokens / elapsed,
+            "ttft_p99_ms": percentile(ttft, 99) * 1e3,
+            "ttft_p50_ms": percentile(ttft, 50) * 1e3,
+            "hot_recompiles": recompiles,
+            "prefix_hits": cache["prefix_hits"],
+            "prefix_misses": cache["prefix_misses"],
+            "prefix_evictions": cache["prefix_evictions"],
+            "blocks_cached": cache["blocks_cached"],
+        }
+
+    try:
+        best = {False: None, True: None}
+
+        def interleave(n):
+            for _ in range(n):
+                for cached in (False, True):
+                    r = one_config(cached)
+                    if (best[cached] is None
+                            or r["ttft_p99_ms"]
+                            < best[cached]["ttft_p99_ms"]):
+                        best[cached] = r
+
+        interleave(repeats)
+        # noisy-host escape hatch: re-interleave (never re-run one side
+        # alone) before judging the 2x gate
+        extra = 0
+        while (best[False]["ttft_p99_ms"]
+               < 2.0 * best[True]["ttft_p99_ms"]) and extra < 3:
+            extra += 1
+            interleave(1)
+        speedup = best[False]["ttft_p99_ms"] / best[True]["ttft_p99_ms"]
+        assert speedup >= 2.0, \
+            f"shared-prefix TTFT p99 speedup {speedup:.2f}x < 2x gate " \
+            f"(cold {best[False]['ttft_p99_ms']:.1f} ms, warm " \
+            f"{best[True]['ttft_p99_ms']:.1f} ms)"
+        return {"cold": best[False], "warm": best[True],
+                "ttft_p99_speedup": speedup}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _best_of(run_fn, label, repeats, **kw):
     """Best-of-N jnp and Pallas timings for one RNN lane; the shared dev
     chip shows large run-to-run variance (8.7..14.4 ms for the identical
@@ -1587,6 +1754,32 @@ def main():
         "decode_steps_static": gen["static"]["steps"],
         # asserted zero inside the lane, both configs
         "hot_recompiles": gen["continuous"]["hot_recompiles"],
+    })))
+
+    # ---- shared-prefix serving lane (prefix-cache KV reuse) ----
+    # smoke runs the lane defaults (368-token shared prefix, 23 cached
+    # blocks); the full run doubles the request count and adds best-of
+    # rounds — same workload shape, tighter percentiles
+    sp_kw = {} if args.smoke \
+        else dict(requests_per_client=6, repeats=4)
+    sp = run_shared_prefix_serving_lane(**sp_kw)
+    print(json.dumps(_rec({
+        "metric": "shared_prefix_serving" + ("_smoke" if args.smoke else ""),
+        "value": round(sp["warm"]["ttft_p99_ms"], 2),
+        "unit": "ms TTFT p99, 8 GenClient streams sharing a 368-token "
+                "system prompt, prefix cache warm (gate: >= 2x better "
+                "than cold prefill, asserted in-lane)",
+        # higher-is-better cold/warm TTFT p99 ratio — the lane's gate
+        "vs_baseline": round(sp["ttft_p99_speedup"], 3),
+        "ttft_p99_ms_cold": round(sp["cold"]["ttft_p99_ms"], 2),
+        "ttft_p50_ms_warm": round(sp["warm"]["ttft_p50_ms"], 2),
+        "ttft_p50_ms_cold": round(sp["cold"]["ttft_p50_ms"], 2),
+        "tokens_s_warm": round(sp["warm"]["tokens_s"], 1),
+        "tokens_s_cold": round(sp["cold"]["tokens_s"], 1),
+        "prefix_hits": sp["warm"]["prefix_hits"],
+        "blocks_cached": sp["warm"]["blocks_cached"],
+        # asserted zero inside the lane, both configs
+        "hot_recompiles": sp["warm"]["hot_recompiles"],
     })))
 
     # ---- fused-kernel microbench lane (Pallas kernel tier milestone) ----

@@ -241,6 +241,31 @@ DEFINE_flag("serving_kv_num_blocks", 256,
             "blocks, admission rejects typed with CacheExhausted and the "
             "scheduler keeps it queued")
 
+DEFINE_flag("serving_prefix_cache_blocks", 0,
+            "budget of refcount-0 KV blocks the paged arena RETAINS as a "
+            "shared-prefix cache instead of recycling eagerly "
+            "(serving/generate/kvcache.py): full prompt-prefix blocks are "
+            "content-hash-chained at prefill, a new request whose prompt "
+            "starts with a cached chain attaches to those blocks "
+            "(refcount sharing, copy-on-write protected) and prefills "
+            "only its uncached tail. Evicted least-recently-used when "
+            "the pool exceeds this budget or admission needs the blocks; "
+            "blocks a live sequence holds (refcount > 0) are never "
+            "eviction candidates. 0 (default) disables retention — "
+            "release recycles eagerly, the pre-cache behavior. Host-side "
+            "only: flipping it never retraces")
+
+DEFINE_flag("serving_prefill_chunk", 0,
+            "when > 0, a prompt's uncached prefill runs in chunks of at "
+            "most this many tokens instead of one whole-window dispatch, "
+            "and the generation engine interleaves ONE chunk per decode "
+            "step boundary — a long cold prompt admits without stalling "
+            "in-flight decode streams for its whole prefill. 0 (default) "
+            "keeps single-dispatch prefill. Chunks run through the "
+            "chunked-prefill executable (per prompt bucket, compiled at "
+            "warmup when chunking or the prefix cache is enabled), so "
+            "the hot path stays retrace-free")
+
 DEFINE_flag("serving_max_seqs", 8,
             "decode slots in the generation engine's ONE fixed-shape "
             "[max_seqs, 1] decode executable. Bounds concurrent in-flight "

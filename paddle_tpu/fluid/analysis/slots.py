@@ -86,6 +86,10 @@ _SPECS = {
         {"Q": "1", "K": "1", "V": "1", "KCache": "1", "VCache": "1",
          "SlotMapping": "1"},
         {"Out": "1", "KCacheOut": "1", "VCacheOut": "1"}),
+    "chunked_prefill_attention": (
+        {"Q": "1", "K": "1", "V": "1", "KCache": "1", "VCache": "1",
+         "SlotMapping": "1", "BlockTables": "1", "ChunkStart": "1"},
+        {"Out": "1", "KCacheOut": "1", "VCacheOut": "1"}),
     "paged_attention": (
         {"Q": "1", "K": "1", "V": "1", "KCache": "1", "VCache": "1",
          "SlotMapping": "1", "BlockTables": "1", "ContextLens": "1"},

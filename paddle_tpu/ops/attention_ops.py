@@ -12,12 +12,27 @@ assumes exists above the kernel):
   window, plus a scatter of every position's K/V rows into the arena at
   ``SlotMapping`` (flat ``block*block_size+offset`` slots; out-of-range
   sentinel slots — padding positions — are dropped by the scatter).
+* ``chunked_prefill_attention`` — the PARTIAL prefill: a chunk of the
+  prompt whose earlier positions already live in the arena (a cached
+  shared prefix, or this prompt's previous chunks). The chunk's K/V rows
+  scatter in first, then every chunk query attends over the arena
+  context gathered through the sequence's block table, masked causally
+  at its ABSOLUTE position (``ChunkStart`` + window index) — so the
+  math a tail position sees is element-for-element the full-window
+  causal attention, which is what makes cached-prefix token streams
+  bitwise equal to cold ones.
 * ``paged_attention`` — the fixed-shape ``[max_seqs, 1]`` decode step:
   write the new token's K/V row, then attend its Q against the sequence's
   context gathered THROUGH its block table. Ragged in-flight sequences
   share the one executable: each row sees only its own ``ContextLens``
   prefix, and rows with ``ContextLens == 0`` (inactive slots) write
-  nothing (sentinel slot) and emit zeros.
+  nothing (sentinel slot) and emit zeros. The gather-then-attend form
+  is the jnp twin of the Pallas ragged paged-attention kernel
+  (ops/pallas/paged_attention.py): under a Pallas ``kernel_tier`` the
+  decode step attends straight through the arena with scalar-prefetched
+  block tables instead of materializing the gathered
+  ``[max_seqs, max_ctx]`` context (silent jnp fallback on unsupported
+  shapes, like every kernel in the tier).
 
 Both phase ops are row-independent (no cross-row reductions), which is
 what makes continuous batching BITWISE equal to one-sequence-at-a-time
@@ -123,6 +138,65 @@ def prefill_attention(ctx):
     ctx.set_output("Out", _causal_mha(q, k, v, h))
 
 
+def _gather_context(cache, bt):
+    """Arena rows of every context position a block-table row may see:
+    cache [nb, bs, H, D], bt [b, P] -> [b, P*bs, H, D] ordered by
+    position (table order x in-block offset). Unused table entries
+    gather garbage the caller's mask excludes."""
+    nb, bs = cache.shape[0], cache.shape[1]
+    idx = (bt[:, :, None] * bs
+           + jnp.arange(bs, dtype=jnp.int32)[None, None, :]) \
+        .reshape(bt.shape[0], -1)
+    flat = cache.reshape((nb * bs,) + cache.shape[2:])
+    return flat[idx]
+
+
+@register_op("chunked_prefill_attention")
+def chunked_prefill_attention(ctx):
+    """Partial prefill over a prompt CHUNK whose earlier positions are
+    already in the arena (cached shared prefix and/or previous chunks).
+    Q/K/V are the [b, T, E] chunk window; the chunk's K/V rows scatter in
+    at ``SlotMapping`` first (sentinel = padding, no write), then every
+    window position i attends over the arena context gathered through
+    ``BlockTables``, masked causally at its absolute position
+    ``ChunkStart + i``. ChunkStart == 0 and an empty arena reduce this
+    to full-window causal prefill (the parity anchor)."""
+    q = data_of(ctx.input("Q"))
+    k = data_of(ctx.input("K"))
+    v = data_of(ctx.input("V"))
+    h = int(ctx.attr("num_heads"))
+    kc = data_of(ctx.input("KCache"))
+    vc = data_of(ctx.input("VCache"))
+    bt = data_of(ctx.input("BlockTables")).astype(jnp.int32)   # [b, P]
+    start = data_of(ctx.input("ChunkStart")).astype(jnp.int32) \
+        .reshape(-1)                                           # [b]
+    slots = data_of(ctx.input("SlotMapping")).astype(jnp.int32).reshape(-1)
+
+    kh = _split_heads(k, h).reshape((-1,) + kc.shape[2:])
+    vh = _split_heads(v, h).reshape((-1,) + vc.shape[2:])
+    kc = _scatter_rows(kc, slots, kh)
+    vc = _scatter_rows(vc, slots, vh)
+    ctx.set_output("KCacheOut", kc)
+    ctx.set_output("VCacheOut", vc)
+
+    kctx = _gather_context(kc, bt)                             # [b, C, H, D]
+    vctx = _gather_context(vc, bt)
+    qh = _split_heads(q, h)                                    # [b, T, H, D]
+    d = qh.shape[-1]
+    t = q.shape[1]
+    scores = jnp.einsum("bthd,bchd->bhtc", qh, kctx) * (d ** -0.5)
+    qpos = start[:, None] + jnp.arange(t, dtype=jnp.int32)[None]  # [b, T]
+    cpos = jnp.arange(kctx.shape[1], dtype=jnp.int32)
+    # same mask value (-1e9) and softmax form as _causal_mha: a masked
+    # slot contributes exp(-1e9 - max) == 0.0 exactly, so the extra
+    # never-visible arena slots change no real position's output bits
+    visible = cpos[None, None] <= qpos[:, :, None]             # [b, T, C]
+    scores = jnp.where(visible[:, None], scores, -1e9)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhtc,bchd->bthd", p, vctx)
+    ctx.set_output("Out", out.reshape(q.shape))
+
+
 @register_op("paged_attention")
 def paged_attention(ctx):
     """Phase 2 of the serving split: one decode step for every slot of the
@@ -131,7 +205,10 @@ def paged_attention(ctx):
     each row's Q attends over the UPDATED arena gathered through its
     ``BlockTables`` row, masked to its ``ContextLens`` prefix (which counts
     the just-written token). Inactive rows (ContextLens == 0) output
-    zeros."""
+    zeros. Under a Pallas ``kernel_tier`` the attend rides the ragged
+    paged-attention kernel (scalar-prefetched block tables, no gathered
+    context materialized); unsupported shapes fall back to the jnp twin
+    silently with a ``fallback_counts()`` bump."""
     q = data_of(ctx.input("Q"))
     k = data_of(ctx.input("K"))
     v = data_of(ctx.input("V"))
@@ -142,7 +219,6 @@ def paged_attention(ctx):
     ctx_lens = data_of(ctx.input("ContextLens")).astype(jnp.int32)  # [b]
     slots = data_of(ctx.input("SlotMapping")).astype(jnp.int32).reshape(-1)
 
-    nb, bs = kc.shape[0], kc.shape[1]
     kh = _split_heads(k, h).reshape((-1,) + kc.shape[2:])      # [b, H, D]
     vh = _split_heads(v, h).reshape((-1,) + vc.shape[2:])
     kc = _scatter_rows(kc, slots, kh)
@@ -150,25 +226,16 @@ def paged_attention(ctx):
     ctx.set_output("KCacheOut", kc)
     ctx.set_output("VCacheOut", vc)
 
-    b, p = bt.shape
-    # flat arena indices of every context position this row may see:
-    # [b, P, bs] -> [b, C]; unused table entries gather garbage that the
-    # ContextLens mask below excludes from the softmax
-    idx = (bt[:, :, None] * bs
-           + jnp.arange(bs, dtype=jnp.int32)[None, None, :]).reshape(b, -1)
-    kf = kc.reshape((nb * bs,) + kc.shape[2:])
-    vf = vc.reshape((nb * bs,) + vc.shape[2:])
-    kctx = kf[idx]                                             # [b, C, H, D]
-    vctx = vf[idx]
+    from .pallas import kernel_span, use_pallas
+    from .pallas import paged_attention as pa
+
     qh = _split_heads(q, h)[:, 0]                              # [b, H, D]
-    d = qh.shape[-1]
-    scores = jnp.einsum("bhd,bchd->bhc", qh, kctx) * (d ** -0.5)
-    live = jnp.arange(idx.shape[1], dtype=jnp.int32)[None, :] \
-        < ctx_lens[:, None]                                    # [b, C]
-    scores = jnp.where(live[:, None, :], scores, -1e9)
-    # a fully-masked (inactive) row softmaxes to uniform weights over
-    # garbage — finite, never NaN — and is zeroed by the active mask below
-    pw = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhc,bchd->bhd", pw, vctx).reshape(b, 1, -1)
-    active = (ctx_lens > 0)[:, None, None]
-    ctx.set_output("Out", jnp.where(active, out, 0.0))
+    b = bt.shape[0]
+    if use_pallas("paged_attention",
+                  pa.paged_attention_supported(qh, kc, bt)):
+        with kernel_span("pallas", "paged_attention"):
+            out = pa.paged_attention_pallas(qh, kc, vc, bt, ctx_lens)
+    else:
+        with kernel_span("jnp", "paged_attention"):
+            out = pa.paged_attention_jnp(qh, kc, vc, bt, ctx_lens)
+    ctx.set_output("Out", out.reshape(b, 1, -1))

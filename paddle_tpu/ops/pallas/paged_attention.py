@@ -1,0 +1,158 @@
+"""Ragged paged-attention Pallas kernel (the decode step's hot gather).
+
+The jnp lowering of the ``paged_attention`` op materializes every row's
+gathered ``[max_seqs, P*block_size, H, D]`` context before one big
+softmax — HBM traffic proportional to the POSSIBLE context, not the
+actual ragged lengths. This kernel is the *Ragged Paged Attention*
+shape: grid ``(max_seqs, P)``, the block table and per-sequence context
+lengths ride SCALAR PREFETCH so each grid step's index map points the
+K/V BlockSpec straight at the arena block the table names — the kernel
+streams one block at a time through VMEM and accumulates an online
+(flash-style) softmax in scratch, so no gathered context ever
+materializes. Table entries past a sequence's length are skipped
+(``pl.when``), making per-step work proportional to the sequence's REAL
+block count.
+
+Numerics: online softmax re-associates the reduction, so kernel-vs-twin
+parity is the OpTest tolerance contract (like conv_bn), not bitwise —
+bitwise guarantees (continuous-vs-sequential, cached-vs-cold) hold
+WITHIN a tier because both sides of those pins run the same lowering.
+Inactive rows (ctx_len == 0) never enter the accumulation and emit
+zeros, matching the twin's explicit mask.
+
+The twin (:func:`paged_attention_jnp`) is verbatim the pre-tier op body,
+so ``kernel_tier=jnp`` stays bitwise the pre-tier behavior.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+
+from . import on_cpu as _on_cpu
+
+# conservative VMEM budget for one grid step's resident blocks: K + V
+# arena block, Q row, accumulator — well under the ~16 MiB/core v5e VMEM
+_VMEM_BUDGET_BYTES = 4 * 1024 * 1024
+
+
+def paged_attention_supported(qh, kc, bt):
+    """Shape/dtype predicate for the kernel: f32 everywhere (the arena
+    dtype the engine allocates) and one block's K+V resident in VMEM."""
+    if qh.dtype != jnp.float32 or kc.dtype != jnp.float32:
+        return False
+    nb, bs, h, d = kc.shape
+    per_step = 4 * (2 * bs * h * d + 2 * h * d + h * d)
+    return bt.shape[1] >= 1 and per_step <= _VMEM_BUDGET_BYTES
+
+
+def _paged_attn_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
+                       m_ref, l_ref, acc_ref, *, block_size, n_tables):
+    s = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref[...], -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref[...])
+        acc_ref[...] = jnp.zeros_like(acc_ref[...])
+
+    ctx = cl_ref[s]
+    base = p * block_size
+
+    @pl.when(base < ctx)
+    def _attend():
+        q = q_ref[0]                                  # [H, D]
+        k = k_ref[0]                                  # [bs, H, D]
+        v = v_ref[0]
+        scale = q.shape[-1] ** -0.5
+        scores = jnp.einsum("hd,bhd->hb", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        pos = base + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)               # [H, bs]
+        scores = jnp.where(pos < ctx, scores, -jnp.inf)
+        m_prev = m_ref[...]                           # [H, 1]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(scores, axis=1, keepdims=True))
+        # base < ctx guarantees >= 1 unmasked slot, so m_new is finite:
+        # exp(-inf - m_new) == 0.0 for masked slots, and the first
+        # contributing block's correction exp(-inf - m_new) zeroes the
+        # (all-zero) initial accumulator exactly
+        w = jnp.exp(scores - m_new)                   # [H, bs]
+        corr = jnp.exp(m_prev - m_new)                # [H, 1]
+        m_ref[...] = m_new
+        l_ref[...] = corr * l_ref[...] + jnp.sum(w, axis=1, keepdims=True)
+        acc_ref[...] = corr * acc_ref[...] + jnp.einsum(
+            "hb,bhd->hd", w, v, preferred_element_type=jnp.float32)
+
+    @pl.when(p == n_tables - 1)
+    def _finalize():
+        l = l_ref[...]
+        # ctx == 0 rows never attended: l == 0, acc == 0 -> emit zeros
+        o_ref[0] = (acc_ref[...]
+                    / jnp.where(l > 0.0, l, 1.0)).astype(o_ref.dtype)
+
+
+def paged_attention_pallas(qh, kc, vc, bt, ctx_lens):
+    """One decode step's attention for every slot: qh [S, H, D] against
+    the arena kc/vc [nb, bs, H, D] through block tables bt [S, P] and
+    per-sequence ctx_lens [S]. Returns [S, H, D] (zeros for inactive
+    rows). Interpret mode on CPU, like every kernel in the tier."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    s, h, d = qh.shape
+    nb, bs = kc.shape[0], kc.shape[1]
+    p = bt.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, p),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda i, j, bt, cl: (i, 0, 0)),
+            pl.BlockSpec((1, bs, h, d),
+                         lambda i, j, bt, cl: (bt[i, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, h, d),
+                         lambda i, j, bt, cl: (bt[i, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda i, j, bt, cl: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),      # running max
+            pltpu.VMEM((h, 1), jnp.float32),      # running normalizer
+            pltpu.VMEM((h, d), jnp.float32),      # running weighted values
+        ],
+    )
+    kernel = functools.partial(_paged_attn_kernel, block_size=bs,
+                               n_tables=p)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, h, d), qh.dtype),
+        interpret=_on_cpu(),
+    )(bt.astype(jnp.int32), ctx_lens.astype(jnp.int32), qh, kc, vc)
+
+
+def paged_attention_jnp(qh, kc, vc, bt, ctx_lens):
+    """The gather-then-attend twin: verbatim the pre-tier op body
+    (materializes the [S, P*bs, H, D] context, one masked softmax)."""
+    nb, bs = kc.shape[0], kc.shape[1]
+    b = bt.shape[0]
+    idx = (bt[:, :, None] * bs
+           + jnp.arange(bs, dtype=jnp.int32)[None, None, :]).reshape(b, -1)
+    kf = kc.reshape((nb * bs,) + kc.shape[2:])
+    vf = vc.reshape((nb * bs,) + vc.shape[2:])
+    kctx = kf[idx]                                             # [b, C, H, D]
+    vctx = vf[idx]
+    d = qh.shape[-1]
+    scores = jnp.einsum("bhd,bchd->bhc", qh, kctx) * (d ** -0.5)
+    live = jnp.arange(idx.shape[1], dtype=jnp.int32)[None, :] \
+        < ctx_lens[:, None]                                    # [b, C]
+    scores = jnp.where(live[:, None, :], scores, -1e9)
+    # a fully-masked (inactive) row softmaxes to uniform weights over
+    # garbage — finite, never NaN — and is zeroed by the active mask below
+    pw = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhc,bchd->bhd", pw, vctx)
+    active = (ctx_lens > 0)[:, None, None]
+    return jnp.where(active, out, 0.0)

@@ -27,7 +27,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+# this jax ships shard_map under jax.experimental only (the top-level
+# jax.shard_map export landed later); same signature modulo the
+# replication-check kwarg name (check_rep here, check_vma upstream)
+from jax.experimental.shard_map import shard_map
 
 
 def _block_attention(q, k, v, m_prev, l_prev, acc_prev, mask=None):
@@ -109,7 +112,7 @@ def _build_ring_fn(mesh, axis, causal, batch_axis=None):
         return out.astype(qb.dtype)
 
     fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec, check_vma=False)
+                   out_specs=spec, check_rep=False)
     return jax.jit(fn), NamedSharding(mesh, spec)
 
 

@@ -9,9 +9,14 @@ pins, despite every in-flight sequence having a different length.
 
 * :class:`PagedKVCache` (kvcache.py) — the paged KV arena: fixed-size
   blocks, per-sequence block tables, typed :class:`CacheExhausted`
-  admission control, block recycling, copy-on-write beam forks.
+  admission control, block recycling, copy-on-write beam forks, and
+  the SHARED-PREFIX cache (content-hash-chained full prompt blocks,
+  LRU retention under ``serving_prefix_cache_blocks``) that collapses
+  TTFT for the same-system-prompt-times-a-million-users workload.
 * :class:`GenerationEngine` (decode_engine.py) — splits the saved
-  program into a per-bucket PREFILL executable and ONE fixed-shape
+  program into a per-bucket PREFILL executable, a CHUNKED-prefill
+  executable family (cached-prefix tails; ``serving_prefill_chunk``
+  bounded admission chunks interleaved with decode) and ONE fixed-shape
   ``[max_seqs, 1]`` DECODE executable over the arena; greedy / top-k /
   beam (the dense ``beam_search`` op) sampling host-side per sequence.
 * :class:`ContinuousBatcher` (scheduler.py) — sequences join the running

@@ -12,6 +12,17 @@ engine SPLITS that one program into the two serving phases:
   window that also scatters each position's K/V into the paged arena
   (kvcache.py). One executable per prompt-length bucket, compiled at
   :meth:`warmup`.
+* **chunked prefill** — a third clone rewritten to
+  ``chunked_prefill_attention``: a prompt CHUNK attending over arena
+  context that is already there (a cached shared prefix, previous
+  chunks). Built and warmed only when the prefix cache
+  (``serving_prefix_cache_blocks``) or chunking
+  (``serving_prefill_chunk``) is enabled, so disabled engines compile
+  exactly what they did before. A request whose prompt prefix is cached
+  attaches to the cached blocks and prefills only its uncached tail; a
+  long cold prompt (with chunking on) admits immediately and prefills
+  one bounded chunk per :meth:`step` boundary, so in-flight decode
+  streams keep producing tokens while it loads.
 * **decode** — the clone rewritten to ``paged_attention``: a fixed-shape
   ``[max_seqs, 1]`` step over the arena. Ragged in-flight sequences share
   this ONE executable through their block tables and context lengths;
@@ -59,6 +70,7 @@ ATTENTION_OP = "causal_self_attention"
 _SLOTS = "__kv_slots__"
 _TABLES = "__kv_block_tables__"
 _CTXLENS = "__kv_context_lens__"
+_CHUNKSTART = "__kv_chunk_start__"
 
 
 class NoFreeSlots(RuntimeError):
@@ -109,7 +121,8 @@ class _Sequence:
     """One decode slot's state (a beam hypothesis is one of these too)."""
 
     __slots__ = ("seq_id", "slot", "next_token", "emitted", "max_new",
-                 "params", "rng", "group", "finished", "user_data")
+                 "params", "rng", "group", "finished", "user_data",
+                 "prompt", "pending", "prefilling")
 
     def __init__(self, seq_id, slot, params, max_new):
         self.seq_id = seq_id
@@ -122,6 +135,9 @@ class _Sequence:
         self.group = None          # set for beam hypotheses
         self.finished = False
         self.user_data = None      # scheduler's stream handle
+        self.prompt = None         # full prompt (prefix registration)
+        self.pending = None        # prompt tail still to chunk-prefill
+        self.prefilling = False    # occupies a slot but must not decode
 
 
 class _BeamGroup:
@@ -129,7 +145,7 @@ class _BeamGroup:
 
     __slots__ = ("seqs", "pre_ids", "pre_scores", "hist_ids",
                  "hist_parents", "steps", "max_new", "end_id", "finished",
-                 "user_data")
+                 "user_data", "prompt", "pending", "prefilling")
 
     def __init__(self, seqs, max_new, end_id):
         self.seqs = seqs
@@ -143,6 +159,9 @@ class _BeamGroup:
         self.steps = 0
         self.finished = False
         self.user_data = None
+        self.prompt = None
+        self.pending = None        # lead hypothesis's unprefilled tail
+        self.prefilling = False
 
 
 class GenerationEngine:
@@ -159,7 +178,8 @@ class GenerationEngine:
     def __init__(self, model_dir=None, program=None, feed_names=None,
                  fetch_vars=None, executor=None, scope=None, max_seqs=None,
                  block_size=None, num_blocks=None, max_len=128,
-                 prefill_buckets=None):
+                 prefill_buckets=None, prefix_cache_blocks=None,
+                 prefill_chunk=None):
         import paddle_tpu.fluid as fluid
 
         self._scope = scope or Scope()
@@ -196,10 +216,22 @@ class GenerationEngine:
         self.num_layers = layers
         self.cache = PagedKVCache(layers, heads, head_dim,
                                   num_blocks=num_blocks,
-                                  block_size=block_size)
+                                  block_size=block_size,
+                                  prefix_cache_blocks=prefix_cache_blocks)
+        self.prefill_chunk = int(prefill_chunk if prefill_chunk is not None
+                                 else get_flag("serving_prefill_chunk"))
         self._table_width = self.cache.blocks_for(self.max_len)
         self._prefill_program = self._rewrite(program, "prefill_attention")
         self._decode_program = self._rewrite(program, "paged_attention")
+        # the chunked-prefill executable family exists only when a
+        # partial prefill can happen (cached-prefix tails, chunked
+        # admission) — disabled engines compile exactly what they always
+        # did, and warmup cost doesn't grow for them
+        self._partial_enabled = (self.cache.prefix_cache_blocks > 0
+                                 or self.prefill_chunk > 0)
+        self._chunk_program = (
+            self._rewrite(program, "chunked_prefill_attention")
+            if self._partial_enabled else None)
         if prefill_buckets is None:
             b, buckets = 8, []
             while b < self.max_len:
@@ -211,6 +243,7 @@ class GenerationEngine:
 
         self._slots = [None] * self.max_seqs
         self._groups = []
+        self._prefill_queue = []   # FIFO of handles mid-chunked-prefill
         self._next_seq_id = 0
         self._lock = threading.Lock()
         self._stats_lock = threading.Lock()
@@ -219,7 +252,7 @@ class GenerationEngine:
         # obs.metrics registry under this engine's instance label;
         # stats() derives the historical phases dict from them
         self.obs_instance = next_instance("genengine")
-        self._phase = {"prefill": {}, "decode": {}}
+        self._phase = {"prefill": {}, "chunk": {}, "decode": {}}
         self._m_hot = _M_HOT.labels(instance=self.obs_instance)
         self._warmed = False
         from ...ops.pallas import resolve_tier
@@ -269,6 +302,9 @@ class GenerationEngine:
         if phase_op == "paged_attention":
             _declare(_TABLES, "int32")
             _declare(_CTXLENS, "int32")
+        elif phase_op == "chunked_prefill_attention":
+            _declare(_TABLES, "int32")
+            _declare(_CHUNKSTART, "int32")
         layer = 0
         for i, op in enumerate(block.ops):
             if op.type != ATTENTION_OP:
@@ -285,6 +321,9 @@ class GenerationEngine:
             if phase_op == "paged_attention":
                 inputs["BlockTables"] = [_TABLES]
                 inputs["ContextLens"] = [_CTXLENS]
+            elif phase_op == "chunked_prefill_attention":
+                inputs["BlockTables"] = [_TABLES]
+                inputs["ChunkStart"] = [_CHUNKSTART]
             block.ops[i] = Operator(block, phase_op, inputs, outputs,
                                     dict(op.attrs))
             layer += 1
@@ -362,6 +401,43 @@ class GenerationEngine:
                                 bucket)
         return logits[0, len(prompt) - 1]          # [vocab]
 
+    def _chunk_limit(self):
+        # tails longer than this defer to the chunked pump; with
+        # chunking off nothing defers (a tail never exceeds max_len)
+        return self.prefill_chunk if self.prefill_chunk > 0 else self.max_len
+
+    def _run_chunk(self, seq, chunk, start):
+        """One partial-prefill dispatch: ``chunk`` prompt tokens whose
+        context starts at absolute position ``start`` (everything before
+        them — cached prefix, earlier chunks — is already in the arena).
+        Returns the chunk's last real position's logits."""
+        bucket = self._prefill_bucket(len(chunk))
+        toks = np.zeros((1, bucket, 1), np.int64)
+        toks[0, :len(chunk), 0] = chunk
+        slots = np.full((1, bucket), self.cache.sentinel_slot, np.int32)
+        slots[0, :len(chunk)] = self.cache.append_slots(
+            seq.seq_id, len(chunk))
+        feed = self._arena_feed()
+        feed["tokens"] = toks
+        feed[_SLOTS] = slots
+        feed[_TABLES] = self.cache.block_table(
+            seq.seq_id, self._table_width).reshape(1, -1)
+        feed[_CHUNKSTART] = np.asarray([start], np.int32)
+        if "positions" in self._feed_names:
+            feed["positions"] = (start + np.arange(bucket, dtype=np.int64)) \
+                .reshape(1, bucket, 1)
+        logits = self._dispatch(self._chunk_program, feed, "chunk", bucket)
+        return logits[0, len(chunk) - 1]           # [vocab]
+
+    def _run_tail(self, seq, prompt, cached):
+        """Single-dispatch prefill of the uncached tail: a cold prompt
+        keeps the original full-window prefill path (bitwise the
+        pre-cache behavior); a cached prefix prefills only the tail
+        through the chunked executable."""
+        if cached == 0:
+            return self._run_prefill(seq, prompt)
+        return self._run_chunk(seq, prompt[cached:], cached)
+
     def _run_decode(self):
         S, P = self.max_seqs, self._table_width
         toks = np.zeros((S, 1, 1), np.int64)
@@ -370,7 +446,7 @@ class GenerationEngine:
         ctx = np.zeros(S, np.int32)
         slots = np.full(S, self.cache.sentinel_slot, np.int32)
         for s in self._slots:
-            if s is None or s.finished:
+            if s is None or s.finished or s.prefilling:
                 continue
             j = s.slot
             toks[j, 0, 0] = s.next_token
@@ -413,6 +489,22 @@ class GenerationEngine:
                             .reshape(1, b, 1)
                     self._dispatch(self._prefill_program, feed, "prefill",
                                    b)
+                    if self._partial_enabled:
+                        # warm the chunked-prefill twin of every bucket
+                        # with an inert feed (sentinel slots write
+                        # nothing) so a cached-tail or chunked prefill
+                        # never compiles on the hot path
+                        feed = self._arena_feed()
+                        feed["tokens"] = toks
+                        feed[_SLOTS] = slots
+                        feed[_TABLES] = np.zeros((1, self._table_width),
+                                                 np.int32)
+                        feed[_CHUNKSTART] = np.zeros(1, np.int32)
+                        if "positions" in self._feed_names:
+                            feed["positions"] = np.arange(
+                                b, dtype=np.int64).reshape(1, b, 1)
+                        self._dispatch(self._chunk_program, feed,
+                                       "chunk", b)
             self._warmed = True
             return self._compiles() - before
 
@@ -498,13 +590,26 @@ class GenerationEngine:
                     f"all {self.max_seqs} decode slots are busy")
             slot = free[0]
             seq = self._new_seq(slot, params, max_new)
+            seq.prompt = prompt
             self.cache.admit(seq.seq_id, len(prompt) + max_new)
+            cached = self.cache.attach_prefix(seq.seq_id, prompt) \
+                if self.cache.prefix_cache_blocks > 0 else 0
+            if len(prompt) - cached > self._chunk_limit():
+                # long uncached tail under chunking: admit NOW, prefill
+                # one bounded chunk per step boundary (the in-flight
+                # decode batch keeps stepping in between)
+                seq.pending = list(prompt[cached:])
+                seq.prefilling = True
+                self._slots[slot] = seq
+                self._prefill_queue.append(seq)
+                return seq, [], False
             try:
-                logits = self._run_prefill(seq, prompt)
+                logits = self._run_tail(seq, prompt, cached)
             except Exception:
                 self.cache.release(seq.seq_id)
                 raise
             self._slots[slot] = seq
+            self.cache.register_prefix(seq.seq_id, prompt)
             tok = self._sample(seq, logits)
             toks, finished = self._advance(seq, tok)
             if finished:
@@ -544,12 +649,37 @@ class GenerationEngine:
                 self.cache.release(s.seq_id)
             raise
         group = _BeamGroup(seqs, max_new, params["eos_id"])
+        group.prompt = prompt
+        cached = self.cache.attach_prefix(seqs[0].seq_id, prompt) \
+            if self.cache.prefix_cache_blocks > 0 else 0
+        if len(prompt) - cached > self._chunk_limit():
+            # chunked beam prefill: the lead hypothesis loads the prompt
+            # chunk-by-chunk; siblings fork COW once it completes
+            group.pending = list(prompt[cached:])
+            group.prefilling = True
+            for s in seqs:
+                s.group = group
+                s.prefilling = True
+                self._slots[s.slot] = s
+            self._prefill_queue.append(group)
+            return group, [], False
         try:
-            logits = self._run_prefill(seqs[0], prompt)
+            logits = self._run_tail(seqs[0], prompt, cached)
         except Exception:
             for s in admitted:
                 self.cache.release(s.seq_id)
             raise
+        return self._finish_beam_prefill(group, logits)
+
+    def _finish_beam_prefill(self, group, logits):
+        """Completion of a beam request's (possibly chunked) prefill:
+        register the prefix, fork the sibling hypotheses COW off the
+        prefilled lead, and seed the beam from the prompt logits. A beam
+        stream emits only on completion (the winning hypothesis is
+        unknown until the search ends)."""
+        seqs = group.seqs
+        B = len(seqs)
+        self.cache.register_prefix(seqs[0].seq_id, group.prompt)
         for s in seqs[1:]:
             self.cache.fork(seqs[0].seq_id, s.seq_id)
         logp = _log_softmax(logits.astype(np.float64)).astype(np.float32)
@@ -559,14 +689,14 @@ class GenerationEngine:
         group.hist_ids.append(group.pre_ids.copy())
         group.hist_parents.append(np.arange(B))
         group.steps = 1
+        group.prefilling = False
         for s, t in zip(seqs, group.pre_ids):
             s.group = group
+            s.prefilling = False
             s.next_token = int(t)
             self._slots[s.slot] = s
         self._groups.append(group)
-        # a beam stream emits only on completion (the winning hypothesis
-        # is unknown until the search ends)
-        if group.steps >= max_new or bool(
+        if group.steps >= group.max_new or bool(
                 np.all(group.pre_ids == group.end_id)):
             toks = self._finish_beam(group)
             return group, toks, True
@@ -574,20 +704,24 @@ class GenerationEngine:
 
     # ------------------------------------------------------------------
     def step(self):
-        """One continuous-batching decode step over every active slot:
-        a single fixed-shape dispatch, then per-sequence sampling / one
-        dense ``beam_search`` op call per beam group. Returns a list of
-        ``(handle, new_tokens, finished)`` events (handles are the
-        objects :meth:`start` returned). Finished sequences leave the
-        batch immediately — their slots and blocks are free before the
-        next step."""
+        """One continuous-batching step: advance the FIFO-head chunked
+        prefill by ONE bounded chunk (if any is pending), then one
+        fixed-shape decode dispatch over every active slot, then
+        per-sequence sampling / one dense ``beam_search`` op call per
+        beam group. Returns a list of ``(handle, new_tokens, finished)``
+        events (handles are the objects :meth:`start` returned).
+        Finished sequences leave the batch immediately — their slots and
+        blocks are free before the next step."""
         with self._lock:
-            if self.active_sequences == 0:
-                return []
-            logits = self._run_decode()
             events = []
+            if self._prefill_queue:
+                events.extend(self._pump_prefill_locked())
+            if not any(s is not None and not s.finished
+                       and not s.prefilling for s in self._slots):
+                return events
+            logits = self._run_decode()
             for s in list(self._slots):
-                if s is None or s.group is not None:
+                if s is None or s.group is not None or s.prefilling:
                     continue
                 tok = self._sample(s, logits[s.slot])
                 toks, finished = self._advance(s, tok)
@@ -598,6 +732,30 @@ class GenerationEngine:
             for g in list(self._groups):
                 events.extend(self._beam_step(g, logits))
             return events
+
+    def _pump_prefill_locked(self):
+        """Advance the oldest pending chunked prefill by one chunk; on
+        the LAST chunk the request's first sample happens and it joins
+        the decode batch — the completion event(s) are returned."""
+        handle = self._prefill_queue[0]
+        lead = handle.seqs[0] if isinstance(handle, _BeamGroup) else handle
+        chunk = handle.pending[:self.prefill_chunk]
+        del handle.pending[:len(chunk)]
+        start = self.cache.context_len(lead.seq_id)
+        logits = self._run_chunk(lead, chunk, start)
+        if handle.pending:
+            return []
+        self._prefill_queue.pop(0)
+        if isinstance(handle, _BeamGroup):
+            h, toks, finished = self._finish_beam_prefill(handle, logits)
+            return [(h, toks, finished)]
+        handle.prefilling = False
+        self.cache.register_prefix(handle.seq_id, handle.prompt)
+        tok = self._sample(handle, logits)
+        toks, finished = self._advance(handle, tok)
+        if finished:
+            self._retire(handle)
+        return [(handle, toks, finished)]
 
     def _beam_step(self, group, logits):
         B = len(group.seqs)
@@ -693,8 +851,11 @@ class GenerationEngine:
 
     def abort(self, handle):
         """Cancel an in-flight request (client disconnected): frees its
-        slot(s) and blocks immediately."""
+        slot(s) and blocks immediately (mid-chunked-prefill requests
+        leave the prefill queue too)."""
         with self._lock:
+            if handle in self._prefill_queue:
+                self._prefill_queue.remove(handle)
             if isinstance(handle, _BeamGroup):
                 if not handle.finished:
                     handle.finished = True
@@ -702,7 +863,8 @@ class GenerationEngine:
                         if not s.finished:
                             s.finished = True
                             self._retire(s)
-                    self._groups.remove(handle)
+                    if handle in self._groups:
+                        self._groups.remove(handle)
             elif not handle.finished:
                 handle.finished = True
                 self._retire(handle)
@@ -729,9 +891,11 @@ class GenerationEngine:
             "hot_recompiles": self.hot_recompiles,
             "warmed": self._warmed,
             "active_sequences": self.active_sequences,
+            "prefilling": len(self._prefill_queue),
             "max_seqs": self.max_seqs,
             "blocks_in_use": self.cache.stats()["blocks_in_use"],
             "cache": self.cache.stats(),
+            "prefill_chunk": self.prefill_chunk,
             "kernel_tier": self._kernel_tier,
         })
 

@@ -25,14 +25,32 @@ prompt prefix at the cost of at most one block copy per fork. Beam slots
 are admitted with one block of COW headroom on top of the worst-case
 reservation.
 
+SHARED-PREFIX CACHING (the "same system prompt x a million users"
+workload): every FULL prompt block is content-hash-chained at prefill —
+``h_i = sha1(h_{i-1} || tokens of block i)`` — so a chain hash names a
+whole prefix, not one block's tokens. A new request whose prompt starts
+with a cached chain ATTACHES to those blocks (refcount bump, the same
+sharing the COW fork machinery already protects) and prefills only its
+uncached tail; at least the last prompt token always re-prefills so the
+first sample has logits. Release no longer recycles registered blocks
+eagerly: refcount-0 cached blocks park in an LRU pool (budget =
+``serving_prefix_cache_blocks``; 0 disables retention entirely) and are
+evicted — oldest first, hash unregistered before the block re-enters
+the free list — when the pool overflows or admission needs the block.
+Blocks a live sequence holds (refcount > 0) are never candidates.
+
 The arena arrays themselves (``self.k[l]`` / ``self.v[l]``, jax arrays)
 are written by the phase ops (ops/attention_ops.py) — the engine feeds
 them into the dispatch and stores the functionally-updated arrays back —
 while this class owns all HOST-side accounting (free list, refcounts,
-tables, reservations) plus the device block copies COW requires.
+tables, reservations, the prefix-hash index) plus the device block
+copies COW requires.
 """
 
 from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
 
 import numpy as np
 
@@ -51,6 +69,24 @@ _M_COW = _METRICS.counter(
     "paddle_tpu_kvcache_cow_copies",
     "copy-on-write block copies taken by beam forks, per cache instance",
     labels=("instance",))
+_M_PREFIX_HITS = _METRICS.counter(
+    "paddle_tpu_kvcache_prefix_hits",
+    "prompt blocks attached from the shared-prefix cache instead of "
+    "being re-prefilled, per cache instance", labels=("instance",))
+_M_PREFIX_MISSES = _METRICS.counter(
+    "paddle_tpu_kvcache_prefix_misses",
+    "admissions whose prompt had cacheable full blocks beyond the "
+    "matched chain (the walk stopped on an unregistered hash), per "
+    "cache instance", labels=("instance",))
+_M_PREFIX_EVICTIONS = _METRICS.counter(
+    "paddle_tpu_kvcache_prefix_evictions",
+    "cached prefix blocks evicted (LRU: pool over budget or admission "
+    "pressure), per cache instance", labels=("instance",))
+_M_BLOCKS_CACHED = _METRICS.gauge(
+    "paddle_tpu_kvcache_blocks_cached",
+    "blocks currently registered in the shared-prefix hash index "
+    "(live-referenced + evictable), per cache instance",
+    labels=("instance",))
 
 
 class CacheExhausted(RuntimeError):
@@ -66,7 +102,8 @@ class PagedKVCache:
     ``serving_kv_num_blocks`` flags."""
 
     def __init__(self, num_layers, num_heads, head_dim, num_blocks=None,
-                 block_size=None, dtype=np.float32):
+                 block_size=None, dtype=np.float32,
+                 prefix_cache_blocks=None):
         import jax.numpy as jnp
 
         self.num_layers = int(num_layers)
@@ -92,12 +129,29 @@ class PagedKVCache:
         self._lens = {}          # seq_id -> tokens written
         self._promised = {}      # seq_id -> admission-time block budget
         self._promised_total = 0
+        # ---- shared-prefix cache state ----
+        self.prefix_cache_blocks = int(
+            prefix_cache_blocks if prefix_cache_blocks is not None
+            else get_flag("serving_prefix_cache_blocks"))
+        self._hash_to_block = {}   # chain hash -> registered block id
+        self._block_hash = {}      # registered block id -> chain hash
+        # refcount-0 registered blocks, insertion order = LRU (oldest
+        # first); values unused — OrderedDict for O(1) move/pop
+        self._evictable = OrderedDict()
         # arena accounting in the obs.metrics registry (stats() derives
         # its counters from these children)
         self.obs_instance = next_instance("kvcache")
         self._m_in_use = _M_BLOCKS_IN_USE.labels(instance=self.obs_instance)
         self._m_rejects = _M_REJECTS.labels(instance=self.obs_instance)
         self._m_cow = _M_COW.labels(instance=self.obs_instance)
+        self._m_prefix_hits = _M_PREFIX_HITS.labels(
+            instance=self.obs_instance)
+        self._m_prefix_misses = _M_PREFIX_MISSES.labels(
+            instance=self.obs_instance)
+        self._m_prefix_evictions = _M_PREFIX_EVICTIONS.labels(
+            instance=self.obs_instance)
+        self._m_blocks_cached = _M_BLOCKS_CACHED.labels(
+            instance=self.obs_instance)
 
     # ------------------------------------------------------------------
     @property
@@ -112,8 +166,11 @@ class PagedKVCache:
 
     def available_blocks(self):
         """Free blocks not yet committed to an admitted sequence's worst
-        case — what :meth:`admit` has to offer a new sequence."""
-        return len(self._free) - self._promised_unspent()
+        case — what :meth:`admit` has to offer a new sequence. Cached
+        refcount-0 blocks count: they evict on demand when a draw needs
+        them (a cache entry never blocks an admission)."""
+        return (len(self._free) + len(self._evictable)
+                - self._promised_unspent())
 
     # ------------------------------------------------------------------
     def admit(self, seq_id, max_total_len, cow_headroom=0):
@@ -150,6 +207,11 @@ class PagedKVCache:
         return len(self._tables[seq_id])
 
     def _draw(self, seq_id):
+        if not self._free and self._evictable:
+            # admission pressure: evict the least-recently-used cached
+            # block (refcount 0 by construction — live blocks are never
+            # in the pool) to satisfy the draw
+            self._evict_lru()
         if not self._free:
             self._m_rejects.inc()
             raise CacheExhausted(
@@ -159,6 +221,92 @@ class PagedKVCache:
         self._ref[b] = 1
         self._m_in_use.set(self.num_blocks - len(self._free))
         return b
+
+    # ------------------------------------------------------------------
+    # shared-prefix cache
+    # ------------------------------------------------------------------
+    def _chain_hashes(self, tokens, n_blocks):
+        """Content-hash chain over the first ``n_blocks`` FULL blocks of
+        ``tokens``: hash i commits to every token in blocks 0..i, so a
+        single hash names a whole prefix and a lookup never attaches a
+        block whose left context differs."""
+        hashes, h = [], b""
+        for i in range(n_blocks):
+            blk = tokens[i * self.block_size:(i + 1) * self.block_size]
+            h = hashlib.sha1(
+                h + np.asarray(blk, np.int64).tobytes()).digest()
+            hashes.append(h)
+        return hashes
+
+    def _cacheable_blocks(self, tokens):
+        # full prompt blocks, capped so at least the LAST prompt token
+        # always re-prefills (the first sample needs its logits)
+        return max(0, (len(tokens) - 1) // self.block_size)
+
+    def attach_prefix(self, seq_id, tokens):
+        """Attach the longest cached chain matching ``tokens``'s full
+        prompt blocks to freshly-admitted ``seq_id`` (table must be
+        empty). Returns the attached length in TOKENS — the prefill may
+        skip that many prompt positions. No-op (returns 0) when the
+        cache is disabled or nothing matches."""
+        if self._tables[seq_id] or self._lens[seq_id]:
+            raise ValueError(
+                f"attach_prefix on {seq_id!r} after writes (len="
+                f"{self._lens[seq_id]})")
+        n = self._cacheable_blocks(tokens)
+        if self.prefix_cache_blocks <= 0 or n <= 0:
+            return 0
+        table = self._tables[seq_id]
+        matched = 0
+        for h in self._chain_hashes(tokens, n):
+            b = self._hash_to_block.get(h)
+            if b is None:
+                self._m_prefix_misses.inc()
+                break
+            if self._ref[b] == 0:
+                self._evictable.pop(b)
+            self._ref[b] += 1
+            table.append(b)
+            matched += 1
+            self._m_prefix_hits.inc()
+        self._lens[seq_id] = matched * self.block_size
+        self._m_in_use.set(self.num_blocks - len(self._free))
+        return matched * self.block_size
+
+    def register_prefix(self, seq_id, tokens):
+        """Register ``seq_id``'s full prompt blocks in the hash index
+        once the whole prompt is written (cold and attached blocks
+        alike; already-registered hashes keep their existing block).
+        Returns the number of newly registered blocks."""
+        if self.prefix_cache_blocks <= 0:
+            return 0
+        n = min(self._cacheable_blocks(tokens),
+                self._lens[seq_id] // self.block_size)
+        table = self._tables[seq_id]
+        new = 0
+        for i, h in enumerate(self._chain_hashes(tokens, n)):
+            if h in self._hash_to_block:
+                continue
+            b = table[i]
+            if b in self._block_hash:
+                # COW gave this sequence a private copy of a block that
+                # is itself registered under an earlier chain — never
+                # alias one block to two hashes
+                continue
+            self._hash_to_block[h] = b
+            self._block_hash[b] = h
+            new += 1
+        if new:
+            self._m_blocks_cached.set(len(self._block_hash))
+        return new
+
+    def _evict_lru(self):
+        b, _ = self._evictable.popitem(last=False)
+        h = self._block_hash.pop(b)
+        del self._hash_to_block[h]
+        self._free.append(b)
+        self._m_prefix_evictions.inc()
+        self._m_blocks_cached.set(len(self._block_hash))
 
     # ------------------------------------------------------------------
     def append_slots(self, seq_id, n=1):
@@ -240,16 +388,32 @@ class PagedKVCache:
 
     # ------------------------------------------------------------------
     def _release_blocks(self, blocks):
+        parked = []
         for b in blocks:
             self._ref[b] -= 1
             if self._ref[b] == 0:
-                self._free.append(b)
+                if b in self._block_hash:
+                    parked.append(b)
+                else:
+                    self._free.append(b)
+        # registered prefix blocks park in the LRU pool instead of
+        # recycling — in REVERSE table order, so within one release the
+        # DEEPEST chain block is the eviction-oldest: a chain is only
+        # ever trimmed from its tail (evicting a chain's head would
+        # strand every deeper block unreachable while still caching it)
+        for b in reversed(parked):
+            self._evictable[b] = None
+            self._evictable.move_to_end(b)
+        while len(self._evictable) > self.prefix_cache_blocks:
+            self._evict_lru()
         self._m_in_use.set(self.num_blocks - len(self._free))
 
     def release(self, seq_id):
         """Finish a sequence: recycle its blocks (refcounted) and return
         its reservation. Freed blocks go to the END of the free list, so
-        the next allocation reuses the most-recently-freed block."""
+        the next allocation reuses the most-recently-freed block;
+        registered prefix blocks park in the LRU cache pool instead
+        (see the class docstring)."""
         self._release_blocks(self._tables.pop(seq_id))
         del self._lens[seq_id]
         self._promised_total -= self._promised.pop(seq_id)
@@ -266,6 +430,20 @@ class PagedKVCache:
         (admission, per-sequence budget, and COW-overdraw alike)."""
         return int(self._m_rejects.value)
 
+    @property
+    def prefix_hits(self):
+        """Prompt blocks attached from the prefix cache — derived from
+        the registry counter."""
+        return int(self._m_prefix_hits.value)
+
+    @property
+    def prefix_misses(self):
+        return int(self._m_prefix_misses.value)
+
+    @property
+    def prefix_evictions(self):
+        return int(self._m_prefix_evictions.value)
+
     def stats(self):
         return json_safe({
             "num_blocks": self.num_blocks,
@@ -276,6 +454,12 @@ class PagedKVCache:
             "sequences": len(self._tables),
             "cow_copies": self.cow_copies,
             "exhausted_rejects": self.exhausted_rejects,
+            "prefix_cache_blocks": self.prefix_cache_blocks,
+            "blocks_cached": len(self._block_hash),
+            "blocks_evictable": len(self._evictable),
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "prefix_evictions": self.prefix_evictions,
         })
 
 

@@ -16,7 +16,13 @@ that rejects FAST with the same typed
 :class:`~..batcher.ServerOverloaded` when full. Admission is strict
 FIFO — a head request that doesn't fit (slots or blocks) blocks the
 queue rather than being overtaken, so admission order (and therefore
-the parity-pinned token streams) is deterministic.
+the parity-pinned token streams) is deterministic. Under chunked
+prefill (``serving_prefill_chunk``) a long prompt ADMITS immediately
+(reserving its slot and worst-case blocks, keeping the FIFO contract)
+and its prefill work interleaves with decode: every worker loop turn is
+one ``engine.step()``, which runs at most ONE bounded prefill chunk
+before the decode dispatch, so in-flight streams keep emitting tokens
+while a cold prompt loads.
 
 ``submit`` returns a :class:`TokenStream` — an iterator the caller
 drains as the worker emits tokens (the RPC layer turns it into
@@ -29,6 +35,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from collections import deque
 
 from ...core.flags import get_flag
@@ -62,6 +69,7 @@ class TokenStream:
         self._q = queue.Queue()
         self._closed = False
         self.first_token_s = None      # set by the worker (TTFT probe)
+        self._submit_s = None          # worker stamps TTFT against this
 
     # worker side -------------------------------------------------------
     def _emit(self, tokens):
@@ -163,7 +171,6 @@ class ContinuousBatcher:
         Rejects FAST with :class:`ServerOverloaded` when ``capacity``
         requests already wait (in-flight sequences don't count — they
         are bounded by the engine's slots, not the queue)."""
-        import time
         sampling = normalize_sampling(sampling)   # reject bad specs HERE
         stream = TokenStream(self)
         req = _Pending(list(prompt), int(max_new_tokens), sampling, stream,
@@ -231,7 +238,6 @@ class ContinuousBatcher:
         fills it, then waits for every member to finish."""
         if not self.continuous and self._handles:
             return
-        import time
         while self._pending and not self._closed:
             req = self._pending[0]
             try:
@@ -244,7 +250,13 @@ class ContinuousBatcher:
                 req.stream._finish(e)
                 continue
             self._pending.popleft()
-            req.stream.first_token_s = time.perf_counter() - req.submit_s
+            req.stream._submit_s = req.submit_s
+            # TTFT is stamped at the FIRST ACTUAL token: a beam or
+            # chunked-prefill admission emits nothing yet — its first
+            # token lands later via _route_locked
+            if first:
+                req.stream.first_token_s = \
+                    time.perf_counter() - req.submit_s
             req.stream._emit(first)
             self._n_tokens += len(first)
             if finished:
@@ -260,6 +272,9 @@ class ContinuousBatcher:
             stream = handle.user_data
             if stream is None or stream not in self._handles:
                 continue               # cancelled mid-step
+            if tokens and stream.first_token_s is None \
+                    and stream._submit_s is not None:
+                stream.first_token_s = time.perf_counter() - stream._submit_s
             stream._emit(tokens)
             self._n_tokens += len(tokens)
             if finished:

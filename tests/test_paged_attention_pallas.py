@@ -1,0 +1,164 @@
+"""Pallas ragged paged-attention kernel: interpret-mode parity vs its
+jnp twin (OpTest through the real ``paged_attention`` op under
+``kernel_tier=pallas``), ragged/inactive-row edges, the silent-fallback
+counter pin for unsupported dtypes, and engine-level token parity
+across tiers (zero hot recompiles under the kernel).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.ops.pallas import fallback_counts, reset_fallback_counts
+from paddle_tpu.ops.pallas.paged_attention import (
+    paged_attention_jnp, paged_attention_pallas, paged_attention_supported)
+
+from op_test import OpTest
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture
+def pallas_tier():
+    fluid.set_flags({"kernel_tier": "pallas"})
+    try:
+        yield
+    finally:
+        fluid.set_flags({"kernel_tier": "auto"})
+
+
+def _case(seed=0, s=4, h=2, d=8, nb=8, bs=4, p=2, dtype=np.float32,
+          ctx_lens=(7, 0, 8, 1)):
+    """One decode step's op inputs + twin-computed expected outputs.
+    ctx_lens counts the just-written token, mirroring the engine; row 1
+    is inactive (sentinel slot, ctx 0)."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(seed)
+    e = h * d
+    q = rng.normal(0, 1, (s, 1, e)).astype(dtype)
+    k = rng.normal(0, 1, (s, 1, e)).astype(dtype)
+    v = rng.normal(0, 1, (s, 1, e)).astype(dtype)
+    kc = rng.normal(0, 1, (nb, bs, h, d)).astype(dtype)
+    vc = rng.normal(0, 1, (nb, bs, h, d)).astype(dtype)
+    bt = rng.randint(0, nb, (s, p)).astype(np.int32)
+    cl = np.asarray(ctx_lens, np.int32)
+    sentinel = nb * bs
+    slots = np.where(cl > 0,
+                     bt[np.arange(s), (cl - 1) // bs] * bs + (cl - 1) % bs,
+                     sentinel).astype(np.int32)
+
+    def scatter(cache, rows):
+        flat = cache.reshape(nb * bs, h, d).copy()
+        live = slots < sentinel
+        flat[slots[live]] = rows[live]
+        return flat.reshape(cache.shape)
+
+    kh = k.reshape(s, h, d)
+    vh = v.reshape(s, h, d)
+    kc_out = scatter(kc, kh)
+    vc_out = scatter(vc, vh)
+    out = np.asarray(paged_attention_jnp(
+        jnp.asarray(q.reshape(s, h, d)), jnp.asarray(kc_out),
+        jnp.asarray(vc_out), jnp.asarray(bt),
+        jnp.asarray(cl))).reshape(s, 1, e)
+    inputs = {"Q": q, "K": k, "V": v, "KCache": kc, "VCache": vc,
+              "SlotMapping": slots, "BlockTables": bt, "ContextLens": cl}
+    outputs = {"Out": out, "KCacheOut": kc_out, "VCacheOut": vc_out}
+    return inputs, outputs, h
+
+
+class TestPagedAttentionPallasParity(OpTest):
+    """The acceptance pin: the op under kernel_tier=pallas (interpret
+    mode on CPU) matches the jnp twin's numerics through OpTest, eager
+    AND jit, with NO silent fallback taken."""
+    op_type = "paged_attention"
+
+    def test_output(self, pallas_tier):
+        self.inputs, self.outputs, h = _case()
+        self.attrs = {"num_heads": h}
+        reset_fallback_counts()
+        self.check_output()
+        assert fallback_counts().get("paged_attention", 0) == 0
+
+
+class TestPagedAttentionFallback(OpTest):
+    """A non-f32 arena has no kernel lowering: the dispatch routes
+    SILENTLY to the jnp twin (correct output, counter bumped)."""
+    op_type = "paged_attention"
+
+    def test_fallback(self, pallas_tier):
+        self.inputs, self.outputs, h = _case(dtype=np.float16)
+        self.attrs = {"num_heads": h}
+        reset_fallback_counts()
+        self.check_output(atol=5e-3, rtol=5e-2)
+        assert fallback_counts().get("paged_attention", 0) >= 1
+
+
+def test_kernel_matches_twin_across_ragged_shapes():
+    import jax.numpy as jnp
+    for seed, (s, h, d, nb, bs, p) in enumerate(
+            [(4, 2, 8, 8, 4, 2), (8, 4, 16, 32, 8, 4), (2, 1, 4, 4, 2, 2)]):
+        rng = np.random.RandomState(seed)
+        qh = jnp.asarray(rng.normal(0, 1, (s, h, d)).astype(np.float32))
+        kc = jnp.asarray(rng.normal(0, 1, (nb, bs, h, d)).astype(np.float32))
+        vc = jnp.asarray(rng.normal(0, 1, (nb, bs, h, d)).astype(np.float32))
+        bt = jnp.asarray(rng.randint(0, nb, (s, p)).astype(np.int32))
+        cl = jnp.asarray(rng.randint(0, p * bs + 1, s).astype(np.int32))
+        assert paged_attention_supported(qh, kc, bt)
+        ref = np.asarray(paged_attention_jnp(qh, kc, vc, bt, cl))
+        got = np.asarray(paged_attention_pallas(qh, kc, vc, bt, cl))
+        np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-4,
+                                   err_msg=f"shape case {seed}")
+        # inactive rows emit exact zeros in BOTH lowerings
+        inactive = np.asarray(cl) == 0
+        assert np.all(got[inactive] == 0.0)
+
+
+def test_supported_predicate_edges():
+    import jax.numpy as jnp
+    qh = jnp.zeros((2, 2, 8), jnp.float32)
+    kc = jnp.zeros((4, 4, 2, 8), jnp.float32)
+    bt = jnp.zeros((2, 2), jnp.int32)
+    assert paged_attention_supported(qh, kc, bt)
+    assert not paged_attention_supported(qh.astype(jnp.float16), kc, bt)
+    assert not paged_attention_supported(qh, kc.astype(jnp.bfloat16), bt)
+    huge = jnp.zeros((2, 4096, 32, 128), jnp.float32)
+    assert not paged_attention_supported(qh, huge, bt)
+
+
+def test_engine_tokens_identical_across_tiers(tmp_path):
+    """Greedy decode through the real engine: the pallas tier produces
+    the same token stream as the jnp tier (argmax is robust to the
+    online-softmax reassociation) with zero hot recompiles."""
+    from paddle_tpu.serving import GenerationEngine
+    from paddle_tpu.testing.models import export_tiny_lm
+    d = str(tmp_path / "model")
+    export_tiny_lm(d, vocab=17, emb=8, heads=2, n_layers=2, max_pos=64,
+                   seed=3)
+    kw = dict(max_seqs=4, block_size=4, num_blocks=64, max_len=32,
+              prefill_buckets=(8,))
+
+    def run():
+        eng = GenerationEngine(d, **kw)
+        eng.warmup()
+        h, first, fin = eng.start([1, 2, 3], 8)
+        toks = list(first)
+        while not fin:
+            for hh, ts, f in eng.step():
+                if hh is h:
+                    toks += ts
+                    fin = f
+        assert eng.stats()["hot_recompiles"] == 0
+        return toks, eng.stats()["kernel_tier"]
+
+    jnp_toks, tier0 = run()
+    assert tier0 == "jnp"                          # auto on CPU
+    fluid.set_flags({"kernel_tier": "pallas"})
+    try:
+        reset_fallback_counts()
+        pallas_toks, tier1 = run()
+    finally:
+        fluid.set_flags({"kernel_tier": "auto"})
+    assert tier1 == "pallas"
+    assert pallas_toks == jnp_toks
+    assert fallback_counts().get("paged_attention", 0) == 0

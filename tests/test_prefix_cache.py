@@ -1,0 +1,370 @@
+"""Shared-prefix KV reuse + chunked prefill: the PR-11 contracts.
+
+Cache level: content-hash-chain attach/register accounting, LRU eviction
+order under the ``serving_prefix_cache_blocks`` budget, eviction under
+admission pressure never touching a live sequence's blocks, COW forks
+leaving cached blocks bitwise intact, budget 0 == the pre-cache eager
+recycle. Engine level: THE parity pin — a cached-prefix request's token
+stream is BITWISE the cold stream (greedy, seeded top-k, beam) — plus
+chunked-prefill parity, chunk/decode interleaving (an in-flight decode
+stream keeps producing tokens while a long prompt loads), warmup
+compiling the chunked executable family exactly when a partial prefill
+is possible, and the new obs.metrics families.
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import (ContinuousBatcher, GenerationEngine,
+                                PagedKVCache)
+from paddle_tpu.testing.models import export_tiny_lm
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+VOCAB = 17
+
+
+@pytest.fixture(scope="module")
+def lm_bundle(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("prefixlm") / "model")
+    export_tiny_lm(d, vocab=VOCAB, emb=8, heads=2, n_layers=2, max_pos=64,
+                   seed=3)
+    return d
+
+
+def _engine(d, **kw):
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("prefill_buckets", (8, 16))
+    return GenerationEngine(d, **kw)
+
+
+def _drain(eng, handle, first, finished):
+    toks = list(first)
+    while not finished:
+        for h, ts, f in eng.step():
+            if h is handle:
+                toks += ts
+                finished = f
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# PagedKVCache: attach/register/evict accounting
+# ---------------------------------------------------------------------------
+
+def test_attach_register_hit_miss_accounting():
+    c = PagedKVCache(1, 1, 4, num_blocks=16, block_size=4,
+                     prefix_cache_blocks=8)
+    prompt = list(range(10))             # 2 cacheable full blocks
+    c.admit("a", 12)
+    assert c.attach_prefix("a", prompt) == 0       # cold: nothing cached
+    c.append_slots("a", 10)
+    assert c.register_prefix("a", prompt) == 2
+    a_blocks = list(c._tables["a"][:2])
+    c.release("a")
+    st = c.stats()
+    assert st["blocks_cached"] == 2 and st["blocks_evictable"] == 2
+    # registered blocks parked, NOT recycled to the free list
+    assert st["blocks_in_use"] == 2
+
+    c.admit("b", 12)
+    assert c.attach_prefix("b", prompt) == 8       # 2 blocks x 4 tokens
+    assert c._tables["b"][:2] == a_blocks          # the SAME blocks
+    assert c.context_len("b") == 8
+    assert c.prefix_hits == 2
+    # a different prompt misses (one miss per admission walk)
+    c.admit("d", 12)
+    assert c.attach_prefix("d", [9] * 10) == 0
+    assert c.prefix_misses >= 2                    # a's cold walk + d's
+    # at least the last prompt token always re-prefills: a one-block
+    # prompt whose len == block_size caches nothing
+    c.admit("e", 8)
+    assert c.attach_prefix("e", prompt[:4]) == 0
+
+
+def test_lru_eviction_order_and_budget():
+    c = PagedKVCache(1, 1, 4, num_blocks=16, block_size=4,
+                     prefix_cache_blocks=2)
+    prompts = {n: [n] * 5 for n in (1, 2, 3)}      # 1 cacheable block each
+
+    def prime(n):
+        c.admit(n, 8)
+        cached = c.attach_prefix(n, prompts[n])
+        c.append_slots(n, 5 - cached)
+        c.register_prefix(n, prompts[n])
+        c.release(n)
+
+    prime(1)
+    prime(2)
+    assert c.stats()["blocks_evictable"] == 2      # at budget
+    # touch prefix 1 (attach + release): it becomes most-recently-used
+    c.admit("t", 8)
+    assert c.attach_prefix("t", prompts[1]) == 4
+    c.append_slots("t", 1)
+    c.release("t")
+    prime(3)                                       # over budget: evict LRU
+    assert c.prefix_evictions == 1
+    # prefix 2 (the LRU) was evicted; 1 and 3 survive
+    for n, want in ((1, 4), (3, 4), (2, 0)):
+        c.admit(("probe", n), 8)
+        assert c.attach_prefix(("probe", n), prompts[n]) == want, n
+        c.release(("probe", n))
+
+
+def test_chain_eviction_trims_the_tail_not_the_head():
+    """Budget pressure on a multi-block chain evicts the DEEPEST block:
+    evicting the head would strand every deeper block unreachable (the
+    chain hash walk starts at block 0) while still holding arena."""
+    c = PagedKVCache(1, 1, 4, num_blocks=8, block_size=4,
+                     prefix_cache_blocks=2)
+    prompt = list(range(13))                       # 3 cacheable blocks
+    c.admit("a", 16)
+    c.append_slots("a", 13)
+    assert c.register_prefix("a", prompt) == 3
+    c.release("a")                                 # 3 parked > budget 2
+    assert c.prefix_evictions == 1
+    c.admit("b", 16)
+    # the surviving 2 blocks are the chain HEAD: still attachable
+    assert c.attach_prefix("b", prompt) == 8
+
+
+def test_eviction_under_admission_pressure_never_evicts_live_blocks():
+    import jax.numpy as jnp
+    c = PagedKVCache(1, 1, 4, num_blocks=4, block_size=4,
+                     prefix_cache_blocks=4)
+    # live sequence L holds 2 blocks with distinctive content
+    c.admit("L", 8)
+    slots = c.append_slots("L", 8)
+    rows = np.arange(8 * 4, dtype=np.float32).reshape(8, 1, 4)
+    c.k[0] = c.k[0].reshape(-1, 1, 4).at[slots].set(rows) \
+        .reshape(c.k[0].shape)
+    live_blocks = set(c._tables["L"])
+    before = np.asarray(c.k[0]).copy()
+
+    # cached prefix occupies 1 more block (refcount 0, evictable)
+    prompt = [7] * 5
+    c.admit("p", 8)
+    c.append_slots("p", 5)
+    c.register_prefix("p", prompt)
+    cached_block = c._tables["p"][0]
+    c.release("p")
+    assert c.stats()["blocks_evictable"] == 1
+
+    # admission needs 2 blocks: 1 free + 1 via eviction of the cached
+    # block — NEVER one of L's
+    c.admit("n", 8)
+    got = {int(s) // 4 for s in c.append_slots("n", 8)}
+    assert got.isdisjoint(live_blocks)
+    assert cached_block in got
+    assert c.prefix_evictions == 1
+    # L's content untouched by the whole dance
+    for b in live_blocks:
+        np.testing.assert_array_equal(np.asarray(c.k[0])[b], before[b])
+    # nothing evictable left: a further admission rejects typed
+    from paddle_tpu.serving import CacheExhausted
+    with pytest.raises(CacheExhausted):
+        c.admit("x", 4)
+
+
+def test_cow_fork_leaves_cached_prefix_blocks_bitwise_intact():
+    c = PagedKVCache(1, 2, 4, num_blocks=16, block_size=4,
+                     prefix_cache_blocks=8)
+    prompt = list(range(6))
+    c.admit("p", 8, cow_headroom=1)
+    slots = c.append_slots("p", 6)
+    rows = np.random.RandomState(0).normal(
+        0, 1, (6, 2, 4)).astype(np.float32)
+    c.k[0] = c.k[0].reshape(-1, 2, 4).at[slots].set(rows) \
+        .reshape(c.k[0].shape)
+    c.register_prefix("p", prompt)                 # block 0 cached
+    cached_block = c._tables["p"][0]
+    before = np.asarray(c.k[0]).copy()
+
+    # q attaches the cached block and extends: its first write lands in
+    # a COW copy of the shared TAIL block, never in the cached block
+    c.admit("q", 12, cow_headroom=1)
+    assert c.attach_prefix("q", prompt) == 4
+    c.append_slots("q", 3)                         # positions 4..6
+    assert c._tables["q"][0] == cached_block       # prefix still shared
+    after = np.asarray(c.k[0])
+    np.testing.assert_array_equal(after[cached_block],
+                                  before[cached_block])
+
+    # beam-style fork of q then a write: cached block still bitwise
+    c.admit("r", 12, cow_headroom=1)
+    c.fork("q", "r")
+    r_slot = c.append_slots("r", 1)[0]
+    assert r_slot // 4 != cached_block
+    c.k[0] = c.k[0].reshape(-1, 2, 4).at[r_slot].set(
+        np.full((2, 4), 9.0, np.float32)).reshape(c.k[0].shape)
+    np.testing.assert_array_equal(np.asarray(c.k[0])[cached_block],
+                                  before[cached_block])
+    # releasing everyone leaves the cached block attachable
+    for s in ("p", "q", "r"):
+        c.release(s)
+    c.admit("z", 8)
+    assert c.attach_prefix("z", prompt) == 4
+
+
+def test_budget_zero_is_the_pre_cache_behavior():
+    c = PagedKVCache(1, 1, 4, num_blocks=8, block_size=4,
+                     prefix_cache_blocks=0)
+    prompt = list(range(10))
+    c.admit("a", 12)
+    assert c.attach_prefix("a", prompt) == 0
+    c.append_slots("a", 10)
+    assert c.register_prefix("a", prompt) == 0     # retention disabled
+    c.release("a")
+    st = c.stats()
+    assert st["blocks_in_use"] == 0 and st["blocks_cached"] == 0
+
+
+# ---------------------------------------------------------------------------
+# engine: THE bitwise parity pin + chunked prefill
+# ---------------------------------------------------------------------------
+
+REQUESTS = [
+    (list(range(1, 11)), 5, None),
+    (list(range(1, 11)), 6, {"mode": "topk", "top_k": 4, "seed": 11}),
+    (list(range(1, 11)), 4, {"mode": "beam", "beam_size": 2, "eos_id": 0}),
+]
+
+
+def test_cached_prefix_decode_is_bitwise_equal_to_cold(lm_bundle):
+    """THE acceptance pin: attaching a cached shared prefix changes no
+    request's token stream — greedy, seeded top-k and beam all match a
+    cache-disabled engine bitwise, with zero hot recompiles."""
+    cold = _engine(lm_bundle)
+    cold.warmup()
+    want = [_drain(cold, *cold.start(p, m, s)) for p, m, s in REQUESTS]
+
+    eng = _engine(lm_bundle, prefix_cache_blocks=16)
+    eng.warmup()
+    # first pass runs cold ON the caching engine (fills the cache)...
+    first = [_drain(eng, *eng.start(p, m, s)) for p, m, s in REQUESTS]
+    assert first == want
+    hits0 = eng.cache.prefix_hits
+    # ...second pass attaches the cached prefix and must be bitwise
+    second = [_drain(eng, *eng.start(p, m, s)) for p, m, s in REQUESTS]
+    assert second == want
+    assert eng.cache.prefix_hits > hits0
+    st = eng.stats()
+    assert st["hot_recompiles"] == 0
+    assert st["active_sequences"] == 0
+    assert st["cache"]["blocks_cached"] > 0
+
+
+def test_chunked_prefill_is_bitwise_equal_and_interleaves(lm_bundle):
+    cold = _engine(lm_bundle)
+    cold.warmup()
+    prompt = list(range(1, 11))
+    want = _drain(cold, *cold.start(prompt, 6))
+
+    eng = _engine(lm_bundle, prefill_chunk=4)
+    eng.warmup()
+    # a short request decodes WHILE the long prompt chunk-prefills
+    h_short, first_s, fin_s = eng.start([1, 2], 10)
+    h_long, first_l, fin_l = eng.start(prompt, 6)
+    assert first_l == [] and not fin_l             # admitted, not prefilled
+    assert eng.stats()["prefilling"] == 1
+    toks_short = list(first_s)
+    toks_long = []
+    short_before_long = None
+    while not (fin_s and fin_l):
+        for h, ts, f in eng.step():
+            if h is h_short:
+                toks_short += ts
+                fin_s = f
+            elif h is h_long:
+                if short_before_long is None:
+                    short_before_long = len(toks_short)
+                toks_long += ts
+                fin_l = f
+    # the 10-token tail at chunk 4 = 3 chunked step boundaries the
+    # short sequence decoded through before the long one emitted
+    assert short_before_long is not None and short_before_long >= 3
+    assert toks_long == want
+    assert len(toks_short) == 10
+    assert eng.stats()["hot_recompiles"] == 0
+    assert eng.stats()["active_sequences"] == 0
+
+
+def test_chunked_prefill_through_the_batcher(lm_bundle):
+    eng = _engine(lm_bundle, prefill_chunk=4, prefix_cache_blocks=16)
+    eng.warmup()
+    b = ContinuousBatcher(eng, capacity=8)
+    try:
+        prompt = list(range(1, 11))
+        long1 = b.submit(prompt, 4)
+        shorts = [b.submit([1 + i], 6) for i in range(2)]
+        out1 = list(long1)                         # chunked cold prefill
+        # resubmitted AFTER the first completed: its registered blocks
+        # are attachable now, so this one prefills only the tail
+        long2 = b.submit(prompt, 4)
+        out2 = list(long2)
+        assert out1 == out2 and len(out1) == 4     # cached == cold, again
+        for s in shorts:
+            assert len(list(s)) == 6
+        assert eng.cache.prefix_hits > 0
+    finally:
+        assert b.close()
+    assert eng.stats()["hot_recompiles"] == 0
+
+
+def test_abort_mid_chunked_prefill_frees_everything(lm_bundle):
+    eng = _engine(lm_bundle, prefill_chunk=4)
+    eng.warmup()
+    h, first, fin = eng.start(list(range(1, 11)), 6)
+    assert not fin
+    eng.step()                                     # one chunk in
+    eng.abort(h)
+    st = eng.stats()
+    assert st["active_sequences"] == 0 and st["prefilling"] == 0
+    assert st["blocks_in_use"] == 0
+    # beam flavor
+    h, first, fin = eng.start(list(range(1, 11)), 6,
+                              {"mode": "beam", "beam_size": 2})
+    assert not fin
+    eng.step()
+    eng.abort(h)
+    st = eng.stats()
+    assert st["active_sequences"] == 0 and st["blocks_in_use"] == 0
+
+
+def test_warmup_compiles_partial_family_only_when_enabled(lm_bundle):
+    # disabled: exactly the PR-7 executables (decode + 2 prefill buckets)
+    eng = _engine(lm_bundle)
+    assert eng.warmup() == 3
+    assert eng._chunk_program is None
+    # enabled: + one chunked executable per bucket, still zero hot
+    # recompiles through a cached-tail prefill afterwards
+    eng2 = _engine(lm_bundle, prefix_cache_blocks=16)
+    assert eng2.warmup() == 5
+    prompt = list(range(1, 11))
+    _drain(eng2, *eng2.start(prompt, 4))
+    _drain(eng2, *eng2.start(prompt, 4))           # cached tail dispatch
+    assert eng2.stats()["hot_recompiles"] == 0
+    assert eng2.stats()["phases"]["chunk"]
+
+
+def test_prefix_metrics_families_registered():
+    from paddle_tpu.obs import REGISTRY
+    names = REGISTRY.names()
+    for n in ("paddle_tpu_kvcache_prefix_hits",
+              "paddle_tpu_kvcache_prefix_misses",
+              "paddle_tpu_kvcache_prefix_evictions",
+              "paddle_tpu_kvcache_blocks_cached"):
+        assert n in names, n
+    c = PagedKVCache(1, 1, 4, num_blocks=8, block_size=4,
+                     prefix_cache_blocks=4)
+    prompt = list(range(6))
+    c.admit("a", 8)
+    c.append_slots("a", 6)
+    c.register_prefix("a", prompt)
+    from paddle_tpu.obs.metrics import REGISTRY as R
+    snap = R.snapshot()["paddle_tpu_kvcache_blocks_cached"]["values"]
+    assert any(v["labels"]["instance"] == c.obs_instance
+               and v["value"] == 1 for v in snap)

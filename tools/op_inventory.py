@@ -34,7 +34,7 @@ INFER_SHAPE_EXEMPT = {
     'beam_search_decode', 'bilinear_tensor_product', 'bipartite_match',
     'box_coder', 'cast', 'causal_self_attention',
     'channel_close', 'channel_create', 'channel_recv',
-    'channel_send', 'chunk_eval', 'concat',
+    'channel_send', 'chunk_eval', 'chunked_prefill_attention', 'concat',
     'conditional_block', 'conv3d', 'cos_sim',
     'create_double_buffer_reader', 'create_multi_pass_reader',
     'create_recordio_file_reader',
