@@ -115,7 +115,7 @@ def _rec(d):
     flag, so bench JSON rows are attributable to the lowering tier AND the
     verification mode that produced them."""
     from paddle_tpu.core.flags import get_flag
-    from paddle_tpu.obs import REGISTRY, json_safe
+    from paddle_tpu.obs import REGISTRY, json_safe, recorder, slo
     from paddle_tpu.ops.pallas import resolve_tier
     out = dict(d)
     out.setdefault("kernel_tier", resolve_tier())
@@ -125,6 +125,17 @@ def _rec(d):
     # counter state that produced it (full snapshots are too wide for
     # one-line JSON records)
     out.setdefault("metrics", json_safe(REGISTRY.totals()))
+    # actionable-layer stamp: which recorder/SLO configuration produced
+    # this row (a lane measured with a live SloMonitor + flight ring is
+    # a different row than one without)
+    mon = slo.installed()
+    out.setdefault("obs", json_safe({
+        "slo_rules": len(mon.rules) if mon is not None else 0,
+        "slo_running": bool(mon is not None and mon.running()),
+        "slo_interval_s": float(get_flag("obs_slo_interval_s")),
+        "flight_capacity": int(get_flag("obs_flight_events")),
+        "flight_events": len(recorder.RECORDER.events()),
+    }))
     return out
 
 
@@ -411,9 +422,16 @@ def run_observability_overhead_lane(batch=8, image_size=32, class_dim=10,
     Interleaved best-of-N windows so shared-host scheduler noise cancels;
     asserts ZERO executor retraces across the whole measured phase — the
     flag is not in the jit key, so flipping it and metering steps must
-    never recompile. Gate: overhead < 3%."""
+    never recompile. Gate: overhead < 3%.
+
+    The ON configuration runs the FULL actionable layer: a live
+    SloMonitor (two rules re-evaluated on a tight interval, snapshotting
+    the registry concurrently with the measured steps) and the flight
+    recorder taking events — the <3% gate and the zero-retrace pin must
+    hold with everything on, or the layer is not deployable."""
     import paddle_tpu.fluid as fluid
-    from paddle_tpu.obs import REGISTRY
+    from paddle_tpu.obs import REGISTRY, recorder as obs_recorder
+    from paddle_tpu.obs.slo import SloMonitor
 
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
@@ -445,31 +463,74 @@ def run_observability_overhead_lane(batch=8, image_size=32, class_dim=10,
     def retraces():
         return REGISTRY.totals().get("paddle_tpu_executor_retraces", 0)
 
+    # the ON state's actionable layer: a monitor whose rules exercise
+    # both reducer families (a counter rate and a histogram percentile)
+    # against series this very loop produces, evaluating on a tight
+    # interval so several evaluations land INSIDE each measured window
+    monitor = SloMonitor(
+        [{"name": "bench_step_rate", "objective": 1e9, "reducer": "rate",
+          "metric": "paddle_tpu_executor_steps",
+          "windows": [[0.5, 1.0], [5.0, 1.0]]},
+         {"name": "bench_wire_p99", "objective": 1e6, "reducer": "p99_ms",
+          "metric": "paddle_tpu_wire_call_seconds",
+          "windows": [[5.0, 1.0]]}],
+        interval_s=0.05)
+    monitor.install()
+
+    def set_state(on):
+        fluid.set_flags({"obs_op_metrics": on})
+        if on and not monitor.running():
+            monitor.start()
+        elif not on and monitor.running():
+            monitor.stop()
+
     # compile + warm BOTH flag states before measuring (the second state
     # must not pay first-use counter-child creation inside its window)
-    fluid.set_flags({"obs_op_metrics": False})
+    set_state(False)
     window(warmup)
-    fluid.set_flags({"obs_op_metrics": True})
+    set_state(True)
     window(2)
     r0 = retraces()
 
     best = {False: float("inf"), True: float("inf")}
-    for _ in range(repeats):
+
+    def measure_round():
         for state in (False, True):
-            fluid.set_flags({"obs_op_metrics": state})
+            set_state(state)
             best[state] = min(best[state], window(steps))
+            if state:
+                # the recorder is part of the measured layer: one
+                # lifecycle-shaped event per ON window (the ring is
+                # bounded; event volume in real serving is per-request,
+                # not per-step)
+                obs_recorder.record("bench_window",
+                                    component="observability_overhead",
+                                    steps=steps)
+
+    for _ in range(repeats):
+        measure_round()
     # noisy-host escape hatch: a best-of window can still catch a bad
     # scheduling slice; re-interleave before judging the gate
     while best[True] / best[False] - 1.0 > 0.03 and repeats < 8:
         repeats += 1
-        for state in (False, True):
-            fluid.set_flags({"obs_op_metrics": state})
-            best[state] = min(best[state], window(steps))
-    fluid.set_flags({"obs_op_metrics": False})
+        measure_round()
+    set_state(False)
+    from paddle_tpu.obs import slo as _slo
+    if _slo.installed() is monitor:
+        _slo.install(None)
     r1 = retraces()
 
     assert r1 == r0, \
         f"metering retraced the step function ({r1 - r0} retraces)"
+    slo_evals = monitor.health_section()["evaluations"]
+    assert slo_evals > 0, \
+        "SloMonitor never evaluated during the ON windows — the lane " \
+        "measured nothing of the actionable layer"
+    assert monitor.breach_count() == 0, \
+        f"bench SLO rules breached ({monitor.status()}) — objectives " \
+        "are sized to never fire; the layer misjudged"
+    assert obs_recorder.RECORDER.events(kinds={"bench_window"}), \
+        "flight recorder captured no bench events with the layer on"
     overhead_pct = (best[True] / best[False] - 1.0) * 100.0
     assert overhead_pct < 3.0, \
         f"obs overhead {overhead_pct:.2f}% exceeds the 3% gate " \
@@ -481,6 +542,8 @@ def run_observability_overhead_lane(batch=8, image_size=32, class_dim=10,
         "hot_recompiles": int(r1 - r0),
         "steps_per_window": steps,
         "windows_per_config": repeats,
+        "slo_evaluations": int(slo_evals),
+        "slo_rules": len(monitor.rules),
     }
 
 
@@ -985,7 +1048,17 @@ def run_online_learning_lane(n_clients=4, n_pservers=2, n_replicas=2,
     ``min_rollouts`` served-version advances (monotonic), and both
     killed children supervisor-restarted. The headline number is the
     publish-to-served lag: how fresh the fleet's model is relative to
-    the trainer's stream."""
+    the trainer's stream.
+
+    Actionable-layer assertions (the obs/slo + obs/recorder contract):
+    the SIGKILLs auto-produce an incident bundle holding flight-recorder
+    events from >= 2 distinct processes on one stitched clock with at
+    least one cross-process trace id linked end to end; and two SEEDED
+    SLO breaches (p99 objectives set far below anything measurable —
+    one judged in this process over the FleetClient latency, one judged
+    inside each replica over its serving latency) flip
+    ``paddle_tpu_slo_breaches`` and appear in ``stats()["slo"]`` /
+    replica ``health()["slo"]`` within one evaluation window."""
     import os
     import shutil
     import tempfile
@@ -1015,13 +1088,30 @@ def run_online_learning_lane(n_clients=4, n_pservers=2, n_replicas=2,
             yield {"x": X, "y": X @ w_true}
 
     root = tempfile.mkdtemp(prefix="pdtpu-online-")
+    # SEEDED breaches: objectives far below any real latency, so both
+    # rules burn from the first evaluation — "fleet_p99" judges in THIS
+    # process (the FleetClient latency window lives client-side),
+    # "replica_p99" measures nothing here but breaches inside every
+    # replica (ModelServer installs its own monitor from these rules)
+    slo_rules = [
+        {"name": "fleet_p99", "objective": 1e-4, "reducer": "p99_ms",
+         "metric": "paddle_tpu_fleet_request_seconds",
+         "windows": [[1.0, 1.0]],
+         "description": "seeded: any measured fleet p99 breaches"},
+        {"name": "replica_p99", "objective": 1e-4, "reducer": "p99_ms",
+         "metric": "paddle_tpu_serving_request_seconds",
+         "windows": [[1.0, 1.0]],
+         "description": "seeded: any measured serving p99 breaches"},
+    ]
     loop = OnlineLearningLoop(
         main_p, startup, reader, ["x"], [pred],
         registry_root=os.path.join(root, "registry"), model="lin",
         n_pservers=n_pservers, n_replicas=n_replicas,
         publish_every_steps=publish_every_steps, min_serve_s=min_serve_s,
         rollout_poll_s=0.2, buckets="1,2", max_delay_ms=1.0,
-        checkpoint_dir=os.path.join(root, "ckpt"))
+        checkpoint_dir=os.path.join(root, "ckpt"),
+        slo_rules=slo_rules,
+        incident_dir=os.path.join(root, "incidents"))
     errs = []
     infers = [0]
     lat = []
@@ -1073,6 +1163,12 @@ def run_online_learning_lane(n_clients=4, n_pservers=2, n_replicas=2,
         elapsed = time.perf_counter() - t_traffic
         for t in ts:
             t.join(30.0)
+        # the SIGKILLs fired incident triggers; let the async captures
+        # land before judging the bundles
+        loop.incidents.wait_idle(20.0)
+        deadline = time.monotonic() + 20.0
+        while not loop.incidents.bundles and time.monotonic() < deadline:
+            time.sleep(0.25)
         st = loop.stats()
         assert not errs, f"infer requests failed under chaos: {errs[:3]}"
         assert st["rollout"]["rollouts"] >= min_rollouts, st["rollout"]
@@ -1084,6 +1180,48 @@ def run_online_learning_lane(n_clients=4, n_pservers=2, n_replicas=2,
             "killed pserver shard never restarted"
         assert sum(c["restart_count"] for c in st["fleet_children"]) >= 1, \
             "killed serving replica never restarted"
+
+        # ---- actionable layer: incident bundle auto-produced ----
+        bundles = list(loop.incidents.bundles)
+        assert bundles, "SIGKILLs produced no incident bundle " \
+            f"(incidents: {loop.incidents.stats()})"
+        multi = [b for b in bundles
+                 if len({e["source"] for e in b["events"]}) >= 2]
+        assert multi, \
+            "no incident bundle holds recorder events from >= 2 " \
+            f"processes: {[sorted({e['source'] for e in b['events']}) for b in bundles]}"
+        linked = [b for b in multi if b["linked_traces"]]
+        assert linked, \
+            "no cross-process trace id linked end to end in any bundle"
+        bundle = linked[0]
+        # one stitched clock: every event timestamp is wall-clock within
+        # the lane's own lifetime
+        ts_all = [e["t"] for e in bundle["events"]]
+        assert max(ts_all) - min(ts_all) < 3600, "bundle clock not stitched"
+
+        # ---- actionable layer: seeded SLO breaches ----
+        assert st["slo"] is not None and \
+            st["slo"]["rules"]["fleet_p99"]["breaches"] >= 1, \
+            f"seeded fleet_p99 breach never fired: {st.get('slo')}"
+        # the replica-side rule breached inside a replica and shows in
+        # its health() within one evaluation window
+        rep_health = None
+        for i in range(n_replicas):
+            h = loop.fleet.replica_health(i, timeout=5.0)
+            if h and h.get("slo", {}).get(
+                    "rules", {}).get("replica_p99", {}).get("breaches", 0):
+                rep_health = h
+                break
+        assert rep_health is not None, \
+            "no replica health() reports the seeded replica_p99 breach"
+        # and the breach counters are scrape-visible in the merged
+        # fleet metrics view
+        slo_fam = st["metrics"].get("paddle_tpu_slo_breaches", {})
+        breach_total = sum(v.get("value", 0)
+                           for v in slo_fam.get("values", []))
+        assert breach_total >= 2, \
+            f"paddle_tpu_slo_breaches never flipped fleet-wide: {slo_fam}"
+
         lag = st["rollout"]["publish_to_served"]
         frz = st["freezer"]
         from paddle_tpu.core.profiler import percentile
@@ -1105,6 +1243,11 @@ def run_online_learning_lane(n_clients=4, n_pservers=2, n_replicas=2,
                                  for c in st["pserver_children"]],
             "replica_restarts": [c["restart_count"]
                                  for c in st["fleet_children"]],
+            "incident_bundles": len(bundles),
+            "incident_sources": sorted({e["source"]
+                                        for e in bundle["events"]}),
+            "incident_linked_traces": len(bundle["linked_traces"]),
+            "slo_breaches_fleetwide": int(breach_total),
         }
     finally:
         stop.set()

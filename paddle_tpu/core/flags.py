@@ -346,6 +346,29 @@ DEFINE_flag("obs_metrics_window", 2048,
             "this many recent observations for p50/p99 readout); "
             "families may override per-histogram via window=")
 
+DEFINE_flag("obs_slo_interval_s", 1.0,
+            "evaluation period of a background obs.slo.SloMonitor: how "
+            "often each declared SLO rule is reduced against a registry "
+            "snapshot, its burn rate updated "
+            "(paddle_tpu_slo_burn_rate) and its multi-window breach "
+            "state re-judged. Overridable per monitor via "
+            "SloMonitor(interval_s=)")
+
+DEFINE_flag("obs_flight_events", 2048,
+            "capacity of the per-process flight recorder ring "
+            "(obs.recorder): how many recent structured lifecycle "
+            "events (admissions, evictions, restarts, rollout/canary "
+            "outcomes, retry/failover/spillover decisions, Pallas "
+            "fallbacks) each process retains for the built-in "
+            "flight_dump RPC and incident bundles. Oldest events are "
+            "overwritten (the dropped count is reported in dumps)")
+
+DEFINE_flag("obs_incident_dir", "",
+            "directory obs.recorder.IncidentCollector writes incident "
+            "bundles (one JSON file per trigger: breach / canary_failed "
+            "/ child_restart) into; empty (default) keeps bundles "
+            "in-memory only (IncidentCollector.bundles, bounded)")
+
 # PDTPU_FLAGS=check_nan_inf=1,benchmark=0 — unknown names warn and are
 # ignored (a typo'd env var must not make the package unimportable)
 _env = os.environ.get("PDTPU_FLAGS", "")

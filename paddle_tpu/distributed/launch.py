@@ -28,6 +28,7 @@ ENV_NPROC = "PDTPU_NUM_PROCESSES"
 ENV_RANK = "PDTPU_PROCESS_ID"
 
 from ..obs.metrics import REGISTRY as _METRICS  # noqa: E402
+from ..obs.recorder import record as _flight_record  # noqa: E402
 
 _M_RESTARTS = _METRICS.counter(
     "paddle_tpu_supervisor_restarts",
@@ -196,6 +197,11 @@ class ChildSupervisor:
         self._spawned_at = [0.0] * n_children
         self._procs = [None] * n_children
         self._stop = threading.Event()
+        # incident trigger (obs.recorder.IncidentCollector.trigger or any
+        # callable(reason, detail=)): fired after each child restart so a
+        # crash leaves a fleet-wide flight-recorder bundle behind; None =
+        # record the event locally only
+        self.incident_hook = None
         # gates _spawn against stop(): without it the monitor could respawn
         # a child between stop()'s flag-set and its terminate sweep,
         # leaking a live child process on the fixed port
@@ -279,6 +285,22 @@ class ChildSupervisor:
                     continue
                 self._m_restarts[i].inc()
                 self.last_restart_at[i] = time.time()
+                # flight recorder: a dead child with no WHY is
+                # undebuggable — the restart and its reason land in this
+                # process's ring (and, via incident_hook, trigger a
+                # fleet-wide bundle capture)
+                _flight_record(
+                    "child_restart", component=self.obs_instance,
+                    child=i, address=tuple(self.addresses[i]),
+                    reason=reason, restart_count=self.restarts[i])
+                if self.incident_hook is not None:
+                    try:
+                        self.incident_hook(
+                            "child_restart",
+                            detail={"supervisor": self.obs_instance,
+                                    "child": i, "reason": reason})
+                    except Exception:
+                        pass             # monitoring never kills the monitor
                 try:
                     self._spawn(i)
                 except Exception as e:

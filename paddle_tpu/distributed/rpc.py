@@ -451,6 +451,18 @@ def _builtin_metrics():
     return _m.json_safe(_m.REGISTRY.snapshot())
 
 
+def _builtin_flight_dump():
+    """The built-in ``flight_dump`` RPC (the ``metrics`` twin): this
+    process's flight-recorder ring — what ``tools/dump_flight.py`` and
+    ``obs.recorder.capture_bundle`` scrape into incident bundles."""
+    from ..obs import recorder as _r
+    return _r.RECORDER.dump()
+
+
+_BUILTIN_METHODS = {"metrics": _builtin_metrics,
+                    "flight_dump": _builtin_flight_dump}
+
+
 class RemoteError(RuntimeError):
     """A handler exception surfaced across the wire as a STRUCTURED error:
     ``code`` is the remote exception's type name (machine-checkable — the
@@ -630,12 +642,14 @@ class RpcServer:
                         return
                     t0 = time.perf_counter()
                     try:
-                        if method == "metrics" \
-                                and not hasattr(self._handler, "metrics"):
-                            # built-in scrape surface: every RpcServer
-                            # answers the obs.metrics registry snapshot;
-                            # a handler-defined metrics method wins
-                            fn = _builtin_metrics
+                        if method in _BUILTIN_METHODS \
+                                and not hasattr(self._handler, method):
+                            # built-in scrape surfaces: every RpcServer
+                            # answers the obs.metrics registry snapshot
+                            # (``metrics``) and the flight-recorder ring
+                            # (``flight_dump``); handler-defined methods
+                            # of the same name win
+                            fn = _BUILTIN_METHODS[method]
                         else:
                             fn = getattr(self._handler, method)
                         with record_event(f"rpc.serve/{method}", kind="rpc"):

@@ -15,20 +15,34 @@ pieces:
 * :mod:`.trace` — cross-process trace-id propagation: ids generated at
   client edges, carried in the RPC header, restored server-side, so
   ``tools/merge_traces.py`` can stitch one request across processes.
+* :mod:`.slo` — the ACTIONABLE layer: declarative SLO rules
+  (metric selector, objective, multi-window burn-rate thresholds)
+  evaluated by a background ``SloMonitor`` against registry snapshots or
+  merged fleet views, emitting ``paddle_tpu_slo_*`` series and typed
+  breach findings surfaced through every ``health()``/``stats()``.
+* :mod:`.recorder` — the per-process flight recorder (bounded ring of
+  structured lifecycle events, ``flight_dump`` RPC on every RpcServer)
+  and the ``IncidentCollector`` that snapshots the whole fleet into one
+  incident bundle on breach / canary-fail / child-restart triggers.
 * :func:`~.metrics.json_safe` — the wire-safety coercion every
   ``stats()``/``health()`` payload passes through.
 """
 
-from . import metrics, trace
+from . import metrics, recorder, slo, trace
 from .metrics import (Counter, Gauge, Histogram, REGISTRY, json_safe,
                       merge_snapshots, next_instance, prometheus_text,
                       scrape)
+from .recorder import (FlightRecorder, IncidentCollector, RECORDER,
+                       capture_bundle, record)
+from .slo import SloBreach, SloMonitor, SloRule
 from .trace import (current_trace_id, new_trace_id, set_trace_id,
                     reset_trace_id, trace_context)
 
 __all__ = [
-    "metrics", "trace", "REGISTRY", "Counter", "Gauge", "Histogram",
-    "json_safe", "merge_snapshots", "next_instance", "prometheus_text",
-    "scrape", "current_trace_id", "new_trace_id", "set_trace_id",
-    "reset_trace_id", "trace_context",
+    "metrics", "trace", "slo", "recorder", "REGISTRY", "Counter", "Gauge",
+    "Histogram", "json_safe", "merge_snapshots", "next_instance",
+    "prometheus_text", "scrape", "current_trace_id", "new_trace_id",
+    "set_trace_id", "reset_trace_id", "trace_context", "SloRule",
+    "SloMonitor", "SloBreach", "FlightRecorder", "IncidentCollector",
+    "RECORDER", "record", "capture_bundle",
 ]

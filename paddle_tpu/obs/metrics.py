@@ -549,12 +549,13 @@ def prometheus_text(snapshot=None):
     return "\n".join(lines) + "\n"
 
 
-def scrape(addresses, timeout=2.0):
-    """Scrape the built-in ``metrics`` RPC from each address; returns
-    ``{address: snapshot | None}`` (None = unreachable). Endpoints are
-    contacted CONCURRENTLY, so a fleet of mid-restart children costs one
-    ``timeout``, not one per endpoint — the fleet-wide helper under
-    ``FleetSupervisor.fleet_metrics`` and ``tools/metrics_dump.py``."""
+def scrape_method(addresses, method, timeout=2.0,
+                  thread_name_prefix="obs-scrape"):
+    """Call one no-arg RPC ``method`` on each address CONCURRENTLY;
+    returns ``{address: payload | None}`` (None = unreachable) — a fleet
+    of mid-restart children costs one ``timeout``, not one per endpoint.
+    The shared engine under :func:`scrape` (``metrics``) and
+    ``obs.recorder.scrape_flight`` (``flight_dump``)."""
     from concurrent.futures import ThreadPoolExecutor
 
     from ..distributed.rpc import RpcClient
@@ -562,7 +563,7 @@ def scrape(addresses, timeout=2.0):
     def one(addr):
         c = RpcClient(addr, timeout=timeout)
         try:
-            return c.call("metrics")
+            return c.call(method)
         except Exception:
             return None
         finally:
@@ -574,13 +575,21 @@ def scrape(addresses, timeout=2.0):
     if len(addrs) == 1:
         return {addrs[0]: one(addrs[0])}
     with ThreadPoolExecutor(max_workers=min(8, len(addrs)),
-                            thread_name_prefix="obs-scrape") as pool:
-        snaps = list(pool.map(one, addrs))
-    return dict(zip(addrs, snaps))
+                            thread_name_prefix=thread_name_prefix) as pool:
+        payloads = list(pool.map(one, addrs))
+    return dict(zip(addrs, payloads))
+
+
+def scrape(addresses, timeout=2.0):
+    """Scrape the built-in ``metrics`` RPC from each address; returns
+    ``{address: snapshot | None}`` (None = unreachable) — the fleet-wide
+    helper under ``FleetSupervisor.fleet_metrics`` and
+    ``tools/metrics_dump.py``."""
+    return scrape_method(addresses, "metrics", timeout=timeout)
 
 
 __all__ = [
     "REGISTRY", "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "json_safe", "next_instance", "merge_snapshots", "prometheus_text",
-    "scrape",
+    "scrape", "scrape_method",
 ]

@@ -67,7 +67,8 @@ class OnlineLearningLoop:
                  rollout_poll_s=None, registry_keep=None,
                  buckets=None, max_delay_ms=None, checkpoint_dir=None,
                  checkpoint_every=1, trainer_retry=None, extra_fetch=(),
-                 prefetch=2, fleet_kwargs=None):
+                 prefetch=2, fleet_kwargs=None, slo_rules=None,
+                 incident_dir=None):
         from ..serving.registry import ModelRegistry
 
         self._main = main_program
@@ -96,12 +97,16 @@ class OnlineLearningLoop:
         self._extra_fetch = extra_fetch
         self._prefetch = prefetch
         self._fleet_kwargs = dict(fleet_kwargs or {})
+        self._slo_rules = list(slo_rules or [])
+        self._incident_dir = incident_dir
         self.pservers = None
         self.fleet = None
         self.trainer = None
         self.freezer = None
         self.rollout = None
         self.client = None
+        self.slo_monitor = None
+        self.incidents = None
         self._exe = None
         self._scope = None
         self._started = False
@@ -114,6 +119,8 @@ class OnlineLearningLoop:
         import paddle_tpu.fluid as fluid
         from ..distributed.launch import PserverSupervisor
         from ..distributed.rpc import RetryPolicy
+        from ..obs.recorder import IncidentCollector
+        from ..obs.slo import SloMonitor
         from ..serving.fleet import FleetSupervisor
         from .freezer import CheckpointFreezer
         from .rollout import RolloutController
@@ -166,16 +173,37 @@ class OnlineLearningLoop:
             self.fleet = FleetSupervisor(
                 self.registry, self.model, version="latest",
                 n_replicas=self._n_replicas, buckets=self._buckets,
-                max_delay_ms=self._max_delay_ms, **self._fleet_kwargs)
+                max_delay_ms=self._max_delay_ms,
+                # the same declarative rules judge every replica's OWN
+                # registry (surfaced via its health()) AND this process
+                slo_rules=self._slo_rules or None, **self._fleet_kwargs)
             if not self.fleet.wait_ready(wait_ready_s):
                 raise RuntimeError("serving fleet never became ready")
+
+            # the actionable obs layer: one incident collector over the
+            # WHOLE tree (pserver shards + serving replicas + this
+            # process), triggered by child restarts, canary failures,
+            # and SLO breaches — every chaos event leaves a fleet-wide
+            # flight-recorder bundle behind
+            self.incidents = IncidentCollector(
+                addresses_fn=self._all_addresses,
+                out_dir=self._incident_dir)
+            self.pservers.incident_hook = self.incidents.trigger
+            self.fleet.incident_hook = self.incidents.trigger
+            if self._slo_rules:
+                self.slo_monitor = SloMonitor(
+                    self._slo_rules,
+                    on_breach=self.incidents.trigger)
+                self.slo_monitor.install()
+                self.slo_monitor.start()
 
             self.rollout = RolloutController(
                 self.registry, self.model, self.fleet,
                 poll_interval_s=self._rollout_poll_s,
                 min_serve_s=self._min_serve_s,
                 rollout_timeout_s=wait_ready_s,
-                registry_keep=self._registry_keep)
+                registry_keep=self._registry_keep,
+                incident_collector=self.incidents)
             self.rollout.start()
 
             self.trainer = StreamingTrainer(
@@ -190,6 +218,15 @@ class OnlineLearningLoop:
             self.stop()               # resets _started: retryable
             raise
         return self.fleet.version
+
+    # ------------------------------------------------------------------
+    def _all_addresses(self):
+        addrs = []
+        if self.fleet is not None:
+            addrs += [tuple(a) for a in self.fleet.addresses]
+        if self.pservers is not None:
+            addrs += [tuple(a) for a in self.pservers.addresses]
+        return addrs
 
     # ------------------------------------------------------------------
     def stats(self, fleet_metrics=True, scrape_timeout=1.0):
@@ -221,12 +258,12 @@ class OnlineLearningLoop:
             out["published_versions"] = self.registry.versions(self.model)
         except ValueError:
             out["published_versions"] = []
+        if self.slo_monitor is not None:
+            out["slo"] = self.slo_monitor.health_section()
+        if self.incidents is not None:
+            out["incidents"] = self.incidents.stats()
         if fleet_metrics:
-            addrs = []
-            if self.fleet is not None:
-                addrs += [tuple(a) for a in self.fleet.addresses]
-            if self.pservers is not None:
-                addrs += [tuple(a) for a in self.pservers.addresses]
+            addrs = self._all_addresses()
             scraped = _m.scrape(addrs, timeout=scrape_timeout) \
                 if addrs else {}
             out["metrics"] = _m.merge_snapshots(
@@ -245,6 +282,21 @@ class OnlineLearningLoop:
         if self.rollout is not None:
             self.rollout.stop()
             self.rollout = None
+        if self.slo_monitor is not None:
+            from ..obs import slo as _slo
+            self.slo_monitor.stop()
+            if _slo.installed() is self.slo_monitor:
+                _slo.install(None)
+            self.slo_monitor = None
+        if self.incidents is not None:
+            # detach the hooks first so a child dying during teardown
+            # doesn't race a capture into the closing fleet
+            if self.pservers is not None:
+                self.pservers.incident_hook = None
+            if self.fleet is not None:
+                self.fleet.incident_hook = None
+            self.incidents.wait_idle(timeout=5.0)
+            self.incidents = None
         if self.freezer is not None:
             self.freezer.close()
             self.freezer = None

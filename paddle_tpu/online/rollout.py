@@ -43,6 +43,7 @@ import time
 from ..core.flags import get_flag
 from ..obs.metrics import (REGISTRY as _METRICS, json_safe,
                            next_instance)
+from ..obs.recorder import record as _flight_record
 from ..serving.fleet import CanaryFailed
 
 # rollout outcomes in the obs.metrics registry: ok / canary_failed
@@ -75,10 +76,13 @@ class RolloutController:
 
     def __init__(self, registry, model, supervisor, poll_interval_s=None,
                  min_serve_s=None, rollout_timeout_s=120.0,
-                 registry_keep=None):
+                 registry_keep=None, incident_collector=None):
         self._registry = registry
         self._model = model
         self._sup = supervisor
+        # obs.recorder.IncidentCollector (or any callable-bearing twin):
+        # a canary failure triggers a fleet-wide flight-recorder bundle
+        self._incidents = incident_collector
         if poll_interval_s is None:
             poll_interval_s = float(get_flag("online_rollout_poll_ms")) / 1e3
         if min_serve_s is None:
@@ -185,9 +189,17 @@ class RolloutController:
             self._sup.rolling_reload(target, wait_timeout=self._timeout)
         except CanaryFailed as e:
             self._m_canary.inc()
+            _flight_record("canary_quarantine",
+                           component=self.obs_instance, version=target,
+                           rolled_back_to=e.rolled_back_to)
             with self._lock:
                 self._bad.add(target)
                 self._last_error = f"CanaryFailed: {e}"
+            if self._incidents is not None:
+                self._incidents.trigger(
+                    "canary_failed",
+                    detail={"version": target,
+                            "rolled_back_to": e.rolled_back_to})
             return
         except Exception as e:
             # transient (canary unreachable; mid-fleet failure after the

@@ -43,6 +43,7 @@ import numpy as np
 from ..core.flags import get_flag
 from ..obs.metrics import (REGISTRY as _METRICS, json_safe,
                            next_instance)
+from ..obs.recorder import record as _flight_record
 
 _M_STEPS = _METRICS.counter(
     "paddle_tpu_online_trainer_steps",
@@ -168,6 +169,12 @@ class StreamingTrainer:
                 return self._client.push(grads, seq=seq)
             except Exception as e:
                 self._m_push_retries.inc()
+                # the retry DECISION: a partially-applied push is being
+                # re-sent with the SAME seq through a shard restart —
+                # exactly what an incident bundle needs to explain a
+                # training stall
+                _flight_record("push_retry", component=self.obs_instance,
+                               seq=seq, error=type(e).__name__)
                 self._last_error = f"push(seq={seq}): " \
                                    f"{type(e).__name__}: {e}"
                 if self._stop.wait(0.25):

@@ -128,6 +128,12 @@ def use_pallas(kernel, supported=True):
 
 def record_fallback(kernel):
     _M_FALLBACKS.labels(kernel=kernel).inc()
+    # flight recorder: a silent tier downgrade is exactly the kind of
+    # decision an incident bundle must surface (a fleet quietly running
+    # jnp twins explains a perf regression)
+    from ...obs.recorder import record as _flight_record
+    _flight_record("pallas_fallback", component="ops.pallas",
+                   kernel=kernel)
 
 
 def fallback_counts():

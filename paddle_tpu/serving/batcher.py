@@ -27,6 +27,7 @@ import numpy as np
 
 from ..core.flags import get_flag
 from ..obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
+from ..obs.recorder import record as _flight_record
 
 _M_REQUESTS = _METRICS.counter(
     "paddle_tpu_batcher_requests",
@@ -124,6 +125,10 @@ class DynamicBatcher:
             self._m_requests.inc()
             if len(self._pending) >= self.capacity:
                 self._m_rejected.inc()
+                _flight_record("overload_reject",
+                               component=self.obs_instance,
+                               queue_depth=len(self._pending),
+                               capacity=self.capacity)
                 raise ServerOverloaded(
                     f"serving queue full ({self.capacity} requests "
                     "waiting); back off and retry")

@@ -36,8 +36,10 @@ import threading
 import time
 
 from ..core.flags import get_flag
+from ..core.profiler import trace_context
 from ..distributed.launch import ChildSupervisor
 from ..distributed.rpc import RemoteError, RpcClient
+from ..obs import recorder as _flight
 from .registry import ModelRegistry
 
 
@@ -86,7 +88,12 @@ def _replica_child(address, model_dir, version, cfg, fault_plan=None):
         batching=cfg.get("batching", True),
         max_delay_ms=cfg.get("max_delay_ms"),
         queue_capacity=cfg.get("queue_capacity"),
-        fault_plan=fault_plan, version=version)
+        fault_plan=fault_plan, version=version,
+        # SLO rules ride the child config as plain dicts (spawn =
+        # picklable args); the server builds + installs its own
+        # SloMonitor, so every replica judges its OWN registry and
+        # surfaces verdicts through health()
+        slo_rules=cfg.get("slo_rules"))
     server.serve_forever(warmup=False)
 
 
@@ -111,8 +118,10 @@ class FleetSupervisor(ChildSupervisor):
                  max_delay_ms=None, queue_capacity=None,
                  heartbeat_interval_s=0.25, heartbeat_timeout_s=None,
                  heartbeat_misses=3, max_restarts=5, startup_grace_s=120.0,
-                 fault_plans=None, host="127.0.0.1"):
+                 fault_plans=None, host="127.0.0.1", slo_rules=None):
         import jax
+
+        from ..obs.slo import SloRule
 
         self.registry = registry_root if isinstance(registry_root,
                                                     ModelRegistry) \
@@ -121,9 +130,15 @@ class FleetSupervisor(ChildSupervisor):
         _path, v = self.registry.resolve(model, version)
         self._version = v
         self._version_lock = threading.Lock()
+        # validate rules HERE (a bad rule must fail the supervisor, not
+        # crash-loop every spawned child); ship the dict form
+        slo_dicts = [r.to_dict() if isinstance(r, SloRule)
+                     else SloRule.from_dict(r).to_dict()
+                     for r in (slo_rules or [])] or None
         self._cfg = dict(batching=batching, buckets=buckets,
                          max_delay_ms=max_delay_ms,
                          queue_capacity=queue_capacity,
+                         slo_rules=slo_dicts,
                          # resolved platform, not the env var: the child
                          # must land on the same backend the parent
                          # exported/validated the model on
@@ -146,6 +161,11 @@ class FleetSupervisor(ChildSupervisor):
         comes back serving."""
         with self._version_lock:
             return self._version
+
+    def _obs_name(self):
+        # flight-recorder component label; getattr because structural
+        # tests build supervisors via __new__ without the obs instance
+        return getattr(self, "obs_instance", type(self).__name__)
 
     def _child_spec(self, i):
         with self._version_lock:
@@ -195,16 +215,25 @@ class FleetSupervisor(ChildSupervisor):
 
     def _reload_replica(self, i, path, version, timeout):
         """Ask replica ``i`` to hot-swap, then health-gate the result.
-        Returns None on success, the failure on any error."""
+        Returns None on success, the failure on any error. The whole
+        exchange runs under ONE trace id, and the decision lands in this
+        process's flight recorder under it — the replica records its
+        ``reload`` event under the SAME id server-side, so an incident
+        bundle links the rollout decision to its execution across the
+        two processes."""
         c = RpcClient(self.addresses[i], timeout=timeout)
         try:
-            h = c.call("health")
-            if h.get("version") != version:
-                # a replica that crash-restarted AFTER the version advanced
-                # already serves the target; reloading it again is harmless
-                # but wasteful
-                c.call("reload", model_dir=path, version=version)
-            h = c.call("health")
+            with trace_context():
+                _flight.record("replica_reload",
+                               component=self._obs_name(),
+                               replica=i, version=version)
+                h = c.call("health")
+                if h.get("version") != version:
+                    # a replica that crash-restarted AFTER the version
+                    # advanced already serves the target; reloading it
+                    # again is harmless but wasteful
+                    c.call("reload", model_dir=path, version=version)
+                h = c.call("health")
             if not (h.get("status") == "serving" and h.get("warmed")
                     and h.get("version") == version):
                 return RuntimeError(f"replica {i} unhealthy after reload: "
@@ -234,6 +263,11 @@ class FleetSupervisor(ChildSupervisor):
             if err is not None:
                 if i == 0:
                     self._rollback_canary(prev, wait_timeout)
+                    _flight.record(
+                        "canary_failed", component=self._obs_name(),
+                        version=target, rolled_back_to=prev,
+                        error=f"{type(err).__name__}: {err}",
+                        condemned=isinstance(err, RemoteError))
                     if isinstance(err, RemoteError):
                         # the canary ANSWERED with a structured error —
                         # it processed the reload and rejected the bundle
@@ -260,8 +294,13 @@ class FleetSupervisor(ChildSupervisor):
                     f"{target}, rest on {prev}): "
                     f"{type(err).__name__}: {err}") from err
             if i == 0:
+                _flight.record("canary_passed",
+                               component=self._obs_name(),
+                               version=target)
                 with self._version_lock:
                     self._version = target
+        _flight.record("rollout_complete", component=self._obs_name(),
+                       version=target, replicas=len(self.addresses))
         return target
 
     def _rollback_canary(self, prev_version, wait_timeout):
@@ -300,14 +339,40 @@ class FleetSupervisor(ChildSupervisor):
         ``OnlineLearningLoop.stats()`` read."""
         from ..obs import metrics as _m
 
+        from ..obs import slo as _slo
+
         scraped = _m.scrape(self.addresses, timeout=timeout)
         replicas = {i: scraped.get(tuple(a))
                     for i, a in enumerate(self.addresses)}
         snaps = list(replicas.values())
         if include_local:
             snaps.append(_m.REGISTRY.snapshot())
-        return _m.json_safe({"replicas": replicas,
-                             "merged": _m.merge_snapshots(snaps)})
+        merged = _m.merge_snapshots(snaps)
+        out = {"replicas": replicas, "merged": merged}
+        # SLO verdicts over the FLEET view: the process-installed
+        # monitor's rules re-judged against the merged snapshot — via a
+        # THROWAWAY monitor so the one-shot never pollutes the
+        # background monitor's windowed burn state (a fresh state's
+        # single sample makes this the instantaneous fleet verdict).
+        # Rate rules need TWO samples for a counter delta, so a fresh
+        # one-shot would silently report them ok=burn-0 — they are
+        # surfaced as unmeasurable instead of falsely green.
+        mon = _slo.installed()
+        if mon is not None:
+            instant = [r.to_dict() for r in mon.rules
+                       if r.reducer != "rate"]
+            fleet_view = _slo.SloMonitor(
+                instant, emit_metrics=False).evaluate_once(merged) \
+                if instant else {}
+            for r in mon.rules:
+                if r.reducer == "rate":
+                    fleet_view[r.name] = {
+                        "ok": None,
+                        "unmeasurable": "rate rules need two samples; "
+                                        "see the background monitor"}
+            out["slo"] = {"local": mon.health_section(),
+                          "fleet": fleet_view}
+        return _m.json_safe(out)
 
 
 __all__ = ["FleetSupervisor", "CanaryFailed"]

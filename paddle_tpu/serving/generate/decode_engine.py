@@ -50,6 +50,7 @@ from ...core.flags import get_flag
 from ...core.profiler import record_event
 from ...core.scope import Scope
 from ...obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
+from ...obs.recorder import record as _flight_record
 from ..engine import parse_buckets
 from .kvcache import CacheExhausted, PagedKVCache
 
@@ -65,6 +66,21 @@ _M_HOT = _METRICS.counter(
     "paddle_tpu_genengine_hot_recompiles",
     "generation compiles observed AFTER warmup (the no-recompile alarm)",
     labels=("instance",))
+# per-request serving quantities: TTFT (submit -> first ACTUAL token —
+# stamped by the scheduler, which owns the submit clock; a request
+# aborted before its first token DISCARDS its probe) and TPOT (mean
+# time per output token after the first, recorded once at stream end
+# for requests that emitted >= 2 tokens)
+_M_TTFT = _METRICS.histogram(
+    "paddle_tpu_genengine_ttft_seconds",
+    "time to first token per generation request (submit -> first actual "
+    "token), per engine instance", labels=("instance",),
+    span_name="serving/ttft", span_kind="stage")
+_M_TPOT = _METRICS.histogram(
+    "paddle_tpu_genengine_tpot_seconds",
+    "mean time per output token after the first, recorded once per "
+    "finished stream that emitted >= 2 tokens, per engine instance",
+    labels=("instance",), span_name="serving/tpot", span_kind="stage")
 
 ATTENTION_OP = "causal_self_attention"
 _SLOTS = "__kv_slots__"
@@ -254,6 +270,10 @@ class GenerationEngine:
         self.obs_instance = next_instance("genengine")
         self._phase = {"prefill": {}, "chunk": {}, "decode": {}}
         self._m_hot = _M_HOT.labels(instance=self.obs_instance)
+        # per-request TTFT/TPOT windows: the scheduler (which owns the
+        # submit clock) records into these; stats() snapshots them
+        self.ttft = _M_TTFT.labels(instance=self.obs_instance)
+        self.tpot = _M_TPOT.labels(instance=self.obs_instance)
         self._warmed = False
         from ...ops.pallas import resolve_tier
         self._kernel_tier = resolve_tier()
@@ -594,6 +614,12 @@ class GenerationEngine:
             self.cache.admit(seq.seq_id, len(prompt) + max_new)
             cached = self.cache.attach_prefix(seq.seq_id, prompt) \
                 if self.cache.prefix_cache_blocks > 0 else 0
+            _flight_record(
+                "gen_admit", component=self.obs_instance,
+                seq=seq.seq_id, prompt_tokens=len(prompt),
+                cached_tokens=cached, max_new=max_new,
+                mode=params["mode"],
+                chunked=len(prompt) - cached > self._chunk_limit())
             if len(prompt) - cached > self._chunk_limit():
                 # long uncached tail under chunking: admit NOW, prefill
                 # one bounded chunk per step boundary (the in-flight
@@ -652,6 +678,12 @@ class GenerationEngine:
         group.prompt = prompt
         cached = self.cache.attach_prefix(seqs[0].seq_id, prompt) \
             if self.cache.prefix_cache_blocks > 0 else 0
+        _flight_record(
+            "gen_admit", component=self.obs_instance,
+            seq=seqs[0].seq_id, prompt_tokens=len(prompt),
+            cached_tokens=cached, max_new=max_new, mode="beam",
+            beam_size=B,
+            chunked=len(prompt) - cached > self._chunk_limit())
         if len(prompt) - cached > self._chunk_limit():
             # chunked beam prefill: the lead hypothesis loads the prompt
             # chunk-by-chunk; siblings fork COW once it completes
@@ -742,6 +774,9 @@ class GenerationEngine:
         chunk = handle.pending[:self.prefill_chunk]
         del handle.pending[:len(chunk)]
         start = self.cache.context_len(lead.seq_id)
+        _flight_record("gen_prefill_chunk", component=self.obs_instance,
+                       seq=lead.seq_id, chunk_tokens=len(chunk),
+                       start=start, remaining=len(handle.pending))
         logits = self._run_chunk(lead, chunk, start)
         if handle.pending:
             return []
@@ -854,6 +889,12 @@ class GenerationEngine:
         slot(s) and blocks immediately (mid-chunked-prefill requests
         leave the prefill queue too)."""
         with self._lock:
+            if not handle.finished:
+                lead = handle.seqs[0] if isinstance(handle, _BeamGroup) \
+                    else handle
+                _flight_record(
+                    "gen_abort", component=self.obs_instance,
+                    seq=lead.seq_id, prefilling=bool(handle.prefilling))
             if handle in self._prefill_queue:
                 self._prefill_queue.remove(handle)
             if isinstance(handle, _BeamGroup):
@@ -897,6 +938,8 @@ class GenerationEngine:
             "cache": self.cache.stats(),
             "prefill_chunk": self.prefill_chunk,
             "kernel_tier": self._kernel_tier,
+            "ttft": self.ttft.snapshot(),
+            "tpot": self.tpot.snapshot(),
         })
 
 

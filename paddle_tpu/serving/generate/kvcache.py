@@ -56,6 +56,7 @@ import numpy as np
 
 from ...core.flags import get_flag
 from ...obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
+from ...obs.recorder import record as _flight_record
 
 _M_BLOCKS_IN_USE = _METRICS.gauge(
     "paddle_tpu_kvcache_blocks_in_use",
@@ -307,6 +308,11 @@ class PagedKVCache:
         self._free.append(b)
         self._m_prefix_evictions.inc()
         self._m_blocks_cached.set(len(self._block_hash))
+        # flight recorder: an eviction under admission pressure is a
+        # capacity decision incident bundles reconstruct cache-thrash
+        # from (the bounded ring absorbs bursts)
+        _flight_record("kv_evict", component=self.obs_instance, block=b,
+                       cached=len(self._block_hash))
 
     # ------------------------------------------------------------------
     def append_slots(self, seq_id, n=1):

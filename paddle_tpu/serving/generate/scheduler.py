@@ -40,6 +40,7 @@ from collections import deque
 
 from ...core.flags import get_flag
 from ...obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
+from ...obs.recorder import record as _flight_record
 from ..batcher import ServerOverloaded
 from .decode_engine import CacheExhausted, NoFreeSlots, normalize_sampling
 
@@ -70,6 +71,16 @@ class TokenStream:
         self._closed = False
         self.first_token_s = None      # set by the worker (TTFT probe)
         self._submit_s = None          # worker stamps TTFT against this
+        # per-stream serving-telemetry state (worker-side, under _cv):
+        # the TTFT probe resolves exactly once — STAMPED at the first
+        # actual token (into the engine's ttft histogram) or DISCARDED
+        # when the stream ends first (abort/cancel/error); TPOT records
+        # once at stream end for streams that emitted >= 2 tokens
+        self._first_emit_t = None
+        self._last_emit_t = None
+        self._ntokens = 0
+        self._resolved = False         # TTFT probe stamped or discarded
+        self._tpot_done = False
 
     # worker side -------------------------------------------------------
     def _emit(self, tokens):
@@ -162,6 +173,7 @@ class ContinuousBatcher:
         self._m_rejected = _GEN_REJECTED.labels(instance=self.obs_instance)
         self._n_steps = 0
         self._n_tokens = 0
+        self._n_ttft_discarded = 0
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
@@ -181,6 +193,10 @@ class ContinuousBatcher:
             self._m_requests.inc()
             if len(self._pending) >= self.capacity:
                 self._m_rejected.inc()
+                _flight_record("overload_reject",
+                               component=self.obs_instance,
+                               queue_depth=len(self._pending),
+                               capacity=self.capacity)
                 raise ServerOverloaded(
                     f"generation queue full ({self.capacity} requests "
                     "waiting); back off and retry")
@@ -192,6 +208,49 @@ class ContinuousBatcher:
         with self._cv:
             self._cancels.append(stream)
             self._cv.notify_all()
+
+    # ------------------------------------------------------------------
+    # per-request serving telemetry (TTFT / TPOT), worker-side under _cv
+    # ------------------------------------------------------------------
+    def _note_emit_locked(self, stream, tokens):
+        """Account one emission: the FIRST actual token stamps the TTFT
+        probe into the engine's ttft histogram; every emission advances
+        the TPOT clock."""
+        if not tokens:
+            return
+        now = time.perf_counter()
+        if stream.first_token_s is None and stream._submit_s is not None:
+            stream.first_token_s = now - stream._submit_s
+            stream._first_emit_t = now
+            stream._resolved = True
+            self.engine.ttft.observe(stream.first_token_s)
+        stream._last_emit_t = now
+        stream._ntokens += len(tokens)
+        self._n_tokens += len(tokens)
+
+    def _finalize_stream_locked(self, stream, reason):
+        """Resolve a stream's probes exactly once, however it ends
+        (finish / cancel / worker error): an UNSTAMPED TTFT probe is
+        DISCARDED (counted — never recorded as a sample, never left
+        dangling), and TPOT records once for streams that emitted >= 2
+        tokens."""
+        if not stream._resolved:
+            # aborted/errored before its first token: stamp-or-discard
+            # resolves to DISCARD — the histogram must not see a sample
+            # for a token that never arrived
+            stream._resolved = True
+            self._n_ttft_discarded += 1
+            _flight_record("gen_finish", component=self.obs_instance,
+                           reason=reason, tokens=0, ttft_discarded=True)
+            return
+        if not stream._tpot_done and stream._ntokens >= 2:
+            stream._tpot_done = True
+            self.engine.tpot.observe(
+                (stream._last_emit_t - stream._first_emit_t)
+                / (stream._ntokens - 1))
+        _flight_record("gen_finish", component=self.obs_instance,
+                       reason=reason, tokens=stream._ntokens,
+                       ttft_ms=round(stream.first_token_s * 1e3, 3))
 
     # ------------------------------------------------------------------
     def _run(self):
@@ -212,6 +271,7 @@ class ContinuousBatcher:
                 with self._cv:
                     for stream, handle in list(self._handles.items()):
                         self.engine.abort(handle)
+                        self._finalize_stream_locked(stream, "worker_error")
                         stream._finish(e)
                     self._handles.clear()
                 continue
@@ -224,6 +284,10 @@ class ContinuousBatcher:
             handle = self._handles.pop(stream, None)
             if handle is not None:
                 self.engine.abort(handle)
+                # stamp-or-discard: a stream cancelled before its first
+                # token discards its TTFT probe here (a started one was
+                # stamped at the token); never a dangling probe
+                self._finalize_stream_locked(stream, "cancelled")
             else:
                 # not started yet: drop it from the wait queue
                 for req in list(self._pending):
@@ -254,12 +318,10 @@ class ContinuousBatcher:
             # TTFT is stamped at the FIRST ACTUAL token: a beam or
             # chunked-prefill admission emits nothing yet — its first
             # token lands later via _route_locked
-            if first:
-                req.stream.first_token_s = \
-                    time.perf_counter() - req.submit_s
+            self._note_emit_locked(req.stream, first)
             req.stream._emit(first)
-            self._n_tokens += len(first)
             if finished:
+                self._finalize_stream_locked(req.stream, "finished")
                 req.stream._finish()
             else:
                 handle.user_data = req.stream
@@ -272,13 +334,11 @@ class ContinuousBatcher:
             stream = handle.user_data
             if stream is None or stream not in self._handles:
                 continue               # cancelled mid-step
-            if tokens and stream.first_token_s is None \
-                    and stream._submit_s is not None:
-                stream.first_token_s = time.perf_counter() - stream._submit_s
+            self._note_emit_locked(stream, tokens)
             stream._emit(tokens)
-            self._n_tokens += len(tokens)
             if finished:
                 del self._handles[stream]
+                self._finalize_stream_locked(stream, "finished")
                 stream._finish()
 
     # ------------------------------------------------------------------
@@ -313,6 +373,9 @@ class ContinuousBatcher:
                 "rejected": int(self._m_rejected.value),
                 "steps": self._n_steps,
                 "tokens_emitted": self._n_tokens,
+                "ttft_discarded": self._n_ttft_discarded,
+                "ttft": self.engine.ttft.snapshot(),
+                "tpot": self.engine.tpot.snapshot(),
             }
         return json_safe(out)
 

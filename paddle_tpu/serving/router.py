@@ -37,6 +37,7 @@ import time
 from ..core.flags import get_flag
 from ..core.profiler import trace_context
 from ..distributed.rpc import RetryPolicy, RpcClient
+from ..obs import recorder as _flight
 from ..obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
 from .batcher import ServerOverloaded
 from .client import InferClient
@@ -173,14 +174,22 @@ class FleetClient:
     def _eject(self, r):
         with self._lock:
             self._m_failovers.inc()
+            ejected = False
             if r.healthy:
                 r.healthy = False
                 r.ejections += 1
                 self._m_ejections.inc()
+                ejected = True
             r.consec_ok = 0
             # pooled idle connections point at the dead incarnation; drop
             # them so a re-admitted replica starts on fresh sockets
             r.close_all_locked()
+        # flight recorder: the routing DECISION (called inside the
+        # request's trace context, so the event joins its track); one
+        # event per failover, the ejection flagged on the first
+        _flight.record("failover", component=self.obs_instance,
+                       replica=f"{r.address[0]}:{r.address[1]}",
+                       ejected=ejected)
 
     # ------------------------------------------------------------------
     def infer(self, feed):
@@ -213,6 +222,9 @@ class FleetClient:
                         return out
                     except ServerOverloaded as e:
                         self._m_spillovers.inc()
+                        _flight.record(
+                            "spillover", component=self.obs_instance,
+                            replica=f"{r.address[0]}:{r.address[1]}")
                         broken = False   # replica alive; conn still good
                         overload = e
                     except TimeoutError:
@@ -232,6 +244,13 @@ class FleetClient:
                         or attempt >= self._retry.max_retries:
                     raise conn_err
                 attempt += 1
+                # the retry DECISION: a whole-fleet sweep failed and the
+                # request is backing off for another — recorded so an
+                # incident bundle shows how long a request chased a
+                # restarting fleet
+                _flight.record("retry_sweep", component=self.obs_instance,
+                               attempt=attempt,
+                               error=type(conn_err).__name__)
                 time.sleep(self._retry.delay_s(attempt))
 
     # ------------------------------------------------------------------

@@ -39,6 +39,7 @@ import os
 import threading
 
 from ..distributed.rpc import RpcServer
+from ..obs import recorder as _flight, slo as _slo
 from ..obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
 from .batcher import DynamicBatcher
 from .engine import InferenceEngine
@@ -110,7 +111,8 @@ class ModelServer:
     def __init__(self, model_dir=None, engine=None, address=("127.0.0.1", 0),
                  batching=True, max_delay_ms=None, queue_capacity=None,
                  buckets=None, fault_plan=None, version=None,
-                 model_kind=None, continuous=True, gen_opts=None):
+                 model_kind=None, continuous=True, gen_opts=None,
+                 slo_rules=None):
         from .generate import ContinuousBatcher, GenerationEngine
         if model_kind is None:
             if engine is not None:
@@ -166,6 +168,17 @@ class ModelServer:
         self.latency = _M_REQUEST_SECONDS.labels(instance=self.obs_instance)
         self._rpc = RpcServer(_ServingHandler(self), address,
                               fault_plan=fault_plan)
+        # slo_rules (SloRule objects or their dict form — the spawned
+        # replica child ships dicts): build, INSTALL as the process
+        # default (for surfaces with no server at hand) and start
+        # evaluating — AFTER the RpcServer bound, so a failed
+        # construction never leaks a running process-default monitor.
+        # A server-owned monitor stops with the server.
+        self._slo_monitor = None
+        if slo_rules:
+            self._slo_monitor = _slo.SloMonitor(slo_rules)
+            self._slo_monitor.install()
+            self._slo_monitor.start()
         self._serving = False
 
     # ------------------------------------------------------------------
@@ -253,6 +266,21 @@ class ModelServer:
         old engine serving) if the new bundle fails to load
         (``load_inference_model``'s typed ValueError) or fails warmup.
         Returns the new serving version and the warmup compile count."""
+        try:
+            out = self._reload_inner(model_dir, version)
+        except Exception as e:
+            # flight recorder: a rejected reload is a canary verdict in
+            # the making — record it under the caller's trace id (the
+            # rollout's reload RPC restored it into the contextvar)
+            _flight.record("reload_failed", component=self.obs_instance,
+                           model_dir=str(model_dir), version=version,
+                           error=f"{type(e).__name__}: {e}")
+            raise
+        _flight.record("reload", component=self.obs_instance,
+                       version=version, compiles=out.get("compiles"))
+        return out
+
+    def _reload_inner(self, model_dir, version=None):
         with self._reload_lock:
             if self.model_kind == "generative":
                 from .generate import ContinuousBatcher, GenerationEngine
@@ -299,6 +327,16 @@ class ModelServer:
                "queue_depth": 0}
         if self.batcher is not None:
             out["queue_depth"] = self.batcher.stats()["queue_depth"]
+        # SLO verdicts on the same surface rollouts and routers already
+        # health-gate on: this server's OWN monitor when it has one
+        # (two servers in one process must not report each other's
+        # rules), else the process-installed default
+        if self._slo_monitor is not None:
+            out["slo"] = self._slo_monitor.health_section()
+        else:
+            slo = _slo.health_section()
+            if slo is not None:
+                out["slo"] = slo
         return json_safe(out)
 
     def stats(self):
@@ -327,13 +365,22 @@ class ModelServer:
             # in-flight submits completed during the rpc drain; this
             # flushes nothing in the normal path and joins the worker
             drained = self.batcher.close(timeout) and drained
+        self._stop_slo_monitor()
         return drained
+
+    def _stop_slo_monitor(self):
+        if self._slo_monitor is not None:
+            self._slo_monitor.stop()
+            if _slo.installed() is self._slo_monitor:
+                _slo.install(None)
+            self._slo_monitor = None
 
     def kill(self):
         """Crash simulation (tests): sever everything, no drain — what a
         SIGKILLed serving process looks like to its clients."""
         self._serving = False
         self._rpc.kill()
+        self._stop_slo_monitor()
 
 
 __all__ = ["ModelServer"]

@@ -1,0 +1,230 @@
+"""Per-request generation lifecycle telemetry: TTFT/TPOT histograms on
+the obs plane, the stamp-or-discard TTFT probe contract under abort
+(the dangling-probe fix), and the flight-recorder lifecycle events
+(admission -> chunked-prefill pumps -> finish/abort)."""
+
+import time
+import threading
+
+import pytest
+
+from paddle_tpu.obs import recorder as rec
+from paddle_tpu.obs.metrics import REGISTRY, next_instance
+from paddle_tpu.serving.generate.scheduler import ContinuousBatcher
+
+_TTFT = REGISTRY.histogram("paddle_tpu_genengine_ttft_seconds",
+                           labels=("instance",))
+_TPOT = REGISTRY.histogram("paddle_tpu_genengine_tpot_seconds",
+                           labels=("instance",))
+
+
+class _Handle:
+    def __init__(self):
+        self.user_data = None
+        self.finished = False
+
+
+class _ScriptedEngine:
+    """Deterministic ContinuousBatcher driver: start() admits instantly
+    with NO first token (the beam / chunked-admission shape), step()
+    pops pre-scripted events — so the abort-before-first-token race is
+    a scripted certainty, not a timing accident."""
+
+    def __init__(self):
+        self.obs_instance = next_instance("fakegen")
+        self.ttft = _TTFT.labels(instance=self.obs_instance)
+        self.tpot = _TPOT.labels(instance=self.obs_instance)
+        self._lock = threading.Lock()
+        self._script = []
+        self.handles = []
+        self.aborted = []
+
+    def start(self, prompt, max_new_tokens, sampling=None):
+        h = _Handle()
+        with self._lock:
+            self.handles.append(h)
+        return h, [], False
+
+    def push_events(self, events):
+        with self._lock:
+            self._script.append(events)
+
+    def step(self):
+        with self._lock:
+            if self._script:
+                return self._script.pop(0)
+        time.sleep(0.005)
+        return []
+
+    def abort(self, handle):
+        handle.finished = True
+        with self._lock:
+            self.aborted.append(handle)
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# THE pin: stamp-or-discard on abort
+# ---------------------------------------------------------------------------
+
+def test_abort_before_first_token_discards_ttft_probe():
+    """A stream aborted before its FIRST token must leave no dangling
+    TTFT probe: the histogram sees no sample, the discard is counted,
+    and the lifecycle closes with a gen_finish(ttft_discarded) event."""
+    eng = _ScriptedEngine()
+    b = ContinuousBatcher(eng, capacity=4)
+    try:
+        before = eng.ttft.count
+        s = b.submit([1, 2, 3], 8, {"mode": "greedy"})
+        assert _wait(lambda: eng.handles)         # admitted, zero tokens
+        s.close()
+        assert _wait(lambda: eng.aborted)
+        assert _wait(lambda: b.stats()["ttft_discarded"] == 1)
+        assert eng.ttft.count == before           # probe DISCARDED
+        assert eng.tpot.count == 0
+        with pytest.raises(Exception):
+            list(s)                               # consumer sees cancel
+        evs = rec.RECORDER.events(kinds={"gen_finish"})
+        mine = [e for e in evs
+                if e["component"] == b.obs_instance]
+        assert mine and mine[-1]["detail"]["ttft_discarded"] is True
+        assert mine[-1]["detail"]["tokens"] == 0
+    finally:
+        b.close()
+
+
+def test_ttft_stamps_at_first_actual_token_and_tpot_on_finish():
+    eng = _ScriptedEngine()
+    b = ContinuousBatcher(eng, capacity=4)
+    try:
+        s = b.submit([1], 8, {"mode": "greedy"})
+        assert _wait(lambda: eng.handles)
+        h = eng.handles[0]
+        assert _wait(lambda: h.user_data is not None)
+        before_t, before_p = eng.ttft.count, eng.tpot.count
+        # a tokenless heartbeat step must NOT stamp the probe
+        eng.push_events([(h, [], False)])
+        time.sleep(0.1)
+        assert eng.ttft.count == before_t
+        eng.push_events([(h, [7], False)])
+        assert _wait(lambda: eng.ttft.count == before_t + 1)
+        assert eng.tpot.count == before_p         # not until finish
+        eng.push_events([(h, [8, 9], True)])
+        toks = list(s)
+        assert toks == [7, 8, 9]
+        assert eng.ttft.count == before_t + 1     # stamped exactly once
+        assert eng.tpot.count == before_p + 1     # once, >=2 tokens
+        st = b.stats()
+        assert st["ttft"]["count"] >= 1 and st["tpot"]["count"] >= 1
+        assert st["ttft_discarded"] == 0
+        evs = [e for e in rec.RECORDER.events(kinds={"gen_finish"})
+               if e["component"] == b.obs_instance]
+        assert evs[-1]["detail"]["reason"] == "finished"
+        assert evs[-1]["detail"]["tokens"] == 3
+        assert evs[-1]["detail"]["ttft_ms"] >= 0
+    finally:
+        b.close()
+
+
+def test_abort_after_first_token_keeps_stamp_records_tpot():
+    """The other half of stamp-or-discard: a stream cancelled AFTER
+    tokens flowed keeps its TTFT sample (stamped at the token) and
+    still resolves TPOT over what it emitted."""
+    eng = _ScriptedEngine()
+    b = ContinuousBatcher(eng, capacity=4)
+    try:
+        s = b.submit([1], 8, {"mode": "greedy"})
+        assert _wait(lambda: eng.handles)
+        h = eng.handles[0]
+        assert _wait(lambda: h.user_data is not None)
+        before_t, before_p = eng.ttft.count, eng.tpot.count
+        eng.push_events([(h, [7, 8], False)])
+        assert _wait(lambda: eng.ttft.count == before_t + 1)
+        s.close()
+        assert _wait(lambda: eng.aborted)
+        assert _wait(lambda: eng.tpot.count == before_p + 1)
+        assert eng.ttft.count == before_t + 1
+        assert b.stats()["ttft_discarded"] == 0
+    finally:
+        b.close()
+
+
+def test_worker_error_resolves_probes_typed():
+    class _Dying(_ScriptedEngine):
+        def step(self):
+            raise RuntimeError("decode died")
+
+    eng = _Dying()
+    b = ContinuousBatcher(eng, capacity=4)
+    try:
+        before = eng.ttft.count
+        s = b.submit([1], 8, {"mode": "greedy"})
+        with pytest.raises(RuntimeError, match="decode died"):
+            list(s)
+        assert eng.ttft.count == before
+        assert _wait(lambda: b.stats()["ttft_discarded"] >= 1)
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# real-engine integration: lifecycle events + histograms end to end
+# ---------------------------------------------------------------------------
+
+def test_real_engine_lifecycle_events_and_histograms(tmp_path):
+    from paddle_tpu.serving.generate import GenerationEngine
+    from paddle_tpu.testing.models import export_tiny_lm
+
+    d = str(tmp_path / "lm")
+    export_tiny_lm(d)
+    eng = GenerationEngine(d, max_seqs=2, max_len=32, num_blocks=32,
+                           block_size=4, prefill_buckets="8,16",
+                           prefill_chunk=4)
+    eng.warmup()
+    b = ContinuousBatcher(eng)
+    try:
+        # a 12-token prompt under prefill_chunk=4 admits chunked: the
+        # lifecycle is admission -> pump -> pump -> ... -> first token
+        s = b.submit(list(range(1, 13)), 4, {"mode": "greedy"})
+        toks = list(s)
+        assert len(toks) == 4
+        assert eng.ttft.count == 1 and eng.tpot.count == 1
+        st = eng.stats()
+        assert st["ttft"]["count"] == 1 and st["tpot"]["count"] == 1
+        admits = [e for e in rec.RECORDER.events(kinds={"gen_admit"})
+                  if e["component"] == eng.obs_instance]
+        assert admits and admits[-1]["detail"]["chunked"] is True
+        assert admits[-1]["detail"]["prompt_tokens"] == 12
+        pumps = [e for e in
+                 rec.RECORDER.events(kinds={"gen_prefill_chunk"})
+                 if e["component"] == eng.obs_instance]
+        # 12 tokens in 4-token chunks = 3 pumps, remaining counts down
+        assert [p["detail"]["remaining"] for p in pumps] == [8, 4, 0]
+        finishes = [e for e in rec.RECORDER.events(kinds={"gen_finish"})
+                    if e["component"] == b.obs_instance]
+        assert finishes[-1]["detail"]["tokens"] == 4
+
+        # abort path on the real engine records gen_abort — close only
+        # once the request is ADMITTED (a cancel still in the wait queue
+        # never reached the engine, so there is nothing to abort)
+        s2 = b.submit(list(range(1, 13)), 16, {"mode": "greedy"})
+        assert _wait(lambda: eng.active_sequences > 0)
+        s2.close()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            ab = [e for e in rec.RECORDER.events(kinds={"gen_abort"})
+                  if e["component"] == eng.obs_instance]
+            if ab:
+                break
+            time.sleep(0.02)
+        assert ab, "abort left no gen_abort event"
+    finally:
+        b.close()

@@ -432,8 +432,113 @@ def test_model_server_metrics_rpc_matches_stats_and_cli(tmp_path):
             capture_output=True, text=True, timeout=180)
         assert r.returncode == 0, r.stdout + r.stderr
         assert "# TYPE paddle_tpu_engine_compiles counter" in r.stdout
+        # HELP lines are sourced from the README metrics-table rows —
+        # the same per-family descriptions check_metrics_doc validates —
+        # so scraped text is self-describing in the reviewed wording
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "metrics_dump", os.path.join(TOOLS, "metrics_dump.py"))
+        md = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(md)
+        doc_help = md.readme_metric_help()
+        assert doc_help.get("paddle_tpu_engine_compiles"), \
+            "README metrics table row for engine compiles not parsed"
+        assert (f"# HELP paddle_tpu_engine_compiles "
+                f"{doc_help['paddle_tpu_engine_compiles']}") in r.stdout
+        # every family the server exposed got a README-sourced HELP line
+        for name in ("paddle_tpu_batcher_requests",
+                     "paddle_tpu_serving_request_seconds"):
+            assert f"# HELP {name} {doc_help[name]}" in r.stdout, name
     finally:
         server.shutdown()
+
+
+def _dead_address():
+    """host:port with nothing listening (bound then closed)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    addr = s.getsockname()
+    s.close()
+    return addr
+
+
+def test_scrape_partial_failure_one_timeout_and_merged_view():
+    """One dead endpoint costs exactly one scrape timeout (endpoints are
+    contacted concurrently), the dead endpoint is REPORTED (None), and
+    the merged fleet snapshot is still produced from the live ones."""
+
+    class _H:
+        def ping(self):
+            return True
+
+    live1 = RpcServer(_H(), ("127.0.0.1", 0))
+    live2 = RpcServer(_H(), ("127.0.0.1", 0))
+    live1.serve_in_thread()
+    live2.serve_in_thread()
+    obsm.REGISTRY.counter("paddle_tpu_test_scrape_partial").child().inc(3)
+    dead1, dead2 = _dead_address(), _dead_address()
+    try:
+        t0 = time.monotonic()
+        out = obsm.scrape([live1.address, dead1, live2.address, dead2],
+                          timeout=1.5)
+        elapsed = time.monotonic() - t0
+        # dead endpoints reported as None, not dropped
+        assert out[tuple(dead1)] is None and out[tuple(dead2)] is None
+        for srv in (live1, live2):
+            snap = out[tuple(srv.address)]
+            assert snap is not None
+            assert snap["paddle_tpu_test_scrape_partial"]["values"][0][
+                "value"] == 3
+        # TWO dead endpoints cost about ONE timeout, not one each
+        # (refused connects fail instantly; the bound guards only
+        # against per-endpoint serialization)
+        assert elapsed < 3.0, f"scrape serialized: {elapsed:.1f}s"
+        # the merged fleet view is still produced, summing the live ones
+        merged = obsm.merge_snapshots(out.values())
+        assert merged["paddle_tpu_test_scrape_partial"]["values"][0][
+            "value"] == 6
+    finally:
+        live1.shutdown()
+        live2.shutdown()
+
+
+def test_check_metrics_cardinality_gate_is_green():
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS,
+                                      "check_metrics_cardinality.py")],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "every label bounded" in r.stdout
+    assert "wire funnels hold" in r.stdout
+
+
+def test_check_metrics_cardinality_detects_drift():
+    """The in-process halves of the gate: an undeclared label name is a
+    violation, and a family claimed WIRE_FED must exist with its funnel
+    label declared."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_cardinality",
+        os.path.join(TOOLS, "check_metrics_cardinality.py"))
+    cmc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cmc)
+
+    fam = obsm.Counter("paddle_tpu_test_unbounded",
+                       labels=("user_id",))      # NOT in the vocabulary
+    bad = cmc.unbounded_label_violations(
+        {"paddle_tpu_test_unbounded": fam})
+    assert bad == [("paddle_tpu_test_unbounded", "user_id")]
+    ok_fam = obsm.Counter("paddle_tpu_test_bounded",
+                          labels=("instance", "kind"))
+    assert cmc.unbounded_label_violations(
+        {"paddle_tpu_test_bounded": ok_fam}) == []
+    # a stale WIRE_FED entry (family gone) is itself a violation
+    msgs = cmc.wire_funnel_violations(
+        {n: obsm.REGISTRY.get(n) for n in obsm.REGISTRY.names()
+         if n != "paddle_tpu_wire_calls"})
+    assert any("paddle_tpu_wire_calls" in m for m in msgs)
+    # every label name the gate vouches for has a documented reason
+    assert all(cmc.BOUNDED_LABELS.values())
 
 
 def test_wire_method_label_cardinality_is_bounded():

@@ -563,7 +563,15 @@ def test_wire_counters_surface_in_server_stats_and_client():
     c.init_params({"w": np.zeros((32, 8), np.float32)})
     c.push({"w": np.ones((32, 8), np.float32)})
     c.pull()
+    # the server notes a call AFTER sending its response (bytes_sent is
+    # only known then), so the client can observably return a beat
+    # before the server's accounting lands — poll it in
+    deadline = time.monotonic() + 5.0
     st = ps.stats()
+    while "pull" not in st["wire"]["calls"] \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+        st = ps.stats()
     assert st["wire"]["bytes_recv"] > 32 * 8 * 4         # saw the push
     assert st["wire"]["calls"]["push"]["count"] == 1
     assert st["wire"]["calls"]["pull"]["count"] == 1

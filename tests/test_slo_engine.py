@@ -1,0 +1,322 @@
+"""SLO engine (paddle_tpu/obs/slo.py): declarative rule validation and
+wire form, reducer/burn math, multi-window breach semantics, the
+background monitor + breach counters/findings, the process-default
+install surface through ``ModelServer.health()`` (a seeded breach
+appears within one evaluation window), and the one-shot fleet-view
+evaluation ``FleetSupervisor.fleet_metrics`` runs (which must not
+pollute the background monitor's registry series)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.obs import metrics as obsm
+from paddle_tpu.obs import slo as obslo
+from paddle_tpu.obs.slo import SloMonitor, SloRule
+
+
+def _hist_snapshot(name, values, labels=("instance",), lv="i1"):
+    """A registry-shaped snapshot holding one histogram family."""
+    durs = sorted(values)
+    return {name: {
+        "type": "histogram", "help": "", "labels": list(labels),
+        "values": [{"labels": {labels[0]: lv}, "count": len(durs),
+                    "window": len(durs),
+                    "p50_ms": durs[len(durs) // 2],
+                    "p99_ms": durs[-1], "max_ms": durs[-1]}],
+    }}
+
+
+def _gauge_snapshot(name, by_instance):
+    return {name: {
+        "type": "gauge", "help": "", "labels": ["instance"],
+        "values": [{"labels": {"instance": k}, "value": v}
+                   for k, v in by_instance.items()],
+    }}
+
+
+# ---------------------------------------------------------------------------
+# rule validation + wire form
+# ---------------------------------------------------------------------------
+
+def test_rule_validation_and_dict_round_trip():
+    r = SloRule("p99", "paddle_tpu_serving_request_seconds", 50.0,
+                reducer="p99_ms", labels={"instance": "x"},
+                windows=((5.0, 1.0), (60.0, 0.5)), description="d")
+    r2 = SloRule.from_dict(r.to_dict())
+    assert r2.to_dict() == r.to_dict()
+    json.dumps(r.to_dict())                      # crosses the spawn wire
+
+    with pytest.raises(ValueError, match="objective"):
+        SloRule("bad", "m", 0.0)
+    with pytest.raises(ValueError, match="reducer"):
+        SloRule("bad", "m", 1.0, reducer="p42_ms")
+    with pytest.raises(ValueError, match="agg"):
+        SloRule("bad", "m", 1.0, agg="median")
+    with pytest.raises(ValueError, match="at least"):
+        SloRule("bad", "m", 1.0, windows=())
+    with pytest.raises(ValueError, match="window"):
+        SloRule("bad", "m", 1.0, windows=((0.0, 1.0),))
+    with pytest.raises(ValueError, match="unknown fields"):
+        SloRule.from_dict({"name": "x", "metric": "m", "objective": 1.0,
+                           "bogus": 1})
+    with pytest.raises(ValueError, match="duplicate"):
+        SloMonitor([SloRule("a", "m", 1.0), SloRule("a", "m", 2.0)])
+
+
+def test_rule_measure_reducers_selectors_and_agg():
+    snap = _gauge_snapshot("paddle_tpu_test_slo_depth",
+                           {"a": 3.0, "b": 7.0})
+    r_max = SloRule("d", "paddle_tpu_test_slo_depth", 5.0,
+                    reducer="value")
+    r_sum = SloRule("d", "paddle_tpu_test_slo_depth", 5.0,
+                    reducer="value", agg="sum")
+    r_sel = SloRule("d", "paddle_tpu_test_slo_depth", 5.0,
+                    reducer="value", labels={"instance": "a"})
+    assert r_max.measure(snap) == 7.0            # worst instance
+    assert r_sum.measure(snap) == 10.0
+    assert r_sel.measure(snap) == 3.0            # label-filtered
+    # absent family / no matching child measures None (burn 0)
+    assert r_max.measure({}) is None
+    assert r_sel.measure(_gauge_snapshot("paddle_tpu_test_slo_depth",
+                                         {"z": 9.0})) is None
+    h = _hist_snapshot("paddle_tpu_test_slo_lat", [1.0, 2.0, 40.0])
+    assert SloRule("l", "paddle_tpu_test_slo_lat", 10.0,
+                   reducer="p99_ms").measure(h) == 40.0
+
+
+# ---------------------------------------------------------------------------
+# burn-rate evaluation + multi-window breach semantics
+# ---------------------------------------------------------------------------
+
+def test_single_window_breach_transition_and_recovery():
+    mon = SloMonitor([SloRule("depth", "paddle_tpu_test_slo_depth", 4.0,
+                              reducer="value", windows=((1.0, 1.0),))],
+                     emit_metrics=False)
+    ok = _gauge_snapshot("paddle_tpu_test_slo_depth", {"a": 2.0})
+    hot = _gauge_snapshot("paddle_tpu_test_slo_depth", {"a": 8.0})
+    st = mon.evaluate_once(ok, now=100.0)
+    assert st["depth"]["ok"] and st["depth"]["burn"] == 0.5
+    # breach fires on the ok->breach TRANSITION only, and re-arms after
+    # recovery
+    st = mon.evaluate_once(hot, now=101.5)       # old sample aged out
+    assert not st["depth"]["ok"] and st["depth"]["breaches"] == 1
+    st = mon.evaluate_once(hot, now=101.6)
+    assert st["depth"]["breaches"] == 1          # no re-count while hot
+    st = mon.evaluate_once(ok, now=103.0)
+    assert st["depth"]["ok"]
+    st = mon.evaluate_once(hot, now=105.0)
+    assert st["depth"]["breaches"] == 2          # re-armed
+    f = mon.findings()
+    assert len(f) == 2 and f[0].rule == "depth" and f[0].burn == 2.0
+    json.dumps(f[0].as_dict())
+
+
+def test_multi_window_requires_every_window_burning():
+    # short window (1s) + long window (60s), both threshold 1.0: one
+    # hot sample trips the short window alone — the classic pairing
+    # where a spike must NOT breach until the burn is sustained
+    cool = _hist_snapshot("paddle_tpu_test_slo_lat", [1.0])    # burn 0.1
+    hot = _hist_snapshot("paddle_tpu_test_slo_lat", [100.0])   # burn 10
+    mon = SloMonitor([SloRule("lat", "paddle_tpu_test_slo_lat", 10.0,
+                              reducer="p99_ms",
+                              windows=((1.0, 1.0), (60.0, 1.0)))],
+                     emit_metrics=False)
+    t = 1000.0
+    for i in range(30):
+        mon.evaluate_once(cool, now=t + i)
+    st = mon.evaluate_once(hot, now=t + 30)
+    # the 1s window (the hot sample + the boundary-inclusive last cool
+    # one) burns well past threshold; the 60s average stays cool
+    assert st["lat"]["windows"]["1s"] > 1.0
+    assert st["lat"]["windows"]["60s"] < 1.0
+    assert st["lat"]["ok"], "one spike must not breach the long window"
+    # sustained burn trips BOTH windows
+    for i in range(31, 31 + 40):
+        st = mon.evaluate_once(hot, now=t + i)
+    assert not st["lat"]["ok"] and st["lat"]["breaches"] == 1
+
+
+def test_rate_reducer_uses_counter_deltas():
+    def counter_snap(v):
+        return {"paddle_tpu_test_slo_errs": {
+            "type": "counter", "help": "", "labels": [],
+            "values": [{"labels": {}, "value": v}]}}
+
+    mon = SloMonitor([SloRule("errs", "paddle_tpu_test_slo_errs", 5.0,
+                              reducer="rate", windows=((10.0, 1.0),))],
+                     emit_metrics=False)
+    st = mon.evaluate_once(counter_snap(100), now=10.0)
+    assert st["errs"]["value"] is None           # no delta yet
+    st = mon.evaluate_once(counter_snap(120), now=12.0)
+    assert st["errs"]["value"] == pytest.approx(10.0)   # 20 in 2s
+    assert st["errs"]["burn"] == pytest.approx(2.0)
+    # counter reset (restarted process) clamps to 0, never negative
+    st = mon.evaluate_once(counter_snap(5), now=14.0)
+    assert st["errs"]["value"] == 0.0
+
+
+def test_background_monitor_emits_series_and_findings():
+    fam = obsm.REGISTRY.gauge("paddle_tpu_test_slo_bg",
+                              labels=("instance",))
+    fam.labels(instance="x").set(50.0)
+    mon = SloMonitor([SloRule("bg", "paddle_tpu_test_slo_bg", 10.0,
+                              reducer="value", windows=((0.5, 1.0),))],
+                     interval_s=0.05)
+    mon.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while mon.breach_count() == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert mon.breach_count() == 1
+        hs = mon.health_section()
+        assert hs["ok"] is False and hs["evaluations"] >= 1
+        assert hs["recent_breaches"][-1]["rule"] == "bg"
+        json.dumps(hs)
+        # the registry series moved: burn gauge set, breach counter
+        # bumped — the scrape-visible half of the verdict
+        burn = obsm.REGISTRY.get("paddle_tpu_slo_burn_rate")
+        assert burn.labels(rule="bg", window="0.5s").value \
+            == pytest.approx(5.0)
+        breaches = obsm.REGISTRY.get("paddle_tpu_slo_breaches")
+        assert breaches.labels(rule="bg").value == 1
+    finally:
+        mon.stop()
+    assert not mon.running()
+
+
+def test_on_breach_callback_fires_outside_lock():
+    fired = []
+    fam = obsm.REGISTRY.gauge("paddle_tpu_test_slo_cb")
+    fam.child().set(99.0)
+    mon = SloMonitor([SloRule("cb", "paddle_tpu_test_slo_cb", 1.0,
+                              reducer="value", windows=((0.5, 1.0),))],
+                     on_breach=lambda f: fired.append(f),
+                     emit_metrics=False)
+    mon.evaluate_once()
+    assert len(fired) == 1 and fired[0].rule == "cb"
+    mon.evaluate_once()
+    assert len(fired) == 1                       # transition only
+
+
+# ---------------------------------------------------------------------------
+# install surface: ModelServer.health() + fleet one-shot view
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _fast_slo_interval():
+    set_flags({"obs_slo_interval_s": 0.05})
+    yield
+    set_flags({"obs_slo_interval_s": 1.0})
+    obslo.install(None)
+
+
+def _export_model(tmp_path, dim=4, classes=2):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[dim])
+        y = fluid.layers.fc(input=x, size=classes, act="softmax")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [y], exe, main, scope=scope)
+    return d, dim
+
+
+def test_seeded_breach_appears_in_model_server_health(tmp_path,
+                                                      _fast_slo_interval):
+    """The acceptance shape: an objective set BELOW anything measurable
+    flips paddle_tpu_slo_breaches and shows in health() within one
+    evaluation window."""
+    from paddle_tpu.serving import InferClient, ModelServer
+
+    d, dim = _export_model(tmp_path)
+    breaches_before = int(sum(
+        c.value for c in obsm.REGISTRY.get(
+            "paddle_tpu_slo_breaches").children().values()))
+    server = ModelServer(d, buckets="1,2", slo_rules=[
+        {"name": "seeded_latency", "objective": 1e-6, "reducer": "p99_ms",
+         "metric": "paddle_tpu_serving_request_seconds",
+         "windows": [[0.3, 1.0]]}])
+    server.start()
+    try:
+        with InferClient(server.address) as c:
+            c.infer({"x": np.zeros((1, dim), np.float32)})
+            deadline = time.monotonic() + 10.0
+            h = c.health()
+            while time.monotonic() < deadline:
+                h = c.health()
+                if h.get("slo", {}).get("rules", {}).get(
+                        "seeded_latency", {}).get("breaches", 0):
+                    break
+                time.sleep(0.05)
+        assert h["slo"]["ok"] is False
+        rule = h["slo"]["rules"]["seeded_latency"]
+        assert rule["breaches"] >= 1 and rule["value"] > rule["objective"]
+        assert h["slo"]["recent_breaches"][-1]["rule"] == "seeded_latency"
+        json.dumps(h)
+        breaches_after = int(sum(
+            c.value for c in obsm.REGISTRY.get(
+                "paddle_tpu_slo_breaches").children().values()))
+        assert breaches_after > breaches_before
+    finally:
+        server.shutdown()
+    # the server-owned monitor stopped and uninstalled with the server
+    assert obslo.installed() is None
+
+
+def test_fleet_one_shot_view_does_not_pollute_registry(tmp_path):
+    """fleet_metrics-style one-shot evaluation over a merged snapshot:
+    fresh throwaway state, emit_metrics=False — the background
+    monitor's paddle_tpu_slo_* series must not move."""
+    rule = SloRule("oneshot", "paddle_tpu_test_slo_fleet", 5.0,
+                   reducer="value", windows=((60.0, 1.0),))
+    merged = obsm.merge_snapshots([
+        _gauge_snapshot("paddle_tpu_test_slo_fleet", {"r0": 4.0}),
+        _gauge_snapshot("paddle_tpu_test_slo_fleet", {"r1": 9.0}),
+    ])
+    before = obsm.REGISTRY.get("paddle_tpu_slo_breaches").snapshot()
+    view = SloMonitor([rule.to_dict()],
+                      emit_metrics=False).evaluate_once(merged)
+    assert view["oneshot"]["ok"] is False        # worst replica judged
+    assert view["oneshot"]["value"] == 9.0
+    assert obsm.REGISTRY.get("paddle_tpu_slo_breaches").snapshot() \
+        == before
+    # no burn series for the one-shot rule either
+    burn = obsm.REGISTRY.get("paddle_tpu_slo_burn_rate")
+    assert not any(k[0] == "oneshot" for k in burn.children())
+
+
+def test_fleet_metrics_marks_rate_rules_unmeasurable():
+    """A rate rule needs two samples for a counter delta; a fresh
+    one-shot fleet view must surface it as unmeasurable, never as a
+    falsely-green burn-0 verdict (other reducers are judged)."""
+    import threading
+
+    from paddle_tpu.serving.fleet import FleetSupervisor
+
+    fam = obsm.REGISTRY.gauge("paddle_tpu_test_slo_fleetrate")
+    fam.child().set(9.0)
+    mon = SloMonitor([
+        SloRule("gauge_rule", "paddle_tpu_test_slo_fleetrate", 1.0,
+                reducer="value", windows=((60.0, 1.0),)),
+        SloRule("rate_rule", "paddle_tpu_test_slo_fleetrate", 1.0,
+                reducer="rate", windows=((60.0, 1.0),)),
+    ], emit_metrics=False)
+    mon.install()
+    try:
+        sup = FleetSupervisor.__new__(FleetSupervisor)  # no children
+        sup.addresses = []
+        sup._version = 1
+        sup._version_lock = threading.Lock()
+        view = sup.fleet_metrics(include_local=True)["slo"]["fleet"]
+        assert view["gauge_rule"]["ok"] is False     # judged one-shot
+        assert view["rate_rule"]["ok"] is None
+        assert "unmeasurable" in view["rate_rule"]
+    finally:
+        obslo.install(None)

@@ -34,6 +34,8 @@ def registered_metrics():
     import paddle_tpu  # noqa: F401  (core.executor families)
     import paddle_tpu.distributed.launch    # noqa: F401
     import paddle_tpu.distributed.rpc       # noqa: F401
+    import paddle_tpu.obs.recorder          # noqa: F401
+    import paddle_tpu.obs.slo               # noqa: F401
     import paddle_tpu.online.freezer        # noqa: F401
     import paddle_tpu.online.rollout        # noqa: F401
     import paddle_tpu.online.trainer        # noqa: F401

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Dump the flight-recorder rings of live paddle_tpu processes into one
+incident bundle.
+
+Every ``RpcServer`` (ModelServer replicas, pserver shards, the master)
+answers a built-in ``flight_dump`` method with its process's bounded
+ring of structured lifecycle events (obs/recorder.py: admissions,
+evictions, restarts with reasons, rollout/canary outcomes,
+retry/failover/spillover decisions, Pallas fallbacks — each stamped
+with the wall clock and the active distributed trace id). This CLI
+scrapes one or many endpoints CONCURRENTLY and writes the merged bundle:
+events from every reachable process on ONE clock, sources labeled,
+cross-process trace ids listed under ``linked_traces``.
+
+    python tools/dump_flight.py 127.0.0.1:7000 127.0.0.1:7001
+    python tools/dump_flight.py 127.0.0.1:7000 -o incident.json
+    python tools/dump_flight.py 127.0.0.1:7000 --chrome incident_trace.json
+
+``--chrome`` additionally renders the bundle as a chrome trace (one
+process lane per source, instant events, trace-id flow arrows) through
+the tools/merge_traces.py machinery — open it in chrome://tracing /
+Perfetto next to profiler traces of the same incident.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_TOOLS))   # repo root: paddle_tpu
+sys.path.insert(0, _TOOLS)                    # sibling merge_traces.py
+
+
+def parse_address(s):
+    host, _, port = s.rpartition(":")
+    if not host or not port.isdigit():
+        raise argparse.ArgumentTypeError(
+            f"address {s!r} is not host:port")
+    return host, int(port)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("addresses", nargs="+", type=parse_address,
+                    metavar="host:port",
+                    help="RpcServer endpoints to scrape flight_dump from")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write the bundle JSON here (default: stdout)")
+    ap.add_argument("--chrome", default=None, metavar="trace.json",
+                    help="also render the bundle as a merged chrome "
+                         "trace (flow-linked per trace id)")
+    ap.add_argument("--reason", default="manual",
+                    help="reason stamped into the bundle (default "
+                         "'manual')")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="per-endpoint scrape timeout, seconds")
+    ap.add_argument("--kind", action="append", default=[],
+                    help="keep only events of this kind (repeatable)")
+    ap.add_argument("--indent", type=int, default=2,
+                    help="json indent (default 2)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.obs import recorder as rec
+
+    bundle = rec.capture_bundle(args.addresses, reason=args.reason,
+                                timeout=args.timeout, include_local=False)
+    reached = [s for s in bundle["processes"].values() if s is not None]
+    if not reached:
+        print("dump_flight: no endpoint answered", file=sys.stderr)
+        return 1
+    if args.kind:
+        keep = set(args.kind)
+        bundle["events"] = [e for e in bundle["events"]
+                            if e["kind"] in keep]
+
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(bundle, f, indent=args.indent or None)
+    else:
+        json.dump(bundle, sys.stdout, indent=args.indent or None)
+        sys.stdout.write("\n")
+
+    if args.chrome:
+        from merge_traces import merge_trace_docs
+
+        docs, labels = rec.bundle_to_chrome(bundle)
+        merged = merge_trace_docs(docs, labels)
+        with open(args.chrome, "w") as f:
+            json.dump(merged, f)
+        print(f"dump_flight: chrome trace -> {args.chrome} "
+              f"({len(merged['otherData']['trace_ids'])} trace ids "
+              "linked)", file=sys.stderr)
+
+    n_src = len(reached)
+    print(f"dump_flight: {n_src}/{len(bundle['processes'])} endpoints, "
+          f"{len(bundle['events'])} events, "
+          f"{len(bundle['linked_traces'])} cross-process trace ids",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

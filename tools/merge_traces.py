@@ -51,7 +51,17 @@ def merge_trace_files(paths, labels=None, trace=None):
     while len(labels) < len(paths):
         p = paths[len(labels)]
         labels.append(os.path.splitext(os.path.basename(p))[0])
+    merged = merge_trace_docs(docs, labels, trace=trace)
+    merged["otherData"]["merged_from"] = [str(p) for p in paths]
+    return merged
 
+
+def merge_trace_docs(docs, labels, trace=None):
+    """The files-independent core of :func:`merge_trace_files`: merge
+    already-loaded chrome trace documents (each with an
+    ``otherData.epoch_origin_us`` anchor) onto one clock with flow links
+    — also the entry point ``tools/dump_flight.py`` feeds in-memory
+    documents built from flight-recorder bundles."""
     epochs = [_epoch_us(d) for d in docs]
     known = [e for e in epochs if e]
     base = min(known) if known else 0
@@ -94,7 +104,6 @@ def merge_trace_files(paths, labels=None, trace=None):
 
     return {"traceEvents": events + flows, "displayTimeUnit": "ms",
             "otherData": {"epoch_origin_us": base,
-                          "merged_from": [str(p) for p in paths],
                           "trace_ids": sorted(by_trace)}}
 
 

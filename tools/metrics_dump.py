@@ -36,6 +36,41 @@ def parse_address(s):
     return host, int(port)
 
 
+def readme_metric_help(readme_path=None):
+    """{metric name: description} parsed from the README metrics table
+    — the SAME per-family rows ``tools/check_metrics_doc.py`` validates
+    against the registry, reused here so the ``# HELP`` lines in scraped
+    Prometheus text carry the reviewed docs wording (the wire snapshot's
+    help string is the fallback for families the table hasn't caught up
+    with — the doc gate makes that a transient state)."""
+    import re
+
+    if readme_path is None:
+        readme_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "README.md")
+    out = {}
+    try:
+        with open(readme_path) as f:
+            src = f.read()
+    except OSError:
+        return out
+    row = re.compile(r'^\|\s*`(paddle_tpu_[A-Za-z0-9_]+)`\s*'
+                     r'\|[^|]*\|[^|]*\|\s*([^|]+?)\s*\|', re.MULTILINE)
+    for name, desc in row.findall(src):
+        out[name] = desc
+    return out
+
+
+def apply_readme_help(snapshot, help_by_name):
+    """Overlay README descriptions onto a snapshot's per-family help
+    fields (in place; returns the snapshot)."""
+    for name, fam in (snapshot or {}).items():
+        if isinstance(fam, dict) and name in help_by_name:
+            fam["help"] = help_by_name[name]
+    return snapshot
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("addresses", nargs="+", type=parse_address,
@@ -65,16 +100,23 @@ def main(argv=None):
 
     merged = len(args.addresses) == 1 or args.merged
     if args.format == "prom":
+        # HELP lines come from the README metrics-table descriptions —
+        # the same rows check_metrics_doc.py keeps in lockstep with the
+        # registry — so scraped text is self-describing in the reviewed
+        # docs wording
+        doc_help = readme_metric_help()
         snap = m.merge_snapshots(reached) if merged else None
         if snap is not None:
-            sys.stdout.write(m.prometheus_text(snap))
+            sys.stdout.write(m.prometheus_text(
+                apply_readme_help(snap, doc_help)))
         else:
             for addr, s in by_addr.items():
                 if s is None:
                     sys.stdout.write(f"# {addr}: unreachable\n")
                     continue
                 sys.stdout.write(f"# ==== {addr} ====\n")
-                sys.stdout.write(m.prometheus_text(s))
+                sys.stdout.write(m.prometheus_text(
+                    apply_readme_help(s, doc_help)))
         return 0
 
     if len(args.addresses) == 1:
