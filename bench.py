@@ -109,13 +109,18 @@ import numpy as np
 LAYOUT = "NHWC"
 
 
+# every record _rec stamped this process, in emission order — what
+# --compare-to diffs against the previous run's records
+_EMITTED_RECORDS = []
+
+
 def _rec(d):
     """Stamp every lane record with the ACTIVE kernel tier (what the
     kernel_tier flag resolved to for this process) and the executor_verify
     flag, so bench JSON rows are attributable to the lowering tier AND the
     verification mode that produced them."""
     from paddle_tpu.core.flags import get_flag
-    from paddle_tpu.obs import REGISTRY, json_safe, recorder, slo
+    from paddle_tpu.obs import REGISTRY, json_safe, perf, recorder, slo
     from paddle_tpu.ops.pallas import resolve_tier
     out = dict(d)
     out.setdefault("kernel_tier", resolve_tier())
@@ -136,6 +141,16 @@ def _rec(d):
         "flight_capacity": int(get_flag("obs_flight_events")),
         "flight_events": len(recorder.RECORDER.events()),
     }))
+    # perf-layer stamp: how many executables this process compiled (and
+    # what that cost) by the time the row was emitted, plus the live
+    # device bytes — the compile/memory context every number sits in
+    cl = perf.COMPILE_LOG.stats()
+    out.setdefault("perf", json_safe({
+        "compiles": cl["count"],
+        "compile_seconds": round(float(cl["total_seconds"]), 3),
+        "device_bytes_live": perf.sample_device_memory()["total"],
+    }))
+    _EMITTED_RECORDS.append(out)
     return out
 
 
@@ -426,11 +441,16 @@ def run_observability_overhead_lane(batch=8, image_size=32, class_dim=10,
 
     The ON configuration runs the FULL actionable layer: a live
     SloMonitor (two rules re-evaluated on a tight interval, snapshotting
-    the registry concurrently with the measured steps) and the flight
-    recorder taking events — the <3% gate and the zero-retrace pin must
-    hold with everything on, or the layer is not deployable."""
+    the registry concurrently with the measured steps), the flight
+    recorder taking events, AND the perf layer live — the compile log
+    recording (obs_compile_log default-on; the measured windows must
+    add ZERO records, the zero-retrace invariant now observable) plus a
+    background MemorySampler refreshing the device-memory gauge — the
+    <3% gate and the zero-retrace pin must hold with everything on, or
+    the layer is not deployable."""
     import paddle_tpu.fluid as fluid
-    from paddle_tpu.obs import REGISTRY, recorder as obs_recorder
+    from paddle_tpu.obs import REGISTRY, perf as obs_perf, \
+        recorder as obs_recorder
     from paddle_tpu.obs.slo import SloMonitor
 
     main, startup = fluid.Program(), fluid.Program()
@@ -477,12 +497,25 @@ def run_observability_overhead_lane(batch=8, image_size=32, class_dim=10,
         interval_s=0.05)
     monitor.install()
 
+    # the ON state's perf layer: a background memory sampler next to the
+    # always-on compile log. 0.15 s is already ~7x the production
+    # cadence (obs_slo_interval_s defaults to 1.0 s); each CPU-fallback
+    # sample walks jax.live_arrays() under the GIL (~1 ms), so a
+    # 0.05 s cadence on a small box steals measurable time from the
+    # step loop it shares a core with — that cost is the SAMPLER'S
+    # bug at that cadence, not the layer's steady-state overhead
+    sampler = obs_perf.MemorySampler(interval_s=0.15)
+
     def set_state(on):
         fluid.set_flags({"obs_op_metrics": on})
         if on and not monitor.running():
             monitor.start()
         elif not on and monitor.running():
             monitor.stop()
+        if on and not sampler.running():
+            sampler.start()
+        elif not on and sampler.running():
+            sampler.stop()
 
     # compile + warm BOTH flag states before measuring (the second state
     # must not pay first-use counter-child creation inside its window)
@@ -490,7 +523,15 @@ def run_observability_overhead_lane(batch=8, image_size=32, class_dim=10,
     window(warmup)
     set_state(True)
     window(2)
+    # one synchronous sample OUTSIDE any timed window: the "ran live"
+    # assert can never race the cadence, and the sampler's cost-bounded
+    # backoff is primed with the real per-sample cost BEFORE the first
+    # measured window (in a process with many live arrays the CPU
+    # fallback costs milliseconds — the backoff keeps it off the step
+    # loop's core)
+    sampler.sample_now()
     r0 = retraces()
+    compiles0 = obs_perf.COMPILE_LOG.stats()["count"]
 
     best = {False: float("inf"), True: float("inf")}
 
@@ -514,6 +555,8 @@ def run_observability_overhead_lane(batch=8, image_size=32, class_dim=10,
     while best[True] / best[False] - 1.0 > 0.03 and repeats < 8:
         repeats += 1
         measure_round()
+    sampler_alive = sampler.running()
+    sampler_stats = sampler.stats()
     set_state(False)
     from paddle_tpu.obs import slo as _slo
     if _slo.installed() is monitor:
@@ -522,6 +565,20 @@ def run_observability_overhead_lane(batch=8, image_size=32, class_dim=10,
 
     assert r1 == r0, \
         f"metering retraced the step function ({r1 - r0} retraces)"
+    compiles1 = obs_perf.COMPILE_LOG.stats()["count"]
+    assert compiles1 == compiles0, \
+        f"the compile log caught {compiles1 - compiles0} executable " \
+        "builds inside the measured windows — the zero-retrace " \
+        "invariant is broken (and now observable)"
+    # the priming sample_now() makes samples >= 1 by construction, so
+    # the meaningful liveness pins are: the background thread was STILL
+    # alive through the measured rounds and no sample ever errored
+    # (its cost-bounded backoff may legitimately skip short windows)
+    assert sampler_alive, \
+        "the memory sampler thread died during the ON windows"
+    assert sampler.samples > 0 and sampler_stats["last_error"] is None, \
+        f"the memory sampler never sampled cleanly ({sampler_stats})"
+    mem_total = obs_perf.sample_device_memory()["total"]
     slo_evals = monitor.health_section()["evaluations"]
     assert slo_evals > 0, \
         "SloMonitor never evaluated during the ON windows — the lane " \
@@ -544,6 +601,9 @@ def run_observability_overhead_lane(batch=8, image_size=32, class_dim=10,
         "windows_per_config": repeats,
         "slo_evaluations": int(slo_evals),
         "slo_rules": len(monitor.rules),
+        "compile_log_records": int(compiles1),
+        "memory_samples": int(sampler.samples),
+        "device_bytes_live": int(mem_total),
     }
 
 
@@ -1767,6 +1827,14 @@ def main():
                          "jnp elsewhere; the flagship lane additionally "
                          "fuses conv+bn chains and the momentum step when "
                          "the tier resolves to pallas")
+    ap.add_argument("--compare-to", default=None, metavar="PREV.json",
+                    help="after all lanes, diff this previous run's "
+                         "records (driver BENCH_r*.json or raw bench "
+                         "output) against the lanes just measured "
+                         "(tools/bench_compare.py in-process, 5%% noise "
+                         "threshold); the verdict is stamped into the "
+                         "final flagship record as 'bench_compare' and "
+                         "the delta table printed to stderr")
     args = ap.parse_args()
 
     if args.smoke:
@@ -2112,13 +2180,52 @@ def main():
         assert np.isfinite(loss_v), f"non-finite loss {loss_v}"
     images_per_sec = steps * batch / elapsed
     baseline = 3000.0  # BASELINE.json: ResNet-50 >= 3000 images/sec/chip
-    print(json.dumps(_rec({
+    flagship = _rec({
         "metric": "resnet50_train_throughput" + ("_smoke" if args.smoke else ""),
         "value": round(images_per_sec, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(images_per_sec / baseline, 4),
-    })))
+    })
+    if args.compare_to:
+        # in-process regression gate: every lane just measured vs the
+        # previous run's records, verdict stamped into the LAST record
+        # so the next session's BENCH_r*.json carries its own comparison
+        flagship["bench_compare"] = _compare_records(args.compare_to)
+    print(json.dumps(flagship))
     return 0
+
+
+def _compare_records(prev_path):
+    """tools/bench_compare.py against the records this run emitted;
+    returns the JSON-safe verdict block (never raises — a bad baseline
+    file becomes an 'error' verdict, the measured lanes still print)."""
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import bench_compare
+    try:
+        old = bench_compare.load_records(prev_path)
+        new = {bench_compare._lane_name(r["metric"]): r
+               for r in _EMITTED_RECORDS if "metric" in r}
+        result = bench_compare.compare_records(old, new)
+    except Exception as e:
+        # never-raises contract: a bad baseline OR a malformed
+        # just-measured record becomes an error verdict — the run's
+        # measured lanes must still print after a whole bench run
+        print(f"bench_compare: {type(e).__name__}: {e}", file=sys.stderr)
+        return {"baseline": prev_path, "error": str(e), "ok": False}
+    print(f"bench_compare vs {prev_path} "
+          f"(threshold {result['threshold_pct']:g}%):", file=sys.stderr)
+    print(bench_compare.format_table(result), file=sys.stderr)
+    return {
+        "baseline": prev_path,
+        "ok": bool(result["ok"]),
+        "threshold_pct": result["threshold_pct"],
+        "regressions": result["regressions"],
+        "missing": result["missing"],
+        "new_lanes": result["new_lanes"],
+        "deltas": {r["lane"]: r["delta_pct"] for r in result["rows"]},
+    }
 
 
 if __name__ == "__main__":

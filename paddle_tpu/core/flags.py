@@ -363,6 +363,25 @@ DEFINE_flag("obs_flight_events", 2048,
             "flight_dump RPC and incident bundles. Oldest events are "
             "overwritten (the dropped count is reported in dumps)")
 
+DEFINE_flag("obs_compile_log", 256,
+            "capacity of the per-process obs.perf CompileLog ring: how "
+            "many recent CompileRecords (site, wall seconds, executable "
+            "identity, optional cost_analysis flops/bytes) are retained "
+            "for stats()/bench stamps; 0 disables compile telemetry "
+            "entirely (no histogram observations, no records, no "
+            "'compile' flight events). NOT in the executor jit key — "
+            "flipping it never retraces")
+
+DEFINE_flag("obs_compile_cost", False,
+            "harvest compiled.cost_analysis() flops/bytes-accessed into "
+            "each CompileRecord by AOT-lowering the just-built "
+            "executable. The backend compiles the computation a SECOND "
+            "time for the harvest (jax shares the trace but not the "
+            "executable between jit dispatch and AOT lower().compile()), "
+            "so this roughly doubles compile cost — a profiling-session "
+            "switch, off by default. Not in the jit key: flipping never "
+            "retraces")
+
 DEFINE_flag("obs_incident_dir", "",
             "directory obs.recorder.IncidentCollector writes incident "
             "bundles (one JSON file per trigger: breach / canary_failed "

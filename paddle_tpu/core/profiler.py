@@ -218,6 +218,14 @@ class LatencyWindow:
         self.count = 0
         self.name = name
         self.kind = kind
+        # snapshot memo keyed on (generation, count): re-reading an IDLE
+        # window (SLO monitors on tight intervals, fleet scrapes over
+        # hundreds of histogram children) must not re-sort the full ring
+        # each time. record() bumps count; reset() bumps the generation
+        # (count alone is ambiguous — a reset-then-refill can restore an
+        # old count while a concurrent snapshot is mid-memoize)
+        self._snap_memo = None
+        self._snap_gen = 0
 
     def record(self, seconds):
         with self._lock:
@@ -247,13 +255,24 @@ class LatencyWindow:
 
     def snapshot(self):
         with self._lock:
+            memo = self._snap_memo
+            if memo is not None and memo[0] == self._snap_gen \
+                    and memo[1] == self.count:
+                return dict(memo[2])
             durs = sorted(self._durs)
             n = self.count
+            gen = self._snap_gen
         out = {"count": n, "window": len(durs)}
         for q in (50, 99):
             out[f"p{q}_ms"] = _percentile_sorted(durs, q) * 1e3
         if durs:
             out["max_ms"] = durs[-1] * 1e3
+        with self._lock:
+            # only memoize the state we actually sorted: a record()
+            # between the lock windows moved count on, a reset() bumped
+            # the generation — either way this memo simply never hits
+            if gen == self._snap_gen:
+                self._snap_memo = (gen, n, dict(out))
         return out
 
     def reset(self):
@@ -263,6 +282,8 @@ class LatencyWindow:
             self._durs = []
             self._next = 0
             self.count = 0
+            self._snap_memo = None
+            self._snap_gen += 1
 
 
 def export_chrome_tracing(path, evs=None):

@@ -24,14 +24,24 @@ pieces:
   structured lifecycle events, ``flight_dump`` RPC on every RpcServer)
   and the ``IncidentCollector`` that snapshots the whole fleet into one
   incident bundle on breach / canary-fail / child-restart triggers.
+* :mod:`.perf` — performance introspection: compile telemetry (the
+  ``paddle_tpu_compile_seconds`` histogram + bounded per-process
+  :data:`~.perf.COMPILE_LOG` of ``CompileRecord``\\ s, ``compile``
+  flight events), device-memory watermark gauges
+  (``paddle_tpu_device_bytes_live``/``_peak``,
+  :func:`~.perf.sample_device_memory` / ``MemorySampler``), and the
+  cost-attribution API (:func:`~.perf.attribute` AOT HLO/cost-analysis
+  merge, :func:`~.perf.profile` device-trace aggregation) the profiling
+  CLIs are thin argument parsers over.
 * :func:`~.metrics.json_safe` — the wire-safety coercion every
   ``stats()``/``health()`` payload passes through.
 """
 
-from . import metrics, recorder, slo, trace
+from . import metrics, perf, recorder, slo, trace
 from .metrics import (Counter, Gauge, Histogram, REGISTRY, json_safe,
                       merge_snapshots, next_instance, prometheus_text,
                       scrape)
+from .perf import COMPILE_LOG, CompileRecord, MemorySampler
 from .recorder import (FlightRecorder, IncidentCollector, RECORDER,
                        capture_bundle, record)
 from .slo import SloBreach, SloMonitor, SloRule
@@ -39,10 +49,11 @@ from .trace import (current_trace_id, new_trace_id, set_trace_id,
                     reset_trace_id, trace_context)
 
 __all__ = [
-    "metrics", "trace", "slo", "recorder", "REGISTRY", "Counter", "Gauge",
-    "Histogram", "json_safe", "merge_snapshots", "next_instance",
+    "metrics", "trace", "slo", "recorder", "perf", "REGISTRY", "Counter",
+    "Gauge", "Histogram", "json_safe", "merge_snapshots", "next_instance",
     "prometheus_text", "scrape", "current_trace_id", "new_trace_id",
     "set_trace_id", "reset_trace_id", "trace_context", "SloRule",
     "SloMonitor", "SloBreach", "FlightRecorder", "IncidentCollector",
-    "RECORDER", "record", "capture_bundle",
+    "RECORDER", "record", "capture_bundle", "COMPILE_LOG", "CompileRecord",
+    "MemorySampler",
 ]

@@ -228,6 +228,8 @@ class _HistogramChild:
                     w._durs = []
                     w._next = 0
                     w.count = 0
+                    w._snap_memo = None
+                    w._snap_gen += 1
                     self._epoch = _FORK_EPOCH
 
     def observe(self, seconds):

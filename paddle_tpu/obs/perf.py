@@ -1,0 +1,771 @@
+"""Performance introspection plane: compile telemetry, device-memory
+watermarks, and HLO cost attribution — the fourth obs pillar.
+
+PRs 10 and 12 built the *operational* planes (metrics/traces, then
+SLO/flight-recorder/incidents); this module carries the signals
+profile-driven kernel work needs:
+
+* **Compile telemetry** — every compiled-executable build (Executor jit
+  (re)traces, engine warmup buckets, the generation engine's
+  prefill/chunk/decode clones, ``run_steps`` scans) lands a
+  ``paddle_tpu_compile_seconds`` observation labeled by *site*, a
+  :class:`CompileRecord` in the bounded per-process :data:`COMPILE_LOG`
+  (wall time, bucket/program identity, ``cost_analysis()`` flops /
+  bytes-accessed when harvested — the ``obs_compile_cost`` flag), and a
+  ``compile`` flight-recorder event carrying the active trace id, so a
+  rollout that pays warmup compiles is visible in the incident bundle.
+  The existing ``paddle_tpu_executor_retraces`` counter says *that*
+  something retraced; this layer says *which* executable and *what it
+  cost*. Detection rides the jit trace-cache size (one C++ probe per
+  dispatch, ~0.02 us), so per-bucket internal retraces of one compiled
+  fn are each attributed. The ``obs_compile_log`` flag (capacity; 0
+  disables) is deliberately NOT in the executor's ``_JIT_KEY_FLAGS`` —
+  flipping the layer on/off never retraces.
+* **Device-memory watermarks** — :func:`sample_device_memory` sets
+  ``paddle_tpu_device_bytes_live{device}`` (and ``_peak`` where the
+  backend reports it) from ``jax.local_devices()[*].memory_stats()``,
+  falling back to a ``jax.live_arrays()`` byte tally on backends
+  without allocator stats (CPU). :class:`MemorySampler` re-samples on
+  the existing background-monitor cadence (``obs_slo_interval_s``);
+  ``ModelServer.health()`` samples per scrape — so the gauge is
+  SLO-able through the PR-12 rule engine with zero new machinery.
+* **Cost attribution** — :func:`attribute` AOT-lowers one dispatch of
+  any program / engine / registry bundle exactly as the Executor would
+  compile it, and merges the optimized HLO's static per-instruction
+  operand+result bytes (:func:`hlo_shape_bytes`, extracted from
+  ``tools/hlo_report.py`` and unit-tested) with the backend's
+  ``cost_analysis()`` totals into a top-N table. :func:`profile` wraps
+  ``jax.profiler.trace`` device-event aggregation (extracted from
+  ``tools/profile_step.py``) around ANY step callable. The two CLIs
+  are argument parsing over these entry points.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import tempfile
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+from ..core.flags import get_flag
+from .metrics import REGISTRY as _METRICS, json_safe
+
+# the obs_compile_log / obs_compile_cost flags are DEFINEd in
+# core/flags.py with every other flag (check_flags_doc.py regex-scans
+# that one file)
+
+_M_COMPILE_SECONDS = _METRICS.histogram(
+    "paddle_tpu_compile_seconds",
+    "wall seconds per compiled-executable build (trace + XLA compile + "
+    "the dispatch that triggered it), labeled by compile site",
+    labels=("site",), span_name="perf/compile", span_kind="stage")
+_M_BYTES_LIVE = _METRICS.gauge(
+    "paddle_tpu_device_bytes_live",
+    "live device memory bytes per local device — backend memory_stats "
+    "bytes_in_use when available, else a jax.live_arrays() byte tally",
+    labels=("device",))
+_M_BYTES_PEAK = _METRICS.gauge(
+    "paddle_tpu_device_bytes_peak",
+    "peak device memory bytes per local device (backends that report "
+    "memory_stats peak_bytes_in_use only — absent on CPU)",
+    labels=("device",))
+
+# ---------------------------------------------------------------------------
+# fork safety (mirrors obs.recorder: O(1) hook, lazy ring reset)
+# ---------------------------------------------------------------------------
+
+_FORK_EPOCH = 0
+
+
+def _bump_fork_epoch():
+    global _FORK_EPOCH
+    _FORK_EPOCH += 1
+
+
+os.register_at_fork(after_in_child=_bump_fork_epoch)
+
+
+# ---------------------------------------------------------------------------
+# compile telemetry
+# ---------------------------------------------------------------------------
+
+def enabled():
+    """Whether the compile-telemetry layer records anything (the
+    ``obs_compile_log`` capacity flag is > 0)."""
+    return int(get_flag("obs_compile_log")) > 0
+
+
+class CompileRecord:
+    """One compiled-executable build: where it happened (``site``), what
+    it cost (``seconds`` wall: trace + XLA compile + the dispatch that
+    triggered it), which executable (``identity`` — bucket / phase /
+    feed shapes / program version, site-dependent), and the backend's
+    ``cost_analysis()`` ``flops`` / ``bytes_accessed`` when harvested
+    (``obs_compile_cost``; None otherwise)."""
+
+    __slots__ = ("site", "seconds", "t", "identity", "flops",
+                 "bytes_accessed", "trace", "seq")
+
+    def __init__(self, site, seconds, identity=None, flops=None,
+                 bytes_accessed=None, trace=None):
+        self.site = str(site)
+        self.seconds = float(seconds)
+        self.t = time.time()
+        self.identity = json_safe(identity or {})
+        self.flops = None if flops is None else float(flops)
+        self.bytes_accessed = None if bytes_accessed is None \
+            else float(bytes_accessed)
+        self.trace = trace
+        self.seq = 0
+
+    def as_dict(self):
+        return json_safe({
+            "site": self.site, "seconds": self.seconds, "t": self.t,
+            "identity": self.identity, "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed, "trace": self.trace,
+            "seq": self.seq,
+        })
+
+    def __repr__(self):
+        return (f"CompileRecord({self.site!r}, {self.seconds:.3f}s, "
+                f"identity={self.identity})")
+
+
+class CompileLog:
+    """Bounded per-process ring of :class:`CompileRecord`. Capacity
+    defaults from the ``obs_compile_log`` flag (read lazily at first
+    record); fork-started children lazily reset — they never report the
+    parent's compiles nor deadlock on an inherited lock."""
+
+    def __init__(self, capacity=None):
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        self._records = None
+        self._seq = 0
+        self._total_seconds = 0.0
+        self._epoch = _FORK_EPOCH
+
+    def _check_fork(self):
+        if self._epoch != _FORK_EPOCH:
+            self._lock = threading.Lock()
+            self._records = None
+            self._seq = 0
+            self._total_seconds = 0.0
+            self._epoch = _FORK_EPOCH
+
+    def _ring_locked(self):
+        if self._records is None:
+            cap = self._capacity
+            if cap is None:
+                cap = int(get_flag("obs_compile_log"))
+            self._records = deque(maxlen=max(1, int(cap)))
+        return self._records
+
+    def add(self, record):
+        self._check_fork()
+        with self._lock:
+            self._seq += 1
+            record.seq = self._seq
+            self._total_seconds += record.seconds
+            self._ring_locked().append(record)
+        return record
+
+    def records(self, site=None):
+        """Records oldest-first (the ring's window), optionally filtered
+        to one site."""
+        self._check_fork()
+        with self._lock:
+            recs = list(self._ring_locked())
+        if site is not None:
+            recs = [r for r in recs if r.site == site]
+        return recs
+
+    def stats(self):
+        """``{count, total_seconds, by_site}`` — count/total cover the
+        process lifetime (not just the ring window)."""
+        self._check_fork()
+        with self._lock:
+            recs = list(self._ring_locked())
+            count, total = self._seq, self._total_seconds
+        by_site = {}
+        for r in recs:
+            s = by_site.setdefault(r.site, {"count": 0, "seconds": 0.0})
+            s["count"] += 1
+            s["seconds"] += r.seconds
+        return json_safe({"count": count,
+                          "total_seconds": total,
+                          "by_site": by_site})
+
+    def clear(self):
+        """TEST hygiene: drop every record and reset counters."""
+        self._check_fork()
+        with self._lock:
+            if self._records is not None:
+                self._records.clear()
+            self._seq = 0
+            self._total_seconds = 0.0
+
+
+COMPILE_LOG = CompileLog()
+
+# compile-site labeling: engines (and any other owner of a compiled
+# executable) wrap their dispatch in compile_site(...) so a build
+# detected inside Executor dispatch is attributed to the REAL site
+# (engine_warmup / genengine_decode / ...) with its bucket/phase
+# identity, not just "jit_step"
+_SITE = threading.local()
+
+
+@contextmanager
+def compile_site(site, **detail):
+    """Label any compile detected inside the block with ``site`` (a
+    bounded code-site enum — it becomes a metric label value) and attach
+    ``detail`` to its CompileRecord identity."""
+    prev = getattr(_SITE, "value", None)
+    _SITE.value = (str(site), detail)
+    try:
+        yield
+    finally:
+        _SITE.value = prev
+
+
+def current_site(default="jit_step"):
+    """(site, detail) the next detected compile should be attributed to."""
+    v = getattr(_SITE, "value", None)
+    if v is None:
+        return default, {}
+    return v
+
+
+def note_compile(site, seconds, identity=None, flops=None,
+                 bytes_accessed=None):
+    """Land one compiled-executable build in the telemetry layer:
+    histogram observation (labeled by site), CompileRecord in
+    :data:`COMPILE_LOG`, and a ``compile`` flight-recorder event (which
+    carries the active distributed trace id — a reload RPC's warmup
+    compiles join the rollout's trace). No-op when the layer is off."""
+    if not enabled():
+        return None
+    rec = CompileRecord(site, seconds, identity=identity, flops=flops,
+                        bytes_accessed=bytes_accessed)
+    from .recorder import record as _flight_record
+    _M_COMPILE_SECONDS.labels(site=rec.site).observe(rec.seconds)
+    ev = _flight_record("compile", component=rec.site,
+                        seconds=round(rec.seconds, 4),
+                        **{k: v for k, v in rec.identity.items()
+                           if k in ("bucket", "phase", "instance",
+                                    "program_version")})
+    rec.trace = ev.get("trace")
+    COMPILE_LOG.add(rec)
+    return rec
+
+
+def harvest_cost(fn, *args):
+    """Best-effort ``cost_analysis()`` totals of ``fn`` AOT-lowered at
+    ``args`` — ``(flops, bytes_accessed)``, (None, None) when the
+    backend provides nothing. The backend compiles a second executable
+    for this (jit dispatch and AOT lower().compile() do not share), so
+    callers gate it (``obs_compile_cost``)."""
+    try:
+        ca = fn.lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if not isinstance(ca, dict):
+            return None, None
+        return ca.get("flops"), ca.get("bytes accessed")
+    except Exception:
+        return None, None
+
+
+# ---------------------------------------------------------------------------
+# device-memory watermarks
+# ---------------------------------------------------------------------------
+
+def sample_device_memory():
+    """One memory sample: per-device live bytes into
+    ``paddle_tpu_device_bytes_live{device}`` (and ``_peak`` where the
+    backend reports it). Source per device: allocator ``memory_stats()``
+    when available (TPU/GPU), else the device's share of a
+    ``jax.live_arrays()`` byte tally (CPU — no allocator stats).
+    Returns ``{"devices": {label: bytes}, "peaks": {...}, "sources":
+    {label: "memory_stats"|"live_arrays"}, "total": int}``."""
+    import jax
+
+    devices, peaks, sources = {}, {}, {}
+    tally_labels = []
+    for d in jax.local_devices():
+        label = f"{d.platform}:{d.id}"
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if ms and ms.get("bytes_in_use") is not None:
+            devices[label] = int(ms["bytes_in_use"])
+            sources[label] = "memory_stats"
+            if ms.get("peak_bytes_in_use") is not None:
+                peaks[label] = int(ms["peak_bytes_in_use"])
+        else:
+            tally_labels.append(label)
+    if tally_labels:
+        tally = {label: 0 for label in tally_labels}
+        for a in jax.live_arrays():
+            try:
+                ds = list(a.devices())
+                nbytes = int(a.nbytes)
+            except Exception:
+                continue
+            for d in ds:
+                label = f"{d.platform}:{d.id}"
+                if label in tally:
+                    # a sharded array's bytes split across its devices
+                    tally[label] += nbytes // max(len(ds), 1)
+        for label, b in tally.items():
+            devices[label] = b
+            sources[label] = "live_arrays"
+    for label, b in devices.items():
+        _M_BYTES_LIVE.labels(device=label).set(b)
+    for label, b in peaks.items():
+        _M_BYTES_PEAK.labels(device=label).set(b)
+    return {"devices": devices, "peaks": peaks, "sources": sources,
+            "total": sum(devices.values())}
+
+
+def memory_section():
+    """The JSON-safe dict ``health()``/``stats()`` surfaces embed — one
+    fresh sample (so a health scrape always carries a current gauge)."""
+    s = sample_device_memory()
+    return json_safe({
+        "device_bytes_live": s["devices"],
+        "device_bytes_peak": s["peaks"],
+        "sources": s["sources"],
+        "total_bytes_live": s["total"],
+    })
+
+
+class MemorySampler:
+    """Background device-memory sampler: re-samples every ``interval_s``
+    (default: the ``obs_slo_interval_s`` flag — the same cadence the
+    background SLO monitor evaluates on), keeping the
+    ``paddle_tpu_device_bytes_live`` gauge fresh for SLO rules and
+    scrapes without a caller in the loop.
+
+    Self-bounding: the CPU fallback walks ``jax.live_arrays()`` under
+    the GIL, whose cost grows with the process's live-array count
+    (milliseconds in a busy server) — so after each sample the wait
+    stretches to at least ``cost_factor`` times the observed sample
+    duration. A sampler can then never steal more than
+    ~1/cost_factor of a core no matter how expensive sampling gets;
+    it degrades to a sparser cadence instead (``effective_interval_s``
+    in :meth:`stats` reports the stretch)."""
+
+    def __init__(self, interval_s=None, cost_factor=50.0):
+        self.interval_s = float(get_flag("obs_slo_interval_s")
+                                if interval_s is None else interval_s)
+        self.cost_factor = float(cost_factor)
+        self._stop = threading.Event()
+        self._thread = None
+        self._samples = 0
+        self._last_error = None
+        self._effective_interval_s = self.interval_s
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError("MemorySampler already running")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._watch, daemon=True,
+                                        name="perf-memory-sampler")
+        self._thread.start()
+        return self
+
+    def _watch(self):
+        while not self._stop.wait(self._effective_interval_s):
+            try:
+                t0 = time.perf_counter()
+                sample_device_memory()
+                dt = time.perf_counter() - t0
+                self._samples += 1
+                self._effective_interval_s = max(self.interval_s,
+                                                 dt * self.cost_factor)
+            except Exception as e:     # the sampler must never die
+                self._last_error = f"{type(e).__name__}: {e}"
+
+    def sample_now(self):
+        """One synchronous sample on the calling thread — counts like a
+        background sample and primes the cost-bounded cadence (callers
+        that are about to enter a measured/latency-sensitive phase take
+        one up front so the background thread already knows the cost)."""
+        t0 = time.perf_counter()
+        out = sample_device_memory()
+        dt = time.perf_counter() - t0
+        self._samples += 1
+        self._effective_interval_s = max(self.interval_s,
+                                         dt * self.cost_factor)
+        return out
+
+    def stop(self, timeout=10.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            return not self._thread.is_alive()
+        return True
+
+    def running(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def samples(self):
+        return self._samples
+
+    def stats(self):
+        return json_safe({"running": self.running(),
+                          "interval_s": self.interval_s,
+                          "effective_interval_s": self._effective_interval_s,
+                          "samples": self._samples,
+                          "last_error": self._last_error})
+
+
+# ---------------------------------------------------------------------------
+# static HLO traffic estimation (the hlo_report.py estimator, extracted)
+# ---------------------------------------------------------------------------
+
+_HLO_DTYPE_BYTES = {
+    "f64": 8, "s64": 8, "u64": 8, "c64": 8, "c128": 16,
+    "f32": 4, "s32": 4, "u32": 4,
+    "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "pred": 1,
+}
+
+_HLO_SHAPE_RE = re.compile(
+    r"(c128|c64|f64|f32|bf16|f16|s64|s32|s16|s8|u64|u32|u16|u8|pred)"
+    r"\[([0-9,]*)\]")
+
+
+def hlo_shape_bytes(shape_str):
+    """Total bytes of every HLO shape in ``shape_str`` — a plain array
+    shape (``bf16[256,56,56,64]{3,2,1,0}``), a SCALAR (``f32[]`` — zero
+    dims is one element), or a tuple, arbitrarily nested
+    (``(f32[2]{0}, (s32[], pred[3]))`` sums every member). Layout/tiling
+    suffixes and unknown dtypes contribute nothing."""
+    total = 0
+    for m in _HLO_SHAPE_RE.finditer(shape_str):
+        dt, dims = m.groups()
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _HLO_DTYPE_BYTES[dt]
+    return total
+
+
+def hlo_entry_rows(hlo_text, skip_kinds=("parameter", "constant",
+                                         "get-tuple-element", "tuple",
+                                         "bitcast")):
+    """Static per-instruction traffic estimate over the ENTRY computation
+    of an optimized-HLO dump: for every top-level instruction, its
+    result bytes plus the operand shapes named on its line. Returns
+    ``(rows, kind_totals)`` where rows are
+    ``(total_bytes, result_bytes, kind, name, line_snippet)`` sorted
+    largest-first."""
+    entry, in_entry = [], False
+    for ln in hlo_text.splitlines():
+        if ln.startswith("ENTRY "):
+            in_entry = True
+            continue
+        if in_entry:
+            if ln.startswith("}"):
+                break
+            entry.append(ln.strip())
+    rows = []
+    kind_totals = {}
+    for ln in entry:
+        # "ROOT %x = ..." lines count too (the original estimator
+        # silently skipped the root instruction)
+        m = re.match(r"(?:ROOT )?(%?[\w.\-]+) = (.+?) (\w+)\(", ln)
+        if not m:
+            continue
+        name, shape_str, kind = m.groups()
+        if kind in skip_kinds:
+            continue
+        result_b = hlo_shape_bytes(shape_str)
+        operand_b = hlo_shape_bytes(ln[m.end():])
+        total = result_b + operand_b
+        rows.append((total, result_b, kind, name, ln[:160]))
+        kind_totals[kind] = kind_totals.get(kind, 0) + total
+    rows.sort(reverse=True)
+    return rows, kind_totals
+
+
+# ---------------------------------------------------------------------------
+# cost attribution (AOT lower + cost_analysis + static HLO merge)
+# ---------------------------------------------------------------------------
+
+def template_feed(program, feed_names, batch=1):
+    """Zero feed synthesized from the program's feed-var metadata
+    (shape ``[-1, d1, ...]`` + dtype) at ``batch`` rows — the analysis
+    twin of the serving engine's warmup template."""
+    import numpy as np
+    from ..core.types import np_dtype
+
+    block = program.global_block()
+    feed = {}
+    for name in feed_names:
+        v = block.var(name)
+        dims = list(v.shape or [])
+        if dims and dims[0] == -1:
+            dims = dims[1:]
+        if any(d is None or int(d) < 0 for d in dims):
+            raise ValueError(
+                f"feed var {name!r} has unknown dims {v.shape}; pass an "
+                "explicit feed")
+        dt = np_dtype(v.dtype) if v.dtype is not None else np.float32
+        feed[name] = np.zeros([int(batch)] + [int(d) for d in dims], dt)
+    return feed
+
+
+def lower_program(program, feed, fetch_list, executor=None, scope=None):
+    """AOT-lower one dispatch of ``program`` exactly as ``Executor.run``
+    would compile it (same state/feed surface resolution, same jit
+    wrapper) and compile it for the attached backend. Returns
+    ``(lowered, compiled)``."""
+    import jax
+    from ..core.amp import amp_guard
+    from ..core.executor import (Executor, _RNG_KEY, _collect_free_inputs,
+                                 _written_names)
+    from ..core.scope import global_scope
+
+    exe = executor or Executor(mode="jit")
+    # default scope = the global scope, exactly Executor.run's default
+    # (a fresh empty scope would miss the program's trained parameters)
+    scope = scope if scope is not None else global_scope()
+    fetch_names = tuple(f if isinstance(f, str) else f.name
+                        for f in fetch_list)
+    feed = dict(feed)
+    if scope.find_var(_RNG_KEY) is None:
+        scope.set(_RNG_KEY, jax.random.PRNGKey(program.random_seed or 0))
+    block = program.global_block()
+    free = _collect_free_inputs(program, 0)
+    state_in = tuple(n for n in free if n not in feed and scope.has_var(n))
+    written = _written_names(program, 0)
+    state_out = tuple(n for n in written
+                      if (block.has_var(n) and block.var(n).persistable)
+                      or scope.has_var(n))
+    fn = exe._compiled(program, tuple(sorted(feed)), fetch_names,
+                       state_in, state_out)
+    state = {n: scope.find_var(n) for n in state_in}
+    state[_RNG_KEY] = scope.find_var(_RNG_KEY)
+    with amp_guard(exe.amp):
+        lowered = fn.lower(state, feed)
+    return lowered, lowered.compile()
+
+
+def cost_totals(compiled):
+    """``cost_analysis()`` of an AOT-compiled executable flattened to
+    ``{flops, bytes_accessed, detail}`` (detail keeps every per-category
+    ``bytes accessed*`` entry above 1e8 bytes); empty values when the
+    backend provides nothing."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        ca = None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        ca = {}
+    detail = {k: v for k, v in ca.items()
+              if "bytes accessed" in k and k != "bytes accessed"
+              and v > 1e8}
+    return json_safe({"flops": ca.get("flops"),
+                      "bytes_accessed": ca.get("bytes accessed"),
+                      "detail": detail})
+
+
+def attribute(target, feed=None, fetch_list=None, batch=1, top=40,
+              executor=None, scope=None, dump_hlo=None):
+    """Per-op cost attribution for one dispatch: AOT-lower ``target``,
+    merge the backend's ``cost_analysis()`` totals with the optimized
+    HLO's static per-instruction operand+result bytes, and return a
+    top-N table.
+
+    ``target`` is a ``Program`` (with ``feed`` + ``fetch_list``), a
+    bundle directory (``save_inference_model`` export or a registry
+    version dir — loaded into a private scope, feeds synthesized at
+    ``batch`` rows), or an ``InferenceEngine`` (its program/scope).
+    Returns ``{"cost": {flops, bytes_accessed, detail}, "kind_totals",
+    "rows": [{bytes, result_bytes, kind, name, hlo}], "instructions",
+    "compile_seconds"}``; ``dump_hlo=`` writes the optimized HLO text."""
+    from ..serving.engine import InferenceEngine
+
+    if isinstance(target, str):
+        import paddle_tpu.fluid as fluid
+        from ..core.scope import Scope
+        scope = scope if scope is not None else Scope()
+        exe = executor or fluid.Executor()
+        program, feed_names, fetch_vars = fluid.io.load_inference_model(
+            target, exe, scope=scope)
+        feed = feed if feed is not None \
+            else template_feed(program, feed_names, batch=batch)
+        fetch_list = fetch_vars if fetch_list is None else fetch_list
+        executor = exe
+    elif isinstance(target, InferenceEngine):
+        program = target.program
+        scope = target._scope if scope is None else scope
+        executor = target._exe if executor is None else executor
+        feed = feed if feed is not None \
+            else template_feed(program, target.feed_names, batch=batch)
+        fetch_list = target.fetch_names if fetch_list is None else fetch_list
+    else:
+        program = target
+        if feed is None or fetch_list is None:
+            raise ValueError(
+                "attribute(program, ...) needs feed= and fetch_list= "
+                "(bundle dirs and engines synthesize their own)")
+
+    t0 = time.perf_counter()
+    _lowered, compiled = lower_program(program, feed, fetch_list,
+                                       executor=executor, scope=scope)
+    compile_seconds = time.perf_counter() - t0
+    cost = cost_totals(compiled)
+    hlo = compiled.as_text()
+    if dump_hlo:
+        with open(dump_hlo, "w") as f:
+            f.write(hlo)
+    rows, kind_totals = hlo_entry_rows(hlo)
+    note_compile("attribute", compile_seconds,
+                 identity={"fetch": [f if isinstance(f, str) else f.name
+                                     for f in fetch_list][:4]},
+                 flops=cost.get("flops"),
+                 bytes_accessed=cost.get("bytes_accessed"))
+    return json_safe({
+        "cost": cost,
+        "kind_totals": dict(sorted(kind_totals.items(),
+                                   key=lambda kv: -kv[1])),
+        "rows": [{"bytes": t, "result_bytes": rb, "kind": k,
+                  "name": n, "hlo": snip}
+                 for t, rb, k, n, snip in rows[:int(top)]],
+        "instructions": len(rows),
+        "compile_seconds": compile_seconds,
+    })
+
+
+# ---------------------------------------------------------------------------
+# device-trace profiling (the profile_step.py aggregation, extracted)
+# ---------------------------------------------------------------------------
+
+def aggregate_device_trace(trace_dir):
+    """Aggregate the complete ('X') events of a ``jax.profiler.trace``
+    output directory by event name. Prefers device lanes (process names
+    mentioning TPU/GPU); without any (CPU backend) it aggregates host
+    lanes instead. Returns ``(per_name_us, per_name_count, on_device)``."""
+    files = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    per_name, per_name_n = {}, {}
+    on_device = False
+    for path in files:
+        with gzip.open(path) as f:
+            tr = json.load(f)
+        ev = tr.get("traceEvents", [])
+        device_pids = set()
+        for e in ev:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                pname = e.get("args", {}).get("name", "")
+                if "TPU" in pname or "GPU" in pname:
+                    device_pids.add(e["pid"])
+        if device_pids:
+            on_device = True
+        for e in ev:
+            if e.get("ph") != "X":
+                continue
+            if device_pids and e.get("pid") not in device_pids:
+                continue
+            name = e["name"]
+            per_name[name] = per_name.get(name, 0) + e.get("dur", 0)
+            per_name_n[name] = per_name_n.get(name, 0) + 1
+    return per_name, per_name_n, on_device
+
+
+def profile(fn, steps=8, warmup=2, trace_dir=None, top=40):
+    """Per-kernel device timing of ANY step callable: run ``warmup``
+    un-traced dispatches, then ``steps`` under ``jax.profiler.trace``,
+    and aggregate the trace's device events by name (host events on
+    backends without device lanes — ``on_device`` says which you got).
+    ``fn`` dispatches one step (a program run, an engine infer, a
+    generation step — anything); its return value is block_until_ready'd
+    best-effort so the measured window is honest.
+
+    Returns ``{"steps", "wall_s_per_step", "on_device",
+    "busy_us_per_step", "by_kind": [...], "top": [...]}`` — ``by_kind``
+    groups trailing ``.N`` fusion indices."""
+    import jax
+
+    out = None
+    for _ in range(int(warmup)):
+        out = fn()
+    _block(out)
+    tmp = trace_dir or tempfile.mkdtemp(prefix="pdtpu_prof_")
+    t0 = time.perf_counter()
+    with jax.profiler.trace(tmp):
+        for _ in range(int(steps)):
+            out = fn()
+        _block(out)
+    wall = time.perf_counter() - t0
+    if not glob.glob(os.path.join(tmp, "**", "*.trace.json.gz"),
+                     recursive=True):
+        # a broken profiler setup (unwritable dir, profiler unavailable)
+        # must not read as a valid 0-ms measurement
+        raise RuntimeError(f"jax.profiler produced no trace under {tmp}")
+    per_name, per_name_n, on_device = aggregate_device_trace(tmp)
+    # drop the outer module/step spans: whole-step 'jit_*' events, bare
+    # numeric per-step spans nested under them, and (host fallback) the
+    # profiler's own '$file.py:line' python-frame events — what's left
+    # is executed kernels/executables
+    leaf = {n: us for n, us in per_name.items()
+            if not n.startswith("jit_") and not n.isdigit()
+            and not n.startswith("$")}
+    total_us = sum(leaf.values())
+    grouped = {}
+    for name, us in leaf.items():
+        base = re.sub(r"\.[0-9]+$", "", name)
+        grouped[base] = grouped.get(base, 0) + us
+    by_kind = [{"name": n, "us_per_step": us / steps,
+                "pct": 100.0 * us / max(total_us, 1)}
+               for n, us in sorted(grouped.items(), key=lambda kv: -kv[1])]
+    top_rows = [{"name": n, "us_per_step": us / steps,
+                 "pct": 100.0 * us / max(total_us, 1),
+                 "count": per_name_n.get(n, 0)}
+                for n, us in sorted(leaf.items(),
+                                    key=lambda kv: -kv[1])[:int(top)]]
+    return json_safe({
+        "steps": int(steps),
+        "wall_s_per_step": wall / max(int(steps), 1),
+        "on_device": on_device,
+        "busy_us_per_step": total_us / max(int(steps), 1),
+        "by_kind": by_kind,
+        "top": top_rows,
+    })
+
+
+def _block(out):
+    import jax
+    try:
+        jax.block_until_ready(out)
+    except Exception:
+        import numpy as np
+        try:
+            np.asarray(out)
+        except Exception:
+            pass
+
+
+__all__ = [
+    "COMPILE_LOG", "CompileLog", "CompileRecord", "MemorySampler",
+    "aggregate_device_trace", "attribute", "compile_site", "cost_totals",
+    "current_site", "enabled", "harvest_cost", "hlo_entry_rows",
+    "hlo_shape_bytes", "lower_program", "memory_section", "note_compile",
+    "profile", "sample_device_memory", "template_feed",
+]

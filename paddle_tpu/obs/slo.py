@@ -219,7 +219,7 @@ class SloMonitor:
         names = [r.name for r in self.rules]
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate SLO rule names: {sorted(names)}")
-        self._snapshot_fn = snapshot_fn or _METRICS.snapshot
+        self._snapshot_fn = snapshot_fn or self._rule_families_snapshot
         self.interval_s = float(get_flag("obs_slo_interval_s")
                                 if interval_s is None else interval_s)
         self._on_breach = on_breach
@@ -231,6 +231,23 @@ class SloMonitor:
         self._last_error = None
         self._stop = threading.Event()
         self._thread = None
+
+    def _rule_families_snapshot(self):
+        """Default snapshot source: ONLY the metric families the rules
+        reference, resolved live from the local registry. A full
+        ``REGISTRY.snapshot()`` serializes every family — including
+        every histogram child's percentile sort — and its cost grows
+        with the whole process's series count; a monitor judging two
+        rules on a tight interval was paying for all of it (measured
+        several ms per pass in a bench-sized registry, real GIL steal
+        on small hosts). Pass ``snapshot_fn=`` for fleet views or full
+        snapshots."""
+        out = {}
+        for name in {r.metric for r in self.rules}:
+            fam = _METRICS.get(name)
+            if fam is not None:
+                out[name] = fam.snapshot()
+        return out
 
     # ------------------------------------------------------------------
     def start(self):

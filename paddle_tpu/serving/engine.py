@@ -34,6 +34,7 @@ from ..core.flags import get_flag
 from ..core.profiler import record_event
 from ..core.scope import Scope
 from ..core.types import np_dtype
+from ..obs import perf as _perf
 from ..obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
 
 # obs plane: the engine's compile/hit/hot-recompile counters live in the
@@ -78,6 +79,23 @@ def parse_buckets(spec=None):
     return sorted(set(vals))
 
 
+def commit_scope_arrays(scope):
+    """Convert a scope's plain numpy arrays to jax arrays IN PLACE —
+    exactly the conversion the jit boundary applies at every dispatch
+    anyway (same dtype rules), done once up front. Without this, the
+    FIRST dispatch of each engine traces against numpy state avals and
+    the next dispatch of the same executable (now fed the jax arrays
+    the first run wrote back) lands a SECOND jit cache entry — a whole
+    hidden recompile per engine that the engine's own signature-based
+    compile counters never saw (found by obs.perf compile telemetry:
+    the zero-steady-state-compile pin caught it)."""
+    import jax.numpy as jnp
+    for name in scope.local_names():
+        v = scope.find_var(name)
+        if isinstance(v, np.ndarray):
+            scope.set(name, jnp.asarray(v))
+
+
 def _pad_rows(a, bucket):
     """Pad a [n, ...] array up to [bucket, ...] by replicating its last
     row (outputs for the padding rows are discarded by the caller)."""
@@ -119,6 +137,7 @@ class InferenceEngine:
             raise ValueError(
                 "InferenceEngine needs model_dir= or all of program=/"
                 "feed_names=/fetch_vars=")
+        commit_scope_arrays(self._scope)
         self._program = program
         self._feed_names = list(feed_names)
         self._fetch_names = [v if isinstance(v, str) else v.name
@@ -284,10 +303,17 @@ class InferenceEngine:
                 if self._warmed:
                     self._m_hot.inc()
         with self._lock:
-            with record_event(f"serving/infer_b{bucket}", kind="stage"):
-                outs = self._exe.run(self._program, feed=padded,
-                                     fetch_list=list(fetch_names),
-                                     scope=self._scope)
+            # compile-site label for obs.perf: a build detected inside
+            # this dispatch (each bucket's first padded shape) is
+            # attributed to the engine with its bucket identity; after
+            # warmup any compile here is the hot-recompile alarm's twin
+            site = "engine_warmup" if not self._warmed else "engine_infer"
+            with _perf.compile_site(site, instance=self.obs_instance,
+                                    bucket=bucket):
+                with record_event(f"serving/infer_b{bucket}", kind="stage"):
+                    outs = self._exe.run(self._program, feed=padded,
+                                         fetch_list=list(fetch_names),
+                                         scope=self._scope)
         trimmed = []
         for name, o in zip(fetch_names, outs):
             if isinstance(o, np.ndarray) and o.ndim >= 1 \
@@ -308,10 +334,34 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------
     @property
+    def warmed(self):
+        """Whether warmup() ran — the cheap liveness bit health() reads
+        (stats() includes a device-memory sample since the perf plane;
+        a health poll must not pay that walk twice)."""
+        return self._warmed
+
+    @property
     def hot_recompiles(self):
         """Compiles observed after warmup — derived from this engine's
         registry counter (the dict shape callers read is unchanged)."""
         return int(self._m_hot.value)
+
+    def _memory_section(self):
+        """Accounting reconciliation: bytes this engine can explain
+        (its scope's parameter arrays) next to the device's live total,
+        so an operator can see how much of
+        ``paddle_tpu_device_bytes_live`` THIS engine's weights are —
+        and how much is bucket executables / other tenants."""
+        param_bytes = 0
+        for name in self._scope.local_names():
+            v = self._scope.find_var(name)
+            nb = getattr(v, "nbytes", None)
+            if nb is not None:
+                param_bytes += int(nb)
+        mem = _perf.sample_device_memory()
+        return {"param_bytes": param_bytes,
+                "device_bytes_live": mem["total"],
+                "unaccounted_bytes": max(0, mem["total"] - param_bytes)}
 
     def stats(self):
         # the historical dict shape, DERIVED from this instance's
@@ -328,6 +378,7 @@ class InferenceEngine:
             "hot_recompiles": self.hot_recompiles,
             "warmed": self._warmed,
             "kernel_tier": self._kernel_tier,
+            "memory": self._memory_section(),
         })
 
 

@@ -49,9 +49,10 @@ import numpy as np
 from ...core.flags import get_flag
 from ...core.profiler import record_event
 from ...core.scope import Scope
+from ...obs import perf as _perf
 from ...obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
 from ...obs.recorder import record as _flight_record
-from ..engine import parse_buckets
+from ..engine import commit_scope_arrays, parse_buckets
 from .kvcache import CacheExhausted, PagedKVCache
 
 _M_COMPILES = _METRICS.counter(
@@ -207,6 +208,10 @@ class GenerationEngine:
             raise ValueError(
                 "GenerationEngine needs model_dir= or all of program=/"
                 "feed_names=/fetch_vars=")
+        # numpy state's first dispatch would land a second jit cache
+        # entry per executable once the run writes jax arrays back —
+        # commit up front (see engine.commit_scope_arrays)
+        commit_scope_arrays(self._scope)
         self._feed_names = list(feed_names)
         unknown = [n for n in self._feed_names
                    if n not in ("tokens", "positions")]
@@ -387,9 +392,17 @@ class GenerationEngine:
                 if self._warmed:
                     self._m_hot.inc()
         fetch = [self._logits_name] + self._arena_fetch_names()
-        with record_event(f"serving/gen_{phase}_b{bucket}", kind="stage"):
-            outs = self._exe.run(program, feed=feed, fetch_list=fetch,
-                                 scope=self._scope, return_numpy=False)
+        # compile-site label for obs.perf: a build under this dispatch
+        # (warmup compiles one executable per phase clone x bucket) is
+        # attributed with its phase/bucket identity
+        site = "genengine_warmup" if not self._warmed \
+            else f"genengine_{phase}"
+        with _perf.compile_site(site, instance=self.obs_instance,
+                                phase=phase, bucket=bucket):
+            with record_event(f"serving/gen_{phase}_b{bucket}",
+                              kind="stage"):
+                outs = self._exe.run(program, feed=feed, fetch_list=fetch,
+                                     scope=self._scope, return_numpy=False)
         for l in range(self.num_layers):
             self.cache.k[l] = outs[1 + 2 * l]
             self.cache.v[l] = outs[2 + 2 * l]
@@ -912,10 +925,41 @@ class GenerationEngine:
 
     # ------------------------------------------------------------------
     @property
+    def warmed(self):
+        """Whether warmup() ran — the cheap liveness bit health() reads
+        without paying stats()'s device-memory sample."""
+        return self._warmed
+
+    @property
     def hot_recompiles(self):
         """Compiles observed after warmup — derived from this engine's
         registry counter."""
         return int(self._m_hot.value)
+
+    def _memory_section(self):
+        """KV-arena accounting reconciliation: the arena's full byte
+        footprint (pre-allocated — live regardless of occupancy), the
+        share its in-use blocks address, the scope's parameter bytes,
+        and the device's live total, so an operator can see what of
+        ``paddle_tpu_device_bytes_live`` the serving state explains."""
+        arena_bytes = sum(int(a.nbytes)
+                          for arrs in (self.cache.k, self.cache.v)
+                          for a in arrs)
+        cs = self.cache.stats()
+        in_use_frac = cs["blocks_in_use"] / max(cs["num_blocks"], 1)
+        param_bytes = 0
+        for name in self._scope.local_names():
+            v = self._scope.find_var(name)
+            nb = getattr(v, "nbytes", None)
+            if nb is not None:
+                param_bytes += int(nb)
+        mem = _perf.sample_device_memory()
+        accounted = arena_bytes + param_bytes
+        return {"arena_bytes": arena_bytes,
+                "arena_bytes_in_use": int(arena_bytes * in_use_frac),
+                "param_bytes": param_bytes,
+                "device_bytes_live": mem["total"],
+                "unaccounted_bytes": max(0, mem["total"] - accounted)}
 
     def stats(self):
         with self._stats_lock:
@@ -940,6 +984,7 @@ class GenerationEngine:
             "kernel_tier": self._kernel_tier,
             "ttft": self.ttft.snapshot(),
             "tpot": self.tpot.snapshot(),
+            "memory": self._memory_section(),
         })
 
 

@@ -39,7 +39,7 @@ import os
 import threading
 
 from ..distributed.rpc import RpcServer
-from ..obs import recorder as _flight, slo as _slo
+from ..obs import perf as _perf, recorder as _flight, slo as _slo
 from ..obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
 from .batcher import DynamicBatcher
 from .engine import InferenceEngine
@@ -319,14 +319,23 @@ class ModelServer:
 
     def health(self):
         engine = self._current_engine()
+        # engine.warmed, NOT engine.stats()["warmed"]: stats() includes
+        # a device-memory sample since the perf plane, and health is the
+        # cheap-liveness surface — one memory_section() below is the
+        # whole memory cost of a health poll
         out = {"status": "serving" if self._serving else "stopped",
-               "warmed": engine.stats()["warmed"],
+               "warmed": engine.warmed,
                "batching": self.batching,
                "model_kind": self.model_kind,
                "version": self._version,
                "queue_depth": 0}
         if self.batcher is not None:
             out["queue_depth"] = self.batcher.stats()["queue_depth"]
+        # device-memory watermark, sampled per scrape so every health
+        # poll (and the SLO rules judging the gauge it refreshes)
+        # reads a current number — json-safe, present on every backend
+        # (CPU falls back to the live-arrays tally)
+        out["memory"] = _perf.memory_section()
         # SLO verdicts on the same surface rollouts and routers already
         # health-gate on: this server's OWN monitor when it has one
         # (two servers in one process must not report each other's

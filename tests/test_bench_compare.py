@@ -1,0 +1,166 @@
+"""tools/bench_compare.py — the bench regression gate, pinned as a
+tier-1 subprocess gate: identical records exit 0, a seeded 10%
+throughput regression exits nonzero NAMING the lane, and malformed /
+missing-lane records fail typed (exit 2) rather than tracebacking.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "tools", "bench_compare.py")
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import bench_compare  # noqa: E402
+
+
+def _lines(records):
+    return "\n".join(json.dumps(r) for r in records) + "\n"
+
+
+RECORDS = [
+    {"metric": "resnet50_train_throughput", "value": 2567.5,
+     "unit": "images/sec/chip", "vs_baseline": 0.86},
+    {"metric": "generation_serving", "value": 99.4,
+     "unit": "tokens/sec, 8 concurrent GenClient streams"},
+    {"metric": "online_learning", "value": 1900.0,
+     "unit": "ms publish-to-served lag p50 (freeze cut -> ...)"},
+    {"metric": "lstm_textcls_train_ms_batch", "value": 5.03,
+     "unit": "ms/batch (bs64 hid512 len100, lower is better)"},
+]
+
+
+def _run(*argv):
+    return subprocess.run([sys.executable, CLI, *argv],
+                          capture_output=True, text=True, timeout=60)
+
+
+# ---------------------------------------------------------------------------
+# the subprocess gate
+# ---------------------------------------------------------------------------
+
+def test_identical_records_exit_zero(tmp_path):
+    p = tmp_path / "run.json"
+    p.write_text(_lines(RECORDS))
+    r = _run(str(p), str(p))
+    assert r.returncode == 0, r.stderr
+    assert "OK" in r.stdout
+
+
+def test_self_compare_of_real_bench_record():
+    """The acceptance pin: exit 0 on self-compare of a real
+    BENCH_r*.json from the trajectory."""
+    real = os.path.join(REPO, "BENCH_r05.json")
+    if not os.path.exists(real):
+        pytest.skip("no BENCH_r05.json in this checkout")
+    r = _run(real, real)
+    assert r.returncode == 0, r.stderr
+    assert "resnet50_train_throughput" in r.stdout
+
+
+def test_seeded_throughput_regression_exits_nonzero_naming_lane(tmp_path):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(_lines(RECORDS))
+    regressed = [dict(r) for r in RECORDS]
+    regressed[0] = dict(regressed[0], value=round(2567.5 * 0.9, 1))
+    new.write_text(_lines(regressed))
+    r = _run(str(old), str(new))
+    assert r.returncode == 1
+    assert "resnet50_train_throughput" in r.stderr   # named
+    assert "REGRESSION" in r.stdout
+
+
+def test_lower_is_better_lane_regresses_upward(tmp_path):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(_lines(RECORDS))
+    worse = [dict(r) for r in RECORDS]
+    worse[2] = dict(worse[2], value=1900.0 * 1.12)   # lag p50 ms UP 12%
+    new.write_text(_lines(worse))
+    r = _run(str(old), str(new))
+    assert r.returncode == 1
+    assert "online_learning" in r.stderr
+    # ...and the same delta DOWN is an improvement, not a regression
+    better = [dict(r) for r in RECORDS]
+    better[2] = dict(better[2], value=1900.0 * 0.88)
+    new.write_text(_lines(better))
+    assert _run(str(old), str(new)).returncode == 0
+
+
+def test_malformed_records_fail_typed(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("this is not a bench record\nnor { this\n")
+    ok = tmp_path / "ok.json"
+    ok.write_text(_lines(RECORDS))
+    r = _run(str(bad), str(ok))
+    assert r.returncode == 2
+    assert "bench_compare:" in r.stderr
+    assert "Traceback" not in r.stderr
+    # a lane whose value is not numeric fails typed too
+    bad.write_text(_lines([{"metric": "x", "value": "fast",
+                            "unit": "QPS"}]))
+    r = _run(str(bad), str(ok))
+    assert r.returncode == 2
+    assert "Traceback" not in r.stderr
+
+
+def test_missing_lane_fails_typed_unless_ignored(tmp_path):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(_lines(RECORDS))
+    new.write_text(_lines(RECORDS[:-1]))             # lstm lane dropped
+    r = _run(str(old), str(new))
+    assert r.returncode == 2
+    assert "lstm_textcls_train_ms_batch" in r.stderr
+    assert "Traceback" not in r.stderr
+    assert _run(str(old), str(new), "--ignore-missing").returncode == 0
+
+
+def test_trajectory_dir_mode(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(_lines(RECORDS))
+    (tmp_path / "BENCH_r02.json").write_text(_lines(RECORDS))
+    r = _run("--dir", str(tmp_path))
+    assert r.returncode == 0
+    assert "BENCH_r01.json -> " in r.stdout
+    # fewer than two records: typed failure
+    r = _run("--dir", str(tmp_path / "nothing"))
+    assert r.returncode == 2 and "Traceback" not in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# in-process API (what bench.py --compare-to runs)
+# ---------------------------------------------------------------------------
+
+def test_compare_records_threshold_and_smoke_suffix():
+    old = {"a": {"metric": "a", "value": 100.0, "unit": "QPS"}}
+    new = {"a": {"metric": "a", "value": 96.0, "unit": "QPS"}}
+    assert bench_compare.compare_records(old, new, 5.0)["ok"]
+    new["a"]["value"] = 94.0
+    res = bench_compare.compare_records(old, new, 5.0)
+    assert not res["ok"] and res["regressions"] == ["a"]
+    # _smoke suffixes strip, so smoke runs compare against full runs
+    assert bench_compare._lane_name("serving_throughput_smoke") \
+        == "serving_throughput"
+
+
+def test_driver_record_shape_parses(tmp_path):
+    driver = {"n": 5, "cmd": "python bench.py", "rc": 0,
+              "tail": "WARNING: noise line\n" + _lines(RECORDS),
+              "parsed": RECORDS[0]}
+    p = tmp_path / "BENCH_r05.json"
+    p.write_text(json.dumps(driver))
+    recs = bench_compare.load_records(str(p))
+    assert set(recs) == {r["metric"] for r in RECORDS}
+
+
+def test_new_lanes_are_not_failures():
+    old = {"a": {"metric": "a", "value": 1.0, "unit": "QPS"}}
+    new = {"a": {"metric": "a", "value": 1.0, "unit": "QPS"},
+           "b": {"metric": "b", "value": 9.9, "unit": "QPS"}}
+    res = bench_compare.compare_records(old, new)
+    assert res["ok"] and res["new_lanes"] == ["b"]

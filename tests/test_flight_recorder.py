@@ -197,6 +197,11 @@ def test_capture_bundle_links_traces_across_processes(tmp_path):
     (wall) clock and lists the id under linked_traces."""
     p, addr = _spawn_bundle_server(tmp_path)
     try:
+        # isolate the LOCAL ring: earlier tests in this process leave
+        # events behind (since obs.perf, every compiling test records a
+        # 'compile' event), and the tight-window assertions below are
+        # about THIS bundle's events, not the suite's lifetime
+        rec.RECORDER.clear()
         c = RpcClient(addr, timeout=60.0)
         with prof.trace_context() as tid:
             rec.record("parent_mark", component="bundle_parent")

@@ -40,6 +40,7 @@ def test_wheel_builds_with_all_subpackages(tmp_path):
                 "paddle_tpu/obs/__init__.py",
                 "paddle_tpu/obs/slo.py",
                 "paddle_tpu/obs/recorder.py",
+                "paddle_tpu/obs/perf.py",
                 "paddle_tpu/dataset/__init__.py",
                 "paddle_tpu/reader/__init__.py",
                 "paddle_tpu/trainer/__init__.py",

@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Bench regression gate: machine-diff two bench runs per lane.
+
+Accepts three record sources, auto-detected per file:
+
+* a driver ``BENCH_r*.json`` (``{"n", "cmd", "tail", ...}`` — lane
+  records are the JSON lines inside ``tail``),
+* a raw bench.py output file (one JSON object per line, non-JSON lines
+  ignored),
+* a plain JSON list/object of lane records.
+
+Lane records are the ``{"metric", "value", "unit", ...}`` rows bench.py
+prints; ``_smoke`` suffixes are stripped so a smoke run compares against
+a full run of the same lane. Direction comes from the unit string: units
+starting with ``ms``/``%`` or saying "lower is better" regress UP,
+everything else (img/s, QPS, MB/s, tokens/s, x-speedups) regresses DOWN.
+
+Exit codes (the tier-1 subprocess gate pins all three):
+
+* ``0`` — every lane within the noise threshold (default 5%),
+* ``1`` — at least one regression, named in the table,
+* ``2`` — typed input failure: unreadable/malformed records, a record
+  without metric/value, or a lane present in OLD but missing from NEW
+  (``--ignore-missing`` downgrades the last to a note).
+
+Usage:
+    python tools/bench_compare.py OLD.json NEW.json [--threshold 5]
+    python tools/bench_compare.py --dir .      # two newest BENCH_r*.json
+In-process: ``bench.py --compare-to PREV.json`` runs compare_records()
+and stamps the verdict into the final flagship record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+class BenchCompareError(ValueError):
+    """Typed input failure: malformed record files, lanes without
+    metric/value, missing lanes — exit code 2, never a traceback."""
+
+
+def _lane_name(metric):
+    return re.sub(r"_smoke$", "", str(metric))
+
+
+def _coerce_records(objs, path):
+    out = {}
+    for o in objs:
+        if not isinstance(o, dict) or "metric" not in o:
+            continue
+        if "value" not in o or not isinstance(o["value"], (int, float)) \
+                or isinstance(o["value"], bool):
+            raise BenchCompareError(
+                f"{path}: lane {o.get('metric')!r} has no numeric "
+                f"'value' field (got {o.get('value')!r})")
+        out[_lane_name(o["metric"])] = o
+    if not out:
+        raise BenchCompareError(
+            f"{path}: no bench lane records found (expected JSON lines "
+            "with 'metric' and 'value' fields, a driver BENCH_r*.json "
+            "with them in 'tail', or a JSON list of records)")
+    return out
+
+
+def load_records(path):
+    """``{lane: record}`` from any supported file shape. Raises
+    :class:`BenchCompareError` on unreadable/malformed input."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        raise BenchCompareError(f"cannot read {path}: {e}") from e
+
+    def json_lines(s):
+        objs = []
+        for ln in s.splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                objs.append(json.loads(ln))
+            except ValueError:
+                continue
+        return objs
+
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "tail" in doc:
+        objs = json_lines(doc.get("tail") or "")
+        if isinstance(doc.get("parsed"), dict):
+            objs.append(doc["parsed"])
+    elif isinstance(doc, dict) and "metric" in doc:
+        objs = [doc]
+    elif isinstance(doc, list):
+        objs = doc
+    elif doc is None:
+        objs = json_lines(text)
+    else:
+        raise BenchCompareError(
+            f"{path}: unrecognized record shape "
+            f"({type(doc).__name__} without 'tail'/'metric')")
+    return _coerce_records(objs, path)
+
+
+def lower_is_better(record):
+    unit = str(record.get("unit", ""))
+    return ("lower is better" in unit or unit.startswith("ms")
+            or unit.startswith("%"))
+
+
+def compare_records(old, new, threshold_pct=5.0):
+    """Per-lane delta of two ``{lane: record}`` maps. Returns
+    ``{rows, regressions, missing, new_lanes, ok, threshold_pct}`` —
+    ``ok`` ignores missing lanes (the CLI decides their severity)."""
+    rows, regressions, missing = [], [], []
+    thr = float(threshold_pct) / 100.0
+    for lane in sorted(old):
+        o = old[lane]
+        n = new.get(lane)
+        if n is None:
+            missing.append(lane)
+            continue
+        ov, nv = float(o["value"]), float(n["value"])
+        lib = lower_is_better(o)
+        if ov == 0.0:
+            delta = 0.0 if nv == 0.0 else float("inf") * (1 if nv > 0 else -1)
+        else:
+            delta = (nv - ov) / abs(ov)
+        regressed = (delta > thr) if lib else (delta < -thr)
+        improved = (delta < -thr) if lib else (delta > thr)
+        rows.append({
+            "lane": lane, "old": ov, "new": nv,
+            "delta_pct": round(delta * 100.0, 2),
+            "direction": "lower_is_better" if lib else "higher_is_better",
+            "verdict": ("REGRESSION" if regressed
+                        else "improved" if improved else "ok"),
+        })
+        if regressed:
+            regressions.append(lane)
+    return {
+        "rows": rows,
+        "regressions": regressions,
+        "missing": missing,
+        "new_lanes": sorted(set(new) - set(old)),
+        "ok": not regressions,
+        "threshold_pct": float(threshold_pct),
+    }
+
+
+def format_table(result):
+    lines = [f"{'lane':<36} {'old':>12} {'new':>12} {'delta%':>8}  verdict"]
+    for r in result["rows"]:
+        lines.append(f"{r['lane']:<36} {r['old']:>12.3f} {r['new']:>12.3f} "
+                     f"{r['delta_pct']:>8.2f}  {r['verdict']}")
+    for lane in result["missing"]:
+        lines.append(f"{lane:<36} {'-':>12} {'MISSING':>12}")
+    for lane in result["new_lanes"]:
+        lines.append(f"{lane:<36} {'NEW':>12} {'-':>12}")
+    return "\n".join(lines)
+
+
+def _trajectory_pair(dirname):
+    paths = glob.glob(os.path.join(dirname, "BENCH_r*.json"))
+
+    def key(p):
+        m = re.search(r"BENCH_r(\d+)", os.path.basename(p))
+        return int(m.group(1)) if m else -1
+
+    paths = sorted(paths, key=key)
+    if len(paths) < 2:
+        raise BenchCompareError(
+            f"--dir {dirname}: need at least two BENCH_r*.json to "
+            f"compare, found {len(paths)}")
+    return paths[-2], paths[-1]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="diff two bench runs per lane; nonzero exit on "
+                    "regression (see module docstring for exit codes)")
+    ap.add_argument("old", nargs="?", help="baseline record file")
+    ap.add_argument("new", nargs="?", help="candidate record file")
+    ap.add_argument("--dir", dest="trajectory_dir", default=None,
+                    help="compare the two newest BENCH_r*.json in DIR "
+                         "instead of explicit files")
+    ap.add_argument("--threshold", type=float, default=5.0,
+                    help="noise threshold in percent (default 5)")
+    ap.add_argument("--ignore-missing", action="store_true",
+                    help="lanes present in OLD but absent from NEW are "
+                         "noted instead of failing typed")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.trajectory_dir:
+            old_path, new_path = _trajectory_pair(args.trajectory_dir)
+        elif args.old and args.new:
+            old_path, new_path = args.old, args.new
+        else:
+            raise BenchCompareError(
+                "need OLD and NEW record files (or --dir DIR)")
+        old = load_records(old_path)
+        new = load_records(new_path)
+    except BenchCompareError as e:
+        print(f"bench_compare: {e}", file=sys.stderr)
+        return 2
+
+    print(f"bench_compare: {old_path} -> {new_path} "
+          f"(threshold {args.threshold:g}%)")
+    result = compare_records(old, new, threshold_pct=args.threshold)
+    print(format_table(result))
+    if result["missing"] and not args.ignore_missing:
+        print(f"bench_compare: lanes missing from {new_path}: "
+              f"{', '.join(result['missing'])} (pass --ignore-missing "
+              "to downgrade)", file=sys.stderr)
+        return 2
+    if result["regressions"]:
+        print(f"bench_compare: REGRESSION in "
+              f"{', '.join(result['regressions'])} "
+              f"(> {args.threshold:g}% beyond noise)", file=sys.stderr)
+        return 1
+    print("bench_compare: OK — every lane within threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,12 @@ BOUNDED_LABELS = {
     "window": "declared SLO window lengths — from rule configs",
     "trigger": "incident trigger enums: breach/canary_failed/"
                "child_restart/manual",
+    "site": "compile-site enums (obs.perf: jit_step/jit_scan/"
+            "engine_warmup/engine_infer/genengine_*/attribute) — a "
+            "fixed code-site set; per-executable identity rides the "
+            "CompileRecord, never a label",
+    "device": "local jax devices (platform:id) — bounded by the "
+              "attached hardware",
 }
 
 # families whose label VALUES can arrive off the RPC wire; each entry

@@ -1,142 +1,61 @@
-"""Dump XLA cost analysis + per-fusion HBM traffic for the bench step.
+"""Dump XLA cost analysis + per-instruction HBM traffic for a target.
 
-Builds the flagship ResNet-50 training step exactly as bench.py runs it,
-AOT-compiles it for the attached backend, and reports:
-  * total bytes accessed / flops from compiled.cost_analysis()
-  * the optimized HLO's largest instructions by operand+result bytes
-    (a static estimate: shapes of each fusion's parameters and root)
+Argument parsing over ``obs.perf.attribute``: AOT-compiles the target
+for the attached backend and reports ``compiled.cost_analysis()`` totals
+(bytes accessed / flops) merged with the optimized HLO's largest
+instructions by static operand+result bytes. Default target is the
+flagship ResNet-50 training step exactly as bench.py runs it;
+``--bundle DIR`` retargets any ``save_inference_model`` export or
+registry version dir (tools/profile_common.py is the shared
+scaffolding).
 
 Usage: python tools/hlo_report.py [--batch 256] [--top 40] [--dump FILE]
+                                  [--bundle DIR]
 """
 
 import argparse
-import collections
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
-
-
-def _shape_bytes(shape_str):
-    """Bytes of an HLO shape string like 'bf16[256,56,56,64]{...}' or a
-    tuple '(bf16[...], f32[...])'."""
-    total = 0
-    for m in re.finditer(r"(f64|f32|bf16|f16|s64|s32|u64|u32|s8|u8|pred)"
-                         r"\[([0-9,]*)\]", shape_str):
-        dt, dims = m.groups()
-        size = {"f64": 8, "s64": 8, "u64": 8, "f32": 4, "s32": 4, "u32": 4,
-                "bf16": 2, "f16": 2, "s8": 1, "u8": 1, "pred": 1}[dt]
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * size
-    return total
+import profile_common
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=256)
+    profile_common.add_target_args(ap)
     ap.add_argument("--top", type=int, default=40)
     ap.add_argument("--dump", default=None, help="write optimized HLO here")
     args = ap.parse_args()
 
-    import jax
-    import jax.numpy as jnp
-    import bench
-    import paddle_tpu.fluid as fluid
-    from paddle_tpu.core.executor import _collect_free_inputs, _written_names, _RNG_KEY
+    from paddle_tpu.obs import perf
 
-    batch, image_size, class_dim = args.batch, 224, 1000
-    main_prog, startup, avg_loss = bench.build(batch, image_size, class_dim)
+    target = profile_common.build_target(args)
+    print(f"target: {target.label}")
+    with target.ctx():
+        res = perf.attribute(target.program, feed=target.feeds[0],
+                             fetch_list=target.fetch_names,
+                             executor=target.exe, scope=target.scope,
+                             top=args.top, dump_hlo=args.dump)
 
-    rng = np.random.RandomState(0)
-    img_shape = (batch, image_size, image_size, 3)
-    feeds = {
-        "img": jnp.zeros(img_shape, jnp.bfloat16),
-        "label": jnp.zeros((batch, 1), jnp.int32),
-    }
-
-    scope = fluid.Scope()
-    exe = fluid.Executor(mode="jit", donate=True, amp=True)
-    with jax.default_matmul_precision("bfloat16"):
-        exe.run(startup, scope=scope)
-
-        block = main_prog.global_block()
-        free = _collect_free_inputs(main_prog, 0)
-        state_in = tuple(n for n in free if n not in feeds and scope.has_var(n))
-        written = _written_names(main_prog, 0)
-        state_out = tuple(n for n in written
-                          if (block.has_var(n) and block.var(n).persistable)
-                          or scope.has_var(n))
-        fn = exe._compiled(main_prog, tuple(sorted(feeds)),
-                           (avg_loss.name,), state_in, state_out)
-        state = {n: scope.find_var(n) for n in state_in}
-        state[_RNG_KEY] = scope.find_var(_RNG_KEY)
-
-        from paddle_tpu.core.amp import amp_guard
-        with amp_guard(True):
-            lowered = fn.lower(state, feeds)
-        compiled = lowered.compile()
-
-    ca = compiled.cost_analysis()
-    if isinstance(ca, list):
-        ca = ca[0]
-    print(f"bytes accessed: {ca.get('bytes accessed', 0) / 1e9:.2f} GB")
-    print(f"flops:          {ca.get('flops', 0) / 1e12:.2f} TFLOP")
-    for k, v in sorted(ca.items()):
-        if "bytes accessed" in k and k != "bytes accessed" and v > 1e8:
-            print(f"  {k}: {v/1e9:.2f} GB")
-
-    hlo = compiled.as_text()
+    cost = res["cost"]
+    ba = cost.get("bytes_accessed") or 0
+    fl = cost.get("flops") or 0
+    print(f"bytes accessed: {ba / 1e9:.2f} GB")
+    print(f"flops:          {fl / 1e12:.2f} TFLOP")
+    for k, v in sorted(cost.get("detail", {}).items()):
+        print(f"  {k}: {v/1e9:.2f} GB")
     if args.dump:
-        with open(args.dump, "w") as f:
-            f.write(hlo)
-        print(f"optimized HLO -> {args.dump} ({len(hlo)/1e6:.1f} MB)")
+        print(f"optimized HLO -> {args.dump}")
 
-    # static per-instruction traffic estimate from the entry computation:
-    # every non-fused top-level instruction's operand+result bytes
-    lines = hlo.splitlines()
-    entry = []
-    in_entry = False
-    for ln in lines:
-        if ln.startswith("ENTRY "):
-            in_entry = True
-            continue
-        if in_entry:
-            if ln.startswith("}"):
-                break
-            entry.append(ln.strip())
-
-    rows = []
-    kind_totals = collections.Counter()
-    for ln in entry:
-        m = re.match(r"(%?[\w.\-]+) = (.+?) (\w+)\(", ln)
-        if not m:
-            continue
-        name, shape_str, kind = m.groups()
-        if kind in ("parameter", "constant", "get-tuple-element", "tuple",
-                    "bitcast"):
-            continue
-        result_b = _shape_bytes(shape_str)
-        # operand shapes: any type[dims] appearing after the opcode's '('
-        rest = ln[m.end():]
-        operand_b = _shape_bytes(rest)
-        total = result_b + operand_b
-        rows.append((total, result_b, kind, name, ln[:160]))
-        kind_totals[kind] += total
-
-    rows.sort(reverse=True)
-    print(f"\ntop-level instructions: {len(rows)}")
+    print(f"\ntop-level instructions: {res['instructions']}")
     print("\ntraffic by instruction kind (static estimate):")
-    for k, v in kind_totals.most_common(12):
+    for k, v in list(res["kind_totals"].items())[:12]:
         print(f"  {k:24s} {v/1e9:7.2f} GB")
     print(f"\ntop {args.top} instructions by (operands+result) bytes:")
-    for total, result_b, kind, name, snippet in rows[:args.top]:
-        print(f"  {total/1e9:6.2f} GB  {snippet}")
+    for row in res["rows"]:
+        print(f"  {row['bytes']/1e9:6.2f} GB  {row['hlo']}")
 
 
 if __name__ == "__main__":

@@ -1,142 +1,86 @@
-"""Per-kernel device timing of the flagship ResNet-50 training step.
+"""Per-kernel device timing of one dispatch of a target.
 
-Runs the exact bench.py step under jax.profiler.trace and aggregates the
-/device:TPU events (fusions, convolutions, copies) by name: the dynamic
-analog of tools/hlo_report.py's static traffic estimate. This is the table
-the roofline argument rests on — which fusions actually burn the ~100 ms.
+Argument parsing over ``obs.perf.profile``: runs the target's step under
+``jax.profiler.trace`` and aggregates the device events (fusions,
+convolutions, copies) by name — the dynamic analog of
+tools/hlo_report.py's static traffic estimate, and the table the
+roofline argument rests on. Default target is the flagship ResNet-50
+training step exactly as bench.py runs it; ``--bundle DIR`` retargets
+any ``save_inference_model`` export or registry version dir
+(tools/profile_common.py is the shared scaffolding).
 
 Usage: python tools/profile_step.py [--batch 256] [--steps 8] [--top 40]
                                     [--no-s2d] [--hlo-match DUMP.txt]
+                                    [--bundle DIR]
 """
 
 import argparse
-import collections
-import glob
-import gzip
-import json
 import os
 import re
 import sys
-import tempfile
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
+import profile_common
 
 
-def run_and_trace(batch, steps, warmup, trace_dir):
-    import jax
-    import jax.numpy as jnp
-    import bench
-    import paddle_tpu.fluid as fluid
-
-    image_size, class_dim = 224, 1000
-    main_prog, startup, avg_loss = bench.build(batch, image_size, class_dim)
-    rng = np.random.RandomState(0)
-    feeds = [{
-        "img": jax.device_put(
-            rng.normal(0, 1, (batch, image_size, image_size, 3))
-            .astype("float32")).astype(jnp.bfloat16),
-        "label": jax.device_put(
-            rng.randint(0, class_dim, (batch, 1)).astype("int32")),
-    } for _ in range(2)]
-
-    scope = fluid.Scope()
-    exe = fluid.Executor(mode="jit", donate=True, amp=True)
-    with jax.default_matmul_precision("bfloat16"):
-        exe.run(startup, scope=scope)
-        for i in range(warmup):
-            v = exe.run(main_prog, feed=feeds[i % 2], fetch_list=[avg_loss],
-                        scope=scope)
-        with jax.profiler.trace(trace_dir):
-            t0 = time.perf_counter()
-            for i in range(steps):
-                v = exe.run(main_prog, feed=feeds[i % 2],
-                            fetch_list=[avg_loss], scope=scope,
-                            return_numpy=False)
-            np.asarray(v[0])
-            dt = (time.perf_counter() - t0) / steps
-    return dt
-
-
-def aggregate(trace_dir, steps):
-    files = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
-                      recursive=True)
-    assert files, f"no trace produced under {trace_dir}"
-    with gzip.open(files[0]) as f:
-        tr = json.load(f)
-    ev = tr.get("traceEvents", [])
-    device_pids = set()
-    for e in ev:
-        if e.get("ph") == "M" and e.get("name") == "process_name" \
-                and "TPU" in e.get("args", {}).get("name", ""):
-            device_pids.add(e["pid"])
-    per_name = collections.Counter()
-    per_name_n = collections.Counter()
-    for e in ev:
-        if e.get("ph") == "X" and e.get("pid") in device_pids:
-            per_name[e["name"]] += e.get("dur", 0)
-            per_name_n[e["name"]] += 1
-    return per_name, per_name_n
+def load_hlo_annotations(path):
+    """Map instruction name -> (defining line, static traffic estimate)
+    from an optimized-HLO dump (tools/hlo_report.py --dump), to annotate
+    fusion names with their root op and a GB/s column."""
+    from paddle_tpu.obs.perf import hlo_shape_bytes
+    shapes, nbytes = {}, {}
+    for ln in open(path):
+        m = re.match(r"\s*%?([\w.\-]+) = (.+)", ln)
+        if m:
+            shapes[m.group(1)] = m.group(2)[:150]
+            nbytes[m.group(1)] = hlo_shape_bytes(m.group(2))
+    return shapes, nbytes
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=256)
+    profile_common.add_target_args(ap)
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--top", type=int, default=40)
-    ap.add_argument("--no-s2d", action="store_true")
     ap.add_argument("--hlo-match", default=None,
-                    help="optimized-HLO dump (tools/hlo_report.py --dump) to "
-                         "annotate fusion names with their root op")
+                    help="optimized-HLO dump (tools/hlo_report.py --dump) "
+                         "to annotate fusion names with their root op")
     args = ap.parse_args()
 
-    from paddle_tpu.core.flags import set_flags
-    set_flags({"conv_space_to_depth": not args.no_s2d})
-
-    shapes = {}
-    nbytes = {}
+    shapes, nbytes = {}, {}
     if args.hlo_match and os.path.exists(args.hlo_match):
-        from hlo_report import _shape_bytes
-        # map instruction name -> its defining line (shape + operands) and a
-        # static traffic estimate (result + operand shapes on that line)
-        for ln in open(args.hlo_match):
-            m = re.match(r"\s*%?([\w.\-]+) = (.+)", ln)
-            if m:
-                shapes[m.group(1)] = m.group(2)[:150]
-                nbytes[m.group(1)] = _shape_bytes(m.group(2))
+        shapes, nbytes = load_hlo_annotations(args.hlo_match)
 
-    tmp = tempfile.mkdtemp(prefix="pdtpu_prof_")
-    dt = run_and_trace(args.batch, args.steps, args.warmup, tmp)
-    per_name, per_name_n = aggregate(tmp, args.steps)
+    from paddle_tpu.obs import perf
 
-    # drop the outer module/step spans: the whole-step 'jit_step(...)' event
-    # and the bare per-step numeric spans nested directly under it
-    leaf = {n: us for n, us in per_name.items()
-            if not n.startswith("jit_") and not n.isdigit()}
-    total_us = sum(leaf.values())
-    print(f"wall: {dt*1e3:.2f} ms/step   device leaf-kernel total: "
-          f"{total_us/args.steps/1e3:.2f} ms/step over {args.steps} steps")
+    target = profile_common.build_target(args)
+    print(f"target: {target.label}")
+    with target.ctx():
+        res = perf.profile(target.step_fn(), steps=args.steps,
+                           warmup=args.warmup, top=args.top)
+
+    where = "device" if res["on_device"] else \
+        "HOST (no device lanes in the trace — CPU backend)"
+    print(f"wall: {res['wall_s_per_step']*1e3:.2f} ms/step   "
+          f"{where} leaf total: {res['busy_us_per_step']/1e3:.2f} ms/step "
+          f"over {res['steps']} steps")
 
     print("\nby kernel kind (trailing .N stripped):")
-    grouped = collections.Counter()
-    for name, us in leaf.items():
-        grouped[re.sub(r"\.[0-9]+$", "", name)] += us
-    for name, us in grouped.most_common(15):
-        print(f"  {us/args.steps:10.1f} us {100.0*us/max(total_us,1):6.2f}% "
-              f" {name}")
+    for row in res["by_kind"][:15]:
+        print(f"  {row['us_per_step']:10.1f} us {row['pct']:6.2f}% "
+              f" {row['name']}")
 
     print(f"\ntop {args.top} instances (GB/s = static operand+result bytes "
           f"over measured time; v5e HBM peak ~819):")
     print(f"{'us/step':>10s} {'%':>6s} {'GB/s':>6s}  name | hlo")
-    for name, us in collections.Counter(leaf).most_common(args.top):
-        pct = 100.0 * us / max(total_us, 1)
-        us_step = us / args.steps
-        gbs = nbytes.get(name, 0) / (us_step * 1e-6) / 1e9 if us_step else 0
-        print(f"{us_step:10.1f} {pct:6.2f} {gbs:6.0f}  "
-              f"{name} | {shapes.get(name, '')[:110]}")
+    for row in res["top"]:
+        us_step = row["us_per_step"]
+        gbs = nbytes.get(row["name"], 0) / (us_step * 1e-6) / 1e9 \
+            if us_step else 0
+        print(f"{us_step:10.1f} {row['pct']:6.2f} {gbs:6.0f}  "
+              f"{row['name']} | {shapes.get(row['name'], '')[:110]}")
 
 
 if __name__ == "__main__":
