@@ -266,6 +266,26 @@ DEFINE_flag("serving_prefill_chunk", 0,
             "warmup when chunking or the prefix cache is enabled), so "
             "the hot path stays retrace-free")
 
+DEFINE_flag("serving_exec_cache", True,
+            "whether serving engines LOAD persisted compiled executables "
+            "(serving/execcache.py): a bundle's published warm/ artifacts "
+            "(read-only) or the serving_exec_cache_dir local cache. Every "
+            "artifact is fingerprint-checked (bundle content hash, feed "
+            "shapes/dtypes, jit-key flags incl. kernel_tier, jax/jaxlib "
+            "version, backend platform/device kind) — any mismatch is a "
+            "silent miss followed by a normal compile. False = always "
+            "compile, bitwise the pre-cache behavior even on warmed "
+            "bundles. Host-side only: flipping it never retraces")
+
+DEFINE_flag("serving_exec_cache_dir", "",
+            "per-process READ-WRITE compiled-executable cache directory "
+            "for bundles without published warm/ artifacts: engine warmup "
+            "saves each executable it compiles there and later engines on "
+            "the same bundle bytes load instead of compiling. Empty "
+            "(default) disables the local cache; published registry "
+            "versions use their own <version>/warm/ dir regardless (see "
+            "ModelRegistry.warm / publish(warm_cache=True))")
+
 DEFINE_flag("serving_max_seqs", 8,
             "decode slots in the generation engine's ONE fixed-shape "
             "[max_seqs, 1] decode executable. Bounds concurrent in-flight "

@@ -105,7 +105,10 @@ class CompileRecord:
     """One compiled-executable build: where it happened (``site``), what
     it cost (``seconds`` wall: trace + XLA compile + the dispatch that
     triggered it), which executable (``identity`` — bucket / phase /
-    feed shapes / program version, site-dependent), and the backend's
+    feed shapes / program version, site-dependent; engines with a
+    persistent executable cache stamp a ``cache_hit`` detail field:
+    False marks the compile a warm replica would have skipped), and the
+    backend's
     ``cost_analysis()`` ``flops`` / ``bytes_accessed`` when harvested
     (``obs_compile_cost``; None otherwise)."""
 
@@ -260,7 +263,7 @@ def note_compile(site, seconds, identity=None, flops=None,
                         seconds=round(rec.seconds, 4),
                         **{k: v for k, v in rec.identity.items()
                            if k in ("bucket", "phase", "instance",
-                                    "program_version")})
+                                    "program_version", "cache_hit")})
     rec.trace = ev.get("trace")
     COMPILE_LOG.add(rec)
     return rec

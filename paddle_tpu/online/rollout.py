@@ -76,10 +76,27 @@ class RolloutController:
 
     def __init__(self, registry, model, supervisor, poll_interval_s=None,
                  min_serve_s=None, rollout_timeout_s=120.0,
-                 registry_keep=None, incident_collector=None):
+                 registry_keep=None, incident_collector=None,
+                 warm_cache=False, warm_kwargs=None):
         self._registry = registry
         self._model = model
         self._sup = supervisor
+        # warm_cache: before rolling a target version out, build its
+        # persistent compiled-executable artifacts (registry.warm) so
+        # every replica's reload warmup LOADS instead of compiles — the
+        # controller pays each compile once, the fleet pays none. Best
+        # effort: a failed warm never blocks the rollout (replicas just
+        # compile as before). The artifacts must be built for the
+        # FLEET'S engine geometry or every replica would silently miss:
+        # warm_kwargs overrides, else the supervisor's configured
+        # buckets are threaded through.
+        self._warm_cache = bool(warm_cache)
+        self._warm_kwargs = dict(warm_kwargs or {})
+        if self._warm_cache and "buckets" not in self._warm_kwargs \
+                and "gen_opts" not in self._warm_kwargs:
+            buckets = getattr(supervisor, "_cfg", {}).get("buckets")
+            if buckets is not None:
+                self._warm_kwargs["buckets"] = buckets
         # obs.recorder.IncidentCollector (or any callable-bearing twin):
         # a canary failure triggers a fleet-wide flight-recorder bundle
         self._incidents = incident_collector
@@ -185,6 +202,15 @@ class RolloutController:
             return
         if (time.monotonic() - self._last_rollout_t) < self._min_serve_s:
             return                       # hysteresis: let the fleet serve
+        if self._warm_cache:
+            try:
+                self._registry.warm(self._model, target,
+                                    **self._warm_kwargs)
+            except Exception as e:
+                # the warm is an optimization, not a gate: replicas
+                # compile exactly as before when artifacts are absent
+                with self._lock:
+                    self._last_error = f"warm: {type(e).__name__}: {e}"
         try:
             self._sup.rolling_reload(target, wait_timeout=self._timeout)
         except CanaryFailed as e:

@@ -32,8 +32,15 @@ On top of the single server sits the fleet control plane:
 * :class:`FleetClient` (router.py) — client-side balancer: power-of-two-
   choices picks, connection-failure failover, overload spillover, and
   health probes that eject/probation-readmit replicas.
+* :class:`ExecCache` (execcache.py) — persistent compiled-executable
+  cache: warmup executables are AOT-serialized next to the bundle
+  (``registry.warm()`` / ``publish(warm_cache=True)`` →
+  ``<version>/warm/``) keyed by a full identity fingerprint, so
+  scale-out replicas, crash restarts and rollout reloads LOAD in
+  milliseconds instead of recompiling.
 """
 
+from .execcache import ExecCache
 from .engine import InferenceEngine
 from .batcher import DynamicBatcher, ServerOverloaded
 from .server import ModelServer
@@ -45,7 +52,7 @@ from .generate import (PagedKVCache, CacheExhausted, GenerationEngine,
                        NoFreeSlots, ContinuousBatcher, GenClient)
 
 __all__ = ["InferenceEngine", "DynamicBatcher", "ServerOverloaded",
-           "ModelServer", "InferClient", "ModelRegistry",
+           "ModelServer", "InferClient", "ModelRegistry", "ExecCache",
            "FleetSupervisor", "CanaryFailed", "FleetClient",
            "PagedKVCache", "CacheExhausted", "GenerationEngine",
            "NoFreeSlots", "ContinuousBatcher", "GenClient"]

@@ -15,6 +15,13 @@ holds exactly one trace per bucket. Per-bucket compile/hit counters (and a
 ``hot_recompiles`` alarm — a compile observed AFTER warmup) are surfaced
 through :meth:`stats` so a server can prove the no-recompile contract.
 
+Warm starts (serving/execcache.py): when the bundle carries persisted
+compiled-executable artifacts (a registry version's ``warm/`` dir, or
+the ``serving_exec_cache_dir`` local cache), :meth:`warmup` LOADS each
+bucket's executable whose full-identity fingerprint matches instead of
+compiling it, and dispatches it directly on the hot path — the jit path
+stays as the miss/corruption fallback with bitwise-identical outputs.
+
 Feeds are dense host arrays keyed by feed name (the serving wire form —
 LoD/ragged inputs belong to the batch-shaping layer above, which must pad
 them to static shapes before they reach a server anyway). Padding rows
@@ -36,6 +43,7 @@ from ..core.scope import Scope
 from ..core.types import np_dtype
 from ..obs import perf as _perf
 from ..obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
+from . import execcache as _execcache
 
 # obs plane: the engine's compile/hit/hot-recompile counters live in the
 # process-wide metrics registry (stable names, scraped by the built-in
@@ -125,7 +133,8 @@ class InferenceEngine:
     """
 
     def __init__(self, model_dir=None, program=None, feed_names=None,
-                 fetch_vars=None, executor=None, scope=None, buckets=None):
+                 fetch_vars=None, executor=None, scope=None, buckets=None,
+                 exec_cache=None):
         import paddle_tpu.fluid as fluid
 
         self._scope = scope or Scope()
@@ -138,6 +147,18 @@ class InferenceEngine:
                 "InferenceEngine needs model_dir= or all of program=/"
                 "feed_names=/fetch_vars=")
         commit_scope_arrays(self._scope)
+        # persistent compiled-executable cache (serving/execcache.py):
+        # warmup LOADS each bucket's executable where an artifact with a
+        # matching full-identity fingerprint exists, and compiles+saves
+        # the rest (writable caches only). None = compile always, the
+        # pre-cache behavior.
+        self._exec_cache = _execcache.resolve_cache(model_dir, exec_cache)
+        self._bundle_hash = _execcache.bundle_content_hash(model_dir) \
+            if self._exec_cache is not None and model_dir else None
+        if self._bundle_hash is None:
+            self._exec_cache = None
+        self._warm_execs = {}          # dispatch sig -> WarmExecutable
+        self._warm_loaded = set()      # sigs whose executable was LOADED
         self._program = program
         self._feed_names = list(feed_names)
         self._fetch_names = [v if isinstance(v, str) else v.name
@@ -247,9 +268,41 @@ class InferenceEngine:
         self._kernel_tier = resolve_tier()
         with record_event("serving/warmup", kind="stage"):
             for b in self.buckets:
+                if self._exec_cache is not None:
+                    self._warm_bucket(feed, b)
                 self._dispatch(feed, 1, b)
         self._warmed = True
         return int(sum(c.value for c in self._m_compiles.values()) - before)
+
+    def _sig(self, padded, bucket, fetch_names):
+        # fetch names stay IN ORDER: the executor's jit cache keys on the
+        # ordered fetch tuple, so a reordered fetch_list is a distinct
+        # executable and must count as a compile here too
+        return (bucket, tuple(fetch_names),
+                tuple(sorted((k, a.dtype.str, a.shape[1:])
+                             for k, a in padded.items())))
+
+    def _warm_bucket(self, feed, bucket):
+        """Register one bucket's warm executable: LOAD the artifact whose
+        fingerprint matches this exact dispatch (bundle bytes, padded
+        feed avals, jit-key flags, toolchain, backend), or — writable
+        caches only — AOT-compile exactly as the jit path would and
+        persist it for the next process. Every failure is silent: the
+        bucket just compiles through the normal jit path."""
+        padded = {k: _pad_rows(np.asarray(a), bucket)
+                  for k, a in feed.items()}
+        sig = self._sig(padded, bucket, self._fetch_names)
+        if sig in self._warm_execs:
+            return
+        entry = _execcache.acquire(
+            self._exec_cache, self._bundle_hash, f"infer_b{bucket}",
+            self._program, padded, self._fetch_names, self._exe,
+            self._scope,
+            identity={"instance": self.obs_instance, "bucket": bucket})
+        if entry is not None:
+            self._warm_execs[sig] = entry
+            if entry.source == "cache":
+                self._warm_loaded.add(sig)
 
     # ------------------------------------------------------------------
     def infer(self, feed, fetch_list=None):
@@ -288,32 +341,75 @@ class InferenceEngine:
     def _dispatch(self, arrs, n, bucket, fetch_names=None):
         fetch_names = fetch_names or self._fetch_names
         padded = {k: _pad_rows(a, bucket) for k, a in arrs.items()}
-        # fetch names stay IN ORDER: the executor's jit cache keys on the
-        # ordered fetch tuple, so a reordered fetch_list is a distinct
-        # executable and must count as a compile here too
-        sig = (bucket, tuple(fetch_names),
-               tuple(sorted((k, a.dtype.str, a.shape[1:])
-                            for k, a in padded.items())))
+        sig = self._sig(padded, bucket, fetch_names)
+        warm = self._warm_execs.get(sig)
+        # accounting BEFORE dispatch (mark-then-dispatch, the pre-cache
+        # order): two concurrent first dispatches of one sig must count
+        # ONE compile — the second sees the sig claimed and counts a
+        # hit, exactly like the jit cache it mirrors. A cache-LOADED
+        # first dispatch counts as a hit: nothing compiles, so warmup()
+        # reports 0 compiles for a fully warm engine.
         with self._stats_lock:
             if sig in self._seen:
                 self._m_hits[bucket].inc()
             else:
                 self._seen.add(sig)
-                self._m_compiles[bucket].inc()
-                if self._warmed:
-                    self._m_hot.inc()
+                if warm is not None and sig in self._warm_loaded:
+                    self._m_hits[bucket].inc()
+                else:
+                    self._m_compiles[bucket].inc()
+                    if self._warmed:
+                        self._m_hot.inc()
         with self._lock:
-            # compile-site label for obs.perf: a build detected inside
-            # this dispatch (each bucket's first padded shape) is
-            # attributed to the engine with its bucket identity; after
-            # warmup any compile here is the hot-recompile alarm's twin
-            site = "engine_warmup" if not self._warmed else "engine_infer"
-            with _perf.compile_site(site, instance=self.obs_instance,
-                                    bucket=bucket):
-                with record_event(f"serving/infer_b{bucket}", kind="stage"):
-                    outs = self._exe.run(self._program, feed=padded,
-                                         fetch_list=list(fetch_names),
-                                         scope=self._scope)
+            outs = None
+            if warm is not None:
+                # warm path: the deserialized (or publish-time-compiled)
+                # executable dispatched directly — same trace, same glue
+                # as the jit path, bitwise-identical outputs, zero
+                # compile risk. A failure here (an artifact that
+                # deserialized but will not run) falls through to the
+                # jit path with a reject bump — never an engine error.
+                try:
+                    with record_event(f"serving/infer_b{bucket}",
+                                      kind="stage"):
+                        outs = warm.run(self._exe, self._program, padded,
+                                        self._scope)
+                except Exception as e:
+                    self._warm_execs.pop(sig, None)
+                    loaded = sig in self._warm_loaded
+                    self._warm_loaded.discard(sig)
+                    self._exec_cache.note_reject(f"infer_b{bucket}",
+                                                 "run_failed", error=e)
+                    if loaded:
+                        with self._stats_lock:
+                            # the fallback below REALLY compiles but the
+                            # pre-dispatch accounting booked a cache
+                            # hit: record the real compile and fire the
+                            # hot alarm — an operator watching the ==0
+                            # contract must see a mid-request XLA
+                            # compile (the stray hit on this one-off
+                            # corruption event is accepted; compiles
+                            # and hot_recompiles never undercount)
+                            self._m_compiles[bucket].inc()
+                            if self._warmed:
+                                self._m_hot.inc()
+            if outs is None:
+                # compile-site label for obs.perf: a build detected
+                # inside this dispatch (each bucket's first padded
+                # shape) is attributed to the engine with its bucket
+                # identity; after warmup any compile here is the
+                # hot-recompile alarm's twin
+                site = "engine_warmup" if not self._warmed \
+                    else "engine_infer"
+                detail = dict(instance=self.obs_instance, bucket=bucket)
+                if self._exec_cache is not None:
+                    detail["cache_hit"] = False
+                with _perf.compile_site(site, **detail):
+                    with record_event(f"serving/infer_b{bucket}",
+                                      kind="stage"):
+                        outs = self._exe.run(self._program, feed=padded,
+                                             fetch_list=list(fetch_names),
+                                             scope=self._scope)
         trimmed = []
         for name, o in zip(fetch_names, outs):
             if isinstance(o, np.ndarray) and o.ndim >= 1 \
@@ -378,6 +474,9 @@ class InferenceEngine:
             "hot_recompiles": self.hot_recompiles,
             "warmed": self._warmed,
             "kernel_tier": self._kernel_tier,
+            "exec_cache": self._exec_cache.stats()
+            if self._exec_cache is not None else None,
+            "warm_loaded": len(self._warm_loaded),
             "memory": self._memory_section(),
         })
 

@@ -28,6 +28,15 @@ supervisor's current version advance (so mid-rollout crash-restarts pick
 the right side of the rollout), and a failed canary is rolled back to the
 previous manifest version and the rollout aborted — N−1 replicas never
 even saw the bad version.
+
+Warm starts: a replica's model_dir IS the registry version dir, so when
+the version was published with ``warm_cache=True`` (or ``registry.
+warm()`` ran later) the spawned child finds the ``warm/`` executable
+artifacts right next to the bundle and its warmup LOADS them instead of
+compiling (serving/execcache.py) — scale-out spawns, crash restarts and
+``rolling_reload`` targets all skip their warmup compiles. The
+``serving_exec_cache`` / ``serving_exec_cache_dir`` flag values ride the
+child config so the whole fleet follows the parent's configuration.
 """
 
 from __future__ import annotations
@@ -78,9 +87,16 @@ def _replica_child(address, model_dir, version, cfg, fault_plan=None):
         os.environ["JAX_PLATFORMS"] = platform
         import jax
         jax.config.update("jax_platforms", platform)
+    from ..core.flags import set_flags
     from .engine import InferenceEngine
     from .server import ModelServer
 
+    # spawned children start with default flags — ship the parent's
+    # exec-cache switches so the whole fleet agrees on whether replicas
+    # load persisted executables (model_dir is the registry version dir,
+    # so a published warm/ sidecar is found right next to the bundle)
+    set_flags({"serving_exec_cache": cfg.get("exec_cache", True),
+               "serving_exec_cache_dir": cfg.get("exec_cache_dir", "")})
     engine = InferenceEngine(model_dir, buckets=cfg.get("buckets"))
     engine.warmup()
     server = ModelServer(
@@ -139,6 +155,14 @@ class FleetSupervisor(ChildSupervisor):
                          max_delay_ms=max_delay_ms,
                          queue_capacity=queue_capacity,
                          slo_rules=slo_dicts,
+                         # exec-cache switches ride the child config:
+                         # spawn = fresh default flags, and a replica
+                         # serving a warmed registry version must load
+                         # its warm/ artifacts (or not) exactly as the
+                         # operator configured the parent
+                         exec_cache=bool(get_flag("serving_exec_cache")),
+                         exec_cache_dir=str(
+                             get_flag("serving_exec_cache_dir")),
                          # resolved platform, not the env var: the child
                          # must land on the same backend the parent
                          # exported/validated the model on

@@ -52,6 +52,7 @@ from ...core.scope import Scope
 from ...obs import perf as _perf
 from ...obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
 from ...obs.recorder import record as _flight_record
+from .. import execcache as _execcache
 from ..engine import commit_scope_arrays, parse_buckets
 from .kvcache import CacheExhausted, PagedKVCache
 
@@ -196,7 +197,7 @@ class GenerationEngine:
                  fetch_vars=None, executor=None, scope=None, max_seqs=None,
                  block_size=None, num_blocks=None, max_len=128,
                  prefill_buckets=None, prefix_cache_blocks=None,
-                 prefill_chunk=None):
+                 prefill_chunk=None, exec_cache=None):
         import paddle_tpu.fluid as fluid
 
         self._scope = scope or Scope()
@@ -208,6 +209,19 @@ class GenerationEngine:
             raise ValueError(
                 "GenerationEngine needs model_dir= or all of program=/"
                 "feed_names=/fetch_vars=")
+        # persistent compiled-executable cache: each (phase, bucket)
+        # executable loads from a fingerprint-matched artifact at warmup
+        # instead of compiling (serving/execcache.py). The engine config
+        # (max_seqs, max_len, arena geometry, chunking) needs no explicit
+        # key — it is fully determined by the warmup feed shapes the
+        # fingerprint already covers.
+        self._exec_cache = _execcache.resolve_cache(model_dir, exec_cache)
+        self._bundle_hash = _execcache.bundle_content_hash(model_dir) \
+            if self._exec_cache is not None and model_dir else None
+        if self._bundle_hash is None:
+            self._exec_cache = None
+        self._warm_execs = {}          # (phase, bucket) -> WarmExecutable
+        self._warm_loaded = set()      # keys whose executable was LOADED
         # numpy state's first dispatch would land a second jit cache
         # entry per executable once the run writes jax arrays back —
         # commit up front (see engine.commit_scope_arrays)
@@ -375,34 +389,100 @@ class GenerationEngine:
         return [_kv_name(k, l) for l in range(self.num_layers)
                 for k in ("k", "v")]
 
+    def _gen_fetch(self):
+        return [self._logits_name] + self._arena_fetch_names()
+
+    def _warm_phase(self, program, feed, phase, bucket):
+        """Register one (phase, bucket) warm executable from the
+        persistent cache — or, writable caches only, AOT-compile and
+        persist it. Silent on every failure: the phase just compiles
+        through the normal jit path at its warmup dispatch."""
+        if self._exec_cache is None or (phase, bucket) in self._warm_execs:
+            return
+        entry = _execcache.acquire(
+            self._exec_cache, self._bundle_hash, f"gen_{phase}_b{bucket}",
+            program, feed, self._gen_fetch(), self._exe, self._scope,
+            identity={"instance": self.obs_instance, "phase": phase,
+                      "bucket": bucket})
+        if entry is not None:
+            self._warm_execs[(phase, bucket)] = entry
+            if entry.source == "cache":
+                self._warm_loaded.add((phase, bucket))
+
+    def _phase_children(self, phase, bucket):
+        per = self._phase[phase].get(bucket)
+        if per is None:
+            per = self._phase[phase][bucket] = (
+                _M_COMPILES.labels(instance=self.obs_instance,
+                                   phase=phase, bucket=str(bucket)),
+                _M_HITS.labels(instance=self.obs_instance,
+                               phase=phase, bucket=str(bucket)))
+        return per
+
     def _dispatch(self, program, feed, phase, bucket):
+        fetch = self._gen_fetch()
+        key = (phase, bucket)
+        warm = self._warm_execs.get(key)
+        # accounting BEFORE dispatch (mark-then-dispatch): concurrent
+        # first dispatches of one executable count ONE compile; a
+        # cache-LOADED first dispatch counts as a hit (nothing
+        # compiles — warm warmup() reports 0)
         with self._stats_lock:
-            per = self._phase[phase].get(bucket)
-            if per is None:
-                per = self._phase[phase][bucket] = (
-                    _M_COMPILES.labels(instance=self.obs_instance,
-                                       phase=phase, bucket=str(bucket)),
-                    _M_HITS.labels(instance=self.obs_instance,
-                                   phase=phase, bucket=str(bucket)))
-            if (phase, bucket) in self._seen:
+            per = self._phase_children(phase, bucket)
+            if key in self._seen:
                 per[1].inc()
             else:
-                self._seen.add((phase, bucket))
-                per[0].inc()
-                if self._warmed:
-                    self._m_hot.inc()
-        fetch = [self._logits_name] + self._arena_fetch_names()
-        # compile-site label for obs.perf: a build under this dispatch
-        # (warmup compiles one executable per phase clone x bucket) is
-        # attributed with its phase/bucket identity
-        site = "genengine_warmup" if not self._warmed \
-            else f"genengine_{phase}"
-        with _perf.compile_site(site, instance=self.obs_instance,
-                                phase=phase, bucket=bucket):
-            with record_event(f"serving/gen_{phase}_b{bucket}",
-                              kind="stage"):
-                outs = self._exe.run(program, feed=feed, fetch_list=fetch,
-                                     scope=self._scope, return_numpy=False)
+                self._seen.add(key)
+                if warm is not None and key in self._warm_loaded:
+                    per[1].inc()
+                else:
+                    per[0].inc()
+                    if self._warmed:
+                        self._m_hot.inc()
+        outs = None
+        if warm is not None:
+            # warm path: the persisted executable dispatched directly
+            # (same trace, same glue — bitwise the jit path's outputs);
+            # a deserialized-but-unrunnable artifact falls through to
+            # the jit path with a reject bump, never an engine error
+            try:
+                with record_event(f"serving/gen_{phase}_b{bucket}",
+                                  kind="stage"):
+                    outs = warm.run(self._exe, program, feed, self._scope,
+                                    return_numpy=False)
+            except Exception as e:
+                self._warm_execs.pop(key, None)
+                loaded = key in self._warm_loaded
+                self._warm_loaded.discard(key)
+                self._exec_cache.note_reject(f"gen_{phase}_b{bucket}",
+                                             "run_failed", error=e)
+                if loaded:
+                    with self._stats_lock:
+                        # the jit fallback below really compiles but the
+                        # pre-dispatch accounting booked a hit: record
+                        # the real compile + hot alarm (compiles never
+                        # undercount; the stray hit on this one-off
+                        # corruption event is accepted)
+                        per[0].inc()
+                        if self._warmed:
+                            self._m_hot.inc()
+        if outs is None:
+            # compile-site label for obs.perf: a build under this
+            # dispatch (warmup compiles one executable per phase clone x
+            # bucket) is attributed with its phase/bucket identity
+            site = "genengine_warmup" if not self._warmed \
+                else f"genengine_{phase}"
+            detail = dict(instance=self.obs_instance, phase=phase,
+                          bucket=bucket)
+            if self._exec_cache is not None:
+                detail["cache_hit"] = False
+            with _perf.compile_site(site, **detail):
+                with record_event(f"serving/gen_{phase}_b{bucket}",
+                                  kind="stage"):
+                    outs = self._exe.run(program, feed=feed,
+                                         fetch_list=fetch,
+                                         scope=self._scope,
+                                         return_numpy=False)
         for l in range(self.num_layers):
             self.cache.k[l] = outs[1 + 2 * l]
             self.cache.v[l] = outs[2 + 2 * l]
@@ -509,6 +589,22 @@ class GenerationEngine:
             from ...ops.pallas import resolve_tier
             self._kernel_tier = resolve_tier()
             with record_event("serving/gen_warmup", kind="stage"):
+                if self._exec_cache is not None:
+                    # inert decode feed, shaped exactly like the
+                    # _run_decode below builds it with every slot idle —
+                    # the fingerprint must key the aval set the hot path
+                    # dispatches
+                    S, P = self.max_seqs, self._table_width
+                    dfeed = self._arena_feed()
+                    dfeed["tokens"] = np.zeros((S, 1, 1), np.int64)
+                    dfeed[_SLOTS] = np.full(S, self.cache.sentinel_slot,
+                                            np.int32)
+                    dfeed[_TABLES] = np.zeros((S, P), np.int32)
+                    dfeed[_CTXLENS] = np.zeros(S, np.int32)
+                    if "positions" in self._feed_names:
+                        dfeed["positions"] = np.zeros((S, 1, 1), np.int64)
+                    self._warm_phase(self._decode_program, dfeed,
+                                     "decode", self.max_seqs)
                 self._run_decode()
                 for b in self.prefill_buckets:
                     toks = np.zeros((1, b, 1), np.int64)
@@ -520,6 +616,8 @@ class GenerationEngine:
                     if "positions" in self._feed_names:
                         feed["positions"] = np.arange(b, dtype=np.int64) \
                             .reshape(1, b, 1)
+                    self._warm_phase(self._prefill_program, feed,
+                                     "prefill", b)
                     self._dispatch(self._prefill_program, feed, "prefill",
                                    b)
                     if self._partial_enabled:
@@ -536,6 +634,8 @@ class GenerationEngine:
                         if "positions" in self._feed_names:
                             feed["positions"] = np.arange(
                                 b, dtype=np.int64).reshape(1, b, 1)
+                        self._warm_phase(self._chunk_program, feed,
+                                         "chunk", b)
                         self._dispatch(self._chunk_program, feed,
                                        "chunk", b)
             self._warmed = True
@@ -982,6 +1082,9 @@ class GenerationEngine:
             "cache": self.cache.stats(),
             "prefill_chunk": self.prefill_chunk,
             "kernel_tier": self._kernel_tier,
+            "exec_cache": self._exec_cache.stats()
+            if self._exec_cache is not None else None,
+            "warm_loaded": len(self._warm_loaded),
             "ttft": self.ttft.snapshot(),
             "tpot": self.tpot.snapshot(),
             "memory": self._memory_section(),

@@ -104,7 +104,8 @@ class ModelRegistry:
         return sorted(int(n) for n in os.listdir(d) if n.isdigit())
 
     def publish(self, model, src_dir, version=None, kernel_tier=None,
-                model_kind="feedforward", lineage=None):
+                model_kind="feedforward", lineage=None, warm_cache=False,
+                warm_kwargs=None):
         """Copy the bundle at ``src_dir`` in as ``version`` (next integer
         when None) and make it visible by writing the manifest LAST,
         atomically. Returns the published version number. Versions are
@@ -131,7 +132,14 @@ class ModelRegistry:
         manifest additionally records ``published_at`` (wall-clock), the
         timestamp the rollout controller computes publish-to-served lag
         from. Lineage is metadata only — resolution and verification
-        never read it."""
+        never read it.
+
+        ``warm_cache=True`` runs :meth:`warm` on the just-published
+        version (``warm_kwargs`` forwarded): the publisher pays each
+        executable's compile ONCE and every replica that serves this
+        version loads instead of compiling. The manifest lands FIRST —
+        a crash mid-warm leaves a fully published version whose
+        replicas simply compile."""
         if not os.path.exists(os.path.join(src_dir, MODEL_FILENAME)):
             raise ValueError(
                 f"publish: {src_dir!r} is not a save_inference_model "
@@ -208,7 +216,76 @@ class ModelRegistry:
         with open(tmp, "w") as f:
             json.dump(manifest, f, indent=1, sort_keys=True)
         os.replace(tmp, os.path.join(dst, VERSION_MANIFEST))
+        if warm_cache:
+            self.warm(model, version, **(warm_kwargs or {}))
         return version
+
+    # ------------------------------------------------------------------
+    def warm(self, model, version="latest", buckets=None, sample_feed=None,
+             gen_opts=None):
+        """Build (or complete) the version's persistent compiled-
+        executable artifacts under ``<version>/warm/`` so replicas LOAD
+        instead of compile (serving/execcache.py): an engine of the
+        manifest's ``model_kind`` is constructed on the version dir with
+        a WRITABLE cache and warmed — artifacts that already exist and
+        fingerprint-match are loaded (so re-warming is idempotent:
+        nothing recompiles, nothing is rewritten), the rest are compiled
+        once here and persisted. The manifest then lists every artifact
+        under ``warm_files`` with a per-file sha256, exactly like the
+        bundle files — :meth:`verify` re-hashes them, :meth:`gc` deletes
+        them with the version. The bundle files themselves (and
+        ``content_hash``, which KEYS the artifacts) stay immutable; the
+        warm dir is an additive sidecar.
+
+        ``buckets``/``sample_feed`` configure a feed-forward warmup;
+        ``gen_opts`` are GenerationEngine kwargs for generative bundles
+        — they must match what serving replicas use (both default from
+        the same flags), or the replica's differently-shaped feeds
+        simply miss the cache and compile. The warm dir holds exactly
+        the LAST warm run's artifact set: artifacts a previous
+        toolchain/flag configuration produced fingerprint-miss forever,
+        so they are pruned instead of re-certified into the manifest
+        (``warm/`` and ``VERSION.json`` must not grow monotonically
+        with every jax upgrade). Returns the sorted artifact relpaths
+        recorded in the manifest."""
+        path, v = self.resolve(model, version)
+        m = self.manifest(model, v)
+        from .execcache import ARTIFACT_SUFFIX, ExecCache, WARM_DIRNAME
+        warm_dir = os.path.join(path, WARM_DIRNAME)
+        cache = ExecCache(warm_dir)
+        if m.get("model_kind", "feedforward") == "generative":
+            from .generate import GenerationEngine
+            engine = GenerationEngine(path, exec_cache=cache,
+                                      **(gen_opts or {}))
+            engine.warmup()
+        else:
+            from .engine import InferenceEngine
+            engine = InferenceEngine(path, buckets=buckets,
+                                     exec_cache=cache)
+            engine.warmup(sample_feed)
+        touched = set(cache.touched())
+        warm_files = {}
+        for name in sorted(os.listdir(warm_dir)):
+            fpath = os.path.join(warm_dir, name)
+            if not os.path.isfile(fpath) or name.endswith(".tmp"):
+                continue
+            if name in touched:
+                warm_files[f"{WARM_DIRNAME}/{name}"] = _sha256_file(fpath)
+            elif name.endswith(ARTIFACT_SUFFIX):
+                # stale artifact this warmup neither loaded nor wrote:
+                # its fingerprint can never match again — prune it
+                # (stray non-artifact files are left alone, unlisted)
+                try:
+                    os.unlink(fpath)
+                except OSError:
+                    pass
+        if m.get("warm_files") != warm_files:
+            m["warm_files"] = warm_files
+            tmp = os.path.join(path, VERSION_MANIFEST + ".tmp")
+            with open(tmp, "w") as f:
+                json.dump(m, f, indent=1, sort_keys=True)
+            os.replace(tmp, os.path.join(path, VERSION_MANIFEST))
+        return sorted(warm_files)
 
     # ------------------------------------------------------------------
     def resolve(self, model, version="latest"):
@@ -354,7 +431,16 @@ class ModelRegistry:
         raised by the engine when a resolved version is served."""
         path, v = self.resolve(model, version)
         m = self.manifest(model, v)
-        for name, want in m.get("files", {}).items():
+        # warm_files are covered by the same re-hash: a tampered
+        # compiled-executable artifact fails verify() exactly like a
+        # tampered bundle file. The serving engine independently pins
+        # loads to these SAME manifest digests (execcache checks the
+        # raw bytes against warm_files BEFORE unpickling anything) —
+        # verify is the operator's offline check, the engine's
+        # manifest-pinned reject is the runtime safety net.
+        listed = dict(m.get("files", {}))
+        listed.update(m.get("warm_files", {}))
+        for name, want in listed.items():
             fpath = os.path.join(path, name)
             if not os.path.exists(fpath):
                 raise ValueError(

@@ -112,7 +112,7 @@ class ModelServer:
                  batching=True, max_delay_ms=None, queue_capacity=None,
                  buckets=None, fault_plan=None, version=None,
                  model_kind=None, continuous=True, gen_opts=None,
-                 slo_rules=None):
+                 slo_rules=None, exec_cache=None):
         from .generate import ContinuousBatcher, GenerationEngine
         if model_kind is None:
             if engine is not None:
@@ -126,11 +126,19 @@ class ModelServer:
         self.model_kind = model_kind
         self._gen_opts = dict(gen_opts or {})
         self._continuous = bool(continuous)
+        # persistent compiled-executable cache (serving/execcache.py):
+        # None = resolve per model dir (a published version's warm/
+        # artifacts load read-only; reload()'s fresh engines resolve
+        # against the NEW dir, so a rollout to a warmed version skips
+        # its warmup compiles)
+        self._exec_cache = exec_cache
         if engine is None:
             if model_kind == "generative":
-                engine = GenerationEngine(model_dir, **self._gen_opts)
+                engine = GenerationEngine(model_dir, exec_cache=exec_cache,
+                                          **self._gen_opts)
             else:
-                engine = InferenceEngine(model_dir, buckets=buckets)
+                engine = InferenceEngine(model_dir, buckets=buckets,
+                                         exec_cache=exec_cache)
         self.engine = engine
         self.model_dir = model_dir
         # the reload path rebuilds engines with the SAME bucket set, so
@@ -290,7 +298,9 @@ class ModelServer:
                         f"cannot reload a {new_kind!r} bundle into a "
                         "generative server (engine classes differ); "
                         "roll a fresh replica instead")
-                new = GenerationEngine(model_dir, **self._gen_opts)
+                new = GenerationEngine(model_dir,
+                                       exec_cache=self._exec_cache,
+                                       **self._gen_opts)
                 compiled = new.warmup()
                 new_batcher = ContinuousBatcher(
                     new, capacity=self.batcher.capacity,
@@ -308,7 +318,8 @@ class ModelServer:
                 threading.Thread(target=old_batcher.close,
                                  daemon=True).start()
                 return {"version": version, "compiles": compiled}
-            new = InferenceEngine(model_dir, buckets=self._buckets)
+            new = InferenceEngine(model_dir, buckets=self._buckets,
+                                  exec_cache=self._exec_cache)
             compiled = new.warmup()          # off the hot path: old engine
             with self._engine_lock:          # still answers during this
                 self.engine = new

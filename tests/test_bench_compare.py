@@ -164,3 +164,26 @@ def test_new_lanes_are_not_failures():
            "b": {"metric": "b", "value": 9.9, "unit": "QPS"}}
     res = bench_compare.compare_records(old, new)
     assert res["ok"] and res["new_lanes"] == ["b"]
+
+
+def test_warm_start_lane_is_lower_is_better():
+    """The warm_start_serving lane's second-denominated time-to-ready
+    unit (the exact string bench.py emits) must regress UPWARD in both
+    the direction helper and a full compare; seconds-per-unit throughput
+    strings keep the higher-is-better default."""
+    rec = {"metric": "warm_start_serving", "value": 0.05,
+           "unit": "s replica time-to-ready, warm-started from persisted "
+                   "executables (lower is better; gate: >= 2x faster "
+                   "than cold compile on the same bundle, asserted "
+                   "in-lane)"}
+    assert bench_compare.lower_is_better(rec)
+    assert bench_compare.lower_is_better(
+        {"metric": "x", "value": 1.0, "unit": "s time-to-ready"})
+    assert not bench_compare.lower_is_better(
+        {"metric": "x", "value": 1.0, "unit": "steps/s"})
+    old = {"warm_start_serving": rec}
+    slower = {"warm_start_serving": dict(rec, value=0.07)}
+    res = bench_compare.compare_records(old, slower, 5.0)
+    assert res["regressions"] == ["warm_start_serving"]
+    faster = {"warm_start_serving": dict(rec, value=0.03)}
+    assert bench_compare.compare_records(old, faster, 5.0)["ok"]

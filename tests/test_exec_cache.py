@@ -1,0 +1,441 @@
+"""Warm-start plane (serving/execcache.py): persistent compiled-
+executable cache — replicas load instead of compile.
+
+The pins, in the order the contract matters:
+
+* a warmed bundle's engine loads EVERY executable (warmup() == 0
+  compiles, ZERO compile-log records) and serves bitwise-identical
+  outputs to a cold engine (infer AND generate);
+* corruption at any depth — truncated/bit-flipped artifact bytes, a
+  deserialize raise — falls back to compile with a
+  ``paddle_tpu_exec_cache_rejects`` bump and a flight-recorder event,
+  never an engine failure, and the outputs stay correct;
+* identity is a FULL fingerprint: a ``kernel_tier`` flag flip at load
+  time misses the cache (no cross-tier artifact reuse);
+* registry interplay: ``verify()`` re-hash catches a tampered warm
+  artifact, ``gc()`` removes ``warm/`` with its version,
+  publish-without-warm then ``warm()`` later is idempotent;
+* the ``serving_exec_cache`` flag is a real kill switch (off = compile
+  exactly as before, no cache counters move) and the
+  ``serving_exec_cache_dir`` local cache covers unpublished bundles.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.flags import get_flag, set_flags
+from paddle_tpu.obs import perf as obs_perf
+from paddle_tpu.obs.recorder import RECORDER
+from paddle_tpu.serving import (GenerationEngine, InferenceEngine,
+                                ModelRegistry)
+from paddle_tpu.serving.execcache import (ExecCache, bundle_content_hash,
+                                          fingerprint, fingerprint_key)
+from paddle_tpu.testing.models import (build_mlp, export_tiny_lm, mlp_feed)
+
+BUCKETS = "1,2"
+
+
+@pytest.fixture
+def flags_guard():
+    """Restore every exec-cache-adjacent flag after the test."""
+    saved = {n: get_flag(n) for n in ("serving_exec_cache",
+                                      "serving_exec_cache_dir",
+                                      "kernel_tier")}
+    yield
+    set_flags(saved)
+
+
+def _export_mlp(dirname, seed=7):
+    main, startup, _loss, logits = build_mlp(
+        dim=8, classes=3, hidden=16, depth=1, seed=seed, return_logits=True)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    fluid.io.save_inference_model(str(dirname), ["img"], [logits], exe,
+                                  main, scope=scope)
+
+
+def _feed(n=2):
+    return {"img": mlp_feed(n, dim=8)["img"]}
+
+
+def _published(tmp_path, warm=True, model="m"):
+    export = tmp_path / "export"
+    _export_mlp(export)
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    v = reg.publish(model, str(export), warm_cache=warm,
+                    warm_kwargs={"buckets": BUCKETS})
+    path, v = reg.resolve(model, v)
+    return reg, path, v
+
+
+# ---------------------------------------------------------------------------
+# warm load + parity
+# ---------------------------------------------------------------------------
+
+def test_warm_engine_loads_instead_of_compiling(tmp_path):
+    reg, path, v = _published(tmp_path)
+    # cold twin: cache disabled so it compiles the PR-13 way
+    set_flags({"serving_exec_cache": False})
+    try:
+        cold = InferenceEngine(path, buckets=BUCKETS)
+        assert cold.warmup() == len(BUCKETS.split(","))
+        assert cold.stats()["exec_cache"] is None
+    finally:
+        set_flags({"serving_exec_cache": True})
+    records_before = obs_perf.COMPILE_LOG.stats()["count"]
+    warm = InferenceEngine(path, buckets=BUCKETS)
+    assert warm.warmup() == 0, "warm warmup must compile nothing"
+    assert obs_perf.COMPILE_LOG.stats()["count"] == records_before, \
+        "warm warmup must land ZERO compile-log records"
+    st = warm.stats()
+    assert st["warm_loaded"] == len(BUCKETS.split(","))
+    assert st["exec_cache"]["hits"] == len(BUCKETS.split(","))
+    assert st["exec_cache"]["readonly"] is True
+    # bitwise parity, warmup template shapes and a real batch alike
+    for f in (_feed(1), _feed(2)):
+        a = cold.infer(f)
+        b = warm.infer(f)
+        for x, y in zip(a, b):
+            assert (np.asarray(x) == np.asarray(y)).all()
+    assert warm.hot_recompiles == 0 and cold.hot_recompiles == 0
+
+
+def test_generation_warm_parity_and_zero_records(tmp_path):
+    lm = tmp_path / "lm"
+    export_tiny_lm(str(lm), seed=13)
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    v = reg.publish("lm", str(lm), model_kind="generative")
+    path, v = reg.resolve("lm", v)
+    gen_opts = dict(max_seqs=2, max_len=48)
+
+    def tokens(engine, sampling):
+        handle, toks, finished = engine.start([3, 5, 7], 8, sampling)
+        out = list(toks)
+        while not finished:
+            for h, t, f in engine.step():
+                if h is handle:
+                    out += t
+                    finished = f
+        return out
+
+    cold = GenerationEngine(path, **gen_opts)
+    assert cold.warmup() > 0                   # nothing published yet
+    reg.warm("lm", v, gen_opts=gen_opts)
+    records_before = obs_perf.COMPILE_LOG.stats()["count"]
+    warm = GenerationEngine(path, **gen_opts)
+    assert warm.warmup() == 0
+    assert obs_perf.COMPILE_LOG.stats()["count"] == records_before
+    for sampling in ({"mode": "greedy"},
+                     {"mode": "topk", "seed": 3, "top_k": 4}):
+        assert tokens(cold, sampling) == tokens(warm, sampling)
+    assert warm.hot_recompiles == 0
+
+
+# ---------------------------------------------------------------------------
+# corruption robustness
+# ---------------------------------------------------------------------------
+
+def test_corrupt_artifact_falls_back_to_compile(tmp_path):
+    reg, path, v = _published(tmp_path)
+    ref = InferenceEngine(path, buckets=BUCKETS)
+    ref.warmup()
+    want = ref.infer(_feed())
+    # bit-flip one artifact mid-payload and truncate another
+    warm_dir = os.path.join(path, "warm")
+    arts = sorted(n for n in os.listdir(warm_dir) if n.endswith(".jexec"))
+    assert len(arts) == 2
+    with open(os.path.join(warm_dir, arts[0]), "r+b") as f:
+        f.seek(120)
+        f.write(b"\xff\x00\xff\x00")
+    with open(os.path.join(warm_dir, arts[1]), "r+b") as f:
+        f.truncate(64)
+    engine = InferenceEngine(path, buckets=BUCKETS)
+    compiled = engine.warmup()                 # falls back, never raises
+    assert compiled == 2, "both corrupt artifacts must compile instead"
+    st = engine.stats()["exec_cache"]
+    assert sum(st["rejects"].values()) == 2, st
+    # published warm dirs are manifest-pinned: tampered raw bytes are
+    # refused against the VERSION.json warm_files digest BEFORE any
+    # unpickling (the self-digest "format" stage covers local caches)
+    assert st["rejects"]["manifest"] == 2, st
+    got = engine.infer(_feed())
+    for x, y in zip(want, got):
+        assert (np.asarray(x) == np.asarray(y)).all()
+    # the reject decisions are flight-recorded
+    events = RECORDER.events(kinds={"exec_cache_reject"})
+    assert any(e["detail"].get("reason") == "manifest" for e in events)
+
+
+def test_garbage_pickle_rejects_as_deserialize(tmp_path, flags_guard):
+    """An artifact with valid magic + self-digest over garbage pickle
+    bytes exercises the deeper reject stage — in a LOCAL cache dir
+    (no manifest pinning there: the process writes it itself, so the
+    self-digest is the only integrity layer and bad pickle bytes are
+    caught at the deserialize stage)."""
+    import hashlib
+    export = tmp_path / "export"
+    _export_mlp(export)
+    local = tmp_path / "local-cache"
+    set_flags({"serving_exec_cache_dir": str(local)})
+    InferenceEngine(str(export), buckets=BUCKETS).warmup()  # fill
+    art = sorted(n for n in os.listdir(local)
+                 if n.endswith(".jexec"))[0]
+    blob = b"not a pickle at all"
+    data = (b"PDTPUEXEC1\n" + hashlib.sha256(blob).hexdigest().encode()
+            + b"\n" + blob)
+    with open(os.path.join(local, art), "wb") as f:
+        f.write(data)
+    engine = InferenceEngine(str(export), buckets=BUCKETS)
+    engine.warmup()
+    st = engine.stats()["exec_cache"]
+    assert st["rejects"]["deserialize"] == 1, st
+    assert engine.hot_recompiles == 0
+
+
+def test_unlisted_artifact_is_refused_on_published_dirs(tmp_path):
+    """Manifest pinning: an artifact dropped into a published warm/ dir
+    that VERSION.json never certified is rejected before unpickling —
+    a published version's executables carry the bundle files' trust
+    level."""
+    reg, path, v = _published(tmp_path)
+    warm_dir = os.path.join(path, "warm")
+    art = sorted(n for n in os.listdir(warm_dir)
+                 if n.endswith(".jexec"))[0]
+    # un-certify it: drop the manifest entry but keep the (valid) file
+    m = reg.manifest("m", v)
+    del m["warm_files"][f"warm/{art}"]
+    import json as _json
+    with open(os.path.join(path, "VERSION.json"), "w") as f:
+        _json.dump(m, f)
+    engine = InferenceEngine(path, buckets=BUCKETS)
+    engine.warmup()
+    st = engine.stats()["exec_cache"]
+    assert st["rejects"]["manifest"] == 1, st
+    assert st["hits"] == 1, st                 # the still-listed one loads
+
+
+# ---------------------------------------------------------------------------
+# fingerprint identity
+# ---------------------------------------------------------------------------
+
+def test_kernel_tier_flip_misses_the_cache(tmp_path, flags_guard):
+    set_flags({"kernel_tier": "jnp"})
+    reg, path, v = _published(tmp_path)       # warmed under jnp
+    set_flags({"kernel_tier": "auto"})
+    engine = InferenceEngine(path, buckets=BUCKETS)
+    assert engine.warmup() == len(BUCKETS.split(",")), \
+        "a kernel_tier flip must miss — no cross-tier artifact reuse"
+    st = engine.stats()["exec_cache"]
+    assert st["hits"] == 0
+    assert st["misses"] == len(BUCKETS.split(","))
+    assert sum(st["rejects"].values()) == 0   # miss, not reject
+
+
+def test_fingerprint_covers_the_identity_axes():
+    feeds = {"x": np.zeros((4, 8), np.float32)}
+    fp = fingerprint("hash", "infer_b4", feeds, ["y"])
+    assert fp["feeds"] == {"x": ["float32", [4, 8]]}
+    assert "kernel_tier" in fp["flags"]
+    base = fingerprint_key(fp)
+    for mutate in (lambda d: d.update(content_hash="other"),
+                   lambda d: d.update(tag="infer_b8"),
+                   lambda d: d.update(fetch=["z"]),
+                   lambda d: d["flags"].update(kernel_tier="pallas"),
+                   lambda d: d.update(jax="0.0.0"),
+                   lambda d: d.update(platform="tpu")):
+        fp2 = fingerprint("hash", "infer_b4", feeds, ["y"])
+        mutate(fp2)
+        assert fingerprint_key(fp2) != base
+
+
+def test_bundle_content_hash_prefers_manifest_and_matches_bytes(tmp_path):
+    reg, path, v = _published(tmp_path, warm=False)
+    export = str(tmp_path / "export")
+    # published copy and its export dir hold the same bytes -> same hash
+    assert bundle_content_hash(path) == bundle_content_hash(export)
+    assert bundle_content_hash(path) \
+        == reg.manifest("m", v)["content_hash"]
+
+
+# ---------------------------------------------------------------------------
+# registry interplay
+# ---------------------------------------------------------------------------
+
+def test_verify_catches_tampered_warm_artifact(tmp_path):
+    reg, path, v = _published(tmp_path)
+    reg.verify("m", v)
+    warm_rel = sorted(reg.manifest("m", v)["warm_files"])[0]
+    with open(os.path.join(path, warm_rel), "r+b") as f:
+        f.seek(50)
+        f.write(b"\x00\x00\x00\x00")
+    with pytest.raises(ValueError, match="corrupt"):
+        reg.verify("m", v)
+    # a DELETED artifact is torn, same as a missing bundle file
+    os.unlink(os.path.join(path, warm_rel))
+    with pytest.raises(ValueError, match="torn"):
+        reg.verify("m", v)
+
+
+def test_gc_removes_warm_dir_with_its_version(tmp_path):
+    reg, path, v1 = _published(tmp_path)
+    export = str(tmp_path / "export")
+    for _ in range(3):
+        reg.publish("m", export)
+    assert os.path.isdir(os.path.join(path, "warm"))
+    deleted = reg.gc("m", keep_latest=1)
+    assert v1 in deleted
+    assert not os.path.exists(path)
+
+
+def test_rewarm_prunes_stale_artifacts(tmp_path):
+    """Re-warming under a different engine geometry (a stand-in for a
+    toolchain/flag change) replaces the artifact set: stale artifacts
+    fingerprint-miss forever, so they are pruned, not re-certified —
+    warm/ and VERSION.json must not grow monotonically."""
+    reg, path, v = _published(tmp_path, warm=False)
+    files_a = reg.warm("m", v, buckets="1,2")
+    stray = os.path.join(path, "warm", "NOTES.txt")
+    with open(stray, "w") as f:
+        f.write("operator note: not an artifact")
+    files_b = reg.warm("m", v, buckets="1,4")
+    assert any("infer_b4" in f for f in files_b)
+    assert not any("infer_b2" in f for f in files_b)
+    on_disk = sorted(os.listdir(os.path.join(path, "warm")))
+    assert not any("infer_b2" in n for n in on_disk), on_disk
+    # the shared b1 artifact survived (loaded by the second warm)
+    assert any("infer_b1" in f for f in files_a)
+    assert any("infer_b1" in f for f in files_b)
+    # stray non-artifact files are neither listed nor deleted
+    assert os.path.exists(stray)
+    assert not any("NOTES.txt" in f for f in files_b)
+    reg.verify("m", v)
+
+
+def test_run_failed_fallback_counts_as_hot_recompile(tmp_path):
+    """A warm executable that raises at dispatch AFTER warmup falls back
+    to a REAL hot-path compile — the hot_recompiles alarm must fire (an
+    operator watching the ==0 contract must see the mid-request stall),
+    alongside the run_failed reject."""
+    reg, path, v = _published(tmp_path)
+    engine = InferenceEngine(path, buckets=BUCKETS)
+    engine.warmup()
+    assert engine.stats()["warm_loaded"] == 2
+
+    class _Boom:
+        source = "cache"
+
+        def run(self, *a, **k):
+            raise RuntimeError("deserialized but unrunnable")
+
+    for sig in list(engine._warm_execs):
+        engine._warm_execs[sig] = _Boom()
+    out = engine.infer(_feed(1))              # falls back, still answers
+    assert out and np.asarray(out[0]).shape[0] == 1
+    st = engine.stats()
+    assert st["exec_cache"]["rejects"]["run_failed"] == 1, st["exec_cache"]
+    assert engine.hot_recompiles == 1, \
+        "the fallback compile must fire the hot-recompile alarm"
+
+
+def test_rollout_controller_warms_with_fleet_buckets(tmp_path):
+    """RolloutController(warm_cache=True) must build artifacts for the
+    FLEET'S engine geometry (the supervisor's configured buckets), not
+    the flag defaults — otherwise every replica silently misses."""
+    from paddle_tpu.online.rollout import RolloutController
+
+    reg, path, v = _published(tmp_path, warm=False)
+
+    class _StubSup:
+        _cfg = {"buckets": BUCKETS}
+        addresses = []
+        version = 0
+
+        def rolling_reload(self, target, wait_timeout=None):
+            self.rolled = target
+
+    sup = _StubSup()
+    ctl = RolloutController(reg, "m", sup, warm_cache=True,
+                            min_serve_s=0.0, poll_interval_s=60.0)
+    ctl._last_rollout_t = 0.0
+    ctl._poll()
+    assert sup.rolled == v
+    warm_files = reg.manifest("m", v)["warm_files"]
+    assert len(warm_files) == len(BUCKETS.split(",")), warm_files
+    tags = {f.split("/")[1].split("-")[0] for f in warm_files}
+    assert tags == {f"infer_b{b}" for b in BUCKETS.split(",")}, tags
+
+
+def test_publish_without_warm_then_warm_is_idempotent(tmp_path):
+    reg, path, v = _published(tmp_path, warm=False)
+    assert "warm_files" not in reg.manifest("m", v)
+    files1 = reg.warm("m", v, buckets=BUCKETS)
+    assert len(files1) == len(BUCKETS.split(","))
+    manifest1 = reg.manifest("m", v)
+    mtimes = {f: os.path.getmtime(os.path.join(path, f)) for f in files1}
+    files2 = reg.warm("m", v, buckets=BUCKETS)   # re-warm: all loads
+    assert files2 == files1
+    assert reg.manifest("m", v) == manifest1
+    for f, t in mtimes.items():
+        assert os.path.getmtime(os.path.join(path, f)) == t, \
+            "idempotent re-warm must not rewrite artifacts"
+    reg.verify("m", v)
+
+
+# ---------------------------------------------------------------------------
+# flags
+# ---------------------------------------------------------------------------
+
+def test_kill_switch_disables_loading(tmp_path, flags_guard):
+    reg, path, v = _published(tmp_path)
+    set_flags({"serving_exec_cache": False})
+    engine = InferenceEngine(path, buckets=BUCKETS)
+    assert engine.warmup() == len(BUCKETS.split(","))
+    assert engine.stats()["exec_cache"] is None
+    assert engine.stats()["warm_loaded"] == 0
+
+
+def test_local_cache_dir_covers_unpublished_bundles(tmp_path, flags_guard):
+    export = tmp_path / "export"
+    _export_mlp(export)
+    local = tmp_path / "local-cache"
+    set_flags({"serving_exec_cache_dir": str(local)})
+    first = InferenceEngine(str(export), buckets=BUCKETS)
+    assert first.warmup() == len(BUCKETS.split(","))   # fills the cache
+    st = first.stats()["exec_cache"]
+    assert st["saves"] == len(BUCKETS.split(","))
+    assert not st["readonly"]
+    records_before = obs_perf.COMPILE_LOG.stats()["count"]
+    second = InferenceEngine(str(export), buckets=BUCKETS)
+    assert second.warmup() == 0
+    assert obs_perf.COMPILE_LOG.stats()["count"] == records_before
+    a = first.infer(_feed())
+    b = second.infer(_feed())
+    for x, y in zip(a, b):
+        assert (np.asarray(x) == np.asarray(y)).all()
+
+
+def test_cache_fill_compiles_stamp_cache_hit_false(tmp_path, flags_guard):
+    """CompileRecords carry the cache_hit detail field: a cache-enabled
+    engine's fill compiles stamp False; cache-disabled records carry no
+    field at all."""
+    export = tmp_path / "export"
+    _export_mlp(export)
+    set_flags({"serving_exec_cache_dir": str(tmp_path / "cc")})
+    engine = InferenceEngine(str(export), buckets=BUCKETS)
+    engine.warmup()
+    recs = [r for r in obs_perf.COMPILE_LOG.records("exec_cache_save")]
+    assert recs, "fill compiles must land exec_cache_save records"
+    assert all(r.identity.get("cache_hit") is False for r in recs)
+    set_flags({"serving_exec_cache": False,
+               "serving_exec_cache_dir": ""})
+    seq0 = obs_perf.COMPILE_LOG.stats()["count"]
+    plain = InferenceEngine(str(export), buckets=BUCKETS)
+    plain.warmup()
+    plain_recs = [r for r in obs_perf.COMPILE_LOG.records("engine_warmup")
+                  if r.seq > seq0]
+    assert plain_recs
+    assert all("cache_hit" not in r.identity for r in plain_recs)

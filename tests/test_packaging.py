@@ -35,6 +35,7 @@ def test_wheel_builds_with_all_subpackages(tmp_path):
                 "paddle_tpu/parallel/__init__.py",
                 "paddle_tpu/distributed/__init__.py",
                 "paddle_tpu/serving/__init__.py",
+                "paddle_tpu/serving/execcache.py",
                 "paddle_tpu/serving/generate/__init__.py",
                 "paddle_tpu/online/__init__.py",
                 "paddle_tpu/obs/__init__.py",

@@ -111,9 +111,13 @@ def load_records(path):
 
 
 def lower_is_better(record):
+    # "s " covers second-denominated latency lanes (warm_start_serving's
+    # "s replica time-to-ready ..."), exactly like the ms-denominated
+    # ones; "s/step"-style throughput units don't start with "s " so
+    # they keep the higher-is-better default
     unit = str(record.get("unit", ""))
     return ("lower is better" in unit or unit.startswith("ms")
-            or unit.startswith("%"))
+            or unit.startswith("s ") or unit.startswith("%"))
 
 
 def compare_records(old, new, threshold_pct=5.0):

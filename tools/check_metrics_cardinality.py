@@ -57,9 +57,12 @@ BOUNDED_LABELS = {
     "trigger": "incident trigger enums: breach/canary_failed/"
                "child_restart/manual",
     "site": "compile-site enums (obs.perf: jit_step/jit_scan/"
-            "engine_warmup/engine_infer/genengine_*/attribute) — a "
-            "fixed code-site set; per-executable identity rides the "
-            "CompileRecord, never a label",
+            "engine_warmup/engine_infer/genengine_*/attribute/"
+            "exec_cache_save) — a fixed code-site set; per-executable "
+            "identity rides the CompileRecord, never a label",
+    "reason": "exec-cache artifact reject reasons — the fixed "
+              "serving.execcache.REJECT_REASONS enum (format/manifest/"
+              "fingerprint/deserialize/run_failed)",
     "device": "local jax devices (platform:id) — bounded by the "
               "attached hardware",
 }
