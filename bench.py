@@ -119,12 +119,18 @@ def _rec(d):
     kernel_tier flag resolved to for this process) and the executor_verify
     flag, so bench JSON rows are attributable to the lowering tier AND the
     verification mode that produced them."""
+    import jax
+
     from paddle_tpu.core.flags import get_flag
     from paddle_tpu.obs import REGISTRY, json_safe, perf, recorder, slo
     from paddle_tpu.ops.pallas import resolve_tier
     out = dict(d)
     out.setdefault("kernel_tier", resolve_tier())
     out.setdefault("executor_verify", bool(get_flag("executor_verify")))
+    # backend stamp: which accelerator actually measured this row — a
+    # CPU-smoke record must never be mistaken for a TPU measurement when
+    # runs are compared (tools/bench_compare.py diffs by lane name only)
+    out.setdefault("backend", jax.default_backend())
     # obs.metrics stamp: the registry's compact per-family totals at the
     # instant the lane record is emitted, so every bench row carries the
     # counter state that produced it (full snapshots are too wide for
@@ -1960,6 +1966,202 @@ def run_warm_start_serving_lane(feature_dim=128, hidden=768, depth=4,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_reload_storm_serving_lane(n_clients=8, max_seqs=8, vocab=64,
+                                  emb=128, heads=4, n_layers=3,
+                                  block_size=16, num_blocks=160,
+                                  max_len=256, prefix_len=144,
+                                  suffix_len=8, gen_len=2,
+                                  requests_per_client=6, reload_after=2,
+                                  attempts=3, gate=1.5):
+    """TTFT p99 under a ROLLING RELOAD vs steady state, 8 in-flight
+    shared-prefix GenClient streams throughout — the "can a rollout
+    happen under live traffic without a latency cliff" question the
+    persistent KV tier (serving/generate/kvstore.py) + warm-start
+    executables exist to answer.
+
+    Two versions of one tiny LM are published from the SAME export dir,
+    both with ``kv_prompts=[shared prefix]`` (publish-time prefill ->
+    ``kv/`` chain artifacts) and ``warm_cache=True`` (``warm/``
+    executables). The server starts on v1; once ``reload_after``
+    requests per client have completed, the main thread rolls the
+    server v1 -> v2 -> v1 while the clients keep streaming. Every new
+    engine attaches the shared prefix from its version's ``kv/`` dir
+    with ZERO prefill steps and loads its executables instead of
+    compiling, so the reload window's TTFT p99 must stay within
+    ``gate``x of steady state (asserted in-lane, best of ``attempts``
+    runs). Also asserted: spill-restore counter > 0 on the post-storm
+    engine (the chains really came off disk), zero hot-path recompiles,
+    every token accounted for."""
+    import os
+    import tempfile
+    import shutil
+    import threading
+
+    from paddle_tpu.core.profiler import percentile
+    from paddle_tpu.serving import ModelRegistry, ModelServer
+    from paddle_tpu.serving.generate import GenClient
+    from paddle_tpu.testing.models import export_tiny_lm
+
+    root = tempfile.mkdtemp(prefix="pdtpu-reloadstorm-")
+    prefix = [(7 * i) % (vocab - 2) + 1 for i in range(prefix_len)]
+    cache_blocks = prefix_len // block_size + 1
+    top_bucket = 8
+    while top_bucket < prefix_len + suffix_len:
+        top_bucket *= 2
+    gen_opts = dict(max_seqs=max_seqs, block_size=block_size,
+                    num_blocks=num_blocks, max_len=max_len,
+                    prefill_buckets=(suffix_len + block_size, top_bucket),
+                    prefix_cache_blocks=cache_blocks)
+
+    def suffix(i, j):
+        return [(3 * i + 5 * j + k) % (vocab - 2) + 1
+                for k in range(suffix_len)]
+
+    def one_run(reg, paths):
+        server = ModelServer(paths[1], model_kind="generative",
+                             version=1, gen_opts=gen_opts)
+        server.start()
+        ttft, counts, made, errs = [], [0] * n_clients, [0] * n_clients, []
+        windows, lock = [], threading.Lock()
+        stop = threading.Event()
+        barrier = threading.Barrier(n_clients + 1)
+        try:
+            def client(i):
+                c = GenClient(server.address)
+                try:
+                    c.health()
+                    barrier.wait()
+                    j = 0
+                    # stream until the main thread has its post-storm
+                    # quota (but always the configured minimum, so a
+                    # lightning-fast storm still leaves a fair sample)
+                    while j < requests_per_client or not stop.is_set():
+                        t0 = time.perf_counter()
+                        first, n = None, 0
+                        for tok in c.generate(prefix + suffix(i, j),
+                                              gen_len):
+                            if first is None:
+                                first = time.perf_counter() - t0
+                            n += 1
+                        counts[i] += n
+                        made[i] += 1
+                        j += 1
+                        with lock:
+                            ttft.append((t0, first))
+                except Exception as e:
+                    errs.append((i, e))
+                    stop.set()
+                    try:
+                        barrier.abort()
+                    except Exception:
+                        pass
+                finally:
+                    c.close()
+
+            ts = [threading.Thread(target=client, args=(i,))
+                  for i in range(n_clients)]
+            for t in ts:
+                t.start()
+            try:
+                barrier.wait()
+            except threading.BrokenBarrierError:
+                pass
+            # the storm: once the fleet has a steady-state sample, roll
+            # v1 -> v2 -> v1 while every client keeps streaming
+            while not errs:
+                with lock:
+                    done = len(ttft)
+                if done >= n_clients * reload_after:
+                    break
+                time.sleep(0.005)
+            for v in (2, 1):
+                t0 = time.perf_counter()
+                server.reload(paths[v], version=v)
+                windows.append((t0, time.perf_counter()))
+            # post-storm: keep traffic flowing until the FINAL engine
+            # (fresh arena, published kv/ chains) has answered a steady
+            # sample of its own — that is where the restore counter and
+            # the post-reload TTFT tail come from
+            deadline = time.monotonic() + 120.0
+            post_quota = 2 * n_clients
+            while not errs and time.monotonic() < deadline:
+                with lock:
+                    post = sum(1 for t0, _ in ttft if t0 > windows[-1][1])
+                if post >= post_quota:
+                    break
+                time.sleep(0.005)
+            stop.set()
+            for t in ts:
+                t.join()
+            st = server.stats()
+        finally:
+            stop.set()
+            server.shutdown()
+        assert not errs, f"reload-storm clients failed: {errs[:2]}"
+        assert all(m >= requests_per_client for m in made), \
+            f"request counts {made}"
+        assert counts == [m * gen_len for m in made], \
+            f"token counts {counts} vs requests {made}"
+        eng = st["engine"]
+        assert eng["hot_recompiles"] == 0, \
+            f"hot path recompiled {eng['hot_recompiles']}x under reload"
+        kv = eng["kv_store"]
+        assert kv is not None and kv["restores"] > 0, \
+            f"post-storm engine restored nothing from kv/: {kv}"
+        assert kv["rejects"] == {r: 0 for r in kv["rejects"]}, \
+            f"kv artifacts were rejected: {kv['rejects']}"
+
+        def stormy(t0, dt):
+            return any(t0 <= w1 and t0 + dt >= w0 for w0, w1 in windows)
+
+        storm = [dt for t0, dt in ttft if stormy(t0, dt)]
+        steady = [dt for t0, dt in ttft if not stormy(t0, dt)]
+        assert steady, "every request overlapped a reload window"
+        return {
+            "storm_samples": len(storm),
+            "ttft_p99_storm_ms":
+                percentile(storm, 99) * 1e3 if storm else None,
+            "ttft_p99_steady_ms": percentile(steady, 99) * 1e3,
+            "ratio": (percentile(storm, 99) / percentile(steady, 99))
+                if storm else 1.0,
+            "reload_s": [round(w1 - w0, 3) for w0, w1 in windows],
+            "kv_restores": kv["restores"],
+            "hot_recompiles": eng["hot_recompiles"],
+        }
+
+    try:
+        export = os.path.join(root, "export")
+        export_tiny_lm(export, vocab=vocab, emb=emb, heads=heads,
+                       n_layers=n_layers, max_pos=2 * max_len, seed=13)
+        reg = ModelRegistry(os.path.join(root, "registry"))
+        paths = {}
+        for v in (1, 2):
+            reg.publish("storm", export, model_kind="generative",
+                        warm_cache=True, kv_prompts=[prefix],
+                        warm_kwargs={"gen_opts": gen_opts})
+            paths[v], _ = reg.resolve("storm", v)
+        best = None
+        for _ in range(attempts):
+            r = one_run(reg, paths)
+            if best is None or r["ratio"] < best["ratio"]:
+                best = r
+            # noisy-2-core-host escape hatch: retry the whole run (one
+            # shared timeline — there is no interleave here) until the
+            # gate holds or attempts run out
+            if best["ratio"] <= gate and best["storm_samples"] > 0:
+                break
+        assert best["storm_samples"] > 0, \
+            "no request ever overlapped a reload window (reloads too " \
+            f"fast to measure: {best['reload_s']})"
+        assert best["ratio"] <= gate, \
+            f"reload-storm TTFT p99 ratio {best['ratio']:.2f}x > " \
+            f"{gate}x gate (storm {best['ttft_p99_storm_ms']:.1f} ms, " \
+            f"steady {best['ttft_p99_steady_ms']:.1f} ms)"
+        return best
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _best_of(run_fn, label, repeats, **kw):
     """Best-of-N jnp and Pallas timings for one RNN lane; the shared dev
     chip shows large run-to-run variance (8.7..14.4 ms for the identical
@@ -2029,6 +2231,18 @@ def main():
     import paddle_tpu.fluid as fluid
 
     fluid.set_flags({"kernel_tier": args.kernel_tier})
+
+    backend = jax.default_backend()
+    if backend != "tpu":
+        # every record still carries its backend stamp (_rec), but say
+        # it once up front: the TPU-only acceptance gates (>= 1.15x
+        # fused-kernel speedup, >= 3000 img/s flagship) run UNMEASURED
+        # on this backend — their numbers are correctness smoke, not
+        # performance evidence
+        print(f"bench: backend={backend!r} — TPU-only gates "
+              "(>= 1.15x kernel speedup, >= 3000 img/s flagship) run "
+              "unmeasured here; records are stamped backend="
+              f"{backend!r}", file=sys.stderr)
 
     if args.smoke:
         batch, image_size, class_dim = 8, 32, 10
@@ -2202,6 +2416,28 @@ def main():
                                           3),
         "warm_artifacts": ws["warm_artifacts"],
         "hot_recompiles": 0,
+    })))
+
+    # ---- reload-storm serving lane (persistent KV prefix cache:
+    # rolling reload under live shared-prefix traffic) ----
+    rs_kw = {} if args.smoke else dict(requests_per_client=8, attempts=4)
+    rs = run_reload_storm_serving_lane(**rs_kw)
+    print(json.dumps(_rec({
+        "metric": "reload_storm_serving" + ("_smoke" if args.smoke else ""),
+        "value": round(rs["ratio"], 3),
+        "unit": "x TTFT p99, reload window vs steady state, 8 GenClient "
+                "streams under a rolling v1->v2->v1 reload (lower is "
+                "better; gate <= 1.5x asserted in-lane)",
+        "ttft_p99_storm_ms": None if rs["ttft_p99_storm_ms"] is None
+        else round(rs["ttft_p99_storm_ms"], 2),
+        "ttft_p99_steady_ms": round(rs["ttft_p99_steady_ms"], 2),
+        "storm_samples": rs["storm_samples"],
+        "reload_s": rs["reload_s"],
+        # asserted in-lane: > 0 restores (the post-storm engine's prefix
+        # chains really came off the published kv/ dir), zero rejects,
+        # zero hot recompiles
+        "kv_restores": rs["kv_restores"],
+        "hot_recompiles": rs["hot_recompiles"],
     })))
 
     # ---- fused-kernel microbench lane (Pallas kernel tier milestone) ----

@@ -503,17 +503,19 @@ class _InstrumentedFn:
         self._kind = kind
         self._version = version
 
-    def __call__(self, state, feeds):
+    def __call__(self, state, feeds, *rest):
+        # *rest carries the optional donated-feed dict (KV-arena
+        # donation, _compiled(donate_feed_names=...)) through untouched
         from ..obs import perf as _perf
         if not _perf.enabled():
-            return self._fn(state, feeds)
+            return self._fn(state, feeds, *rest)
         import time as _time
         try:
             before = self._fn._cache_size()
         except Exception:
             before = None
         t0 = _time.perf_counter()
-        out = self._fn(state, feeds)
+        out = self._fn(state, feeds, *rest)
         if before is not None:
             try:
                 grew = self._fn._cache_size() > before
@@ -563,7 +565,7 @@ class Executor:
 
     # ------------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
-            return_numpy=True, use_program_cache=True):
+            return_numpy=True, use_program_cache=True, donate_feeds=()):
         from ..fluid.framework import default_main_program
 
         program = program or default_main_program()
@@ -610,10 +612,16 @@ class Executor:
             new_state[_RNG_KEY] = env[_RNG_KEY]
             fetches = [env[n] for n in fetch_names]
         else:
+            # donated feeds (KV-arena donation) split into a third jit
+            # argument AFTER the analysis above saw them as feeds; eager
+            # dispatch ignores the split (no buffers to alias there)
+            donated = {n: feed_vals.pop(n) for n in donate_feeds
+                       if n in feed_vals} if donate_feeds else {}
             with record_event("executor.prepare", kind="stage"):
                 fn = self._compiled(program, tuple(sorted(feed_vals)),
                                     tuple(fetch_names), tuple(state_in),
-                                    tuple(state_out))
+                                    tuple(state_out),
+                                    tuple(sorted(donated)))
                 # non-traceable state (readers, rank tables) can't cross jit
                 trace_state = {k: v for k, v in state.items()
                                if _is_traceable(v)}
@@ -624,13 +632,15 @@ class Executor:
                     # dispatch ~30x slower.)
                     trace_state = {k: jax.device_put(v, self.device)
                                    for k, v in trace_state.items()}
+            args = (trace_state, feed_vals) \
+                + ((donated,) if donated else ())
             # amp guard wraps dispatch because jax traces lazily (first call
             # and any shape-driven retrace happen inside fn())
             from .flags import get_flag
             if profiler_enabled():
                 with record_event("jit_step_dispatch", kind="stage"):
                     with amp_guard(self.amp):
-                        new_state, fetches = fn(trace_state, feed_vals)
+                        new_state, fetches = fn(*args)
                 with record_event("jit_step_device", kind="stage"):
                     jax.block_until_ready(fetches)
             elif get_flag("check_nan_inf"):
@@ -640,11 +650,11 @@ class Executor:
                 # Inf, hence debug_infs too)
                 with jax.debug_nans(True), jax.debug_infs(True):
                     with amp_guard(self.amp):
-                        new_state, fetches = fn(trace_state, feed_vals)
+                        new_state, fetches = fn(*args)
                         jax.block_until_ready(fetches)
             else:
                 with amp_guard(self.amp):
-                    new_state, fetches = fn(trace_state, feed_vals)
+                    new_state, fetches = fn(*args)
 
         for n, v in new_state.items():
             scope.set(n, v)
@@ -793,10 +803,11 @@ class Executor:
         return fn
 
     # ------------------------------------------------------------------
-    def _compiled(self, program, feed_names, fetch_names, state_in, state_out):
+    def _compiled(self, program, feed_names, fetch_names, state_in, state_out,
+                  donate_feed_names=()):
         key = (id(program), program._version, feed_names, fetch_names,
-               state_in, state_out, self.donate, self.amp, self.auto_layout,
-               _jit_flag_key())
+               state_in, state_out, donate_feed_names, self.donate, self.amp,
+               self.auto_layout, _jit_flag_key())
         fn = self._cache.get(key)
         if fn is not None:
             return fn
@@ -804,9 +815,7 @@ class Executor:
 
         block = program.global_block()
 
-        def step(state, feeds):
-            env = dict(state)
-            env.update(feeds)
+        def _step_body(state, env):
             self._tracing = True
             try:
                 _run_ops(block, env, self)
@@ -822,7 +831,26 @@ class Executor:
             fetches = [env[n] for n in fetch_names]
             return new_state, fetches
 
-        donate = (0,) if self.donate else ()
+        if donate_feed_names:
+            # donated feeds (the generation engine's KV arena) ride a
+            # THIRD argument so donate_argnums can alias their buffers
+            # into the matching fetches without donating regular feeds —
+            # the functional arena update then stays on device instead
+            # of allocating a fresh arena every dispatch
+            def step(state, feeds, donated):
+                env = dict(state)
+                env.update(feeds)
+                env.update(donated)
+                return _step_body(state, env)
+
+            donate = ((0,) if self.donate else ()) + (2,)
+        else:
+            def step(state, feeds):
+                env = dict(state)
+                env.update(feeds)
+                return _step_body(state, env)
+
+            donate = (0,) if self.donate else ()
         fn = _InstrumentedFn(
             tpu_jit(step, auto_state_layout=self.auto_layout,
                     donate_argnums=donate),

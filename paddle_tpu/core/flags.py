@@ -286,6 +286,28 @@ DEFINE_flag("serving_exec_cache_dir", "",
             "versions use their own <version>/warm/ dir regardless (see "
             "ModelRegistry.warm / publish(warm_cache=True))")
 
+DEFINE_flag("serving_kv_spill_dir", "",
+            "per-process READ-WRITE persistent KV-prefix spill directory "
+            "(serving/generate/kvstore.py): when set, the paged arena's "
+            "LRU eviction DEMOTES refcount-0 registered prefix blocks to "
+            "this host-RAM/disk tier instead of discarding them, and "
+            "attach_prefix restores spilled blocks into the arena with "
+            "zero prefill steps on a hash-chain hit. Every artifact is "
+            "fingerprint-checked (bundle content hash, arena geometry, "
+            "kernel_tier, jax/jaxlib version, backend) — any mismatch is "
+            "a silent miss followed by a normal prefill. Empty (default) "
+            "disables spilling; published registry versions use their own "
+            "<version>/kv/ dir regardless (see ModelRegistry.warm / "
+            "publish(kv_prompts=...))")
+
+DEFINE_flag("serving_kv_spill_bytes", 0,
+            "byte budget for the serving_kv_spill_dir tier: when > 0, "
+            "writing a KV artifact that would push the directory past the "
+            "budget first evicts the oldest artifacts (mtime order) until "
+            "the new one fits; an artifact bigger than the whole budget "
+            "is not written at all. 0 (default) = unbounded. Published "
+            "<version>/kv/ dirs are read-only and never evict")
+
 DEFINE_flag("serving_max_seqs", 8,
             "decode slots in the generation engine's ONE fixed-shape "
             "[max_seqs, 1] decode executable. Bounds concurrent in-flight "

@@ -531,11 +531,14 @@ def template_feed(program, feed_names, batch=1):
     return feed
 
 
-def lower_program(program, feed, fetch_list, executor=None, scope=None):
+def lower_program(program, feed, fetch_list, executor=None, scope=None,
+                  donate_feeds=()):
     """AOT-lower one dispatch of ``program`` exactly as ``Executor.run``
     would compile it (same state/feed surface resolution, same jit
-    wrapper) and compile it for the attached backend. Returns
-    ``(lowered, compiled)``."""
+    wrapper) and compile it for the attached backend. ``donate_feeds``
+    names feeds that ride the donated third jit argument (the engine's
+    KV-arena donation) — the lowered signature must match how the engine
+    dispatches. Returns ``(lowered, compiled)``."""
     import jax
     from ..core.amp import amp_guard
     from ..core.executor import (Executor, _RNG_KEY, _collect_free_inputs,
@@ -549,21 +552,26 @@ def lower_program(program, feed, fetch_list, executor=None, scope=None):
     fetch_names = tuple(f if isinstance(f, str) else f.name
                         for f in fetch_list)
     feed = dict(feed)
+    donated = {n: feed.pop(n) for n in donate_feeds
+               if n in feed} if donate_feeds else {}
     if scope.find_var(_RNG_KEY) is None:
         scope.set(_RNG_KEY, jax.random.PRNGKey(program.random_seed or 0))
     block = program.global_block()
     free = _collect_free_inputs(program, 0)
-    state_in = tuple(n for n in free if n not in feed and scope.has_var(n))
+    state_in = tuple(n for n in free
+                     if n not in feed and n not in donated
+                     and scope.has_var(n))
     written = _written_names(program, 0)
     state_out = tuple(n for n in written
                       if (block.has_var(n) and block.var(n).persistable)
                       or scope.has_var(n))
     fn = exe._compiled(program, tuple(sorted(feed)), fetch_names,
-                       state_in, state_out)
+                       state_in, state_out, tuple(sorted(donated)))
     state = {n: scope.find_var(n) for n in state_in}
     state[_RNG_KEY] = scope.find_var(_RNG_KEY)
+    lower_args = (state, feed) + ((donated,) if donated else ())
     with amp_guard(exe.amp):
-        lowered = fn.lower(state, feed)
+        lowered = fn.lower(*lower_args)
     return lowered, lowered.compile()
 
 

@@ -121,7 +121,7 @@ def bundle_content_hash(model_dir):
     return _content_hash(files)
 
 
-def fingerprint(content_hash, tag, feeds, fetch_names):
+def fingerprint(content_hash, tag, feeds, fetch_names, donated=()):
     """The full identity of ONE executable, as a JSON-safe dict. ``tag``
     names which executable of the bundle this is (``infer_b8``,
     ``gen_decode_b4``, ...); ``feeds`` are the PREPARED feed arrays (the
@@ -139,7 +139,7 @@ def fingerprint(content_hash, tag, feeds, fetch_names):
     from ..core.executor import _JIT_KEY_FLAGS
 
     dev = jax.devices()[0]
-    return {
+    fp = {
         "format": 1,
         "content_hash": str(content_hash),
         "tag": str(tag),
@@ -153,6 +153,12 @@ def fingerprint(content_hash, tag, feeds, fetch_names):
         "platform": str(dev.platform),
         "device_kind": str(getattr(dev, "device_kind", dev.platform)),
     }
+    if donated:
+        # donated feeds change the compiled signature (third jit arg +
+        # buffer aliasing) — keyed only when present so every pre-
+        # donation artifact fingerprint is byte-identical to before
+        fp["donated"] = sorted(str(n) for n in donated)
+    return fp
 
 
 def fingerprint_key(fp):
@@ -182,29 +188,36 @@ class WarmExecutable:
         self.compiled = compiled
         self.source = source
 
-    def run(self, executor, program, feed, scope, return_numpy=True):
+    def run(self, executor, program, feed, scope, return_numpy=True,
+            donate_feeds=()):
         import jax
 
         from ..core.executor import _RNG_KEY, _collect_free_inputs
 
         block = program.global_block()
         feed_vals = executor._prepare_feed(block, dict(feed))
+        # the same donated/regular feed split lower_program made at save
+        # time, so the call's arity matches the lowered signature
+        donated = {n: feed_vals.pop(n) for n in donate_feeds
+                   if n in feed_vals} if donate_feeds else {}
         if scope.find_var(_RNG_KEY) is None:
             scope.set(_RNG_KEY, jax.random.PRNGKey(program.random_seed or 0))
         # the same state surface lower_program resolved at save time, so
         # the call's pytree matches the lowered signature exactly
         free = _collect_free_inputs(program, 0)
         state = {n: scope.find_var(n) for n in free
-                 if n not in feed_vals and scope.has_var(n)}
+                 if n not in feed_vals and n not in donated
+                 and scope.has_var(n)}
         state[_RNG_KEY] = scope.find_var(_RNG_KEY)
-        new_state, fetches = self.compiled(state, feed_vals)
+        args = (state, feed_vals) + ((donated,) if donated else ())
+        new_state, fetches = self.compiled(*args)
         for n, v in new_state.items():
             scope.set(n, v)
         return [np.asarray(v) if return_numpy else v for v in fetches]
 
 
 def compile_and_save(cache, fp, program, feed, fetch_names, executor,
-                     scope, site, identity=None):
+                     scope, site, identity=None, donate_feeds=()):
     """Cache fill: AOT-lower one dispatch exactly as the Executor
     compiles it (``obs.perf.lower_program`` — same jit wrapper, same
     state/feed resolution), persist the executable under ``fp``, and
@@ -217,7 +230,8 @@ def compile_and_save(cache, fp, program, feed, fetch_names, executor,
 
     t0 = time.perf_counter()
     _lowered, compiled = _perf.lower_program(
-        program, feed, list(fetch_names), executor=executor, scope=scope)
+        program, feed, list(fetch_names), executor=executor, scope=scope,
+        donate_feeds=donate_feeds)
     seconds = time.perf_counter() - t0
     ident = dict(identity or {})
     ident["tag"] = fp["tag"]
@@ -419,7 +433,7 @@ class ExecCache:
 
 
 def acquire(cache, content_hash, tag, program, feed, fetch_names,
-            executor, scope, identity=None):
+            executor, scope, identity=None, donate_feeds=()):
     """Load-or-build ONE warm executable — the shared engine-side
     sequence: prepare the feed exactly as the jit boundary will see it,
     fingerprint, :meth:`ExecCache.load`, and (writable caches) AOT
@@ -431,13 +445,16 @@ def acquire(cache, content_hash, tag, program, feed, fetch_names,
     try:
         prepared = executor._prepare_feed(program.global_block(),
                                           dict(feed))
-        fp = fingerprint(content_hash, tag, prepared, fetch_names)
+        donated = tuple(sorted(n for n in donate_feeds if n in prepared))
+        fp = fingerprint(content_hash, tag, prepared, fetch_names,
+                         donated=donated)
         entry = cache.load(fp)
         if entry is None and not cache.readonly:
             entry = compile_and_save(cache, fp, program, prepared,
                                      fetch_names, executor=executor,
                                      scope=scope, site="exec_cache_save",
-                                     identity=identity)
+                                     identity=identity,
+                                     donate_feeds=donated)
         return entry
     except Exception as e:
         from ..obs.recorder import record as _flight_record

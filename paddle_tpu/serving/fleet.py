@@ -36,7 +36,10 @@ artifacts right next to the bundle and its warmup LOADS them instead of
 compiling (serving/execcache.py) — scale-out spawns, crash restarts and
 ``rolling_reload`` targets all skip their warmup compiles. The
 ``serving_exec_cache`` / ``serving_exec_cache_dir`` flag values ride the
-child config so the whole fleet follows the parent's configuration.
+child config so the whole fleet follows the parent's configuration, and
+so do ``serving_kv_spill_dir`` / ``serving_kv_spill_bytes`` — a version
+published with ``kv_prompts`` carries its ``kv/`` prefix chains next to
+the bundle the same way (serving/generate/kvstore.py).
 """
 
 from __future__ import annotations
@@ -96,7 +99,9 @@ def _replica_child(address, model_dir, version, cfg, fault_plan=None):
     # load persisted executables (model_dir is the registry version dir,
     # so a published warm/ sidecar is found right next to the bundle)
     set_flags({"serving_exec_cache": cfg.get("exec_cache", True),
-               "serving_exec_cache_dir": cfg.get("exec_cache_dir", "")})
+               "serving_exec_cache_dir": cfg.get("exec_cache_dir", ""),
+               "serving_kv_spill_dir": cfg.get("kv_spill_dir", ""),
+               "serving_kv_spill_bytes": cfg.get("kv_spill_bytes", 0)})
     engine = InferenceEngine(model_dir, buckets=cfg.get("buckets"))
     engine.warmup()
     server = ModelServer(
@@ -163,6 +168,13 @@ class FleetSupervisor(ChildSupervisor):
                          exec_cache=bool(get_flag("serving_exec_cache")),
                          exec_cache_dir=str(
                              get_flag("serving_exec_cache_dir")),
+                         # KV-spill switches ride the same way: a
+                         # replica serving a version published with
+                         # kv_prompts attaches its kv/ chains, and the
+                         # local spill tier (if any) follows the parent
+                         kv_spill_dir=str(get_flag("serving_kv_spill_dir")),
+                         kv_spill_bytes=int(
+                             get_flag("serving_kv_spill_bytes")),
                          # resolved platform, not the env var: the child
                          # must land on the same backend the parent
                          # exported/validated the model on

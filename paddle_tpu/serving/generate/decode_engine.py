@@ -54,6 +54,7 @@ from ...obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
 from ...obs.recorder import record as _flight_record
 from .. import execcache as _execcache
 from ..engine import commit_scope_arrays, parse_buckets
+from . import kvstore as _kvstore
 from .kvcache import CacheExhausted, PagedKVCache
 
 _M_COMPILES = _METRICS.counter(
@@ -197,7 +198,8 @@ class GenerationEngine:
                  fetch_vars=None, executor=None, scope=None, max_seqs=None,
                  block_size=None, num_blocks=None, max_len=128,
                  prefill_buckets=None, prefix_cache_blocks=None,
-                 prefill_chunk=None, exec_cache=None):
+                 prefill_chunk=None, exec_cache=None, kv_store=None,
+                 donate_arena=True):
         import paddle_tpu.fluid as fluid
 
         self._scope = scope or Scope()
@@ -215,11 +217,10 @@ class GenerationEngine:
         # (max_seqs, max_len, arena geometry, chunking) needs no explicit
         # key — it is fully determined by the warmup feed shapes the
         # fingerprint already covers.
-        self._exec_cache = _execcache.resolve_cache(model_dir, exec_cache)
         self._bundle_hash = _execcache.bundle_content_hash(model_dir) \
-            if self._exec_cache is not None and model_dir else None
-        if self._bundle_hash is None:
-            self._exec_cache = None
+            if model_dir else None
+        self._exec_cache = _execcache.resolve_cache(model_dir, exec_cache) \
+            if self._bundle_hash is not None else None
         self._warm_execs = {}          # (phase, bucket) -> WarmExecutable
         self._warm_loaded = set()      # keys whose executable was LOADED
         # numpy state's first dispatch would land a second jit cache
@@ -253,6 +254,29 @@ class GenerationEngine:
                                   num_blocks=num_blocks,
                                   block_size=block_size,
                                   prefix_cache_blocks=prefix_cache_blocks)
+        # persistent KV-prefix spill tier (serving/generate/kvstore.py):
+        # a published <version>/kv/ dir (read-only, manifest-pinned) or
+        # the serving_kv_spill_dir flag's local tier. Keyed by the same
+        # bundle content hash the exec cache uses plus the arena
+        # geometry — no bundle bytes, no spill tier.
+        self._kv_store = None
+        if self._bundle_hash is not None and kv_store is not False:
+            kv_fp = _kvstore.kv_fingerprint(
+                self._bundle_hash, layers, heads, head_dim,
+                self.cache.block_size, self.cache.k[0].dtype)
+            self._kv_store = _kvstore.resolve_store(model_dir, kv_store,
+                                                    kv_fp)
+        self.cache.attach_spill(self._kv_store)
+        # decode-arena donation: the phase executables alias the arena
+        # feed buffers into the arena fetches (donate_argnums on a
+        # dedicated jit argument), so the functional arena update stays
+        # on device instead of allocating a fresh arena every dispatch.
+        # Token streams are bitwise identical either way (donation is
+        # aliasing, never arithmetic); donate_arena=False pins the
+        # undonated twin for parity tests.
+        self.donate_arena = bool(donate_arena)
+        self._donate_feeds = tuple(sorted(self._arena_fetch_names())) \
+            if self.donate_arena else ()
         self.prefill_chunk = int(prefill_chunk if prefill_chunk is not None
                                  else get_flag("serving_prefill_chunk"))
         self._table_width = self.cache.blocks_for(self.max_len)
@@ -403,7 +427,8 @@ class GenerationEngine:
             self._exec_cache, self._bundle_hash, f"gen_{phase}_b{bucket}",
             program, feed, self._gen_fetch(), self._exe, self._scope,
             identity={"instance": self.obs_instance, "phase": phase,
-                      "bucket": bucket})
+                      "bucket": bucket},
+            donate_feeds=self._donate_feeds)
         if entry is not None:
             self._warm_execs[(phase, bucket)] = entry
             if entry.source == "cache":
@@ -449,7 +474,8 @@ class GenerationEngine:
                 with record_event(f"serving/gen_{phase}_b{bucket}",
                                   kind="stage"):
                     outs = warm.run(self._exe, program, feed, self._scope,
-                                    return_numpy=False)
+                                    return_numpy=False,
+                                    donate_feeds=self._donate_feeds)
             except Exception as e:
                 self._warm_execs.pop(key, None)
                 loaded = key in self._warm_loaded
@@ -482,7 +508,8 @@ class GenerationEngine:
                     outs = self._exe.run(program, feed=feed,
                                          fetch_list=fetch,
                                          scope=self._scope,
-                                         return_numpy=False)
+                                         return_numpy=False,
+                                         donate_feeds=self._donate_feeds)
         for l in range(self.num_layers):
             self.cache.k[l] = outs[1 + 2 * l]
             self.cache.v[l] = outs[2 + 2 * l]
@@ -1084,6 +1111,9 @@ class GenerationEngine:
             "kernel_tier": self._kernel_tier,
             "exec_cache": self._exec_cache.stats()
             if self._exec_cache is not None else None,
+            "kv_store": self._kv_store.stats()
+            if self._kv_store is not None else None,
+            "donate_arena": self.donate_arena,
             "warm_loaded": len(self._warm_loaded),
             "ttft": self.ttft.snapshot(),
             "tpot": self.tpot.snapshot(),

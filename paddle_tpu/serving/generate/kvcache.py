@@ -39,6 +39,18 @@ evicted — oldest first, hash unregistered before the block re-enters
 the free list — when the pool overflows or admission needs the block.
 Blocks a live sequence holds (refcount > 0) are never candidates.
 
+PERSISTENT SPILL TIER (serving/generate/kvstore.py): when a
+:class:`~paddle_tpu.serving.generate.kvstore.KVStore` is attached
+(``attach_spill`` — a published ``<version>/kv/`` dir or the
+``serving_kv_spill_dir`` flag), LRU eviction DEMOTES a registered
+block's bytes to the store before recycling it, and ``attach_prefix``
+on an in-arena miss RESTORES the chain's blocks from the store —
+arena write + hash re-registration + refcount bump, zero prefill
+dispatches, bitwise identical to a hot attach. Every store lookup is
+fingerprint-checked (bundle content hash, arena geometry, kernel tier,
+jax/jaxlib, backend); corruption at any depth is a typed reject and a
+normal prefill, never an engine failure.
+
 The arena arrays themselves (``self.k[l]`` / ``self.v[l]``, jax arrays)
 are written by the phase ops (ops/attention_ops.py) — the engine feeds
 them into the dispatch and stores the functionally-updated arrays back —
@@ -139,6 +151,10 @@ class PagedKVCache:
         # refcount-0 registered blocks, insertion order = LRU (oldest
         # first); values unused — OrderedDict for O(1) move/pop
         self._evictable = OrderedDict()
+        # persistent spill tier (kvstore.KVStore) — None until the
+        # engine attaches one; eviction demotes into it, attach_prefix
+        # restores from it
+        self._spill = None
         # arena accounting in the obs.metrics registry (stats() derives
         # its counters from these children)
         self.obs_instance = next_instance("kvcache")
@@ -261,12 +277,19 @@ class PagedKVCache:
         matched = 0
         for h in self._chain_hashes(tokens, n):
             b = self._hash_to_block.get(h)
-            if b is None:
-                self._m_prefix_misses.inc()
-                break
-            if self._ref[b] == 0:
-                self._evictable.pop(b)
-            self._ref[b] += 1
+            if b is not None:
+                if self._ref[b] == 0:
+                    self._evictable.pop(b)
+                self._ref[b] += 1
+            else:
+                # in-arena miss: try the spill tier before giving up —
+                # a restored block arrives registered with refcount 1
+                # (held by this attach), so the walk continues exactly
+                # as if the block had never been evicted
+                b = self._try_restore(h)
+                if b is None:
+                    self._m_prefix_misses.inc()
+                    break
             table.append(b)
             matched += 1
             self._m_prefix_hits.inc()
@@ -305,6 +328,13 @@ class PagedKVCache:
         b, _ = self._evictable.popitem(last=False)
         h = self._block_hash.pop(b)
         del self._hash_to_block[h]
+        if self._spill is not None and not self._spill.readonly:
+            # demote instead of discard: persist the block's bytes to
+            # the spill tier before the arena slot recycles (content-
+            # addressed + idempotent, so re-evicting a chain already
+            # spilled writes nothing)
+            k_blk, v_blk = self._block_kv(b)
+            self._spill.save(h, k_blk, v_blk)
         self._free.append(b)
         self._m_prefix_evictions.inc()
         self._m_blocks_cached.set(len(self._block_hash))
@@ -313,6 +343,78 @@ class PagedKVCache:
         # from (the bounded ring absorbs bursts)
         _flight_record("kv_evict", component=self.obs_instance, block=b,
                        cached=len(self._block_hash))
+
+    # ------------------------------------------------------------------
+    # persistent spill tier
+    # ------------------------------------------------------------------
+    def attach_spill(self, store):
+        """Attach a :class:`~paddle_tpu.serving.generate.kvstore.
+        KVStore` (or None to detach): eviction demotes registered
+        blocks into it, ``attach_prefix`` restores chains from it."""
+        self._spill = store
+
+    @property
+    def spill_store(self):
+        return self._spill
+
+    def _block_kv(self, b):
+        """One block's bytes across every layer, as ``[num_layers,
+        block_size, heads, head_dim]`` numpy stacks (K, V) — the spill
+        artifact payload."""
+        k = np.stack([np.asarray(self.k[l][b])
+                      for l in range(self.num_layers)])
+        v = np.stack([np.asarray(self.v[l][b])
+                      for l in range(self.num_layers)])
+        return k, v
+
+    def _try_restore(self, h):
+        """Restore chain hash ``h``'s block from the spill tier into a
+        fresh arena block: arena write (the COW ``.at[b].set`` idiom),
+        hash re-registration, refcount 1 (the attaching sequence holds
+        it). Returns the block id, or None (no store / miss / reject /
+        no arena capacity) — the caller prefills normally. Never bumps
+        the CacheExhausted reject counter: running out of room for a
+        restore is not an admission failure."""
+        if self._spill is None:
+            return None
+        if not (self._free or self._evictable):
+            return None
+        loaded = self._spill.load(h)
+        if loaded is None:
+            return None
+        k_blk, v_blk = loaded
+        if not self._free:
+            # admission promised this sequence its prompt blocks, so
+            # the draw below is within budget; the LRU eviction here
+            # can itself demote to the spill tier (a swap, not a loss)
+            self._evict_lru()
+        if not self._free:
+            return None
+        b = self._free.pop()
+        self._ref[b] = 1
+        for l in range(self.num_layers):
+            self.k[l] = self.k[l].at[b].set(k_blk[l])
+            self.v[l] = self.v[l].at[b].set(v_blk[l])
+        self._hash_to_block[h] = b
+        self._block_hash[b] = h
+        self._m_in_use.set(self.num_blocks - len(self._free))
+        self._m_blocks_cached.set(len(self._block_hash))
+        return b
+
+    def spill_registered(self):
+        """Force-persist EVERY registered prefix block to the spill
+        tier (publish-time precompute: ``ModelRegistry.warm`` prefills
+        the kv_prompts, then calls this so the chains land under
+        ``<version>/kv/`` whether or not eviction ever ran). Returns
+        the number of blocks now on disk; 0 with no writable store."""
+        if self._spill is None or self._spill.readonly:
+            return 0
+        n = 0
+        for b, h in self._block_hash.items():
+            k_blk, v_blk = self._block_kv(b)
+            if self._spill.save(h, k_blk, v_blk) is not None:
+                n += 1
+        return n
 
     # ------------------------------------------------------------------
     def append_slots(self, seq_id, n=1):
@@ -466,6 +568,7 @@ class PagedKVCache:
             "prefix_hits": self.prefix_hits,
             "prefix_misses": self.prefix_misses,
             "prefix_evictions": self.prefix_evictions,
+            "spill": None if self._spill is None else self._spill.stats(),
         })
 
 

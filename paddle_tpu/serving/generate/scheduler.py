@@ -348,6 +348,36 @@ class ContinuousBatcher:
         while self._pending:
             self._pending.popleft().stream._finish(err)
 
+    def transfer_queued(self, other):
+        """Move every still-QUEUED (unadmitted) request to ``other``,
+        preserving FIFO order — the reload handoff: the old batcher's
+        in-flight sequences finish on the old engine, but its wait
+        queue would otherwise be rejected at close even though the new
+        engine is ready to serve it. Requests the old worker admits
+        concurrently are simply not in the queue anymore and finish
+        where they started. Returns the number moved; requests that
+        cannot move (``other`` already closed) are rejected typed, the
+        close-time behavior they were headed for anyway."""
+        with self._cv:
+            moved = list(self._pending)
+            self._pending.clear()
+        n = 0
+        for req in moved:
+            with other._cv:
+                if not other._closed:
+                    # rebind BEFORE the new worker can touch it: a
+                    # consumer-side close() must cancel against the
+                    # batcher that actually holds the request
+                    req.stream._batcher = other
+                    other._pending.append(req)
+                    other._cv.notify_all()
+                    n += 1
+                    continue
+            req.stream._finish(RuntimeError(
+                "ContinuousBatcher is closed; this queued request was "
+                "rejected without being served"))
+        return n
+
     def close(self, timeout=30.0):
         """Stop admitting, let in-flight sequences FINISH (their callers
         get complete streams), reject still-queued requests typed, and

@@ -105,7 +105,7 @@ class ModelRegistry:
 
     def publish(self, model, src_dir, version=None, kernel_tier=None,
                 model_kind="feedforward", lineage=None, warm_cache=False,
-                warm_kwargs=None):
+                warm_kwargs=None, kv_prompts=None):
         """Copy the bundle at ``src_dir`` in as ``version`` (next integer
         when None) and make it visible by writing the manifest LAST,
         atomically. Returns the published version number. Versions are
@@ -139,7 +139,14 @@ class ModelRegistry:
         executable's compile ONCE and every replica that serves this
         version loads instead of compiling. The manifest lands FIRST —
         a crash mid-warm leaves a fully published version whose
-        replicas simply compile."""
+        replicas simply compile.
+
+        ``kv_prompts`` (generative bundles) additionally runs each
+        prompt's prefill ONCE at publish time and stores the resulting
+        KV-prefix chains under ``<version>/kv/`` (see
+        serving/generate/kvstore.py): replicas that serve this version
+        attach those prefixes with ZERO prefill steps. Passing it
+        implies a warm pass even without ``warm_cache=True``."""
         if not os.path.exists(os.path.join(src_dir, MODEL_FILENAME)):
             raise ValueError(
                 f"publish: {src_dir!r} is not a save_inference_model "
@@ -216,13 +223,16 @@ class ModelRegistry:
         with open(tmp, "w") as f:
             json.dump(manifest, f, indent=1, sort_keys=True)
         os.replace(tmp, os.path.join(dst, VERSION_MANIFEST))
-        if warm_cache:
-            self.warm(model, version, **(warm_kwargs or {}))
+        if warm_cache or kv_prompts:
+            wk = dict(warm_kwargs or {})
+            if kv_prompts is not None:
+                wk.setdefault("kv_prompts", kv_prompts)
+            self.warm(model, version, **wk)
         return version
 
     # ------------------------------------------------------------------
     def warm(self, model, version="latest", buckets=None, sample_feed=None,
-             gen_opts=None):
+             gen_opts=None, kv_prompts=None):
         """Build (or complete) the version's persistent compiled-
         executable artifacts under ``<version>/warm/`` so replicas LOAD
         instead of compile (serving/execcache.py): an engine of the
@@ -247,19 +257,51 @@ class ModelRegistry:
         so they are pruned instead of re-certified into the manifest
         (``warm/`` and ``VERSION.json`` must not grow monotonically
         with every jax upgrade). Returns the sorted artifact relpaths
-        recorded in the manifest."""
+        recorded in the manifest.
+
+        ``kv_prompts`` (generative bundles only) runs each prompt's
+        prefill once HERE and persists the resulting KV-prefix chains
+        under ``<version>/kv/`` (serving/generate/kvstore.py), listed
+        in the manifest as ``kv_files`` with per-file sha256 — same
+        contract as ``warm_files``: :meth:`verify` re-hashes them,
+        :meth:`gc` deletes them with the version, and the serving
+        engine pins loads to these digests before deserializing
+        anything. Re-warming with the same prompts is idempotent
+        (every chain loads from its existing artifact with zero
+        prefill steps; nothing is rewritten). When ``kv_prompts`` is
+        None an existing ``kv/`` dir is left untouched — warm-cache
+        refreshes must not prune KV artifacts they didn't rebuild."""
         path, v = self.resolve(model, version)
         m = self.manifest(model, v)
         from .execcache import ARTIFACT_SUFFIX, ExecCache, WARM_DIRNAME
+        from .generate import kvstore as _kvs
         warm_dir = os.path.join(path, WARM_DIRNAME)
         cache = ExecCache(warm_dir)
+        kv_files = None
         if m.get("model_kind", "feedforward") == "generative":
             from .generate import GenerationEngine
-            engine = GenerationEngine(path, exec_cache=cache,
-                                      **(gen_opts or {}))
+            gopts = dict(gen_opts or {})
+            if kv_prompts:
+                # the prefix cache must be ON so prefilled chains
+                # register (prefix_cache_blocks is a retention cap, not
+                # an allocation), and the engine's KV store must point
+                # at the version's kv/ dir, WRITABLE — resolve_store
+                # gives an explicit path write access; replicas that
+                # later resolve the same dir implicitly get it
+                # read-only and manifest-pinned
+                gopts.setdefault("prefix_cache_blocks", 4096)
+                gopts.setdefault("kv_store",
+                                 os.path.join(path, _kvs.KV_DIRNAME))
+            engine = GenerationEngine(path, exec_cache=cache, **gopts)
             engine.warmup()
+            if kv_prompts:
+                kv_files = self._precompute_kv(engine, path, kv_prompts)
         else:
             from .engine import InferenceEngine
+            if kv_prompts:
+                raise ValueError(
+                    "kv_prompts requires a generative bundle; "
+                    f"{model!r}/{v} is feedforward")
             engine = InferenceEngine(path, buckets=buckets,
                                      exec_cache=cache)
             engine.warmup(sample_feed)
@@ -279,13 +321,56 @@ class ModelRegistry:
                     os.unlink(fpath)
                 except OSError:
                     pass
-        if m.get("warm_files") != warm_files:
-            m["warm_files"] = warm_files
+        changed = m.get("warm_files") != warm_files
+        m["warm_files"] = warm_files
+        if kv_files is not None:
+            changed = changed or m.get("kv_files") != kv_files
+            m["kv_files"] = kv_files
+        if changed:
             tmp = os.path.join(path, VERSION_MANIFEST + ".tmp")
             with open(tmp, "w") as f:
                 json.dump(m, f, indent=1, sort_keys=True)
             os.replace(tmp, os.path.join(path, VERSION_MANIFEST))
-        return sorted(warm_files)
+        return sorted(warm_files) + sorted(kv_files or {})
+
+    def _precompute_kv(self, engine, path, kv_prompts):
+        """Prefill each prompt on the warm engine (chains that already
+        have artifacts restore with zero prefill steps), force-spill
+        every registered block, then certify exactly the artifacts this
+        run touched — stale ``.jkv`` files (earlier prompt sets, older
+        toolchains: their filenames embed the fingerprint key, so a
+        geometry/toolchain flip strands them forever) are pruned."""
+        from .generate import kvstore as _kvs
+        for p in kv_prompts:
+            toks = [int(t) for t in p]
+            handle, _, finished = engine.start(toks, 1, {"mode": "greedy"})
+            # chunked admission parks the prompt on the prefill queue;
+            # step until the chain is prefilled + registered
+            for _ in range(len(toks) + 16):
+                if handle.finished or not handle.prefilling:
+                    break
+                engine.step()
+            if not handle.finished:
+                engine.abort(handle)
+        engine.cache.spill_registered()
+        store = engine.cache.spill_store
+        touched = set(store.touched()) if store is not None else set()
+        kv_dir = os.path.join(path, _kvs.KV_DIRNAME)
+        kv_files = {}
+        if os.path.isdir(kv_dir):
+            for name in sorted(os.listdir(kv_dir)):
+                fpath = os.path.join(kv_dir, name)
+                if not os.path.isfile(fpath) or name.endswith(".tmp"):
+                    continue
+                if name in touched:
+                    kv_files[f"{_kvs.KV_DIRNAME}/{name}"] = \
+                        _sha256_file(fpath)
+                elif name.endswith(_kvs.ARTIFACT_SUFFIX):
+                    try:
+                        os.unlink(fpath)
+                    except OSError:
+                        pass
+        return kv_files
 
     # ------------------------------------------------------------------
     def resolve(self, model, version="latest"):
@@ -440,6 +525,10 @@ class ModelRegistry:
         # manifest-pinned reject is the runtime safety net.
         listed = dict(m.get("files", {}))
         listed.update(m.get("warm_files", {}))
+        # kv_files (publish-time KV-prefix artifacts, kv/) re-hash the
+        # same way: verify is the offline check, the engine's
+        # manifest-pinned load reject is the runtime one
+        listed.update(m.get("kv_files", {}))
         for name, want in listed.items():
             fpath = os.path.join(path, name)
             if not os.path.exists(fpath):

@@ -252,17 +252,51 @@ class ModelServer:
                 "this server hosts a FEED-FORWARD model; call infer() "
                 "(InferClient), not generate()")
         t0 = time.perf_counter()
-        stream = self.batcher.submit(prompt, max_new_tokens, sampling)
+        stream = self._submit_generate(prompt, max_new_tokens, sampling)
 
         def frames():
-            first = True
-            with stream:               # GeneratorExit -> stream.close()
-                for toks in stream.batches():
-                    if first:
-                        self.latency.record(time.perf_counter() - t0)
-                        first = False
-                    yield {"tokens": toks}
+            first, s = True, stream
+            while True:
+                try:
+                    with s:            # GeneratorExit -> stream.close()
+                        for toks in s.batches():
+                            if first:
+                                self.latency.record(
+                                    time.perf_counter() - t0)
+                                first = False
+                            yield {"tokens": toks}
+                    return
+                except RuntimeError as e:
+                    # a reload raced this request onto the OLD batcher
+                    # after its queue handoff: nothing was emitted yet,
+                    # so replaying the whole request on the current
+                    # batcher is safe (a genuine shutdown re-raises
+                    # from _submit_generate instead)
+                    if not first or "ContinuousBatcher is closed" \
+                            not in str(e):
+                        raise
+                    s = self._submit_generate(prompt, max_new_tokens,
+                                              sampling)
         return frames()
+
+    def _submit_generate(self, prompt, max_new_tokens, sampling):
+        """Submit against the CURRENT batcher, retrying across a reload
+        swap: reading the batcher reference and submitting to it cannot
+        be atomic with the swap, so a submit that lands on a
+        just-replaced (closing) batcher retries on its successor. A
+        batcher closed while still being the current one is a real
+        shutdown — that RuntimeError propagates."""
+        while True:
+            with self._engine_lock:
+                batcher = self.batcher
+            try:
+                return batcher.submit(prompt, max_new_tokens, sampling)
+            except RuntimeError as e:
+                if "ContinuousBatcher is closed" not in str(e):
+                    raise
+                with self._engine_lock:
+                    if self.batcher is batcher:
+                        raise
 
     def reload(self, model_dir, version=None):
         """Zero-downtime hot swap to the model at ``model_dir``: build a
@@ -312,12 +346,17 @@ class ModelServer:
                     self.model_dir = model_dir
                     self._version = version
                     self._reloads += 1
+                # zero-downtime also for the WAIT QUEUE: requests still
+                # queued on the old batcher hand off to the new one in
+                # FIFO order instead of being rejected at close
+                requeued = old_batcher.transfer_queued(new_batcher)
                 # in-flight streams keep the OLD engine/batcher through
                 # their closures; close it once they drain (non-blocking
                 # for the reload caller: sequences finish on their own)
                 threading.Thread(target=old_batcher.close,
                                  daemon=True).start()
-                return {"version": version, "compiles": compiled}
+                return {"version": version, "compiles": compiled,
+                        "requeued": requeued}
             new = InferenceEngine(model_dir, buckets=self._buckets,
                                   exec_cache=self._exec_cache)
             compiled = new.warmup()          # off the hot path: old engine

@@ -187,3 +187,24 @@ def test_warm_start_lane_is_lower_is_better():
     assert res["regressions"] == ["warm_start_serving"]
     faster = {"warm_start_serving": dict(rec, value=0.03)}
     assert bench_compare.compare_records(old, faster, 5.0)["ok"]
+
+
+def test_reload_storm_lane_is_lower_is_better():
+    """The reload_storm_serving lane's TTFT-ratio unit (the exact
+    string bench.py emits) pins lower-is-better: a BIGGER reload/steady
+    ratio is a regression. Plain "x ..." speedup units keep the
+    higher-is-better default."""
+    rec = {"metric": "reload_storm_serving", "value": 1.05,
+           "unit": "x TTFT p99, reload window vs steady state, 8 "
+                   "GenClient streams under a rolling v1->v2->v1 reload "
+                   "(lower is better; gate <= 1.5x asserted in-lane)"}
+    assert bench_compare.lower_is_better(rec)
+    assert not bench_compare.lower_is_better(
+        {"metric": "x", "value": 2.0,
+         "unit": "x fused conv+bn+relu (fwd+bwd) vs its jnp twin"})
+    old = {"reload_storm_serving": rec}
+    worse = {"reload_storm_serving": dict(rec, value=1.4)}
+    res = bench_compare.compare_records(old, worse, 5.0)
+    assert res["regressions"] == ["reload_storm_serving"]
+    better = {"reload_storm_serving": dict(rec, value=0.9)}
+    assert bench_compare.compare_records(old, better, 5.0)["ok"]

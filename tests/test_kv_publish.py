@@ -1,0 +1,294 @@
+"""Publish-time KV precompute (ModelRegistry.warm(kv_prompts=...)):
+prefill once at publish, every replica attaches with zero prefill.
+
+Registry interplay pins, mirroring the warm-cache (exec_cache) suite:
+
+* ``publish(kv_prompts=...)`` prefills each prompt ONCE and lists the
+  resulting chain artifacts in the manifest as ``kv_files`` (per-file
+  sha256); a replica engine on the version dir resolves ``kv/``
+  read-only, restores the chains, and its token streams are bitwise a
+  cold engine's;
+* ``verify()`` re-hashes kv artifacts (tampered -> corrupt, deleted ->
+  torn), ``gc()`` deletes ``kv/`` with its version;
+* re-warming with the same prompts is idempotent — every chain LOADS
+  from its existing artifact, nothing is rewritten, the manifest does
+  not change — and a warm-cache refresh WITHOUT kv_prompts leaves the
+  kv set untouched;
+* identity: a ``kernel_tier`` or arena-geometry flip misses CLEANLY
+  (zero restores, zero rejects — the fingerprint key is in the
+  filename) and the engine prefills normally;
+* manifest pinning: a published artifact the manifest never certified
+  is refused with reason "manifest" before anything is unpickled;
+* ``kv_prompts`` on a feedforward bundle is a typed error, and the
+  rollout controller threads ``warm_kwargs`` kv_prompts to the warm
+  pass before rolling the fleet.
+"""
+
+import json
+import os
+
+import pytest
+
+from paddle_tpu.core.flags import get_flag, set_flags
+from paddle_tpu.serving import GenerationEngine, ModelRegistry
+from paddle_tpu.serving.generate import kvstore
+from paddle_tpu.testing.models import export_tiny_lm
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+VOCAB = 17
+PROMPT = list(range(1, 11))                    # 2 cacheable blocks at bs=4
+GEN_OPTS = dict(max_seqs=4, block_size=4, num_blocks=64, max_len=32,
+                prefill_buckets=(8, 16))
+
+
+@pytest.fixture
+def flags_guard():
+    saved = {n: get_flag(n) for n in ("serving_kv_spill_dir",
+                                      "serving_kv_spill_bytes",
+                                      "kernel_tier")}
+    yield
+    set_flags(saved)
+
+
+def _published(tmp_path, kv_prompts=(PROMPT,)):
+    export = str(tmp_path / "export")
+    export_tiny_lm(export, vocab=VOCAB, emb=8, heads=2, n_layers=2,
+                   max_pos=64, seed=3)
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    v = reg.publish("lm", export, model_kind="generative",
+                    kv_prompts=list(kv_prompts),
+                    warm_kwargs={"gen_opts": GEN_OPTS})
+    path, v = reg.resolve("lm", v)
+    return reg, path, v
+
+
+def _replica(path, **kw):
+    opts = dict(GEN_OPTS, prefix_cache_blocks=16)
+    opts.update(kw)
+    return GenerationEngine(path, **opts)
+
+
+def _drain(eng, handle, first, finished):
+    toks = list(first)
+    while not finished:
+        for h, ts, f in eng.step():
+            if h is handle:
+                toks += ts
+                finished = f
+    return toks
+
+
+def _cold_stream(path):
+    eng = _replica(path, kv_store=False, prefix_cache_blocks=0)
+    eng.warmup()
+    return _drain(eng, *eng.start(PROMPT, 5))
+
+
+# ---------------------------------------------------------------------------
+# publish -> replica attach
+# ---------------------------------------------------------------------------
+
+def test_publish_precomputes_and_replicas_attach_readonly(tmp_path):
+    reg, path, v = _published(tmp_path)
+    m = reg.manifest("lm", v)
+    assert len(m["kv_files"]) == 2, m.get("kv_files")
+    assert all(rel.startswith("kv/") and rel.endswith(".jkv")
+               for rel in m["kv_files"])
+    reg.verify("lm", v)
+    want = _cold_stream(path)
+    replica = _replica(path)
+    replica.warmup()
+    got = _drain(replica, *replica.start(PROMPT, 5))
+    assert got == want
+    kv = replica.stats()["kv_store"]
+    assert kv["readonly"] is True
+    assert kv["restores"] == 2, kv
+    assert sum(kv["rejects"].values()) == 0, kv
+    assert replica.stats()["hot_recompiles"] == 0
+    # read-only stores never grow a published version: retention
+    # pressure on the replica discards instead of writing to kv/
+    before = sorted(os.listdir(os.path.join(path, "kv")))
+    assert replica.cache.spill_registered() == 0
+    assert sorted(os.listdir(os.path.join(path, "kv"))) == before
+
+
+def test_verify_catches_tampered_kv_artifact(tmp_path):
+    reg, path, v = _published(tmp_path)
+    reg.verify("lm", v)
+    rel = sorted(reg.manifest("lm", v)["kv_files"])[0]
+    with open(os.path.join(path, rel), "r+b") as f:
+        f.seek(50)
+        f.write(b"\x00\x00\x00\x00")
+    with pytest.raises(ValueError, match="corrupt"):
+        reg.verify("lm", v)
+    os.unlink(os.path.join(path, rel))
+    with pytest.raises(ValueError, match="torn"):
+        reg.verify("lm", v)
+
+
+def test_gc_removes_kv_dir_with_its_version(tmp_path):
+    reg, path, v1 = _published(tmp_path)
+    export = str(tmp_path / "export")
+    for _ in range(3):
+        reg.publish("lm", export, model_kind="generative")
+    assert os.path.isdir(os.path.join(path, "kv"))
+    deleted = reg.gc("lm", keep_latest=1)
+    assert v1 in deleted
+    assert not os.path.exists(path)
+
+
+def test_rewarm_with_same_prompts_is_idempotent(tmp_path):
+    reg, path, v = _published(tmp_path)
+    manifest1 = reg.manifest("lm", v)
+    kv_rels = sorted(manifest1["kv_files"])
+    mtimes = {f: os.path.getmtime(os.path.join(path, f)) for f in kv_rels}
+    files2 = reg.warm("lm", v, gen_opts=GEN_OPTS, kv_prompts=[PROMPT])
+    assert sorted(f for f in files2 if f.startswith("kv/")) == kv_rels
+    assert reg.manifest("lm", v) == manifest1
+    for f, t in mtimes.items():
+        assert os.path.getmtime(os.path.join(path, f)) == t, \
+            "idempotent re-warm must not rewrite kv artifacts"
+    reg.verify("lm", v)
+
+
+def test_warm_refresh_without_prompts_leaves_kv_untouched(tmp_path):
+    reg, path, v = _published(tmp_path)
+    kv_before = reg.manifest("lm", v)["kv_files"]
+    on_disk = sorted(os.listdir(os.path.join(path, "kv")))
+    reg.warm("lm", v, gen_opts=GEN_OPTS)          # exec-cache refresh only
+    assert reg.manifest("lm", v)["kv_files"] == kv_before
+    assert sorted(os.listdir(os.path.join(path, "kv"))) == on_disk
+    reg.verify("lm", v)
+
+
+# ---------------------------------------------------------------------------
+# identity: flips miss cleanly (silent, zero rejects)
+# ---------------------------------------------------------------------------
+
+def test_kernel_tier_flip_misses_cleanly(tmp_path, flags_guard):
+    set_flags({"kernel_tier": "jnp"})
+    reg, path, v = _published(tmp_path)           # precomputed under jnp
+    set_flags({"kernel_tier": "auto"})
+    replica = _replica(path)
+    replica.warmup()
+    _drain(replica, *replica.start(PROMPT, 5))    # prefills normally
+    kv = replica.stats()["kv_store"]
+    assert kv["restores"] == 0, kv
+    assert sum(kv["rejects"].values()) == 0, \
+        "a tier flip must MISS (filenames differ), never reject"
+    assert replica.stats()["cache"]["prefix_misses"] > 0
+
+
+def test_geometry_flip_misses_cleanly(tmp_path):
+    reg, path, v = _published(tmp_path)
+    replica = _replica(path, block_size=8, prefill_buckets=(16,))
+    replica.warmup()
+    _drain(replica, *replica.start(PROMPT, 5))
+    kv = replica.stats()["kv_store"]
+    assert kv["restores"] == 0 and sum(kv["rejects"].values()) == 0, kv
+
+
+# ---------------------------------------------------------------------------
+# manifest pinning + typed errors
+# ---------------------------------------------------------------------------
+
+def test_uncertified_kv_artifact_rejects_as_manifest(tmp_path):
+    """Intact artifacts whose manifest certification was dropped are
+    refused BEFORE unpickling — a published version's kv bytes carry
+    the bundle files' trust level — and the prefill fallback keeps the
+    stream bitwise correct."""
+    reg, path, v = _published(tmp_path)
+    want = _cold_stream(path)
+    m = reg.manifest("lm", v)
+    m["kv_files"] = {}                             # de-certify everything
+    with open(os.path.join(path, "VERSION.json"), "w") as f:
+        json.dump(m, f)
+    replica = _replica(path)
+    replica.warmup()
+    got = _drain(replica, *replica.start(PROMPT, 5))
+    assert got == want
+    kv = replica.stats()["kv_store"]
+    # the chain walk breaks at the first refused block
+    assert kv["rejects"]["manifest"] == 1, kv
+    assert kv["restores"] == 0, kv
+
+
+def test_kv_prompts_on_feedforward_bundle_is_typed(tmp_path):
+    from paddle_tpu.testing.models import build_mlp
+    import paddle_tpu.fluid as fluid
+    main, startup, _loss, logits = build_mlp(
+        dim=8, classes=3, hidden=16, depth=1, seed=7, return_logits=True)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    export = str(tmp_path / "ff")
+    fluid.io.save_inference_model(export, ["img"], [logits], exe, main,
+                                  scope=scope)
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    with pytest.raises(ValueError, match="generative"):
+        reg.publish("ff", export, kv_prompts=[PROMPT])
+
+
+def test_rollout_controller_threads_kv_prompts(tmp_path):
+    """RolloutController(warm_cache=True, warm_kwargs={... kv_prompts})
+    builds the KV artifacts BEFORE rolling the fleet, under the fleet's
+    engine geometry."""
+    from paddle_tpu.online.rollout import RolloutController
+
+    export = str(tmp_path / "export")
+    export_tiny_lm(export, vocab=VOCAB, emb=8, heads=2, n_layers=2,
+                   max_pos=64, seed=3)
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    v = reg.publish("lm", export, model_kind="generative")
+    assert "kv_files" not in reg.manifest("lm", v)
+
+    class _StubSup:
+        _cfg = {}
+        addresses = []
+        version = 0
+
+        def rolling_reload(self, target, wait_timeout=None):
+            self.rolled = target
+
+    sup = _StubSup()
+    ctl = RolloutController(
+        reg, "lm", sup, warm_cache=True, min_serve_s=0.0,
+        poll_interval_s=60.0,
+        warm_kwargs={"gen_opts": GEN_OPTS, "kv_prompts": [PROMPT]})
+    ctl._last_rollout_t = 0.0
+    ctl._poll()
+    assert sup.rolled == v
+    assert ctl.stats().get("last_error") in (None, ""), ctl.stats()
+    kv_files = reg.manifest("lm", v)["kv_files"]
+    assert len(kv_files) == 2, kv_files
+    reg.verify("lm", v)
+
+
+def test_kv_spill_flags_ride_the_fleet_child_config(tmp_path,
+                                                    flags_guard):
+    """FleetSupervisor snapshots the spill flags into the child config
+    at construction — spawned replicas (fresh default flags) inherit
+    the operator's spill tier."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.serving.fleet import FleetSupervisor
+    from paddle_tpu.testing.models import build_mlp
+
+    main, startup, _loss, logits = build_mlp(
+        dim=8, classes=3, hidden=16, depth=1, seed=7, return_logits=True)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    export = str(tmp_path / "ff")
+    fluid.io.save_inference_model(export, ["img"], [logits], exe, main,
+                                  scope=scope)
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    v = reg.publish("ff", export)
+    set_flags({"serving_kv_spill_dir": str(tmp_path / "kvspill"),
+               "serving_kv_spill_bytes": 12345})
+    sup = FleetSupervisor(reg, "ff", version=v, n_replicas=1)
+    try:
+        assert sup._cfg["kv_spill_dir"] == str(tmp_path / "kvspill")
+        assert sup._cfg["kv_spill_bytes"] == 12345
+    finally:
+        sup.stop()

@@ -1,0 +1,362 @@
+"""Persistent KV prefix spill (serving/generate/kvstore.py): eviction
+demotes to a host tier, attach restores with zero prefill.
+
+The pins, in the order the contract matters:
+
+* spill -> restore round-trip: a fresh engine (new arena, nothing
+  registered) attaches a chain persisted by another engine and its
+  token streams are BITWISE the cold streams — greedy, seeded top-k
+  and beam alike — with the restore counters moving and zero rejects;
+* LRU eviction under the retention budget DEMOTES the block to the
+  spill tier instead of discarding, and the same engine later restores
+  it (a swap, not a loss);
+* decode-arena donation is invisible: a ``donate_arena=False`` twin
+  produces bitwise-identical streams;
+* corruption at any depth — truncation, a bit flip, a foreign
+  fingerprint, garbage pickle bytes under a valid digest — is a TYPED
+  reject (``paddle_tpu_kvcache_spill_rejects`` + a flight-recorder
+  event) followed by a normal prefill with bitwise-correct output,
+  never an engine failure;
+* a writable store's byte budget evicts OLDEST artifacts first and
+  refuses oversize artifacts outright;
+* the ``serving_kv_spill_dir`` flag is the only way an unpublished
+  bundle grows a spill tier: empty flag = no store = bitwise the
+  pre-spill behavior, and ``kv_store=False`` kills it regardless.
+"""
+
+import hashlib
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.flags import get_flag, set_flags
+from paddle_tpu.obs.recorder import RECORDER
+from paddle_tpu.serving import GenerationEngine
+from paddle_tpu.serving.generate import kvstore
+from paddle_tpu.serving.generate.kvstore import (KVStore, kv_fingerprint,
+                                                 fingerprint_key,
+                                                 resolve_store)
+from paddle_tpu.testing.models import export_tiny_lm
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+VOCAB = 17
+PROMPT = list(range(1, 11))                    # 2 cacheable blocks at bs=4
+
+REQUESTS = [
+    (PROMPT, 5, None),
+    (PROMPT, 6, {"mode": "topk", "top_k": 4, "seed": 11}),
+    (PROMPT, 4, {"mode": "beam", "beam_size": 2, "eos_id": 0}),
+]
+
+
+@pytest.fixture(scope="module")
+def lm_bundle(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("spilllm") / "model")
+    export_tiny_lm(d, vocab=VOCAB, emb=8, heads=2, n_layers=2, max_pos=64,
+                   seed=3)
+    return d
+
+
+@pytest.fixture
+def flags_guard():
+    saved = {n: get_flag(n) for n in ("serving_kv_spill_dir",
+                                      "serving_kv_spill_bytes",
+                                      "kernel_tier")}
+    yield
+    set_flags(saved)
+
+
+def _engine(d, **kw):
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("prefill_buckets", (8, 16))
+    return GenerationEngine(d, **kw)
+
+
+def _drain(eng, handle, first, finished):
+    toks = list(first)
+    while not finished:
+        for h, ts, f in eng.step():
+            if h is handle:
+                toks += ts
+                finished = f
+    return toks
+
+
+def _cold_streams(d):
+    eng = _engine(d, kv_store=False)
+    eng.warmup()
+    return [_drain(eng, *eng.start(p, m, s)) for p, m, s in REQUESTS]
+
+
+def _fill_spill(d, spill_dir):
+    """Prefill PROMPT once and force-persist its chain; returns the
+    pristine artifact bytes by basename."""
+    set_flags({"serving_kv_spill_dir": str(spill_dir)})
+    w = _engine(d, prefix_cache_blocks=16)
+    w.warmup()
+    _drain(w, *w.start(PROMPT, 5))
+    assert w.cache.spill_registered() == 2
+    st = w.stats()["kv_store"]
+    assert st["writes"] == 2 and not st["readonly"]
+    arts = {}
+    for n in sorted(os.listdir(spill_dir)):
+        if n.endswith(kvstore.ARTIFACT_SUFFIX):
+            with open(os.path.join(spill_dir, n), "rb") as f:
+                arts[n] = f.read()
+    assert len(arts) == 2
+    return arts
+
+
+# ---------------------------------------------------------------------------
+# spill -> restore round-trip: THE bitwise parity pin
+# ---------------------------------------------------------------------------
+
+def test_spill_restore_is_bitwise_equal_to_cold(lm_bundle, tmp_path,
+                                                flags_guard):
+    """A fresh engine restores a chain another engine spilled and every
+    sampling mode's token stream is bitwise the cold stream — zero
+    prefill for the restored prefix, zero rejects, zero recompiles."""
+    want = _cold_streams(lm_bundle)
+    _fill_spill(lm_bundle, tmp_path / "spill")
+
+    reader = _engine(lm_bundle, prefix_cache_blocks=16)
+    reader.warmup()
+    got = [_drain(reader, *reader.start(p, m, s)) for p, m, s in REQUESTS]
+    assert got == want
+    st = reader.stats()
+    kv = st["kv_store"]
+    assert kv["restores"] == 2, kv          # both chain blocks attached
+    assert sum(kv["rejects"].values()) == 0, kv
+    # the restored blocks counted as prefix hits (the walk continued
+    # exactly as if they had never been evicted)...
+    assert st["cache"]["prefix_hits"] >= 2
+    assert st["cache"]["spill"]["restores"] == 2
+    assert st["hot_recompiles"] == 0
+    # ...and later requests attach in-arena without touching the store
+    _drain(reader, *reader.start(PROMPT, 5))
+    assert reader.stats()["kv_store"]["restores"] == 2
+
+
+def test_eviction_demotes_then_the_same_engine_restores(lm_bundle,
+                                                        tmp_path,
+                                                        flags_guard):
+    """Retention pressure spills the evicted block instead of dropping
+    it; the next attach of the same prompt restores it from disk and
+    the stream stays bitwise identical."""
+    set_flags({"serving_kv_spill_dir": str(tmp_path / "spill")})
+    eng = _engine(lm_bundle, prefix_cache_blocks=1)
+    eng.warmup()
+    first = _drain(eng, *eng.start(PROMPT, 5))
+    # release parked 2 registered blocks > budget 1: the deepest block
+    # was demoted to the spill tier, not discarded
+    st = eng.stats()
+    assert st["cache"]["prefix_evictions"] == 1
+    assert st["kv_store"]["writes"] == 1
+    again = _drain(eng, *eng.start(PROMPT, 5))
+    assert again == first
+    st = eng.stats()
+    assert st["kv_store"]["restores"] == 1
+    assert sum(st["kv_store"]["rejects"].values()) == 0
+    assert st["hot_recompiles"] == 0
+
+
+def test_donated_arena_decode_is_bitwise_undonated(lm_bundle):
+    donated = _engine(lm_bundle, prefix_cache_blocks=16)
+    pinned = _engine(lm_bundle, prefix_cache_blocks=16,
+                     donate_arena=False)
+    assert donated.stats()["donate_arena"] is True
+    assert pinned.stats()["donate_arena"] is False
+    donated.warmup()
+    pinned.warmup()
+    for p, m, s in REQUESTS:
+        a = _drain(donated, *donated.start(p, m, s))
+        b = _drain(pinned, *pinned.start(p, m, s))
+        assert a == b, (s, a, b)
+    assert donated.stats()["hot_recompiles"] == 0
+    assert pinned.stats()["hot_recompiles"] == 0
+
+
+# ---------------------------------------------------------------------------
+# corruption robustness: typed reject + prefill fallback, never a failure
+# ---------------------------------------------------------------------------
+
+def _foreign_fingerprint(raw):
+    blob = raw[raw.index(b"\n", len(kvstore._MAGIC)) + 1:]
+    doc = pickle.loads(blob)
+    doc["fingerprint"] = dict(doc["fingerprint"],
+                              content_hash="someone-elses-bundle")
+    blob = pickle.dumps(doc, protocol=pickle.HIGHEST_PROTOCOL)
+    return (kvstore._MAGIC + hashlib.sha256(blob).hexdigest().encode()
+            + b"\n" + blob)
+
+
+def _garbage_payload(raw):
+    blob = b"these bytes are not a pickle"
+    return (kvstore._MAGIC + hashlib.sha256(blob).hexdigest().encode()
+            + b"\n" + blob)
+
+
+def _bit_flip(raw):
+    b = bytearray(raw)
+    b[len(raw) - 8] ^= 0xFF                    # mid-payload, not header
+    return bytes(b)
+
+
+CORRUPTIONS = [
+    ("format", lambda raw: raw[:40]),          # truncated past the header
+    ("format", _bit_flip),                     # payload digest mismatch
+    ("fingerprint", _foreign_fingerprint),     # intact but foreign
+    ("deserialize", _garbage_payload),         # valid digest, bad pickle
+]
+
+
+def test_corrupt_artifacts_reject_typed_and_prefill_correctly(
+        lm_bundle, tmp_path, flags_guard):
+    want = _cold_streams(lm_bundle)[0]
+    pristine = _fill_spill(lm_bundle, tmp_path / "spill")
+    spill = tmp_path / "spill"
+    for reason, corrupt in CORRUPTIONS:
+        # corrupt EVERY artifact so whichever block leads the chain walk
+        # exercises this case; the walk breaks at the first reject, so
+        # exactly one reject lands per engine
+        for name, raw in pristine.items():
+            with open(os.path.join(spill, name), "wb") as f:
+                f.write(corrupt(raw))
+        eng = _engine(lm_bundle, prefix_cache_blocks=16)
+        eng.warmup()
+        got = _drain(eng, *eng.start(PROMPT, 5))
+        assert got == want, reason             # prefill fallback, bitwise
+        kv = eng.stats()["kv_store"]
+        assert kv["rejects"][reason] == 1, (reason, kv)
+        assert kv["restores"] == 0, (reason, kv)
+        events = RECORDER.events(kinds={"kv_spill_reject"})
+        assert any(e["detail"].get("reason") == reason
+                   and e["component"] == eng.cache.spill_store
+                   .obs_instance for e in events), reason
+
+
+# ---------------------------------------------------------------------------
+# budget + write discipline (KVStore unit level)
+# ---------------------------------------------------------------------------
+
+def _unit_fp():
+    return kv_fingerprint("unit-hash", 2, 2, 4, 4, "float32")
+
+
+def _block(seed):
+    rng = np.random.RandomState(seed)
+    return rng.normal(0, 1, (2, 4, 2, 4)).astype(np.float32)
+
+
+def _h(i):
+    return hashlib.sha1(bytes([i])).digest()
+
+
+def test_budget_evicts_oldest_and_refuses_oversize(tmp_path):
+    fp = _unit_fp()
+    # measure one artifact's size in an unbudgeted store
+    probe = KVStore(str(tmp_path / "probe"), fp)
+    size = os.path.getsize(probe.save(_h(0), _block(0), _block(0)))
+
+    store = KVStore(str(tmp_path / "store"), fp,
+                    budget_bytes=2 * size + 16)
+    paths = []
+    for i in range(1, 4):
+        p = store.save(_h(i), _block(i), _block(i))
+        assert p is not None
+        os.utime(p, (1000.0 + i, 1000.0 + i))  # pin eviction order
+        paths.append(p)
+    # the third write overflowed the 2-artifact budget: the OLDEST went
+    assert not os.path.exists(paths[0])
+    assert os.path.exists(paths[1]) and os.path.exists(paths[2])
+    st = store.stats()
+    assert st["bytes"] == 2 * size <= st["budget_bytes"]
+    # an artifact bigger than the whole budget is refused outright
+    tiny = KVStore(str(tmp_path / "tiny"), fp, budget_bytes=16)
+    assert tiny.save(_h(9), _block(9), _block(9)) is None
+    assert tiny.artifacts() == []
+    assert any(e["component"] == tiny.obs_instance
+               for e in RECORDER.events(kinds={"kv_spill_skip"}))
+
+
+def test_saves_are_idempotent_and_readonly_stores_never_write(tmp_path):
+    fp = _unit_fp()
+    store = KVStore(str(tmp_path / "s"), fp)
+    p = store.save(_h(1), _block(1), _block(1))
+    writes = store.stats()["writes"]
+    mtime = os.path.getmtime(p)
+    assert store.save(_h(1), _block(1), _block(1)) == p
+    assert store.stats()["writes"] == writes   # no rewrite, no recount
+    assert os.path.getmtime(p) == mtime
+    ro = KVStore(str(tmp_path / "s"), fp, readonly=True)
+    assert ro.save(_h(2), _block(2), _block(2)) is None
+    assert len(ro.artifacts()) == 1
+    # ...but it loads what the writable twin persisted
+    k, v = ro.load(_h(1))
+    np.testing.assert_array_equal(k, _block(1))
+    assert ro.stats()["restores"] == 1
+
+
+# ---------------------------------------------------------------------------
+# identity + resolution + flags
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_covers_every_identity_axis():
+    base = fingerprint_key(_unit_fp())
+    for mutate in (lambda d: d.update(content_hash="other"),
+                   lambda d: d.update(block_size=8),
+                   lambda d: d.update(heads=4),
+                   lambda d: d.update(dtype="bfloat16"),
+                   lambda d: d["flags"].update(kernel_tier="pallas"),
+                   lambda d: d.update(jax="0.0.0"),
+                   lambda d: d.update(platform="tpu")):
+        fp = _unit_fp()
+        mutate(fp)
+        assert fingerprint_key(fp) != base
+    assert "kernel_tier" in _unit_fp()["flags"]
+
+
+def test_resolve_store_precedence(tmp_path, flags_guard):
+    fp = _unit_fp()
+    set_flags({"serving_kv_spill_dir": "", "serving_kv_spill_bytes": 0})
+    # no flag, no published kv dir, no explicit path -> no store
+    assert resolve_store(str(tmp_path / "bundle"), None, fp) is None
+    # a model_dir-less engine never gets one (no content identity)
+    set_flags({"serving_kv_spill_dir": str(tmp_path / "spill"),
+               "serving_kv_spill_bytes": 4096})
+    assert resolve_store(None, None, fp) is None
+    # kv_store=False kills the tier regardless of the flag
+    assert resolve_store(str(tmp_path / "bundle"), False, fp) is None
+    # the flag names a writable, budgeted local store
+    s = resolve_store(str(tmp_path / "bundle"), None, fp)
+    assert isinstance(s, KVStore) and not s.readonly
+    assert s.budget_bytes == 4096
+    # an explicit path always wins (how registry.warm opens kv/ rw)
+    e = resolve_store(str(tmp_path / "bundle"),
+                      str(tmp_path / "explicit"), fp)
+    assert e.path == str(tmp_path / "explicit") and not e.readonly
+    # an instance passes through untouched
+    assert resolve_store(str(tmp_path / "bundle"), s, fp) is s
+
+
+def test_empty_flag_means_no_store_at_all(lm_bundle, flags_guard):
+    set_flags({"serving_kv_spill_dir": ""})
+    eng = _engine(lm_bundle, prefix_cache_blocks=16)
+    assert eng.stats()["kv_store"] is None
+    assert eng.cache.stats()["spill"] is None
+
+
+def test_spill_metrics_families_registered():
+    from paddle_tpu.obs import REGISTRY
+    names = REGISTRY.names()
+    for n in ("paddle_tpu_kvcache_spill_writes",
+              "paddle_tpu_kvcache_spill_restores",
+              "paddle_tpu_kvcache_spill_rejects",
+              "paddle_tpu_kvcache_spill_bytes"):
+        assert n in names, n

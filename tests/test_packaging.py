@@ -37,6 +37,7 @@ def test_wheel_builds_with_all_subpackages(tmp_path):
                 "paddle_tpu/serving/__init__.py",
                 "paddle_tpu/serving/execcache.py",
                 "paddle_tpu/serving/generate/__init__.py",
+                "paddle_tpu/serving/generate/kvstore.py",
                 "paddle_tpu/online/__init__.py",
                 "paddle_tpu/obs/__init__.py",
                 "paddle_tpu/obs/slo.py",

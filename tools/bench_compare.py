@@ -114,7 +114,10 @@ def lower_is_better(record):
     # "s " covers second-denominated latency lanes (warm_start_serving's
     # "s replica time-to-ready ..."), exactly like the ms-denominated
     # ones; "s/step"-style throughput units don't start with "s " so
-    # they keep the higher-is-better default
+    # they keep the higher-is-better default. Ratio lanes where smaller
+    # wins (reload_storm_serving's "x TTFT p99 ... reload vs steady")
+    # say "lower is better" in their unit string explicitly — "x ..."
+    # alone stays higher-is-better (speedup lanes)
     unit = str(record.get("unit", ""))
     return ("lower is better" in unit or unit.startswith("ms")
             or unit.startswith("s ") or unit.startswith("%"))

@@ -60,9 +60,11 @@ BOUNDED_LABELS = {
             "engine_warmup/engine_infer/genengine_*/attribute/"
             "exec_cache_save) — a fixed code-site set; per-executable "
             "identity rides the CompileRecord, never a label",
-    "reason": "exec-cache artifact reject reasons — the fixed "
-              "serving.execcache.REJECT_REASONS enum (format/manifest/"
-              "fingerprint/deserialize/run_failed)",
+    "reason": "artifact reject reasons — the fixed enums "
+              "serving.execcache.REJECT_REASONS (format/manifest/"
+              "fingerprint/deserialize/run_failed) and "
+              "serving.generate.kvstore.REJECT_REASONS (format/"
+              "manifest/fingerprint/deserialize)",
     "device": "local jax devices (platform:id) — bounded by the "
               "attached hardware",
 }
@@ -91,6 +93,7 @@ def registered_families():
     import paddle_tpu.serving.batcher       # noqa: F401
     import paddle_tpu.serving.engine        # noqa: F401
     import paddle_tpu.serving.generate.kvcache    # noqa: F401
+    import paddle_tpu.serving.generate.kvstore    # noqa: F401
     import paddle_tpu.serving.generate.scheduler  # noqa: F401
     import paddle_tpu.serving.router        # noqa: F401
     import paddle_tpu.serving.server        # noqa: F401

@@ -43,6 +43,7 @@ def registered_metrics():
     import paddle_tpu.serving.batcher       # noqa: F401
     import paddle_tpu.serving.engine        # noqa: F401
     import paddle_tpu.serving.generate.kvcache    # noqa: F401
+    import paddle_tpu.serving.generate.kvstore    # noqa: F401
     import paddle_tpu.serving.generate.scheduler  # noqa: F401
     import paddle_tpu.serving.router        # noqa: F401
     import paddle_tpu.serving.server        # noqa: F401
