@@ -1321,6 +1321,194 @@ def run_online_learning_lane(n_clients=4, n_pservers=2, n_replicas=2,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_elastic_training_lane(n_clients=4, n_pservers=2, n_replicas=2,
+                              feature_dim=16, batch=16,
+                              trainers_min=2, trainers_max=3,
+                              publish_every_s=0.4, min_serve_s=0.3,
+                              min_rollouts=2, startup_timeout=240.0,
+                              chaos_timeout=240.0):
+    """The elastic-fleet chaos lane (paddle_tpu/online/pool.py): an
+    OnlineLearningLoop in elastic mode — a Master task queue feeds a
+    TrainerPool of ``trainers_min`` StreamingTrainer workers whose sync
+    barrier membership is LEASE-based — while the loop-level publish
+    pacer freezes/publishes cuts and the RolloutController rolls them
+    onto a live serving fleet under ``n_clients`` hammering FleetClients.
+    Mid-stream chaos: one pserver shard is SIGKILLed AND one pool worker
+    is killed without deregistering (its pserver lease must EXPIRE and
+    its Master task lease must time out and re-dispatch). Asserts: zero
+    failed infer requests, the pool hot-joins a replacement, training
+    keeps stepping past the kill, the served version advances
+    monotonically across >= ``min_rollouts`` rollouts, no shard ever
+    broke a round (``rounds_broken == 0`` everywhere, >= 1 shrink
+    somewhere), and the killed pserver child supervisor-restarted. The
+    headline number is the same freshness metric as the online lane:
+    publish-to-served lag p50."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed import RetryPolicy
+    from paddle_tpu.distributed.rpc import RpcClient
+    from paddle_tpu.online import OnlineLearningLoop
+    from paddle_tpu.serving import FleetClient
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data("x", shape=[feature_dim])
+        y = fluid.layers.data("y", shape=[1])
+        pred = fluid.layers.fc(x, size=1, act=None)
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss, startup)
+
+    w_true = np.random.RandomState(0).normal(
+        0, 1, (feature_dim, 1)).astype("float32")
+
+    def chunk_feeds(chunk):
+        r = np.random.RandomState(int(chunk) % 4096)
+        for _ in range(2):
+            X = r.normal(0, 1, (batch, feature_dim)).astype("float32")
+            yield {"x": X, "y": X @ w_true}
+
+    root = tempfile.mkdtemp(prefix="pdtpu-elastic-")
+    loop = OnlineLearningLoop(
+        main_p, startup, None, ["x"], [pred],
+        registry_root=os.path.join(root, "registry"), model="lin",
+        n_pservers=n_pservers, n_replicas=n_replicas,
+        publish_every_s=publish_every_s, min_serve_s=min_serve_s,
+        rollout_poll_s=0.2, buckets="1,2", max_delay_ms=1.0,
+        checkpoint_dir=os.path.join(root, "ckpt"),
+        incident_dir=os.path.join(root, "incidents"),
+        chunks=list(range(200000)), chunk_feeds=chunk_feeds,
+        trainers_min=trainers_min, trainers_max=trainers_max,
+        autoscale=False, trainer_lease_s=1.0, master_timeout_s=1.5)
+    errs = []
+    infers = [0]
+    lat = []
+    served_seen = []
+    stop = threading.Event()
+
+    def hammer(i):
+        fc = FleetClient(loop.fleet.addresses,
+                         retry=RetryPolicy(max_retries=10,
+                                           backoff_base_s=0.05,
+                                           backoff_max_s=0.5))
+        X = np.zeros((1, feature_dim), np.float32)
+        try:
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    fc.infer({"x": X})
+                    lat.append(time.perf_counter() - t0)
+                    infers[0] += 1
+                except Exception as e:
+                    errs.append(repr(e))
+        finally:
+            fc.close()
+
+    try:
+        loop.start(wait_ready_s=startup_timeout)
+        ts = [threading.Thread(target=hammer, args=(i,))
+              for i in range(n_clients)]
+        t_traffic = time.perf_counter()
+        for t in ts:
+            t.start()
+        killed = False
+        step_mark = rollouts_mark = 0
+        deadline = time.monotonic() + chaos_timeout
+        while time.monotonic() < deadline:
+            st = loop.stats(fleet_metrics=False)
+            served_seen.append(st["served_version"])
+            if st["rollout"]["rollouts"] >= 1 and not killed:
+                step_mark = loop.pool.global_step()
+                rollouts_mark = st["rollout"]["rollouts"]
+                loop.pservers.kill(1)            # SIGKILL a pserver shard
+                loop.pool.kill(loop.pool.worker_ids()[0])  # crash a worker
+                killed = True
+            if killed and st["rollout"]["rollouts"] >= \
+                    rollouts_mark + min_rollouts:
+                break
+            time.sleep(0.4)
+        # hot-join replacement: the pool monitor tops back up to min
+        join_deadline = time.monotonic() + 30.0
+        while loop.pool.size() < trainers_min and \
+                time.monotonic() < join_deadline:
+            time.sleep(0.1)
+        # training advances past the kill before we judge
+        step_deadline = time.monotonic() + 60.0
+        while loop.pool.global_step() < step_mark + 20 and \
+                time.monotonic() < step_deadline:
+            time.sleep(0.1)
+        stop.set()
+        elapsed = time.perf_counter() - t_traffic
+        for t in ts:
+            t.join(30.0)
+        loop.incidents.wait_idle(20.0)
+        st = loop.stats()
+        assert not errs, f"infer requests failed under chaos: {errs[:3]}"
+        assert killed, "chaos never fired (no rollout happened)"
+        assert st["rollout"]["rollouts"] >= rollouts_mark + min_rollouts, \
+            st["rollout"]
+        assert all(b >= a for a, b in zip(served_seen, served_seen[1:])), \
+            f"served version regressed: {served_seen}"
+        assert loop.pool.size() >= trainers_min, \
+            f"hot-join replacement missing: {st['pool']}"
+        assert st["pool"]["joins"] >= trainers_min + 1, st["pool"]
+        assert st["pool"]["lease_expired"] >= 1, st["pool"]
+        assert loop.pool.global_step() >= step_mark + 20, \
+            "training stalled after the worker kill"
+        assert sum(c["restart_count"]
+                   for c in st["pserver_children"]) >= 1, \
+            "killed pserver shard never restarted"
+        # barrier health: the dead worker's lease expiry SHRANK rounds —
+        # no shard ever waited out a full barrier timeout (round_broken)
+        shard_stats = []
+        for a in loop.pservers.addresses:
+            cli = RpcClient(tuple(a))
+            shard_stats.append(cli.call("stats"))
+            cli.close()
+        assert all(s["rounds_broken"] == 0 for s in shard_stats), \
+            [(s["rounds_shrunk"], s["rounds_broken"]) for s in shard_stats]
+        assert any(s["rounds_shrunk"] >= 1 for s in shard_stats), \
+            [(s["rounds_shrunk"], s["rounds_broken"]) for s in shard_stats]
+        # lineage stays monotone: no torn or out-of-order cut published
+        steps = [loop.registry.manifest(
+                     "lin", v)["lineage"]["global_step"]
+                 for v in st["published_versions"]]
+        assert steps == sorted(steps), steps
+
+        lag = st["rollout"]["publish_to_served"]
+        from paddle_tpu.core.profiler import percentile
+        return {
+            "publish_to_served_p50_ms": round(lag["p50_ms"], 1),
+            "publish_to_served_p99_ms": round(lag["p99_ms"], 1),
+            "rollouts": st["rollout"]["rollouts"],
+            "published_versions": len(st["published_versions"]),
+            "served_version": st["served_version"],
+            "pool_size": loop.pool.size(),
+            "pool_joins": st["pool"]["joins"],
+            "pool_lease_expired": st["pool"]["lease_expired"],
+            "trainer_steps": loop.pool.global_step(),
+            "trainer_steps_s": round(
+                loop.pool.global_step() / elapsed, 1),
+            "backlog_pending": st["backlog"]["pending"],
+            "publish_pacer_accepted": st["publish_pacer"]["accepted"],
+            "rounds_shrunk": sum(s["rounds_shrunk"] for s in shard_stats),
+            "rounds_broken": sum(s["rounds_broken"] for s in shard_stats),
+            "infer_qps": round(infers[0] / elapsed, 1),
+            "infer_p99_ms": round(percentile(lat, 99) * 1e3, 2),
+            "failed_infers": len(errs),
+            "pserver_restarts": [c["restart_count"]
+                                 for c in st["pserver_children"]],
+        }
+    finally:
+        stop.set()
+        loop.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_fused_kernels_lane(smoke):
     """A/B microbench for the two new kernel-tier families against their
     jnp twins, measured OUTSIDE the Program machinery so the numbers
@@ -2338,6 +2526,26 @@ def main():
         # version advanced monotonically across >= min_rollouts rollouts,
         # both SIGKILLed children supervisor-restarted
         **ol,
+    })))
+
+    # ---- elastic-fleet chaos lane (Master-fed TrainerPool, lease-based
+    # barrier membership: pserver-shard SIGKILL + pool-worker kill, hot-
+    # join replacement, live freeze/publish/rollout throughout) ----
+    el_kw = dict(publish_every_s=0.4, min_serve_s=0.3) \
+        if args.smoke else dict(publish_every_s=1.0, min_serve_s=1.0,
+                                min_rollouts=3)
+    el = run_elastic_training_lane(**el_kw)
+    print(json.dumps(_rec({
+        "metric": "elastic_training" + ("_smoke" if args.smoke else ""),
+        "value": el["publish_to_served_p50_ms"],
+        "unit": "ms publish-to-served lag p50 (pacer freeze cut -> "
+                "registry publish -> rollout onto the live fleet), with "
+                "a Master-fed elastic trainer pool surviving a pserver-"
+                "shard SIGKILL + worker kill/hot-join",
+        # asserted inside the lane: zero failed infer requests, pool
+        # hot-joined a replacement, rounds shrank (never broke), served
+        # version advanced monotonically, killed shard restarted
+        **el,
     })))
 
     # ---- generation serving lane (continuous batching + paged KV) ----

@@ -166,6 +166,17 @@ DEFINE_flag("pserver_barrier_timeout_s", 60.0,
             "ParameterServer(barrier_timeout_s=...)/serve(); the flag is "
             "the process-wide default (was a hardcoded 60.0)")
 
+DEFINE_flag("pserver_trainer_lease_s", 10.0,
+            "heartbeat-lease duration in seconds for sync-mode trainer "
+            "membership on a parameter-server shard. A trainer that calls "
+            "register_trainer joins the shard's lease set (pushes and "
+            "further registrations renew it); a sync round's barrier waits "
+            "on the lease set snapshotted at round-open, and a member "
+            "whose lease expires mid-round SHRINKS the barrier instead of "
+            "timing it out. 0 disables lease bookkeeping entirely "
+            "(count-based fan_in barriers only). Overridable per server "
+            "via ParameterServer(trainer_lease_s=...)/serve()")
+
 DEFINE_flag("rpc_timeout_s", 90.0,
             "host-RPC response deadline in seconds (was a hardcoded 90.0): "
             "how long RpcClient waits for a reply before declaring the "
@@ -351,6 +362,19 @@ DEFINE_flag("online_publish_every_s", 0.0,
             "successful freeze request, checked at step boundaries. 0.0 "
             "(default) disables the time trigger — step cadence "
             "(online_publish_every_steps) drives publishes alone")
+
+DEFINE_flag("online_trainers_min", 1,
+            "lower bound on the online TrainerPool's worker count: the "
+            "backlog-driven autoscaler never retires below this many "
+            "StreamingTrainer workers, and the pool hot-joins "
+            "replacements for crashed workers back up to it "
+            "(online/pool.py)")
+
+DEFINE_flag("online_trainers_max", 4,
+            "upper bound on the online TrainerPool's worker count: a "
+            "Master-backlog spike grows the pool (one hot-join per "
+            "autoscaler poll while the scale-up SloRule burns) up to "
+            "this many StreamingTrainer workers, never past it")
 
 DEFINE_flag("online_min_serve_s", 2.0,
             "rollout hysteresis: the RolloutController will not start a "

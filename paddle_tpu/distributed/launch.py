@@ -410,14 +410,16 @@ class PserverSupervisor(ChildSupervisor):
                  opt_kwargs=None, mode="async", fan_in=1, max_staleness=None,
                  barrier_timeout_s=None, checkpoint_every=1,
                  heartbeat_interval_s=0.25, heartbeat_timeout_s=None,
-                 heartbeat_misses=3, max_restarts=5, host="127.0.0.1"):
+                 heartbeat_misses=3, max_restarts=5, host="127.0.0.1",
+                 trainer_lease_s=None):
         import tempfile
 
         self._cfg = dict(optimizer=optimizer, opt_kwargs=opt_kwargs,
                          mode=mode, fan_in=fan_in,
                          max_staleness=max_staleness,
                          barrier_timeout_s=barrier_timeout_s,
-                         checkpoint_every=checkpoint_every)
+                         checkpoint_every=checkpoint_every,
+                         trainer_lease_s=trainer_lease_s)
         self._ckpt_dir = checkpoint_dir or tempfile.mkdtemp(
             prefix="pdtpu_pserver_ckpt_")
         os.makedirs(self._ckpt_dir, exist_ok=True)

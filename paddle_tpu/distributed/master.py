@@ -58,6 +58,10 @@ class Master:
         self._done = []
         self._pass_id = 0
         self._next_id = 0
+        # cumulative failure events (explicit task_failed + lease
+        # expiries), kept as a running counter so backlog() stays O(1)
+        # instead of scanning _done for dropped tasks
+        self._failures_total = 0
         if snapshot_path:
             # a crash mid-snapshot leaves a stale .tmp beside the real
             # file; it is never valid state (os.replace is the commit
@@ -124,6 +128,7 @@ class Master:
                 return False
             del self._doing[task_id]
             t.failures += 1
+            self._failures_total += 1
             if t.failures < self._failure_max:
                 self._todo.append(t)
             else:
@@ -136,6 +141,18 @@ class Master:
             self._requeue_expired_locked()
             return {"todo": len(self._todo), "doing": len(self._doing),
                     "done": len(self._done), "pass_id": self._pass_id}
+
+    def backlog(self):
+        """Cheap queue-depth counts for the trainer autoscaler:
+        ``{pending, leased, failed}``. ``failed`` is the CUMULATIVE
+        failure-event count (explicit fails + lease expiries), a
+        monotone signal rate-rules can watch. O(leased) for the expiry
+        sweep, no task/chunk copies — safe to poll on a tight loop."""
+        with self._lock:
+            self._requeue_expired_locked()
+            return {"pending": len(self._todo),
+                    "leased": len(self._doing),
+                    "failed": self._failures_total}
 
     def request_save_model(self, trainer_id, block_ms):
         """Save-model arbitration (reference go/master/service.go
@@ -158,6 +175,7 @@ class Master:
         for t in expired:
             del self._doing[t.task_id]
             t.failures += 1
+            self._failures_total += 1
             if t.failures < self._failure_max:
                 self._todo.append(t)
             else:
@@ -178,6 +196,7 @@ class Master:
             "done": [t.snapshot() for t in self._done],
             "next_id": self._next_id,
             "pass_id": self._pass_id,
+            "failures_total": self._failures_total,
         }
         tmp = self._snapshot_path + ".tmp"
         with open(tmp, "wb") as f:
@@ -206,6 +225,7 @@ class Master:
                 done.append(t)
             next_id = int(state["next_id"])
             pass_id = int(state["pass_id"])
+            failures_total = int(state.get("failures_total", 0))
         except Exception as e:
             warnings.warn(
                 f"master snapshot {self._snapshot_path!r} unreadable "
@@ -215,6 +235,7 @@ class Master:
         self._done = done
         self._next_id = next_id
         self._pass_id = pass_id
+        self._failures_total = failures_total
 
 
 class MasterClient:
@@ -228,6 +249,13 @@ class MasterClient:
     def set_dataset(self, chunks, chunks_per_task=1):
         return self._rpc.call("set_dataset", chunks=list(chunks),
                               chunks_per_task=chunks_per_task)
+
+    def get_task(self):
+        """One lease attempt: the raw ``get_task`` RPC result — a task
+        dict, ``{"wait": True}`` (everything currently leased), or None
+        (pass complete). For stop-aware polling loops that cannot block
+        inside :meth:`tasks`."""
+        return self._rpc.call("get_task")
 
     def tasks(self, poll_interval=0.05):
         """Generator yielding (task_id, epoch, chunks); call finished/failed
@@ -249,6 +277,11 @@ class MasterClient:
 
     def progress(self):
         return self._rpc.call("pass_progress")
+
+    def backlog(self):
+        """``{pending, leased, failed}`` — the autoscaler's control
+        signal (see :meth:`Master.backlog`)."""
+        return self._rpc.call("backlog")
 
     def request_save_model(self, trainer_id, block_ms):
         return self._rpc.call("request_save_model", trainer_id=trainer_id,

@@ -37,13 +37,32 @@ import functools
 import os
 import pickle
 import threading
+import time
 import warnings
 
 import numpy as np
 
 from ..core.flags import get_flag
 from ..core.profiler import trace_context
+from ..obs.metrics import REGISTRY as _METRICS, next_instance
+from ..obs.recorder import record as _flight_record
 from .rpc import RpcServer, RpcClient, SparseGrad
+
+# membership-churn counters (satellite of the lease-based barrier): a
+# round that SHRANK waited only until a dead member's lease expired; a
+# round that BROKE waited out the full barrier timeout and discarded its
+# partial aggregation, failing every blocked pusher. Scraped off the
+# shard child's registry into the fleet view (OnlineLearningLoop.stats).
+_M_ROUND_SHRUNK = _METRICS.counter(
+    "paddle_tpu_pserver_round_shrunk",
+    "sync-round barrier members dropped mid-round (lease expired or "
+    "trainer deregistered) so the round applied without them, per shard "
+    "instance", labels=("instance",))
+_M_ROUND_BROKEN = _METRICS.counter(
+    "paddle_tpu_pserver_round_broken",
+    "sync rounds invalidated by a barrier timeout (partial aggregation "
+    "discarded, every blocked pusher failed with TimeoutError), per "
+    "shard instance", labels=("instance",))
 
 
 # ---------------------------------------------------------------------------
@@ -145,7 +164,8 @@ class ParameterServer:
 
     def __init__(self, optimizer="sgd", opt_kwargs=None, mode="async",
                  fan_in=1, max_staleness=None, barrier_timeout_s=None,
-                 checkpoint_path=None, checkpoint_every=1):
+                 checkpoint_path=None, checkpoint_every=1,
+                 trainer_lease_s=None):
         self._rule = OPTIMIZERS[optimizer](**(opt_kwargs or {}))
         self._mode = mode
         self._fan_in = fan_in
@@ -153,6 +173,18 @@ class ParameterServer:
         if barrier_timeout_s is None:
             barrier_timeout_s = get_flag("pserver_barrier_timeout_s")
         self._barrier_timeout = float(barrier_timeout_s)
+        # lease-based sync membership (the elastic-trainer contract): a
+        # trainer that register_trainer()s joins the lease set; a round's
+        # barrier waits on the lease set SNAPSHOT taken at round-open,
+        # and an expired/deregistered member shrinks the open round's
+        # barrier instead of timing it out. With no registrations (or
+        # trainer_lease_s=0) barriers stay purely count-based (fan_in).
+        if trainer_lease_s is None:
+            trainer_lease_s = get_flag("pserver_trainer_lease_s")
+        self._lease_s = float(trainer_lease_s)
+        self._leases = {}          # trainer_id -> monotonic lease expiry
+        self._round_members = None  # lease-set snapshot at round-open
+        self._round_pushed = set()  # members that contributed this round
         self._params = {}
         self._opt_state = {}
         # params that have taken an in-place rowwise apply (copy-on-write
@@ -194,6 +226,11 @@ class ParameterServer:
         self._snapshots = {}
         self._snapshot_order = []
         self._snapshot_cap = 4
+        self.obs_instance = next_instance("pserver")
+        self._m_round_shrunk = _M_ROUND_SHRUNK.labels(
+            instance=self.obs_instance)
+        self._m_round_broken = _M_ROUND_BROKEN.labels(
+            instance=self.obs_instance)
 
     def attach_wire_stats(self, wire_stats):
         self._wire_stats = wire_stats
@@ -228,6 +265,80 @@ class ParameterServer:
                     if n in self._sparse_applied else self._params[n]
                     for n in names}
 
+    # ---- trainer membership leases (elastic sync barriers) ----
+    def register_trainer(self, trainer_id):
+        """Join (or renew) this shard's sync-membership lease for
+        ``trainer_id``. Pushes renew an existing lease (see push), so a
+        worker actively training stays a member without extra traffic; a
+        SIGKILLed trainer stops renewing and the open round shrinks past
+        it at expiry. Callers register when they acquire work and
+        deregister when idle — an idle-but-alive member would stall its
+        peers' barriers for the lease duration every round. Returns the
+        lease duration for the client's renewal bookkeeping."""
+        with self._lock:
+            if self._lease_s <= 0:
+                return {"lease_s": 0.0}
+            self._leases[trainer_id] = time.monotonic() + self._lease_s
+            # waiters recompute their next-expiry wait slice
+            self._lock.notify_all()
+            return {"lease_s": self._lease_s}
+
+    def deregister_trainer(self, trainer_id):
+        """Graceful leave: drop the lease NOW and shrink the open round
+        (if this member had not pushed into it) without waiting for
+        expiry. Returns True when a lease existed."""
+        with self._lock:
+            had = self._leases.pop(trainer_id, None) is not None
+            if had:
+                self._shrink_member_locked(trainer_id,
+                                           reason="deregistered")
+            return had
+
+    def _live_lease_set_locked(self, now=None):
+        """Reap already-expired leases and return the live trainer-id
+        set. Called at round-open, so a long-dead trainer is never
+        waited on even once."""
+        now = time.monotonic() if now is None else now
+        for t in [t for t, exp in self._leases.items() if exp <= now]:
+            del self._leases[t]
+            _flight_record("lease_expired", component=self.obs_instance,
+                           trainer_id=t, round=self._round)
+        return set(self._leases)
+
+    def _next_lease_expiry_locked(self):
+        """Earliest lease expiry among open-round members still being
+        waited on — the wait-slice bound that lets a barrier waiter wake
+        AT expiry instead of sleeping out the full barrier timeout."""
+        pending = [exp for t, exp in self._leases.items()
+                   if self._round_members is not None
+                   and t in self._round_members
+                   and t not in self._round_pushed]
+        return min(pending) if pending else None
+
+    def _shrink_member_locked(self, trainer_id, reason):
+        """Drop one member from the open round's barrier (lease expired
+        or deregistered). Members that already pushed are left alone —
+        their gradient is in the round and nobody waits on them."""
+        if (self._round_members is None
+                or trainer_id not in self._round_members
+                or trainer_id in self._round_pushed):
+            return
+        self._round_members.discard(trainer_id)
+        self._m_round_shrunk.inc()
+        # the membership-churn WHY an incident bundle needs: which
+        # trainer the barrier stopped waiting for, and what remains
+        _flight_record("round_shrunk", component=self.obs_instance,
+                       trainer_id=trainer_id, round=self._round,
+                       reason=reason,
+                       remaining=sorted(map(str, self._round_members)))
+        self._lock.notify_all()
+
+    def _reap_expired_members_locked(self):
+        now = time.monotonic()
+        for t in [t for t, exp in self._leases.items() if exp <= now]:
+            del self._leases[t]
+            self._shrink_member_locked(t, reason="lease_expired")
+
     def push(self, grads, trainer_id=0, seq=None):
         """Apply (sync: accumulate) gradients. ``seq`` is the trainer's
         monotonic push counter (ParamClient assigns it): a replayed push —
@@ -235,6 +346,11 @@ class ParameterServer:
         and answered with the original outcome instead of re-applied. A
         replay of a push still blocked at the barrier joins the wait."""
         with self._lock:
+            # any push is proof of life: renew an existing lease so a
+            # trainer whose step time approaches the lease need not race
+            # its own heartbeat
+            if self._lease_s > 0 and trainer_id in self._leases:
+                self._leases[trainer_id] = time.monotonic() + self._lease_s
             if seq is None:
                 if self._mode == "sync":
                     out = self._push_sync(grads)
@@ -347,53 +463,114 @@ class ParameterServer:
             self._params[name] = self._rule.apply(self._params[name], g,
                                                   self._opt_state[name])
 
+    def _sync_ready_locked(self):
+        """Is the open round complete? Lease mode: every member of the
+        round-open snapshot (shrunk past expiries) has pushed. Count
+        mode (no leases registered): the fan_in-th push arrived."""
+        if self._round_members is not None:
+            return (bool(self._round_members)
+                    and self._round_members <= self._round_pushed)
+        return self._push_count >= self._fan_in
+
+    def _apply_round_locked(self):
+        """Optimize with the round's accumulated gradients and release
+        the barrier. Callable from the completing PUSHER (the classic
+        fan-in release) or from a WAITER whose shrink just made the
+        round complete — either way the whole apply happens in one
+        critical section with the seq dedup marks."""
+        for n, g in self._pending.items():
+            self._apply_locked(n, g, divisor=self._push_count)
+        self._pending = {}
+        self._push_count = 0
+        self._round += 1
+        # every contributor's gradient is now IN the params; mark the
+        # seqs applied in the SAME critical section (and checkpoint if
+        # due) so no checkpoint can hold the update without its dedup
+        # marks or the marks without the update
+        for t, s in self._round_contribs:
+            self._applied_seq[t] = s
+        self._round_contribs = []
+        self._round_members = None
+        self._round_pushed = set()
+        self._maybe_checkpoint_locked()
+        self._lock.notify_all()
+
+    def _break_round_locked(self, my_round):
+        """Barrier timeout: discard the whole round's partial
+        aggregation AND advance the round so retried pushes accumulate
+        fresh, then fail every waiter. Nothing applied -> no seqs
+        marked; a trainer-level retry re-sends in full. Typed counter +
+        flight event: blocked pushers being discarded used to be
+        invisible in incident bundles."""
+        self._broken_round = my_round
+        self._round += 1
+        self._pending = {}
+        self._push_count = 0
+        self._round_contribs = []
+        self._round_members = None
+        self._round_pushed = set()
+        self._m_round_broken.inc()
+        _flight_record("round_broken", component=self.obs_instance,
+                       round=my_round, waited_s=self._barrier_timeout)
+        self._lock.notify_all()
+
     def _push_sync(self, grads, trainer_id=None, seq=None):
-        """Accumulate; the fan_in-th push triggers the optimize step and
-        wakes all waiters (the batch-barrier contract). A barrier timeout
-        ABANDONS the round (advancing the round counter), so retried pushes
-        start a fresh aggregation rather than double-counting into the
-        broken one."""
+        """Accumulate; the round-completing push triggers the optimize
+        step and wakes all waiters (the batch-barrier contract). With
+        trainer leases registered, the barrier waits on the lease set
+        snapshotted at round-open and an expired member SHRINKS it;
+        without leases it is the classic fan_in count. A barrier timeout
+        ABANDONS the round (advancing the round counter), so retried
+        pushes start a fresh aggregation rather than double-counting
+        into the broken one."""
         with self._lock:
             my_round = self._round
+            if self._push_count == 0:
+                # round-open: this round's barrier membership is the
+                # CURRENT live lease set (None -> count mode)
+                self._round_pushed = set()
+                self._round_members = (self._live_lease_set_locked()
+                                       or None) if self._lease_s > 0 \
+                    else None
             for n, g in grads.items():
                 self._accumulate_locked(n, g)
             if seq is not None:
                 self._round_contribs.append((trainer_id, seq))
             self._push_count += 1
-            if self._push_count >= self._fan_in:
-                for n, g in self._pending.items():
-                    self._apply_locked(n, g, divisor=self._fan_in)
-                self._pending = {}
-                self._push_count = 0
-                self._round += 1
-                # every contributor's gradient is now IN the params; mark
-                # the seqs applied in the SAME critical section (and
-                # checkpoint if due) so no checkpoint can hold the update
-                # without its dedup marks or the marks without the update
-                for t, s in self._round_contribs:
-                    self._applied_seq[t] = s
-                self._round_contribs = []
-                self._maybe_checkpoint_locked()
-                self._lock.notify_all()
-            else:
-                while (self._round == my_round
-                       and self._broken_round != my_round):
-                    if not self._lock.wait(timeout=self._barrier_timeout):
-                        # a dead trainer broke the barrier: discard the
-                        # whole round's partial aggregation AND advance the
-                        # round so retried pushes accumulate fresh, then
-                        # fail every waiter. Nothing applied -> no seqs
-                        # marked; a trainer-level retry re-sends in full.
-                        self._broken_round = my_round
-                        self._round += 1
-                        self._pending = {}
-                        self._push_count = 0
-                        self._round_contribs = []
-                        self._lock.notify_all()
-                        raise TimeoutError("sync barrier timed out")
-                if self._broken_round == my_round:
-                    raise TimeoutError("sync barrier broken by a peer "
-                                       "timeout; round discarded")
+            if trainer_id is not None and self._round_members is not None:
+                # a hot-joined trainer pushing mid-round contributes
+                # immediately (it joins the snapshot as already-pushed,
+                # so it never delays the barrier)
+                self._round_members.add(trainer_id)
+                self._round_pushed.add(trainer_id)
+            if self._sync_ready_locked():
+                self._apply_round_locked()
+                return self._round
+            deadline = time.monotonic() + self._barrier_timeout
+            while (self._round == my_round
+                   and self._broken_round != my_round):
+                now = time.monotonic()
+                if now >= deadline:
+                    self._break_round_locked(my_round)
+                    raise TimeoutError("sync barrier timed out")
+                wait_s = deadline - now
+                nxt = self._next_lease_expiry_locked()
+                if nxt is not None:
+                    # wake AT the next member lease expiry, not after
+                    # the full barrier budget — the shrink path
+                    wait_s = min(wait_s, max(nxt - now, 0.01))
+                self._lock.wait(timeout=wait_s)
+                if (self._round != my_round
+                        or self._broken_round == my_round):
+                    break
+                if self._round_members is not None:
+                    self._reap_expired_members_locked()
+                    if self._sync_ready_locked():
+                        self._apply_round_locked()
+                        break
+            if self._broken_round == my_round:
+                raise TimeoutError("sync barrier broken by a peer "
+                                   "timeout; round discarded")
             return self._round
 
     def _push_async(self, grads, trainer_id, seq=None):
@@ -424,9 +601,20 @@ class ParameterServer:
 
     def stats(self):
         with self._lock:
+            now = time.monotonic()
             out = {"params": sorted(self._params), "round": self._round,
                    "trainer_steps": dict(self._trainer_steps),
-                   "applied_seq": dict(self._applied_seq)}
+                   "applied_seq": dict(self._applied_seq),
+                   # lease surface: who is a member, how long each lease
+                   # has left, and the churn counters — what the elastic
+                   # tests and incident bundles read
+                   "trainer_leases": {t: round(exp - now, 3)
+                                      for t, exp in self._leases.items()},
+                   "round_members": (sorted(map(str, self._round_members))
+                                     if self._round_members is not None
+                                     else None),
+                   "rounds_shrunk": int(self._m_round_shrunk.value),
+                   "rounds_broken": int(self._m_round_broken.value)}
         if self._wire_stats is not None:
             # bytes in/out + per-method call counts and latency of the RPC
             # front-end (rpc.WireStats) — the reference pserver's
@@ -533,6 +721,15 @@ class ParameterServer:
             "acked": {t: (rec[0], rec[1][1])
                       for t, rec in self._seq_result.items()
                       if rec[1] is not None and rec[1][0] == "ok"},
+            # lease HOLDERS (not deadlines — monotonic clocks die with
+            # the process) so a restarted shard re-opens rounds with the
+            # same membership snapshot as its peers. Busy trainers renew
+            # on push but only REGISTER when they acquire work, so a
+            # restart that dropped the table would open rounds with a
+            # smaller member set, occasionally apply on a lone pusher,
+            # and drift its round counter permanently out of lockstep —
+            # tearing every snapshot cut from then on.
+            "lease_holders": list(self._leases),
         }
         return self._state_version, state
 
@@ -593,6 +790,7 @@ class ParameterServer:
                 acked = {t: [s, ("ok", payload)]
                          for t, (s, payload)
                          in state.get("acked", {}).items()}
+                lease_holders = list(state.get("lease_holders", []))
             except Exception as e:  # corrupt/truncated/missing-field
                 warnings.warn(
                     f"pserver checkpoint {path!r} unreadable "
@@ -611,6 +809,22 @@ class ParameterServer:
             self._push_count = 0
             self._broken_round = -1
             self._round_contribs = []
+            # re-grant the checkpointed lease holders a FRESH ttl: a
+            # still-working trainer renews it with its next retried push
+            # (it will not re-register — registration happens at task
+            # acquisition), a genuinely dead one simply expires lease_s
+            # later and shrinks the round, the normal failure path.
+            # Restored membership keeps this shard's round-open snapshot
+            # identical to its peers', which is what keeps the round
+            # counters in lockstep across a shard crash.
+            if self._lease_s > 0:
+                now = time.monotonic()
+                self._leases = {t: now + self._lease_s
+                                for t in lease_holders}
+            else:
+                self._leases = {}
+            self._round_members = None
+            self._round_pushed = set()
             self._updates_since_ckpt = 0
             self._due_ckpt = None
             return True
@@ -684,7 +898,7 @@ def shard_names(names, n_shards):
 def serve(optimizer="sgd", opt_kwargs=None, mode="async", fan_in=1,
           max_staleness=None, address=("127.0.0.1", 0),
           barrier_timeout_s=None, checkpoint_path=None, checkpoint_every=1,
-          fault_plan=None):
+          fault_plan=None, trainer_lease_s=None):
     """Start a ParameterServer's RPC loop in this process (call in a forked
     child, the reference test_recv_op pattern). Returns (server, rpc).
 
@@ -695,7 +909,8 @@ def serve(optimizer="sgd", opt_kwargs=None, mode="async", fan_in=1,
     ps = ParameterServer(optimizer, opt_kwargs, mode, fan_in, max_staleness,
                          barrier_timeout_s=barrier_timeout_s,
                          checkpoint_path=checkpoint_path,
-                         checkpoint_every=checkpoint_every)
+                         checkpoint_every=checkpoint_every,
+                         trainer_lease_s=trainer_lease_s)
     if checkpoint_path:
         ps.restore()
     rpc = RpcServer(ps, address, fault_plan=fault_plan)
@@ -899,6 +1114,34 @@ class ParamClient:
         for part in shards.values():
             params.update(part)
         return params
+
+    # ---- membership leases (elastic sync barriers) ----
+    def register_trainer(self):
+        """Register (or renew) this trainer's membership lease on EVERY
+        shard concurrently — called when the worker acquires work (the
+        master_task_reader contract: member while holding a task, not
+        while idle-polling). Returns the lease duration in seconds (0.0
+        when the servers run without leases)."""
+        out = self._fanout("register_trainer",
+                           self._all_shards(trainer_id=self._trainer_id))
+        return min((r.get("lease_s", 0.0) for r in out.values()),
+                   default=0.0)
+
+    def deregister_trainer(self):
+        """Best-effort graceful leave on every shard: drop this
+        trainer's lease NOW so open barriers shrink immediately instead
+        of waiting out the expiry. Per-shard errors are swallowed — a
+        leave is invoked precisely when shards may be restarting, and an
+        undelivered deregister degrades to ordinary lease expiry.
+        Returns True when at least one shard held a lease."""
+        had = False
+        for c in self._clients:
+            try:
+                had = bool(c.call("deregister_trainer",
+                                  trainer_id=self._trainer_id)) or had
+            except Exception:
+                pass
+        return had
 
     # ---- consistent-cut snapshots (online CheckpointFreezer) ----
     def _all_shards(self, **kwargs):

@@ -19,17 +19,26 @@ rolls onto a replica fleet that keeps answering throughout.
   canary-gated ``rolling_reload`` with min-serve-time hysteresis,
   permanent quarantine of canary-rejected versions, and optional
   registry gc.
+* :class:`TrainerPool` / :class:`BacklogAutoscaler` /
+  :func:`master_task_reader` (pool.py) — the elastic trainer fleet: N
+  workers lease data chunks from a ``Master`` queue and hold sync-round
+  barrier membership via pserver leases only while they possess work;
+  the pool hot-joins replacements for crashed workers and the
+  autoscaler sizes it from the Master's backlog.
 * :class:`OnlineLearningLoop` (loop.py) — the whole supervised process
   tree under one start/stats/stop, chaos-tolerant by construction: a
   pserver shard and a serving replica can be SIGKILLed mid-loop with
   zero failed infer requests and a monotonically advancing served
-  version.
+  version; pass ``chunks=``/``chunk_feeds=`` for the elastic
+  Master-fed pool instead of a single reader.
 """
 
 from .freezer import CheckpointFreezer, FreezeError
 from .loop import OnlineLearningLoop
+from .pool import BacklogAutoscaler, TrainerPool, master_task_reader
 from .rollout import RolloutController
 from .trainer import StreamingTrainer
 
 __all__ = ["StreamingTrainer", "CheckpointFreezer", "FreezeError",
-           "RolloutController", "OnlineLearningLoop"]
+           "RolloutController", "OnlineLearningLoop", "TrainerPool",
+           "BacklogAutoscaler", "master_task_reader"]

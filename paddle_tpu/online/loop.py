@@ -35,7 +35,80 @@ crash-restarting replica loads whatever is current by then.
 
 from __future__ import annotations
 
+import threading
+import time
+
 import numpy as np
+
+
+class _PublishPacer:
+    """Publish cadence for the elastic pool: with N churning workers
+    there is no single trainer whose step boundary can drive
+    ``request_freeze``, so a loop-level thread owns the cadence instead
+    — tick, check steps/seconds since the last ACCEPTED cut, freeze.
+    A torn cut (workers push continuously; shard rounds can disagree
+    for a moment) or a busy stitcher returns None and the pacer simply
+    retries next tick — the cadence only resets on acceptance, exactly
+    the StreamingTrainer contract."""
+
+    def __init__(self, freezer, step_fn, every_steps, every_s,
+                 tick_s=0.1):
+        self._freezer = freezer
+        self._step_fn = step_fn
+        self._every_steps = int(every_steps or 0)
+        self._every_s = float(every_s or 0.0)
+        self._tick_s = float(tick_s)
+        self.requests = 0
+        self.accepted = 0
+        self._pending = None
+        self._stop = threading.Event()
+        self._thread = None
+
+    def _due(self, steps_since, last_t):
+        if self._pending is not None and self._pending.done():
+            failed = self._pending.failed()
+            self._pending = None
+            if failed:
+                return True    # accepted cut died in its stitch: due now
+        if self._every_steps > 0 and steps_since >= self._every_steps:
+            return True
+        if self._every_s > 0 and time.monotonic() - last_t >= self._every_s:
+            return True
+        return False
+
+    def _run(self):
+        last_step = self._step_fn()
+        last_t = time.monotonic()
+        while not self._stop.wait(self._tick_s):
+            step = self._step_fn()
+            if not self._due(step - last_step, last_t):
+                continue
+            self.requests += 1
+            try:
+                job = self._freezer.request_freeze(step)
+            except RuntimeError:
+                return         # freezer closed under us: stop pacing
+            if job is not None:
+                self.accepted += 1
+                self._pending = job
+                last_step = step
+                last_t = time.monotonic()
+
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="publish-pacer")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=10.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def stats(self):
+        return {"requests": self.requests, "accepted": self.accepted}
 
 
 class OnlineLearningLoop:
@@ -68,8 +141,22 @@ class OnlineLearningLoop:
                  buckets=None, max_delay_ms=None, checkpoint_dir=None,
                  checkpoint_every=1, trainer_retry=None, extra_fetch=(),
                  prefetch=2, fleet_kwargs=None, slo_rules=None,
-                 incident_dir=None):
+                 incident_dir=None, chunks=None, chunk_feeds=None,
+                 chunks_per_task=1, master_timeout_s=3.0,
+                 trainers_min=None, trainers_max=None, autoscale=True,
+                 trainer_lease_s=None):
         from ..serving.registry import ModelRegistry
+
+        # elastic mode: ``chunks`` + ``chunk_feeds`` replace ``reader``
+        # — a Master task queue feeds a TrainerPool of N workers (leased
+        # membership, hot-join/retire, backlog autoscaling) instead of
+        # one StreamingTrainer consuming one reader
+        if (chunks is None) != (chunk_feeds is None):
+            raise ValueError("elastic mode needs BOTH chunks and "
+                             "chunk_feeds (or neither)")
+        if chunks is not None and reader is not None:
+            raise ValueError("pass either reader (single-trainer) or "
+                             "chunks+chunk_feeds (elastic pool), not both")
 
         self._main = main_program
         self._startup = startup_program
@@ -99,9 +186,22 @@ class OnlineLearningLoop:
         self._fleet_kwargs = dict(fleet_kwargs or {})
         self._slo_rules = list(slo_rules or [])
         self._incident_dir = incident_dir
+        self._chunks = list(chunks) if chunks is not None else None
+        self._chunk_feeds = chunk_feeds
+        self._chunks_per_task = int(chunks_per_task)
+        self._master_timeout_s = float(master_timeout_s)
+        self._trainers_min = trainers_min
+        self._trainers_max = trainers_max
+        self._autoscale = bool(autoscale)
+        self._trainer_lease_s = trainer_lease_s
         self.pservers = None
         self.fleet = None
         self.trainer = None
+        self.pool = None
+        self.master = None
+        self.master_rpc = None
+        self.autoscaler = None
+        self.pacer = None
         self.freezer = None
         self.rollout = None
         self.client = None
@@ -145,7 +245,12 @@ class OnlineLearningLoop:
             n_servers=self._n_pservers, checkpoint_dir=self._ckpt_dir,
             optimizer=t.optimizer, opt_kwargs=t.opt_kwargs,
             mode="sync" if self._sync_mode else "async", fan_in=1,
-            checkpoint_every=self._ckpt_every)
+            checkpoint_every=self._ckpt_every,
+            # elastic pool workers register membership leases, so the
+            # sync barrier sizes itself to the LIVE worker set instead
+            # of the static fan_in above (which stays the lease-less
+            # fallback)
+            trainer_lease_s=self._trainer_lease_s)
         try:
             if not self.pservers.wait_ready(wait_ready_s):
                 raise RuntimeError("pserver shards never became ready")
@@ -206,18 +311,94 @@ class OnlineLearningLoop:
                 incident_collector=self.incidents)
             self.rollout.start()
 
-            self.trainer = StreamingTrainer(
-                self._exe, self._scope, t.get_trainer_program(),
-                t.params_grads, self.client, self._reader,
-                freezer=self.freezer,
-                publish_every_steps=self._pub_steps,
-                publish_every_s=self._pub_s,
-                extra_fetch=self._extra_fetch, prefetch=self._prefetch)
-            self.trainer.start()
+            if self._chunks is not None:
+                self._start_elastic()
+            else:
+                self.trainer = StreamingTrainer(
+                    self._exe, self._scope, t.get_trainer_program(),
+                    t.params_grads, self.client, self._reader,
+                    freezer=self.freezer,
+                    publish_every_steps=self._pub_steps,
+                    publish_every_s=self._pub_s,
+                    extra_fetch=self._extra_fetch,
+                    prefetch=self._prefetch)
+                self.trainer.start()
         except Exception:
             self.stop()               # resets _started: retryable
             raise
         return self.fleet.version
+
+    # ------------------------------------------------------------------
+    def _start_elastic(self):
+        """Elastic-mode trainer plane: an in-process Master dispatches
+        the chunk queue over RPC, a TrainerPool of StreamingTrainer
+        workers leases tasks from it (each with its own ParamClient and
+        a pserver membership lease), a BacklogAutoscaler grows/shrinks
+        the pool from the queue depth, and a publish pacer drives the
+        freeze cadence — any worker may die at any point without losing
+        a chunk (Master lease re-dispatch) or stalling a barrier
+        (pserver lease shrink)."""
+        from ..core.flags import get_flag
+        from ..distributed.master import Master
+        from ..distributed.rpc import RpcServer
+        from .pool import BacklogAutoscaler, TrainerPool
+
+        self.master = Master(timeout_s=self._master_timeout_s)
+        self.master_rpc = RpcServer(self.master)
+        self.master_rpc.serve_in_thread()
+        self.master.set_dataset(self._chunks,
+                                chunks_per_task=self._chunks_per_task)
+
+        self.pool = TrainerPool(self._spawn_trainer,
+                                min_workers=self._trainers_min,
+                                max_workers=self._trainers_max)
+        self.pool.incident_hook = self.incidents.trigger
+        self.pool.start()
+        if self._autoscale:
+            self.autoscaler = BacklogAutoscaler(self.pool,
+                                                self.master.backlog)
+            self.autoscaler.start()
+        pub_steps = self._pub_steps if self._pub_steps is not None \
+            else int(get_flag("online_publish_every_steps"))
+        pub_s = self._pub_s if self._pub_s is not None \
+            else float(get_flag("online_publish_every_s"))
+        self.pacer = _PublishPacer(self.freezer, self.pool.global_step,
+                                   pub_steps, pub_s)
+        self.pacer.start()
+
+    def _spawn_trainer(self, wid, stop_ev):
+        """TrainerPool spawn hook: a startable StreamingTrainer with its
+        OWN scope/executor/ParamClient (unique trainer id — the lease
+        and dedup identity) over a stop-aware Master task reader.
+        ``prefetch=0`` is load-bearing: the reader marks a task finished
+        only when asked for the batch AFTER its last one, which without
+        read-ahead is exactly when every batch's push has acked."""
+        import paddle_tpu.fluid as fluid
+        from ..distributed.param_server import ParamClient
+        from ..distributed.rpc import RetryPolicy
+        from .pool import master_task_reader
+        from .trainer import StreamingTrainer
+
+        t = self._transpiler
+        scope = fluid.Scope()
+        exe = fluid.Executor()
+        exe.run(self._startup, scope=scope)   # shapes; pull overwrites
+        retry = self._retry or RetryPolicy(max_retries=8,
+                                           backoff_base_s=0.05,
+                                           backoff_max_s=1.0)
+        client = ParamClient(
+            [tuple(a) for a in self.pservers.addresses],
+            trainer_id=f"elastic-w{wid}",
+            param_names=[p for p, _g in t.params_grads],
+            sparse_param_names=t.sparse_param_names, retry=retry)
+        reader = master_task_reader(self.master_rpc.address,
+                                    self._chunk_feeds, stop=stop_ev,
+                                    membership=client)
+        return StreamingTrainer(exe, scope, t.get_trainer_program(),
+                                t.params_grads, client, reader,
+                                freezer=None,       # the pacer publishes
+                                publish_every_steps=0, publish_every_s=0,
+                                extra_fetch=self._extra_fetch, prefetch=0)
 
     # ------------------------------------------------------------------
     def _all_addresses(self):
@@ -245,6 +426,14 @@ class OnlineLearningLoop:
         out = {"model": self.model, "started": self._started}
         if self.trainer is not None:
             out["trainer"] = self.trainer.stats()
+        if self.pool is not None:
+            out["pool"] = self.pool.stats()
+        if self.master is not None:
+            out["backlog"] = self.master.backlog()
+        if self.autoscaler is not None:
+            out["autoscaler"] = self.autoscaler.stats()
+        if self.pacer is not None:
+            out["publish_pacer"] = self.pacer.stats()
         if self.freezer is not None:
             out["freezer"] = self.freezer.stats()
         if self.rollout is not None:
@@ -276,6 +465,19 @@ class OnlineLearningLoop:
         surprised). Idempotent, and resets the started flag: a stopped
         loop can be start()ed again from scratch (every component is
         rebuilt there)."""
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+            self.autoscaler = None
+        if self.pacer is not None:
+            self.pacer.stop()
+            self.pacer = None
+        if self.pool is not None:
+            self.pool.stop()
+            self.pool = None
+        if self.master_rpc is not None:
+            self.master_rpc.shutdown()
+            self.master_rpc = None
+            self.master = None
         if self.trainer is not None:
             self.trainer.stop()
             self.trainer = None

@@ -208,3 +208,24 @@ def test_reload_storm_lane_is_lower_is_better():
     assert res["regressions"] == ["reload_storm_serving"]
     better = {"reload_storm_serving": dict(rec, value=0.9)}
     assert bench_compare.compare_records(old, better, 5.0)["ok"]
+
+def test_elastic_training_lane_is_lower_is_better():
+    """The elastic_training lane's publish-to-served-lag unit (the exact
+    string bench.py emits) pins lower-is-better — a LARGER lag under the
+    fleet's kill/hot-join churn is a regression — including for the
+    _smoke-suffixed variant."""
+    rec = {"metric": "elastic_training", "value": 450.0,
+           "unit": "ms publish-to-served lag p50 (pacer freeze cut -> "
+                   "registry publish -> rollout onto the live fleet), "
+                   "with a Master-fed elastic trainer pool surviving a "
+                   "pserver-shard SIGKILL + worker kill/hot-join"}
+    assert bench_compare.lower_is_better(rec)
+    assert bench_compare.lower_is_better(dict(rec, metric="elastic_training_smoke"))
+    old = {"elastic_training_smoke": dict(rec, metric="elastic_training_smoke")}
+    slower = {"elastic_training_smoke":
+              dict(rec, metric="elastic_training_smoke", value=600.0)}
+    res = bench_compare.compare_records(old, slower, 5.0)
+    assert res["regressions"] == ["elastic_training_smoke"]
+    faster = {"elastic_training_smoke":
+              dict(rec, metric="elastic_training_smoke", value=300.0)}
+    assert bench_compare.compare_records(old, faster, 5.0)["ok"]

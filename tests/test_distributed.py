@@ -440,3 +440,419 @@ def test_parse_endpoint_tuple_passthrough():
     # string form unchanged
     assert parse_endpoint("h:80") == ("h", 80)
     assert parse_endpoint(":80") == ("127.0.0.1", 80)
+
+
+# ---------------------------------------------------------------------------
+# lease-based sync-round membership (the elastic-trainer barrier contract)
+# ---------------------------------------------------------------------------
+
+def test_master_backlog_counts():
+    """backlog() is the autoscaler's control signal: cheap {pending,
+    leased, failed} counts, with ``failed`` the CUMULATIVE failure-event
+    count (explicit fails + lease expiries) so rate rules can watch it."""
+    m, rpc = _start_master(timeout_s=0.3)
+    c = MasterClient(rpc.address)
+    c.set_dataset(["a", "b", "c", "d"])
+    assert c.backlog() == {"pending": 4, "leased": 0, "failed": 0}
+    t = c.get_task()
+    assert c.backlog() == {"pending": 3, "leased": 1, "failed": 0}
+    assert c.finished(t["task_id"], t["epoch"]) is True
+    assert c.backlog() == {"pending": 3, "leased": 0, "failed": 0}
+    t = c.get_task()
+    assert c.failed(t["task_id"], t["epoch"]) is True
+    # explicit failure counted; the task went back to pending
+    assert c.backlog() == {"pending": 3, "leased": 0, "failed": 1}
+    c.get_task()
+    time.sleep(0.5)
+    # the lease expiry sweep runs inside backlog() itself: the dead
+    # lease is counted as a failure event and its task is pending again
+    assert c.backlog() == {"pending": 3, "leased": 0, "failed": 2}
+    c.close()
+    rpc.shutdown()
+
+
+def test_master_stale_fail_and_finish_after_redispatch_are_noops():
+    """The hot-join race on the Master side: a task re-dispatched after
+    its lease expired carries a bumped epoch, so the ORIGINAL holder's
+    late TaskFinished/TaskFailed (a zombie worker flushing its last RPC)
+    are no-ops — the new holder's accounting is untouched."""
+    m, rpc = _start_master(timeout_s=0.2)
+    c = MasterClient(rpc.address)
+    c.set_dataset(["a"])
+    t_old = c.get_task()
+    time.sleep(0.35)                      # original lease expires
+    t_new = c.get_task()                  # re-dispatched, epoch bumped
+    assert t_new["task_id"] == t_old["task_id"]
+    assert t_new["epoch"] > t_old["epoch"]
+    assert c.failed(t_old["task_id"], t_old["epoch"]) is False
+    assert c.finished(t_old["task_id"], t_old["epoch"]) is False
+    # the zombie's no-ops didn't disturb the live lease
+    assert c.backlog()["leased"] == 1
+    assert c.finished(t_new["task_id"], t_new["epoch"]) is True
+    assert c.progress()["done"] == 1
+    c.close()
+    rpc.shutdown()
+
+
+def test_lease_barrier_shrinks_on_expired_member():
+    """The tentpole invariant: with lease-based membership, a sync round
+    whose member dies mid-round SHRINKS at lease expiry and applies with
+    the live members' gradients — it does NOT wait out the full barrier
+    timeout, and the round is never broken."""
+    from paddle_tpu.obs.recorder import RECORDER
+
+    ps, rpc = _start_ps(optimizer="sgd", opt_kwargs={"lr": 1.0},
+                        mode="sync", fan_in=1, trainer_lease_s=0.6,
+                        barrier_timeout_s=30.0)
+    c1 = ParamClient([rpc.address], trainer_id="t1", param_names=["w"])
+    c2 = ParamClient([rpc.address], trainer_id="t2", param_names=["w"])
+    c1.init_params({"w": np.zeros(4, np.float32)})
+    assert c1.register_trainer() == 0.6
+    assert c2.register_trainer() == 0.6
+    # full round: both members push, the round applies the average
+    t = threading.Thread(target=lambda: c2.push(
+        {"w": np.full(4, 3.0, np.float32)}))
+    t.start()
+    c1.push({"w": np.ones(4, np.float32)})
+    t.join()
+    np.testing.assert_allclose(c1.pull()["w"], np.full(4, -2.0), rtol=1e-6)
+    # t2 "dies": stops pushing and renewing. t1's next push must complete
+    # at t2's lease expiry (~0.6s), far under the 30s barrier timeout.
+    t0 = time.monotonic()
+    c1.push({"w": np.ones(4, np.float32)})
+    elapsed = time.monotonic() - t0
+    assert elapsed < 5.0, f"barrier waited {elapsed:.1f}s (no shrink?)"
+    np.testing.assert_allclose(c1.pull()["w"], np.full(4, -3.0), rtol=1e-6)
+    st = RpcClient(rpc.address)
+    s = st.call("stats")
+    st.close()
+    assert s["rounds_shrunk"] == 1
+    assert s["rounds_broken"] == 0
+    assert s["round"] == 2
+    ev = [e for e in RECORDER.dump()["events"]
+          if e["kind"] == "round_shrunk"
+          and e["detail"].get("trainer_id") == "t2"]
+    assert ev, "round_shrunk flight event must name the expired trainer"
+    assert ev[-1]["detail"]["reason"] == "lease_expired"
+    assert ev[-1]["detail"]["remaining"] == ["t1"]
+    c1.close()
+    c2.close()
+    rpc.shutdown()
+
+
+def test_lease_deregister_shrinks_immediately():
+    """Graceful leave: deregister_trainer drops the member from the open
+    round's barrier NOW — a blocked peer completes without waiting for
+    any lease expiry."""
+    ps, rpc = _start_ps(optimizer="sgd", opt_kwargs={"lr": 1.0},
+                        mode="sync", fan_in=1, trainer_lease_s=30.0,
+                        barrier_timeout_s=60.0)
+    c1 = ParamClient([rpc.address], trainer_id="t1", param_names=["w"])
+    c2 = ParamClient([rpc.address], trainer_id="t2", param_names=["w"])
+    c1.init_params({"w": np.zeros(2, np.float32)})
+    c1.register_trainer()
+    c2.register_trainer()
+    done = threading.Event()
+
+    def push_one():
+        c1.push({"w": np.ones(2, np.float32)})
+        done.set()
+
+    threading.Thread(target=push_one, daemon=True).start()
+    time.sleep(0.3)
+    assert not done.is_set()          # barrier waits on t2 (30s lease)
+    assert c2.deregister_trainer() is True
+    assert done.wait(5.0), "deregister must release the barrier"
+    s = RpcClient(rpc.address)
+    stats = s.call("stats")
+    s.close()
+    assert stats["rounds_shrunk"] == 1
+    assert stats["rounds_broken"] == 0
+    assert "t2" not in stats["trainer_leases"]
+    c1.close()
+    c2.close()
+    rpc.shutdown()
+
+
+def test_stale_push_old_seq_after_membership_change_is_noop():
+    """The lease-era extension of the same-seq repush contract: after a
+    trainer's rounds have advanced (and membership churned), a LATE
+    replay of one of its OLD seqs — a zombie retry finally landing — is
+    answered from the dedup path without re-applying or disturbing the
+    round."""
+    ps, rpc = _start_ps(optimizer="sgd", opt_kwargs={"lr": 1.0},
+                        mode="sync", fan_in=1, trainer_lease_s=5.0)
+    c1 = ParamClient([rpc.address], trainer_id="t1", param_names=["w"])
+    c1.init_params({"w": np.zeros(4, np.float32)})
+    c1.register_trainer()
+    seq0 = c1.allocate_seq()
+    c1.push({"w": np.ones(4, np.float32)}, seq=seq0)       # round 1
+    # hot-join: t2 registers and both push round 2
+    c2 = ParamClient([rpc.address], trainer_id="t2", param_names=["w"])
+    c2.register_trainer()
+    t = threading.Thread(target=lambda: c2.push(
+        {"w": np.ones(4, np.float32)}))
+    t.start()
+    c1.push({"w": np.ones(4, np.float32)})
+    t.join()
+    np.testing.assert_allclose(c1.pull()["w"], np.full(4, -2.0), rtol=1e-6)
+    # the zombie replay: t1's seq0 arrives AGAIN (pre-churn retry that
+    # sat in a dead connection) — must be a pure no-op
+    direct = RpcClient(rpc.address)
+    direct.call("push", grads={"w": np.ones(4, np.float32)},
+                trainer_id="t1", seq=seq0)
+    s = direct.call("stats")
+    direct.close()
+    assert s["round"] == 2                      # no new round opened
+    np.testing.assert_allclose(c1.pull()["w"], np.full(4, -2.0), rtol=1e-6)
+    c1.close()
+    c2.close()
+    rpc.shutdown()
+
+
+def _elastic_w_true():
+    return np.random.RandomState(0).normal(0, 1, (8,)).astype(np.float32)
+
+
+def _elastic_chunk_xy(name):
+    rng = np.random.RandomState(1000 + int(name[1:]))
+    X = rng.normal(0, 1, (32, 8)).astype(np.float32)
+    return X, X @ _elastic_w_true()
+
+
+def _elastic_sync_worker(master_addr, ps_addrs, tid, out_q, delay=0.0):
+    """Forked elastic worker (numpy-only: fork-safe, no accelerator state
+    inherited): leases tasks from the Master, holds a pserver membership
+    lease ONLY while working a task, trains with same-seq retried pushes,
+    reports its processed chunks, deregisters on the way out."""
+    from paddle_tpu.distributed import MasterClient as MC
+    from paddle_tpu.distributed import ParamClient as PC
+    if delay:
+        time.sleep(delay)
+    mc = MC(tuple(master_addr))
+    pc = PC([tuple(a) for a in ps_addrs], trainer_id=tid,
+            param_names=["a", "b"])
+    processed = []
+    member = False
+    while True:
+        t = mc.get_task()
+        if t is None:
+            break
+        if t.get("wait"):
+            if member:
+                pc.deregister_trainer()
+                member = False
+            time.sleep(0.05)
+            continue
+        if not member:
+            pc.register_trainer()
+            member = True
+        for name in t["chunks"]:
+            X, y = _elastic_chunk_xy(name)
+            for _ in range(4):
+                p = pc.pull()
+                w = np.concatenate([p["a"], p["b"]])
+                g = ((2.0 / len(X)) * (X.T @ (X @ w - y))) \
+                    .astype(np.float32)
+                seq = pc.allocate_seq()
+                while True:   # same-seq retry: the round-lockstep rule
+                    try:
+                        pc.push({"a": g[:4], "b": g[4:]}, seq=seq)
+                        break
+                    except Exception:
+                        time.sleep(0.05)
+        mc.finished(t["task_id"], t["epoch"])
+        processed.extend(t["chunks"])
+    if member:
+        pc.deregister_trainer()
+    out_q.put((tid, processed))
+    pc.close()
+    mc.close()
+
+
+def _elastic_sync_victim(master_addr, ps_addrs, tid):
+    """Forked victim: leases one Master task (never finishes it), joins
+    the barrier membership, and pushes zero gradients on a tight loop —
+    until SIGKILLed mid-everything. Its Master lease must re-dispatch
+    and its pserver lease must expire and shrink the open barrier."""
+    from paddle_tpu.distributed import MasterClient as MC
+    from paddle_tpu.distributed import ParamClient as PC
+    mc = MC(tuple(master_addr))
+    pc = PC([tuple(a) for a in ps_addrs], trainer_id=tid,
+            param_names=["a", "b"])
+    mc.get_task()                  # hold a task lease to the grave
+    pc.register_trainer()
+    z = np.zeros(4, np.float32)
+    while True:
+        try:
+            pc.push({"a": z, "b": z}, seq=pc.allocate_seq())
+        except Exception:
+            time.sleep(0.02)
+
+
+def test_elastic_fleet_sigkill_and_hot_join_chaos():
+    """THE tier-1 elastic chaos proof: 3 sync trainers (2 workers + 1
+    victim) against 2 lease-mode pserver shards and a Master queue. The
+    victim is SIGKILLed mid-round while holding a task lease; a 4th
+    trainer hot-joins after the kill. Required outcome: ZERO lost chunks
+    (the victim's task re-dispatches), Master accounting balances, the
+    barrier never waits anywhere near barrier_timeout on the dead
+    trainer (rounds SHRINK instead — no broken rounds), the cut stays
+    consistent (equal rounds across shards), and the flight recorder
+    names the dead trainer."""
+    import signal
+
+    from paddle_tpu.obs.recorder import RECORDER
+
+    m, m_rpc = _start_master(timeout_s=1.0)
+    c = MasterClient(m_rpc.address)
+    chunks = [f"c{i}" for i in range(10)]
+    c.set_dataset(chunks)
+
+    _psa, rpc_a = _start_ps(optimizer="sgd", opt_kwargs={"lr": 0.02},
+                            mode="sync", fan_in=1, trainer_lease_s=0.8,
+                            barrier_timeout_s=25.0)
+    _psb, rpc_b = _start_ps(optimizer="sgd", opt_kwargs={"lr": 0.02},
+                            mode="sync", fan_in=1, trainer_lease_s=0.8,
+                            barrier_timeout_s=25.0)
+    ps_addrs = [list(rpc_a.address), list(rpc_b.address)]
+    pc0 = ParamClient([rpc_a.address, rpc_b.address])
+    pc0.init_params({"a": np.zeros(4, np.float32),
+                     "b": np.zeros(4, np.float32)})
+
+    ctx = mp.get_context("fork")
+    out_q = ctx.Queue()
+    victim = ctx.Process(target=_elastic_sync_victim,
+                         args=(list(m_rpc.address), ps_addrs, "victim"))
+    workers = [ctx.Process(target=_elastic_sync_worker,
+                           args=(list(m_rpc.address), ps_addrs,
+                                 f"w{i}", out_q))
+               for i in (1, 2)]
+    joiner = ctx.Process(target=_elastic_sync_worker,
+                         args=(list(m_rpc.address), ps_addrs, "w3",
+                               out_q, 0.9))
+    t0 = time.monotonic()
+    victim.start()
+    for p in workers:
+        p.start()
+    time.sleep(0.5)                # victim is mid-lease, mid-rounds
+    os.kill(victim.pid, signal.SIGKILL)
+    victim.join()
+    joiner.start()                 # hot-join AFTER the kill
+
+    reports = {}
+    for _ in range(3):
+        tid, processed = out_q.get(timeout=60.0)
+        reports[tid] = processed
+    for p in workers:
+        p.join(20.0)
+    joiner.join(20.0)
+    elapsed = time.monotonic() - t0
+
+    # zero lost chunks: every chunk processed at least once (the
+    # victim's task re-dispatched; at-least-once is the contract)
+    seen = sorted(set(sum(reports.values(), [])))
+    assert seen == chunks, f"lost chunks: {set(chunks) - set(seen)}"
+    # Master accounting balances: everything done, nothing stuck
+    assert c.progress() == {"todo": 0, "doing": 0, "done": 10,
+                            "pass_id": 1}
+    assert c.backlog() == {"pending": 0, "leased": 0, "failed": 1}
+    # the dead trainer never cost a barrier_timeout: the whole run
+    # (including its 0.8s lease expiry + 1.0s Master re-dispatch) beats
+    # one 25s timeout by a wide margin
+    assert elapsed < 20.0, f"elastic drain took {elapsed:.1f}s"
+    # shards shrank rounds (never broke them) and stayed in lockstep:
+    # the post-drain cut sees EQUAL rounds — not torn
+    rounds = pc0.snapshot_prepare("post-chaos")
+    pc0.snapshot_release("post-chaos")
+    assert len(set(rounds.values())) == 1, f"torn: {rounds}"
+    for rpc in (rpc_a, rpc_b):
+        s_cli = RpcClient(rpc.address)
+        s = s_cli.call("stats")
+        s_cli.close()
+        assert s["rounds_broken"] == 0
+        assert s["rounds_shrunk"] >= 1
+        assert s["trainer_leases"] == {}     # everyone left or expired
+    # params converged toward w_true on the consumed stream (and are
+    # finite — the victim's zero pushes only dilute one round's average)
+    p = pc0.pull()
+    w = np.concatenate([p["a"], p["b"]])
+    assert np.all(np.isfinite(w))
+    assert np.linalg.norm(w - _elastic_w_true()) \
+        < np.linalg.norm(_elastic_w_true())
+    # the incident story is reconstructable: the recorder names the
+    # dead trainer at both its lease expiry and the barrier shrink
+    events = RECORDER.dump()["events"]
+    assert any(e["kind"] == "round_shrunk"
+               and e["detail"].get("trainer_id") == "victim"
+               for e in events)
+    pc0.close()
+    c.close()
+    m_rpc.shutdown()
+    rpc_a.shutdown()
+    rpc_b.shutdown()
+
+
+def test_lease_holders_survive_checkpoint_restore(tmp_path):
+    """A crashed-and-restarted shard must re-open rounds with the SAME
+    membership snapshot as its peers: the checkpoint persists lease
+    HOLDERS and restore re-grants them a fresh ttl. Busy trainers renew
+    on push but only register when they acquire work — a restart that
+    dropped the table would open rounds with fewer members, apply on a
+    lone pusher, and drift its round counter permanently out of
+    lockstep (tearing every snapshot cut from then on)."""
+    path = str(tmp_path / "ps.ckpt")
+    ps, rpc = _start_ps(optimizer="sgd", opt_kwargs={"lr": 1.0},
+                        mode="sync", fan_in=1, trainer_lease_s=5.0,
+                        checkpoint_path=path, checkpoint_every=1)
+    c1 = ParamClient([rpc.address], trainer_id="t1", param_names=["w"])
+    c2 = ParamClient([rpc.address], trainer_id="t2", param_names=["w"])
+    c1.init_params({"w": np.zeros(4, np.float32)})
+    c1.register_trainer()
+    c2.register_trainer()
+    t = threading.Thread(target=lambda: c2.push(
+        {"w": np.full(4, 3.0, np.float32)}))
+    t.start()
+    c1.push({"w": np.ones(4, np.float32)})   # round 1 applies, ckpt due
+    t.join()
+    c1.close()
+    c2.close()
+    rpc.shutdown()
+
+    # "restart": a fresh server restores the checkpoint — both holders
+    # are live members again without anyone re-registering
+    ps2, rpc2 = _start_ps(optimizer="sgd", opt_kwargs={"lr": 1.0},
+                          mode="sync", fan_in=1, trainer_lease_s=5.0,
+                          checkpoint_path=path, checkpoint_every=1)
+    st = RpcClient(rpc2.address)
+    s = st.call("stats")
+    assert sorted(s["trainer_leases"]) == ["t1", "t2"]
+    assert s["round"] == 1
+    # and the restored membership drives the barrier: t1 pushing alone
+    # must WAIT for t2 (member via the restored lease), not apply solo.
+    # Direct RPC with a FRESH seq (the clients' first pushes were
+    # seq 1; a replayed seq is acked from the restored dedup table
+    # instantly, exactly as the crash contract requires).
+    d1 = RpcClient(rpc2.address)
+    done = threading.Event()
+
+    def _push1():
+        d1.call("push", grads={"w": np.ones(4, np.float32)},
+                trainer_id="t1", seq=2)
+        done.set()
+
+    threading.Thread(target=_push1, daemon=True).start()
+    assert not done.wait(0.4), \
+        "lone push applied instantly: restored lease not a round member"
+    d2 = RpcClient(rpc2.address)
+    d2.call("push", grads={"w": np.full(4, 3.0, np.float32)},
+            trainer_id="t2", seq=2)
+    assert done.wait(5.0)
+    pull = RpcClient(rpc2.address)
+    np.testing.assert_allclose(pull.call("pull")["w"], np.full(4, -4.0),
+                               rtol=1e-6)
+    pull.close()
+    st.close()
+    d1.close()
+    d2.close()
+    rpc2.shutdown()

@@ -814,3 +814,196 @@ def test_online_loop_end_to_end_chaos(tmp_path):
     finally:
         stop.set()
         loop.stop()
+
+
+# ---------------------------------------------------------------------------
+# elastic trainer fleet: TrainerPool + backlog autoscaler + elastic loop
+# ---------------------------------------------------------------------------
+
+class _FakePoolClient:
+    def register_trainer(self):
+        return 0.5
+
+    def deregister_trainer(self):
+        return True
+
+    def close(self):
+        pass
+
+
+class _FakePoolTrainer:
+    """Minimal StreamingTrainer stand-in for pool supervision tests."""
+
+    def __init__(self, wid, stop_ev):
+        self.obs_instance = f"fakepool-w{wid}"
+        self._client = _FakePoolClient()
+        self._stop_ev = stop_ev
+        self._running = False
+        self.global_step = 0
+
+    def start(self):
+        self._running = True
+
+    def running(self):
+        return self._running and not self._stop_ev.is_set()
+
+    def stop(self, timeout=30.0):
+        self._running = False
+        return True
+
+    def stats(self):
+        return {"global_step": self.global_step}
+
+
+def test_trainer_pool_autoscale_closed_loop():
+    """The autoscale acceptance: a backlog spike grows the pool to
+    max_workers, the drain shrinks it back to min_workers, a killed
+    worker is hot-join replaced — and the whole membership-churn story
+    (join/leave/lease_expired counters + trainer_join/trainer_leave
+    flight events) lands in ONE incident bundle."""
+    from paddle_tpu.obs.metrics import REGISTRY
+    from paddle_tpu.obs.recorder import IncidentCollector
+    from paddle_tpu.online.pool import BacklogAutoscaler, TrainerPool
+
+    pool = TrainerPool(lambda wid, ev: _FakePoolTrainer(wid, ev),
+                       min_workers=1, max_workers=3, supervise_s=0.05)
+    incidents = IncidentCollector(addresses=[], cooldown_s=0.0)
+    pool.incident_hook = incidents.trigger
+    pool.start()
+    assert pool.size() == 1
+
+    backlog = {"pending": 40, "leased": 0, "failed": 0}
+    scaler = BacklogAutoscaler(pool, lambda: dict(backlog),
+                               poll_s=0.05, idle_polls=2)
+    # spike: the default SloRule burns while pending outruns the fleet;
+    # one hot-join per poll up to max_workers
+    deadline = time.monotonic() + 5.0
+    while pool.size() < 3 and time.monotonic() < deadline:
+        scaler.poll_once()
+        time.sleep(0.05)
+    assert pool.size() == 3, scaler.stats()
+    # the backlog gauge is the published control signal
+    fam = REGISTRY.snapshot()["paddle_tpu_online_backlog_tasks"]
+    mine = [v for v in fam["values"]
+            if v["labels"].get("instance") == pool.obs_instance]
+    assert mine and mine[0]["value"] == 40.0
+    # drain: burn decays, then idle polls retire back down to min
+    backlog = {"pending": 0, "leased": 0, "failed": 0}
+    deadline = time.monotonic() + 10.0
+    while pool.size() > 1 and time.monotonic() < deadline:
+        scaler.poll_once()
+        time.sleep(0.05)
+    assert pool.size() == 1, scaler.stats()
+    sst = scaler.stats()
+    assert sst["scale_ups"] >= 2 and sst["scale_downs"] >= 2, sst
+
+    # chaos: kill the survivor; the monitor hot-joins a replacement and
+    # fires the incident hook
+    [wid] = pool.worker_ids()
+    assert pool.kill(wid)
+    deadline = time.monotonic() + 10.0
+    while pool.size() < 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert pool.size() == 1
+    st = pool.stats()
+    assert st["joins"] >= 4          # 1 boot + 2 scale-ups + replacement
+    assert st["leaves"] >= 2         # the scale-down retires
+    assert st["lease_expired"] == 1  # the kill — never a graceful leave
+    # one incident bundle tells the whole churn story
+    assert incidents.wait_idle(10.0)
+    assert incidents.stats()["captures"] >= 1
+    bundle = incidents.bundles[-1]
+    kinds = {e["kind"] for e in bundle["events"]
+             if e["detail"].get("worker") is not None
+             or e["kind"].startswith("trainer_")}
+    assert "trainer_join" in kinds and "trainer_leave" in kinds, kinds
+    pool.stop()
+    assert pool.size() == 0
+
+
+def test_online_loop_elastic_pool_kill_and_hot_join(tmp_path):
+    """Elastic-mode OnlineLearningLoop acceptance: a Master-fed
+    TrainerPool trains while the fleet serves; one pool worker is
+    killed mid-stream and hot-join replaced; training keeps stepping,
+    the served version advances >= 2 more rollouts past v1 with no torn
+    cut ever published, and the pserver shards shrank rounds (never
+    broke one)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1])
+        pred = fluid.layers.fc(x, size=1, act=None)
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss, startup)
+
+    w_true = np.random.RandomState(0).normal(0, 1, (4, 1)) \
+        .astype(np.float32)
+
+    def chunk_feeds(chunk):
+        r = np.random.RandomState(int(chunk) % 1024)
+        for _ in range(2):
+            X = r.normal(0, 1, (8, 4)).astype(np.float32)
+            yield {"x": X, "y": X @ w_true}
+
+    loop = OnlineLearningLoop(
+        main, startup, None, ["x"], [pred],
+        registry_root=str(tmp_path / "reg"), model="lin",
+        n_pservers=2, n_replicas=1, publish_every_s=0.4,
+        min_serve_s=0.2, rollout_poll_s=0.1,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        chunks=list(range(200000)), chunk_feeds=chunk_feeds,
+        trainers_min=2, trainers_max=3, autoscale=False,
+        trainer_lease_s=1.0, master_timeout_s=1.5)
+    try:
+        v0 = loop.start(wait_ready_s=240.0)
+        assert v0 == 1
+        deadline = time.monotonic() + 60.0
+        while loop.pool.global_step() < 30 \
+                and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert loop.pool.global_step() >= 30, loop.stats(
+            fleet_metrics=False)
+
+        # chaos: kill one of the two workers (no deregister, no task
+        # finish — Master lease re-dispatch + pserver lease shrink)
+        ids = loop.pool.worker_ids()
+        assert loop.pool.kill(ids[0])
+        deadline = time.monotonic() + 30.0
+        while loop.pool.size() < 2 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert loop.pool.size() == 2, "hot-join replacement missing"
+        step_mark = loop.pool.global_step()
+        deadline = time.monotonic() + 60.0
+        while loop.pool.global_step() < step_mark + 30 \
+                and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert loop.pool.global_step() >= step_mark + 30, \
+            "training stalled after the kill"
+
+        # the serving side kept rolling: >= 2 version advances past v1
+        deadline = time.monotonic() + 150.0
+        while loop.fleet.version < 3 and time.monotonic() < deadline:
+            time.sleep(0.2)
+        st = loop.stats(fleet_metrics=False)
+        assert st["served_version"] >= 3, st["rollout"]
+
+        # membership churn is observable end to end
+        assert st["pool"]["joins"] >= 3           # 2 boot + replacement
+        assert st["pool"]["lease_expired"] >= 1   # the kill
+        assert st["backlog"]["pending"] > 0       # queue still feeding
+        assert st["publish_pacer"]["accepted"] >= 2
+        from paddle_tpu.distributed.rpc import RpcClient as _RC
+        for a in loop.pservers.addresses:
+            cli = _RC(tuple(a))
+            s = cli.call("stats")
+            cli.close()
+            assert s["rounds_broken"] == 0
+            assert s["rounds_shrunk"] >= 1
+        # every published version carries monotone lineage (no torn or
+        # out-of-order cut ever made it to the registry)
+        steps = [loop.registry.manifest("lin", v)["lineage"]["global_step"]
+                 for v in st["published_versions"]]
+        assert steps == sorted(steps)
+    finally:
+        loop.stop()

@@ -83,10 +83,12 @@ def registered_families():
     return the registry's families."""
     import paddle_tpu  # noqa: F401
     import paddle_tpu.distributed.launch    # noqa: F401
+    import paddle_tpu.distributed.param_server  # noqa: F401
     import paddle_tpu.distributed.rpc       # noqa: F401
     import paddle_tpu.obs.recorder          # noqa: F401
     import paddle_tpu.obs.slo               # noqa: F401
     import paddle_tpu.online.freezer        # noqa: F401
+    import paddle_tpu.online.pool           # noqa: F401
     import paddle_tpu.online.rollout        # noqa: F401
     import paddle_tpu.online.trainer        # noqa: F401
     import paddle_tpu.ops.pallas            # noqa: F401
