@@ -420,7 +420,8 @@ def _written_names(program, block_idx):
 _JIT_KEY_FLAGS = ("xla_compiler_options", "use_pallas_rnn",
                   "bn_fusion_barrier", "bn_fusion_barrier_fwd",
                   "bn_fusion_barrier_bwd", "conv_space_to_depth",
-                  "conv_1x1_grad_as_dot", "use_pallas_ctc", "kernel_tier")
+                  "conv_1x1_grad_as_dot", "use_pallas_ctc", "kernel_tier",
+                  "kernel_autotune", "kernel_autotune_digest")
 
 _JIT_FLAG_KEY = (None, ())
 

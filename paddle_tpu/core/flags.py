@@ -454,6 +454,37 @@ DEFINE_flag("obs_incident_dir", "",
             "/ child_restart) into; empty (default) keeps bundles "
             "in-memory only (IncidentCollector.bundles, bounded)")
 
+DEFINE_flag("kernel_autotune", True,
+            "consult the attached kernel-tuning table (ops.autotune) "
+            "when routing tunable kernels under kernel_tier=auto; off "
+            "means pure static AUTO_PALLAS routing even with a table "
+            "attached. In the executor's _JIT_KEY_FLAGS: flipping it "
+            "retraces so jitted programs re-route")
+
+DEFINE_flag("kernel_autotune_dir", "",
+            "local directory of kernel-tuning-table artifacts "
+            "(.jtune) consulted read-only when an engine's bundle has "
+            "no published tune/ dir, and the write target for "
+            "tools/autotune.py --out; empty (default) disables the "
+            "local-dir fallback. Not in the jit key: the attached "
+            "table's identity is carried by kernel_autotune_digest")
+
+DEFINE_flag("kernel_autotune_digest", "",
+            "content digest of the ATTACHED kernel-tuning table; set "
+            "and cleared by ops.autotune.attach_table/detach_table, "
+            "not by hand. In the executor's _JIT_KEY_FLAGS so a table "
+            "swap retraces every jitted program and flows into "
+            "execcache fingerprints (a warm executable compiled under "
+            "table X never loads into a process routing by table Y)")
+
+DEFINE_flag("kernel_autotune_bf16", False,
+            "allow the tuner to consider, and tuned dispatch to "
+            "select, bf16-flagged kernel variants (value-changing "
+            "reduced-precision activations, e.g. conv_bn pallas_bf16). "
+            "Off (default) keeps every tunable selection bitwise "
+            "against static routing; a table entry naming a bf16 "
+            "variant is ignored without this opt-in")
+
 # PDTPU_FLAGS=check_nan_inf=1,benchmark=0 — unknown names warn and are
 # ignored (a typo'd env var must not make the package unimportable)
 _env = os.environ.get("PDTPU_FLAGS", "")

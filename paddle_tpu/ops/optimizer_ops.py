@@ -86,9 +86,16 @@ def _sgd_apply(p_v, g_v, lr):
     duplicate rows accumulate correctly)."""
     p = data_of(p_v)
     if is_sparse(g_v):
-        from .pallas import use_pallas, kernel_span
+        from .autotune import dispatch_variant, make_key
+        from .pallas import kernel_span
         supported = p.ndim == 2 and g_v.values.ndim == 2
-        if use_pallas("embedding_sgd", supported):
+        key = make_key(rows=int(p.shape[0]),
+                       dim=int(p.shape[1]) if p.ndim == 2 else 0,
+                       nnz=int(g_v.values.shape[0]), dtype=str(p.dtype))
+        choice = dispatch_variant("embedding", key,
+                                  {"jnp": True, "pallas": supported},
+                                  tier_kernel="embedding_sgd")
+        if choice == "pallas":
             from .pallas.embedding import embedding_sgd_pallas
             m = merge_rows(g_v.astype(p.dtype))
             with kernel_span("pallas", "embedding_sgd"):
@@ -316,7 +323,8 @@ def _fused_apply(ctx, state_slots, out_slots, dense_fn, sparse_fn,
     return a (p_new, *state_news) tuple; arena_fn(*arenas) returns the
     updated arenas in the same order.
     """
-    from .pallas import use_pallas, kernel_span
+    from .autotune import dispatch_variant, make_key
+    from .pallas import kernel_span
 
     slots = ("Params", "Grads") + tuple(state_slots)
     entries = list(zip(*[ctx.inputs(s) for s in slots]))
@@ -335,9 +343,15 @@ def _fused_apply(ctx, state_slots, out_slots, dense_fn, sparse_fn,
                            *[data_of(v) for v in e[2:]])
         for j, v in enumerate(res):
             outs[j][i] = v
-    # use_pallas runs even with no fusable params so an all-sparse op
+    # the dispatch runs even with no fusable params so an all-sparse op
     # under a Pallas tier is a counted fallback, not a silent miss
-    if use_pallas("optimizer", bool(fusable)):
+    kind = {0: "sgd", 1: "momentum"}.get(len(state_slots), "adam")
+    elems = sum(int(data_of(entries[i][0]).size) for i in fusable)
+    choice = dispatch_variant(
+        "optimizer",
+        make_key(kind=kind, tensors=len(fusable), elems=elems),
+        {"jnp": True, "pallas": bool(fusable)})
+    if choice == "pallas":
         from .pallas import optimizer as opk
         ps = [data_of(entries[i][0]) for i in fusable]
         gs = [data_of(entries[i][1]).astype(jnp.float32) for i in fusable]

@@ -55,10 +55,12 @@ def _itemsize(dtype):
 
 
 def supported(x_shape, w_shape, strides, paddings, dilations, groups,
-              data_format, x_dtype, backward=False):
+              data_format, x_dtype, backward=False, block_n=1):
     """Is this conv+bn shape fused-kernel eligible? (The op layer passes
     the verdict to ``use_pallas`` so ineligible shapes fall back to the
-    jnp twin with a counter bump.)"""
+    jnp twin with a counter bump.) ``block_n > 1`` asks about the
+    double-buffered forward variant — ``block_n`` images stream per grid
+    step, so the VMEM working set scales and N must tile evenly."""
     if data_format != "NHWC" or groups != 1:
         return False
     if tuple(dilations) != (1, 1):
@@ -87,10 +89,13 @@ def supported(x_shape, w_shape, strides, paddings, dilations, groups,
     ho, wo = hp - kh + 1, wp - kw + 1
     if ho <= 0 or wo <= 0:
         return False
+    bn = int(block_n)
+    if bn < 1 or (bn > 1 and (backward or n % bn != 0)):
+        return False
     it = _itemsize(x_dtype)
-    x_b = hp * wp * cin * it
+    x_b = hp * wp * cin * it * bn
     wt_b = kh * kw * cin * cout * it
-    z_b = ho * wo * cout * 4
+    z_b = ho * wo * cout * 4 * bn
     if backward:
         dy_b = ho * wo * cout * it
         dzp_b = hp * wp * cout * it
@@ -135,25 +140,41 @@ def _conv_taps(x, wt_ref, kh, kw, ho, wo):
 
 def _conv_bn_train_kernel(x_ref, wt_ref, sb_ref, y_ref, sm_ref, sv_ref,
                           sum_s, sq_s, ab_s, *, kh, kw, ho, wo, count, eps,
-                          act, out_dtype):
+                          act, out_dtype, block_n=1):
     t = pl.program_id(0)
     i = pl.program_id(1)
     n = pl.num_programs(1)
-    # conv block in the COMPUTE dtype (bf16 under AMP): the jnp twin's
-    # lax.conv emits the input dtype, and the BN statistics accumulate in
-    # f32 FROM that — rounding here keeps the two paths aligned
-    z = _conv_taps(x_ref[0], wt_ref, kh, kw, ho, wo).astype(x_ref.dtype)
-    zf = z.astype(jnp.float32)
 
     @pl.when(jnp.logical_and(t == 0, i == 0))
     def _():
         sum_s[...] = jnp.zeros_like(sum_s)
         sq_s[...] = jnp.zeros_like(sq_s)
 
-    @pl.when(t == 0)
-    def _():
-        sum_s[0, :] += jnp.sum(zf, axis=0)
-        sq_s[0, :] += jnp.sum(zf * zf, axis=0)
+    # block_n > 1 is the double-buffered variant: each grid step streams
+    # a block of images so pallas's block double-buffering overlaps the
+    # next block's HBM→VMEM copy with this block's taps. The per-image
+    # loop is unrolled in-image-order, so the Σy/Σy² adds land in the
+    # SAME sequence as block_n=1 — bitwise-identical f32 statistics
+    for j in range(block_n):
+        # conv block in the COMPUTE dtype (bf16 under AMP): the jnp
+        # twin's lax.conv emits the input dtype, and the BN statistics
+        # accumulate in f32 FROM that — rounding here keeps the two
+        # paths aligned
+        z = _conv_taps(x_ref[j], wt_ref, kh, kw, ho, wo) \
+            .astype(x_ref.dtype)
+        zf = z.astype(jnp.float32)
+
+        @pl.when(t == 0)
+        def _(zf=zf):
+            sum_s[0, :] += jnp.sum(zf, axis=0)
+            sq_s[0, :] += jnp.sum(zf * zf, axis=0)
+
+        @pl.when(t == 1)
+        def _(zf=zf, j=j):
+            y = zf * ab_s[0, :][None, :] + ab_s[1, :][None, :]
+            if act == "relu":
+                y = jnp.maximum(y, 0.0)
+            y_ref[j] = y.reshape(ho, wo, -1).astype(out_dtype)
 
     @pl.when(jnp.logical_and(t == 0, i == n - 1))
     def _():
@@ -166,21 +187,16 @@ def _conv_bn_train_kernel(x_ref, wt_ref, sb_ref, y_ref, sm_ref, sv_ref,
         sm_ref[0, :] = m
         sv_ref[0, :] = v
 
-    @pl.when(t == 1)
-    def _():
-        y = zf * ab_s[0, :][None, :] + ab_s[1, :][None, :]
-        if act == "relu":
-            y = jnp.maximum(y, 0.0)
-        y_ref[0] = y.reshape(ho, wo, -1).astype(out_dtype)
 
-
-def conv_bn_train_pallas(x, w, scale, bias, eps, strides, paddings, act):
+def conv_bn_train_pallas(x, w, scale, bias, eps, strides, paddings, act,
+                         block_n=1):
     """Fused training-mode conv+bn(+act) forward.
 
     x [N,H,W,Cin] NHWC, w [Cout,Cin,kh,kw] OIHW (stride 1, or stride 2
     for 1x1), scale/bias [C]. Returns (y, batch_mean, batch_var) — the
     momentum blend into the running stats is [C]-cheap and stays in jnp
-    at the op layer."""
+    at the op layer. ``block_n`` streams that many images per grid step
+    (the autotuner's ``pallas_db`` variant; N must tile evenly)."""
     from jax.experimental.pallas import tpu as pltpu
 
     out_dtype = x.dtype
@@ -189,17 +205,20 @@ def conv_bn_train_pallas(x, w, scale, bias, eps, strides, paddings, act):
     cout = w.shape[0]
     ho, wo = hp - kh + 1, wp - kw + 1
     count = float(n * ho * wo)
+    bn = int(block_n)
+    if n % bn != 0:
+        raise ValueError(f"block_n={bn} does not tile batch {n}")
     sb = jnp.stack([scale.astype(jnp.float32).reshape(-1),
                     bias.astype(jnp.float32).reshape(-1)])
 
     kernel = functools.partial(
         _conv_bn_train_kernel, kh=kh, kw=kw, ho=ho, wo=wo, count=count,
-        eps=float(eps), act=act, out_dtype=out_dtype)
+        eps=float(eps), act=act, out_dtype=out_dtype, block_n=bn)
     y, sm, sv = pl.pallas_call(
         kernel,
-        grid=(2, n),
+        grid=(2, n // bn),
         in_specs=[
-            pl.BlockSpec((1, hp, wp, cin), lambda t, i: (i, 0, 0, 0)),
+            pl.BlockSpec((bn, hp, wp, cin), lambda t, i: (i, 0, 0, 0)),
             pl.BlockSpec((kh * kw, cin, cout), lambda t, i: (0, 0, 0)),
             pl.BlockSpec((2, cout), lambda t, i: (0, 0)),
         ],
@@ -207,7 +226,7 @@ def conv_bn_train_pallas(x, w, scale, bias, eps, strides, paddings, act):
             # t*i: every pass-0 step parks on block 0 (same block ⇒ the
             # write-back defers), pass 1 walks the real blocks — so the
             # unwritten stats pass never flushes garbage rows to HBM
-            pl.BlockSpec((1, ho, wo, cout), lambda t, i: (t * i, 0, 0, 0)),
+            pl.BlockSpec((bn, ho, wo, cout), lambda t, i: (t * i, 0, 0, 0)),
             pl.BlockSpec((1, cout), lambda t, i: (0, 0)),
             pl.BlockSpec((1, cout), lambda t, i: (0, 0)),
         ],
@@ -229,35 +248,43 @@ def conv_bn_train_pallas(x, w, scale, bias, eps, strides, paddings, act):
 # ---------------------------------------------------------------------------
 
 def _conv_affine_kernel(x_ref, wt_ref, ab_ref, y_ref, *, kh, kw, ho, wo,
-                        act, out_dtype):
-    z = _conv_taps(x_ref[0], wt_ref, kh, kw, ho, wo).astype(x_ref.dtype)
-    y = z.astype(jnp.float32) * ab_ref[0, :][None, :] + ab_ref[1, :][None, :]
-    if act == "relu":
-        y = jnp.maximum(y, 0.0)
-    y_ref[0] = y.reshape(ho, wo, -1).astype(out_dtype)
+                        act, out_dtype, block_n=1):
+    for j in range(block_n):
+        z = _conv_taps(x_ref[j], wt_ref, kh, kw, ho, wo).astype(x_ref.dtype)
+        y = z.astype(jnp.float32) * ab_ref[0, :][None, :] \
+            + ab_ref[1, :][None, :]
+        if act == "relu":
+            y = jnp.maximum(y, 0.0)
+        y_ref[j] = y.reshape(ho, wo, -1).astype(out_dtype)
 
 
-def conv_affine_pallas(x, w, a, b, strides, paddings, act):
+def conv_affine_pallas(x, w, a, b, strides, paddings, act, block_n=1):
     """Fused inference conv + y = conv*a + b (+act): the folded-BN serving
-    epilogue (a = scale·rsqrt(var+eps), b = bias − mean·a, precomputed)."""
+    epilogue (a = scale·rsqrt(var+eps), b = bias − mean·a, precomputed).
+    ``block_n`` streams that many images per grid step (the autotuner's
+    ``pallas_db`` variant; N must tile evenly)."""
     out_dtype = x.dtype
     x, wt, kh, kw = _prep(x, w, strides, paddings)
     n, hp, wp, cin = x.shape
     cout = w.shape[0]
     ho, wo = hp - kh + 1, wp - kw + 1
+    bn = int(block_n)
+    if n % bn != 0:
+        raise ValueError(f"block_n={bn} does not tile batch {n}")
     ab = jnp.stack([a.astype(jnp.float32).reshape(-1),
                     b.astype(jnp.float32).reshape(-1)])
     kernel = functools.partial(_conv_affine_kernel, kh=kh, kw=kw, ho=ho,
-                               wo=wo, act=act, out_dtype=out_dtype)
+                               wo=wo, act=act, out_dtype=out_dtype,
+                               block_n=bn)
     return pl.pallas_call(
         kernel,
-        grid=(n,),
+        grid=(n // bn,),
         in_specs=[
-            pl.BlockSpec((1, hp, wp, cin), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((bn, hp, wp, cin), lambda i: (i, 0, 0, 0)),
             pl.BlockSpec((kh * kw, cin, cout), lambda i: (0, 0, 0)),
             pl.BlockSpec((2, cout), lambda i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, ho, wo, cout), lambda i: (i, 0, 0, 0)),
+        out_specs=pl.BlockSpec((bn, ho, wo, cout), lambda i: (i, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((n, ho, wo, cout), out_dtype),
         interpret=_on_cpu(),
     )(x, wt, ab)

@@ -64,7 +64,8 @@ def _lstm_scan(x, lens, w, h0, c0, gate_act, cell_act, cand_act,
     diagonal cell->gate connections (math/detail/lstm_kernel.h:37-40:
     i/f see the PREVIOUS cell state, o sees the NEW one). Returns
     hidden [b, L, H], cell [b, L, H]."""
-    from .pallas import use_pallas, kernel_span
+    from .autotune import dispatch_variant, make_key
+    from .pallas import kernel_span
 
     b, L, H4 = x.shape
     H = H4 // 4
@@ -75,8 +76,12 @@ def _lstm_scan(x, lens, w, h0, c0, gate_act, cell_act, cand_act,
     supported = (peepholes is None
                  and (gate_act, cell_act, cand_act)
                  == ("sigmoid", "tanh", "tanh"))
+    choice = dispatch_variant(
+        "rnn",
+        make_key(cell="lstm", x=tuple(x.shape), dtype=str(x.dtype)),
+        {"jnp": True, "pallas": supported}, tier_kernel="lstm")
 
-    if use_pallas("lstm", supported):
+    if choice == "pallas":
         # whole-recurrence kernel: ONE launch for the full sequence with
         # the recurrent weight VMEM-resident across steps (see
         # ops/pallas/rnn.lstm_seq_pallas)
@@ -257,11 +262,16 @@ def _gru_compute(x, lens, w, bias, h0, attrs):
     if rev:
         x = _reverse_padded(x, lens)
 
-    from .pallas import use_pallas, kernel_span
+    from .autotune import dispatch_variant, make_key
+    from .pallas import kernel_span
     supported = (attrs.get("gate_activation", "sigmoid") == "sigmoid"
                  and attrs.get("activation", "tanh") == "tanh")
+    choice = dispatch_variant(
+        "rnn",
+        make_key(cell="gru", x=tuple(x.shape), dtype=str(x.dtype)),
+        {"jnp": True, "pallas": supported}, tier_kernel="gru")
 
-    if use_pallas("gru", supported):
+    if choice == "pallas":
         # whole-recurrence kernel (see ops/pallas/rnn.gru_seq_pallas)
         from .pallas.rnn import gru_seq_pallas
         with kernel_span("pallas", "gru"):

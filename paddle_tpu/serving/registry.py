@@ -105,7 +105,7 @@ class ModelRegistry:
 
     def publish(self, model, src_dir, version=None, kernel_tier=None,
                 model_kind="feedforward", lineage=None, warm_cache=False,
-                warm_kwargs=None, kv_prompts=None):
+                warm_kwargs=None, kv_prompts=None, tune=False):
         """Copy the bundle at ``src_dir`` in as ``version`` (next integer
         when None) and make it visible by writing the manifest LAST,
         atomically. Returns the published version number. Versions are
@@ -146,7 +146,16 @@ class ModelRegistry:
         KV-prefix chains under ``<version>/kv/`` (see
         serving/generate/kvstore.py): replicas that serve this version
         attach those prefixes with ZERO prefill steps. Passing it
-        implies a warm pass even without ``warm_cache=True``."""
+        implies a warm pass even without ``warm_cache=True``.
+
+        ``tune=True`` (or a dict of Tuner options, e.g.
+        ``{"repeats": 3, "inner": 2}``) additionally runs the kernel
+        autotuner at publish time against the engine's REAL warmup
+        shapes and ships the winning-variant table under
+        ``<version>/tune/`` (ops/autotune.py), manifest-pinned like
+        ``warm_files`` — replicas that serve this version route tunable
+        kernels by measurement with zero in-band tuning work. Implies a
+        warm pass."""
         if not os.path.exists(os.path.join(src_dir, MODEL_FILENAME)):
             raise ValueError(
                 f"publish: {src_dir!r} is not a save_inference_model "
@@ -223,16 +232,18 @@ class ModelRegistry:
         with open(tmp, "w") as f:
             json.dump(manifest, f, indent=1, sort_keys=True)
         os.replace(tmp, os.path.join(dst, VERSION_MANIFEST))
-        if warm_cache or kv_prompts:
+        if warm_cache or kv_prompts or tune:
             wk = dict(warm_kwargs or {})
             if kv_prompts is not None:
                 wk.setdefault("kv_prompts", kv_prompts)
+            if tune:
+                wk.setdefault("tune", tune)
             self.warm(model, version, **wk)
         return version
 
     # ------------------------------------------------------------------
     def warm(self, model, version="latest", buckets=None, sample_feed=None,
-             gen_opts=None, kv_prompts=None):
+             gen_opts=None, kv_prompts=None, tune=False):
         """Build (or complete) the version's persistent compiled-
         executable artifacts under ``<version>/warm/`` so replicas LOAD
         instead of compile (serving/execcache.py): an engine of the
@@ -270,11 +281,36 @@ class ModelRegistry:
         (every chain loads from its existing artifact with zero
         prefill steps; nothing is rewritten). When ``kv_prompts`` is
         None an existing ``kv/`` dir is left untouched — warm-cache
-        refreshes must not prune KV artifacts they didn't rebuild."""
+        refreshes must not prune KV artifacts they didn't rebuild.
+
+        ``tune=True`` (or a Tuner-option dict: ``repeats``/``inner``)
+        runs the kernel autotuner FIRST: a throwaway engine (no exec
+        cache) is warmed under ``ops.autotune.capture`` to learn the
+        real dispatch keys, the tuner measures each key's registered
+        variants, and the winning table lands under ``<version>/tune/``
+        with ``tune_files`` certified into the manifest BEFORE the warm
+        engine is built — so the warm pass attaches the manifest-pinned
+        table and every persisted executable's fingerprint already
+        carries the table digest (a replica loading warm/ under the
+        same table hits; one without the table recompiles instead of
+        loading mismatched routing). When ``tune`` is falsy an existing
+        ``tune/`` dir is left untouched, like ``kv/``."""
         path, v = self.resolve(model, version)
         m = self.manifest(model, v)
         from .execcache import ARTIFACT_SUFFIX, ExecCache, WARM_DIRNAME
         from .generate import kvstore as _kvs
+        if tune:
+            tune_files = self._tune(path, m, buckets=buckets,
+                                    sample_feed=sample_feed,
+                                    gen_opts=gen_opts,
+                                    tune_opts=tune if isinstance(tune, dict)
+                                    else None)
+            if m.get("tune_files") != tune_files:
+                m["tune_files"] = tune_files
+                tmp = os.path.join(path, VERSION_MANIFEST + ".tmp")
+                with open(tmp, "w") as f:
+                    json.dump(m, f, indent=1, sort_keys=True)
+                os.replace(tmp, os.path.join(path, VERSION_MANIFEST))
         warm_dir = os.path.join(path, WARM_DIRNAME)
         cache = ExecCache(warm_dir)
         kv_files = None
@@ -331,7 +367,60 @@ class ModelRegistry:
             with open(tmp, "w") as f:
                 json.dump(m, f, indent=1, sort_keys=True)
             os.replace(tmp, os.path.join(path, VERSION_MANIFEST))
-        return sorted(warm_files) + sorted(kv_files or {})
+        return sorted(warm_files) + sorted(kv_files or {}) \
+            + sorted(m.get("tune_files", {}) if tune else {})
+
+    def _tune(self, path, m, buckets=None, sample_feed=None, gen_opts=None,
+              tune_opts=None):
+        """Run the publish-time autotune pass: capture the real warmup's
+        dispatch keys on a THROWAWAY engine (no exec cache — loading
+        warm artifacts would skip the traced dispatches whose keys this
+        pass exists to learn), measure each captured key's registered
+        variants, and persist the winning table under ``tune/``. Keys an
+        existing valid table already covers are NOT re-measured (re-
+        warming is idempotent: same table bytes, same digest, nothing
+        downstream recompiles). Returns the ``tune_files`` digest map."""
+        from ..ops import autotune as _at
+        if m.get("model_kind", "feedforward") == "generative":
+            from .generate import GenerationEngine
+            engine = GenerationEngine(path, exec_cache=False,
+                                      **dict(gen_opts or {}))
+            with _at.capture() as keys:
+                engine.warmup()
+        else:
+            from .engine import InferenceEngine
+            engine = InferenceEngine(path, buckets=buckets,
+                                     exec_cache=False)
+            with _at.capture() as keys:
+                engine.warmup(sample_feed)
+        store = _at.TuneStore(os.path.join(path, _at.TUNE_DIRNAME))
+        existing = store.load()
+        missing = keys if existing is None else \
+            [c for c in keys
+             if (c[0], _at.key_str(c[1])) not in existing.entries]
+        table = existing
+        if missing or existing is None:
+            tuner = _at.Tuner(**(tune_opts or {}))
+            table = tuner.tune(missing, table=existing)
+        store.save(table)
+        touched = set(store.touched())
+        tune_dir = os.path.join(path, _at.TUNE_DIRNAME)
+        tune_files = {}
+        for name in sorted(os.listdir(tune_dir)):
+            fpath = os.path.join(tune_dir, name)
+            if not os.path.isfile(fpath) or name.endswith(".tmp"):
+                continue
+            if name in touched:
+                tune_files[f"{_at.TUNE_DIRNAME}/{name}"] = \
+                    _sha256_file(fpath)
+            elif name.endswith(_at.ARTIFACT_SUFFIX):
+                # a table another toolchain/backend measured: its
+                # filename fingerprint can never match here — prune
+                try:
+                    os.unlink(fpath)
+                except OSError:
+                    pass
+        return tune_files
 
     def _precompute_kv(self, engine, path, kv_prompts):
         """Prefill each prompt on the warm engine (chains that already
@@ -529,6 +618,9 @@ class ModelRegistry:
         # same way: verify is the offline check, the engine's
         # manifest-pinned load reject is the runtime one
         listed.update(m.get("kv_files", {}))
+        # tune_files (publish-time kernel-tuning tables, tune/) too:
+        # ops.autotune.TuneStore pins loads to these digests at runtime
+        listed.update(m.get("tune_files", {}))
         for name, want in listed.items():
             fpath = os.path.join(path, name)
             if not os.path.exists(fpath):

@@ -209,6 +209,75 @@ def test_reload_storm_lane_is_lower_is_better():
     better = {"reload_storm_serving": dict(rec, value=0.9)}
     assert bench_compare.compare_records(old, better, 5.0)["ok"]
 
+def test_kernel_autotune_lane_is_higher_is_better():
+    """The kernel_autotune lane's tuned-vs-best-static speedup unit (the
+    exact string bench.py emits) keeps the higher-is-better default: a
+    SMALLER speedup means tuned routing lost ground to static tiers."""
+    rec = {"metric": "kernel_autotune", "value": 1.02,
+           "unit": "x tuned-table auto routing vs best single static "
+                   "kernel_tier, fused conv+bn infer step (gate >= 1.0x; "
+                   "5% same-program jitter allowed when the tuned "
+                   "selection is a variant a static tier also compiles; "
+                   "bitwise parity + zero in-band tuning asserted "
+                   "in-lane)"}
+    assert not bench_compare.lower_is_better(rec)
+    assert not bench_compare.lower_is_better(
+        dict(rec, metric="kernel_autotune_smoke"))
+    old = {"kernel_autotune": rec}
+    worse = {"kernel_autotune": dict(rec, value=0.9)}
+    res = bench_compare.compare_records(old, worse, 5.0)
+    assert res["regressions"] == ["kernel_autotune"]
+    better = {"kernel_autotune": dict(rec, value=1.2)}
+    assert bench_compare.compare_records(old, better, 5.0)["ok"]
+
+
+def test_trajectory_backend_skip(tmp_path):
+    """--dir trajectory mode skips lanes whose two records carry
+    DIFFERENT backend stamps (a CPU smoke diffed against a TPU run is a
+    machine change, not a regression) with a one-line note naming them;
+    explicit OLD NEW compares keep diffing every lane."""
+    cpu = [dict(r, backend="cpu") for r in RECORDS]
+    tpu = [dict(r, backend="tpu") for r in RECORDS]
+    # seed a would-be regression in a lane whose backends differ
+    tpu[0] = dict(tpu[0], value=round(2567.5 * 0.5, 1))
+    (tmp_path / "BENCH_r01.json").write_text(_lines(cpu))
+    (tmp_path / "BENCH_r02.json").write_text(_lines(tpu))
+    r = _run("--dir", str(tmp_path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "skipped (backend stamps differ)" in r.stdout
+    assert "resnet50_train_throughput" in r.stdout
+    # same-backend pairs in the same trajectory still gate
+    mixed = [dict(r) for r in cpu]
+    mixed[0] = dict(mixed[0], value=round(2567.5 * 0.5, 1))
+    (tmp_path / "BENCH_r03.json").write_text(_lines(cpu))
+    (tmp_path / "BENCH_r04.json").write_text(_lines(mixed))
+    r = _run("--dir", str(tmp_path))
+    assert r.returncode == 1
+    assert "resnet50_train_throughput" in r.stderr
+    # explicit two-file mode compares regardless of backend stamps
+    old_p, new_p = tmp_path / "BENCH_r01.json", tmp_path / "BENCH_r02.json"
+    r = _run(str(old_p), str(new_p))
+    assert r.returncode == 1
+    assert "resnet50_train_throughput" in r.stderr
+
+
+def test_compare_records_backend_skip_api():
+    old = {"a": {"metric": "a", "value": 100.0, "unit": "QPS",
+                 "backend": "tpu"}}
+    new = {"a": {"metric": "a", "value": 50.0, "unit": "QPS",
+                 "backend": "cpu"}}
+    res = bench_compare.compare_records(old, new, 5.0, backend_skip=True)
+    assert res["ok"] and res["backend_skipped"] == ["a"]
+    assert res["rows"] == []
+    # default (no skip) still regresses; records without stamps compare
+    res = bench_compare.compare_records(old, new, 5.0)
+    assert res["regressions"] == ["a"] and res["backend_skipped"] == []
+    for r in (old, new):
+        r["a"] = {k: v for k, v in r["a"].items() if k != "backend"}
+    res = bench_compare.compare_records(old, new, 5.0, backend_skip=True)
+    assert res["regressions"] == ["a"]
+
+
 def test_elastic_training_lane_is_lower_is_better():
     """The elastic_training lane's publish-to-served-lag unit (the exact
     string bench.py emits) pins lower-is-better — a LARGER lag under the

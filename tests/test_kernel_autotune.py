@@ -1,0 +1,905 @@
+"""Kernel autotuner plane (ops/autotune.py): measured per-shape variant
+selection, persistently cached, selected at trace time.
+
+The contract under test:
+
+* ``make_key``/``TuneTable`` — canonical shape keys, strict
+  ``from_doc`` validation, content digest, merge (newer wins).
+* ``dispatch_variant`` — force pin wins (unsupported non-jnp force =
+  jnp + fallback-counter bump); else the attached table only under
+  ``kernel_tier=auto`` with ``kernel_autotune`` on (entry must be
+  supported AND allowed); else the static pre-autotune routing,
+  bitwise the old behavior. Static tiers NEVER consult the table.
+* ``TuneStore`` — execcache discipline: identity fingerprint in the
+  filename, content-addressed envelope, typed bounded rejects
+  (format/manifest/fingerprint/deserialize) with a counter bump and a
+  flight-recorder event, never a raise; missing file is a silent miss.
+  Published bundles pin RAW bytes to the manifest's ``tune_files``
+  BEFORE parsing; the ``kernel_autotune_dir`` local tier is unpinned.
+* ``Tuner`` — dedups captured keys, measures only multi-candidate
+  keys through the ONE interleaved best-of-N ``measure`` core, gates
+  bf16-flagged variants behind the ``kernel_autotune_bf16`` opt-in,
+  and never lets a variant that cannot build/run win.
+* Parity sweep — every kernel family with >= 2 registered variants
+  agrees through the REAL op under ``force_variant``, eager and jit,
+  with ``fallback_counts()`` asserted (pallas vs pallas_db bitwise;
+  bf16 loose — it is value-changing and opt-in).
+* Engine acceptance — ``publish(tune=...)`` ships the table under
+  ``<version>/tune/`` manifest-pinned; a fresh engine's warmup
+  attaches it BEFORE compiling (digest in the jit key + execcache
+  fingerprint), so a fully tuned engine does ZERO in-band tuning work
+  and ZERO compiles; tuned-vs-untuned outputs and token streams match;
+  a corrupted/unlisted table downgrades to static routing — the
+  engine still serves.
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.flags import get_flag, set_flags
+from paddle_tpu.fluid import framework
+from paddle_tpu.obs import REGISTRY
+from paddle_tpu.obs import perf as obs_perf
+from paddle_tpu.obs.recorder import RECORDER
+from paddle_tpu.ops import autotune as at
+from paddle_tpu.ops import pallas as tier
+from paddle_tpu.serving import (GenerationEngine, InferenceEngine,
+                                ModelRegistry)
+from paddle_tpu.testing.models import export_tiny_lm
+
+from op_test import OpTest
+from test_paged_attention_pallas import _case as paged_case
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SEL = "paddle_tpu_kernel_autotune_selections"
+TUNES = "paddle_tpu_kernel_autotune_tunes"
+REJECTS = "paddle_tpu_kernel_autotune_rejects"
+
+FLAGS = ("kernel_tier", "kernel_autotune", "kernel_autotune_dir",
+         "kernel_autotune_digest", "kernel_autotune_bf16",
+         "serving_exec_cache", "serving_exec_cache_dir")
+
+
+@pytest.fixture(autouse=True)
+def _guard():
+    saved = {n: get_flag(n) for n in FLAGS}
+    yield
+    at.detach_table()
+    set_flags(saved)
+    tier.reset_fallback_counts()
+
+
+def _counter(name):
+    return REGISTRY.totals().get(name, 0)
+
+
+def _reject_events():
+    return list(RECORDER.events(kinds={"kernel_autotune_reject"}))
+
+
+def _static(supported):
+    """The pre-autotune routing for the current flags (the oracle the
+    table-less/refused paths must be bitwise-equal to)."""
+    return "pallas" if tier.use_pallas("conv_bn",
+                                       supported.get("pallas", False)) \
+        else "jnp"
+
+
+# ---------------------------------------------------------------------------
+# keys + table
+# ---------------------------------------------------------------------------
+
+def test_make_key_canonical_and_key_str_stable():
+    k1 = at.make_key(x=(4, 8, 8, 3), dtype="float32", groups=1)
+    k2 = at.make_key(groups=1, dtype="float32", x=[4, 8, 8, 3])
+    assert k1 == k2                       # field order + list/tuple canon
+    assert at.key_str(k1) == at.key_str(k2)
+    # non-primitive values stringify (np/jnp dtypes and friends)
+    k3 = at.make_key(dtype=np.dtype("float32"))
+    assert ("dtype", "float32") in k3
+
+
+def test_table_roundtrip_merge_digest_and_strict_from_doc():
+    fp = {"format": 1, "kind": "kernel_tune_table", "jax": "x",
+          "jaxlib": "y", "platform": "cpu", "device_kind": "cpu"}
+    t = at.TuneTable(fingerprint=fp)
+    key = at.make_key(x=(1, 8, 8, 8), dtype="float32")
+    t.set("conv_bn", key, "pallas_db", {"jnp": 1.0, "pallas_db": 0.5})
+    t.set("rnn", at.make_key(cell="lstm"), "jnp")
+    t2 = at.TuneTable.from_doc(json.loads(json.dumps(t.to_doc())))
+    assert t2.entries == t.entries and t2.fingerprint == fp
+    assert t2.digest() == t.digest()
+    # lookup is by canonical key, not object identity
+    assert t2.lookup("conv_bn",
+                     at.make_key(dtype="float32",
+                                 x=[1, 8, 8, 8])) == "pallas_db"
+    assert t2.lookup("conv_bn", at.make_key(x=(9,))) is None
+    # merge: same-key entries from the OTHER table win (newer wins)
+    o = at.TuneTable(fingerprint=fp)
+    o.set("conv_bn", key, "jnp")
+    t.merge(o)
+    assert t.lookup("conv_bn", key) == "jnp"
+    assert t.lookup("rnn", at.make_key(cell="lstm")) == "jnp"
+    # strict from_doc: any schema violation is a ValueError (the
+    # store's "deserialize" reject)
+    for bad in (None, [], {}, {"schema": "nope"},
+                {"schema": "pdtpu-tune-table-v1", "fingerprint": [],
+                 "entries": []},
+                {"schema": "pdtpu-tune-table-v1", "fingerprint": {},
+                 "entries": [{}]},
+                {"schema": "pdtpu-tune-table-v1", "fingerprint": {},
+                 "entries": [{"kernel": "k", "variant": "v",
+                              "key": "not-a-list"}]},
+                {"schema": "pdtpu-tune-table-v1", "fingerprint": {},
+                 "entries": [{"kernel": "k", "variant": "v", "key": [],
+                              "timings_ms": "not-a-dict"}]}):
+        with pytest.raises(ValueError):
+            at.TuneTable.from_doc(bad)
+
+
+# ---------------------------------------------------------------------------
+# dispatch semantics
+# ---------------------------------------------------------------------------
+
+def test_dispatch_static_tiers_ignore_table_auto_consults_it():
+    key = at.make_key(probe="dispatch", n=1)
+    sup = {"jnp": True, "pallas": True}
+    t = at.TuneTable()
+    t.set("conv_bn", key, "pallas")
+    at.attach_table(t, merge=False)
+    # static tiers: the table is never consulted
+    set_flags({"kernel_tier": "jnp", "kernel_autotune": True})
+    assert at.dispatch_variant("conv_bn", key, dict(sup)) == "jnp"
+    set_flags({"kernel_tier": "pallas"})
+    assert at.dispatch_variant("conv_bn", key, dict(sup)) == "pallas"
+    # auto + attached: the table's entry wins, selections counter bumps
+    set_flags({"kernel_tier": "auto"})
+    sel = _counter(SEL)
+    assert at.dispatch_variant("conv_bn", key, dict(sup)) == "pallas"
+    assert _counter(SEL) == sel + 1
+    # kernel_autotune off: static routing even with a table attached
+    set_flags({"kernel_autotune": False})
+    assert at.dispatch_variant("conv_bn", key, dict(sup)) == _static(sup)
+    set_flags({"kernel_autotune": True})
+    # entry's variant unsupported for THIS call: fall through to static
+    no_pl = {"jnp": True, "pallas": False}
+    assert at.dispatch_variant("conv_bn", key, dict(no_pl)) \
+        == _static(no_pl)
+    # key miss: static
+    assert at.dispatch_variant("conv_bn", at.make_key(probe="other"),
+                               dict(sup)) == _static(sup)
+    # unknown variant name (a table from a newer build): refused
+    t2 = at.TuneTable()
+    t2.set("conv_bn", key, "warp9000")
+    at.attach_table(t2, merge=False)
+    assert at.dispatch_variant("conv_bn", key, dict(sup)) == _static(sup)
+    # detached: static
+    at.detach_table()
+    assert at.dispatch_variant("conv_bn", key, dict(sup)) == _static(sup)
+
+
+def test_force_variant_pin_nesting_and_fallback_bump():
+    set_flags({"kernel_tier": "auto"})
+    tier.reset_fallback_counts()
+    key = at.make_key(probe="force")
+    with at.force_variant("conv_bn", "pallas"):
+        assert at.dispatch_variant(
+            "conv_bn", key, {"jnp": True, "pallas": True}) == "pallas"
+        # unsupported forced non-jnp: jnp with a fallback-counter bump
+        assert at.dispatch_variant(
+            "conv_bn", key, {"jnp": True, "pallas": False}) == "jnp"
+    assert tier.fallback_counts().get("conv_bn", 0) == 1
+    tier.reset_fallback_counts()
+    with at.force_variant("conv_bn", "jnp"):
+        with at.force_variant("conv_bn", "pallas_db"):
+            assert at.dispatch_variant(
+                "conv_bn", key,
+                {"jnp": True, "pallas_db": True}) == "pallas_db"
+        # inner exit restores the OUTER pin, not no-pin
+        assert at.dispatch_variant(
+            "conv_bn", key, {"jnp": True, "pallas_db": True}) == "jnp"
+    assert tier.fallback_counts() == {}
+
+
+def test_attach_detach_digest_flag_and_merge():
+    at.detach_table()
+    assert at.active_digest() is None
+    assert get_flag("kernel_autotune_digest") == ""
+    t1 = at.TuneTable()
+    t1.set("a", at.make_key(n=1), "jnp")
+    d1 = at.attach_table(t1, merge=False)
+    assert d1 == at.active_digest() == get_flag("kernel_autotune_digest")
+    # merge=True folds a second bundle's table in; both entries route
+    t2 = at.TuneTable()
+    t2.set("b", at.make_key(n=2), "jnp")
+    d2 = at.attach_table(t2)
+    assert d2 != d1 and get_flag("kernel_autotune_digest") == d2
+    assert at.active_table().lookup("a", at.make_key(n=1)) == "jnp"
+    assert at.active_table().lookup("b", at.make_key(n=2)) == "jnp"
+    at.detach_table()
+    assert at.active_digest() is None
+    assert get_flag("kernel_autotune_digest") == ""
+
+
+def test_variant_allowed_gates_bf16_and_unknown_names():
+    assert at.variant_allowed("conv_bn", "pallas")
+    assert not at.variant_allowed("conv_bn", "warp9000")
+    assert not at.variant_allowed("nosuchkernel", "jnp")
+    # bf16-flagged variants need the explicit opt-in
+    assert not at.variant_allowed("conv_bn", "pallas_bf16")
+    set_flags({"kernel_autotune_bf16": True})
+    assert at.variant_allowed("conv_bn", "pallas_bf16")
+
+
+# ---------------------------------------------------------------------------
+# capture + measure + tuner
+# ---------------------------------------------------------------------------
+
+def test_capture_records_supported_variant_names():
+    set_flags({"kernel_tier": "jnp"})
+    key = at.make_key(probe="cap")
+    with at.capture() as keys:
+        at.dispatch_variant("conv_bn", key,
+                            {"jnp": True, "pallas": False,
+                             "pallas_db": True})
+    assert keys == [("conv_bn", key, ("jnp", "pallas_db"))]
+    with at.capture() as empty:
+        pass
+    assert empty == []
+
+
+def test_measure_interleaves_windows_and_drops_raising_runner():
+    calls = {"a": 0, "b": 0}
+
+    def mk(name):
+        def run():
+            calls[name] += 1
+        return run
+
+    def boom():
+        raise RuntimeError("cannot run")
+
+    out = at.measure({"a": mk("a"), "b": mk("b"), "c": boom},
+                     repeats=2, inner=3)
+    assert set(out) == {"a", "b"}        # the raising runner cannot win
+    # one untimed warmup + repeats windows of inner calls, per runner
+    assert calls["a"] == calls["b"] == 1 + 2 * 3
+    assert all(v >= 0.0 for v in out.values())
+
+
+def test_tuner_dedup_bf16_gate_single_candidate_and_broken_build():
+    import time as _time
+
+    reg = at.VariantRegistry()
+    reg.register("k", "jnp", lambda key: (lambda: None))
+    reg.register("k", "fast", lambda key: (lambda: None))
+    reg.register("k", "bf", lambda key: (lambda: None), bf16=True)
+    key = at.make_key(n=3)
+    tunes = _counter(TUNES)
+    table = at.Tuner(repeats=1, inner=1, registry=reg).tune(
+        [("k", key, ("bf", "fast", "jnp")),
+         ("k", key, ("bf", "fast", "jnp"))])      # duplicate capture
+    e = table.entries[("k", at.key_str(key))]
+    assert _counter(TUNES) == tunes + 1           # deduped to ONE entry
+    # bf16 candidates are excluded without the opt-in
+    assert set(e["timings_ms"]) == {"fast", "jnp"}
+    assert e["variant"] in ("fast", "jnp")
+    set_flags({"kernel_autotune_bf16": True})
+    t2 = at.Tuner(repeats=1, inner=1, registry=reg).tune(
+        [("k", key, ("bf", "fast", "jnp"))])
+    assert set(t2.entries[("k", at.key_str(key))]["timings_ms"]) \
+        == {"bf", "fast", "jnp"}
+    # single candidate: recorded without timings
+    t3 = at.Tuner(registry=reg).tune([("k", key, ("jnp",))])
+    e3 = t3.entries[("k", at.key_str(key))]
+    assert e3["variant"] == "jnp" and e3["timings_ms"] == {}
+    # a variant whose builder raises cannot win
+    reg2 = at.VariantRegistry()
+    reg2.register("k", "jnp", lambda key: (lambda: None))
+
+    def broken_build(key):
+        raise RuntimeError("cannot build")
+    reg2.register("k", "broken", broken_build)
+    t4 = at.Tuner(repeats=1, inner=1, registry=reg2).tune(
+        [("k", key, ("broken", "jnp"))])
+    assert t4.entries[("k", at.key_str(key))]["variant"] == "jnp"
+    # deterministic winner: min measured time
+    reg3 = at.VariantRegistry()
+    reg3.register("k", "slow", lambda key: (lambda: _time.sleep(0.005)))
+    reg3.register("k", "quick", lambda key: (lambda: None))
+    t5 = at.Tuner(repeats=2, inner=1, registry=reg3).tune(
+        [("k", key, ("quick", "slow"))])
+    assert t5.entries[("k", at.key_str(key))]["variant"] == "quick"
+
+
+# ---------------------------------------------------------------------------
+# store: artifact contract + typed rejects
+# ---------------------------------------------------------------------------
+
+def test_store_roundtrip_identity_filename_and_silent_miss(tmp_path):
+    store = at.TuneStore(str(tmp_path / "tune"))
+    rejects = _counter(REJECTS)
+    assert store.load() is None            # missing file: silent miss
+    assert _counter(REJECTS) == rejects    # ... not a reject
+    t = at.TuneTable()
+    t.set("conv_bn", at.make_key(n=1), "pallas")
+    path = store.save(t)
+    want = (f"table-{at.fingerprint_key(at.table_fingerprint())[:40]}"
+            f"{at.ARTIFACT_SUFFIX}")
+    assert path is not None and os.path.basename(path) == want
+    assert store.touched() == [want]
+    got = at.TuneStore(str(tmp_path / "tune"), readonly=True).load()
+    assert got is not None and got.digest() == t.digest()
+    # a read-only store never writes
+    ro = at.TuneStore(str(tmp_path / "ro"), readonly=True)
+    assert ro.save(t) is None and not (tmp_path / "ro").exists()
+
+
+def test_store_reject_stages_unpinned_dir(tmp_path):
+    d = str(tmp_path / "tune")
+    store = at.TuneStore(d)
+    t = at.TuneTable()
+    t.set("conv_bn", at.make_key(n=1), "jnp")
+    p = store.save(t)
+    with open(p, "rb") as f:
+        raw = f.read()
+
+    def reason_after(data):
+        with open(p, "wb") as f:
+            f.write(data)
+        rejects = _counter(REJECTS)
+        before = len(_reject_events())
+        assert at.TuneStore(d, readonly=True).load() is None  # no raise
+        evs = _reject_events()
+        assert len(evs) == before + 1 and _counter(REJECTS) == rejects + 1
+        assert evs[-1]["detail"]["dir"] == d
+        return evs[-1]["detail"]["reason"]
+
+    assert reason_after(raw[:len(raw) // 2]) == "format"     # truncated
+    flipped = bytearray(raw)
+    flipped[-3] ^= 0x40                                      # payload flip
+    assert reason_after(bytes(flipped)) == "format"
+    blob = b"{not json"                       # valid envelope, bad payload
+    env = at._MAGIC + hashlib.sha256(blob).hexdigest().encode() \
+        + b"\n" + blob
+    assert reason_after(env) == "deserialize"
+    blob2 = json.dumps({"schema": "nope"}).encode()
+    env2 = at._MAGIC + hashlib.sha256(blob2).hexdigest().encode() \
+        + b"\n" + blob2
+    assert reason_after(env2) == "deserialize"
+    # another identity's table planted at OUR filename
+    foreign = at.TuneTable(fingerprint={
+        "format": 1, "kind": "kernel_tune_table", "jax": "0.0",
+        "jaxlib": "0.0", "platform": "mars", "device_kind": "mars"})
+    foreign.set("conv_bn", at.make_key(n=1), "pallas")
+    fb = json.dumps(foreign.to_doc(), sort_keys=True).encode()
+    fenv = at._MAGIC + hashlib.sha256(fb).hexdigest().encode() \
+        + b"\n" + fb
+    assert reason_after(fenv) == "fingerprint"
+    # pristine bytes restored: loads again
+    with open(p, "wb") as f:
+        f.write(raw)
+    assert at.TuneStore(d, readonly=True).load() is not None
+
+
+def test_store_manifest_pinning_on_raw_bytes(tmp_path):
+    d = str(tmp_path / "tune")
+    t = at.TuneTable()
+    t.set("conv_bn", at.make_key(n=2), "jnp")
+    p = at.TuneStore(d).save(t)
+    name = os.path.basename(p)
+    with open(p, "rb") as f:
+        good = hashlib.sha256(f.read()).hexdigest()
+    # correct pin loads
+    got = at.TuneStore(d, readonly=True,
+                       expected_digests={name: good}).load()
+    assert got is not None and got.digest() == t.digest()
+
+    def reason_with(expected):
+        before = len(_reject_events())
+        assert at.TuneStore(d, readonly=True,
+                            expected_digests=expected).load() is None
+        evs = _reject_events()
+        assert len(evs) == before + 1
+        return evs[-1]["detail"]["reason"]
+
+    # unlisted artifact (manifest without this file): manifest reject
+    assert reason_with({}) == "manifest"
+    # listed but wrong bytes: manifest reject BEFORE any parsing
+    assert reason_with({name: "0" * 64}) == "manifest"
+
+
+def test_resolve_store_precedence_and_local_dir_attach(tmp_path):
+    set_flags({"kernel_tier": "auto", "kernel_autotune": True,
+               "kernel_autotune_dir": ""})
+    at.detach_table()
+    assert at.resolve_store(None) is None
+    assert at.attach_for_bundle(None) is None
+    # local dir via the kernel_autotune_dir flag: readonly, UNPINNED
+    d = tmp_path / "local"
+    t = at.TuneTable()
+    t.set("conv_bn", at.make_key(n=7), "pallas")
+    at.TuneStore(str(d)).save(t)
+    set_flags({"kernel_autotune_dir": str(d)})
+    s = at.resolve_store(None)
+    assert s is not None and s.readonly and s._expected is None
+    digest = at.attach_for_bundle(None)
+    assert digest == t.digest() == at.active_digest()
+    # a bundle's published tune/ dir wins over the flag, manifest-pinned
+    bundle = tmp_path / "bundle"
+    (bundle / at.TUNE_DIRNAME).mkdir(parents=True)
+    s2 = at.resolve_store(str(bundle))
+    assert s2.path == str(bundle / at.TUNE_DIRNAME) and s2.readonly
+    assert s2._expected is None            # no manifest: self-digest only
+    with open(bundle / "VERSION.json", "w") as f:
+        json.dump({"model": "x"}, f)       # manifest WITHOUT tune_files
+    assert at.resolve_store(str(bundle))._expected == {}  # pins empty set
+    # off-switches: attach_for_bundle is a no-op
+    at.detach_table()
+    set_flags({"kernel_autotune": False})
+    assert at.attach_for_bundle(None) is None
+    set_flags({"kernel_autotune": True, "kernel_tier": "jnp"})
+    assert at.attach_for_bundle(None) is None
+    assert at.active_digest() is None
+
+
+# ---------------------------------------------------------------------------
+# parity sweep: every kernel family with >= 2 variants, through the
+# REAL op, eager and jit, forced per variant
+# ---------------------------------------------------------------------------
+
+def _conv_infer_out(variant, mode, filter_size=3):
+    framework.reset_unique_name()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[8, 8, 3])
+        c = fluid.layers.conv2d(img, 6, filter_size,
+                                padding=(filter_size - 1) // 2,
+                                bias_attr=False, data_format="NHWC")
+        b = fluid.layers.batch_norm(c, act="relu", data_layout="NHWC",
+                                    is_test=True)
+        assert fluid.fuse_conv_bn(main) == 1
+    exe = fluid.Executor(fluid.CPUPlace(), mode=mode)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.normal(0, 1, (2, 8, 8, 3)).astype("float32")}
+    with at.force_variant("conv_bn", variant):
+        out = exe.run(main, feed=feed, fetch_list=[b], scope=scope)
+    return np.asarray(out[0])
+
+
+def test_parity_conv_bn_all_variants_eager_and_jit():
+    set_flags({"kernel_tier": "auto"})
+    tier.reset_fallback_counts()
+    for mode in ("eager", "jit"):
+        ref = _conv_infer_out("jnp", mode)
+        pl = _conv_infer_out("pallas", mode)
+        db = _conv_infer_out("pallas_db", mode)
+        # force_variant is an explicit pin: pallas_bf16 runs WITHOUT the
+        # kernel_autotune_bf16 opt-in (the flag gates only what a TABLE
+        # may route to)
+        bf = _conv_infer_out("pallas_bf16", mode)
+        np.testing.assert_allclose(pl, ref, rtol=2e-4, atol=1e-5,
+                                   err_msg=f"[{mode}] pallas vs jnp")
+        # the double-buffered kernel is the same accumulation order by
+        # construction: bitwise vs single-buffered pallas
+        assert np.array_equal(db, pl), f"[{mode}] pallas_db not bitwise"
+        # bf16 activations are value-changing: loose tolerance only
+        np.testing.assert_allclose(bf, ref, rtol=0.1, atol=0.05,
+                                   err_msg=f"[{mode}] pallas_bf16")
+    assert tier.fallback_counts() == {}
+
+
+def test_parity_conv_bn_unsupported_force_falls_back_bitwise():
+    set_flags({"kernel_tier": "auto"})
+    tier.reset_fallback_counts()
+    ref = _conv_infer_out("jnp", "jit", filter_size=5)
+    out = _conv_infer_out("pallas", "jit", filter_size=5)  # 5x5: no kernel
+    assert np.array_equal(out, ref)
+    assert tier.fallback_counts().get("conv_bn", 0) >= 1
+
+
+def test_conv_bn_double_buffer_trains_bitwise_vs_pallas():
+    def losses(variant):
+        set_flags({"kernel_tier": "pallas"})  # grads route identically
+        framework.reset_unique_name()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 3
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", shape=[8, 8, 3])
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            c = fluid.layers.conv2d(img, 6, 3, padding=1, bias_attr=False,
+                                    data_format="NHWC")
+            b = fluid.layers.batch_norm(c, act="relu", data_layout="NHWC")
+            pool = fluid.layers.pool2d(b, pool_type="avg",
+                                       global_pooling=True,
+                                       data_format="NHWC")
+            logits = fluid.layers.fc(pool, size=4)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            assert fluid.fuse_conv_bn(main) == 1
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss, startup)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        feed = {"img": rng.normal(0, 1, (2, 8, 8, 3)).astype("float32"),
+                "label": rng.randint(0, 4, (2, 1)).astype("int64")}
+        with at.force_variant("conv_bn", variant):
+            return [float(exe.run(main, feed=feed, fetch_list=[loss],
+                                  scope=scope)[0]) for _ in range(2)]
+    assert losses("pallas_db") == losses("pallas")
+
+
+def test_parity_rnn_lstm_and_gru_variants():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.rnn_ops import _gru_compute, _lstm_scan
+
+    set_flags({"kernel_tier": "auto"})
+    tier.reset_fallback_counts()
+    rng = np.random.RandomState(2)
+    b, L, H = 2, 3, 4
+    lens = jnp.asarray(np.array([3, 2], "int32"))
+    xl = jnp.asarray(rng.normal(0, 0.5, (b, L, 4 * H)).astype("float32"))
+    wl = jnp.asarray(rng.normal(0, 0.5, (H, 4 * H)).astype("float32"))
+    h0 = jnp.zeros((b, H), jnp.float32)
+    c0 = jnp.zeros((b, H), jnp.float32)
+    xg = jnp.asarray(rng.normal(0, 0.5, (b, L, 3 * H)).astype("float32"))
+    wg = jnp.asarray(rng.normal(0, 0.5, (H, 3 * H)).astype("float32"))
+
+    def lstm():
+        return _lstm_scan(xl, lens, wl, h0, c0,
+                          "sigmoid", "tanh", "tanh")
+
+    def gru():
+        return _gru_compute(xg, lens, wg, None, None, {})
+
+    for fn in (lstm, gru):
+        for jitted in (False, True):
+            def run(variant):
+                # fresh jit wrapper per variant: the pin is trace-time
+                f = jax.jit(fn) if jitted else fn
+                with at.force_variant("rnn", variant):
+                    out = f()
+                return [np.asarray(o)
+                        for o in jax.tree_util.tree_leaves(out)]
+            for a, p in zip(run("jnp"), run("pallas")):
+                # the seq kernels matmul in bf16 (the TPU recipe) and
+                # the error compounds through the recurrence; the jnp
+                # scan is f32 — bf16-recipe tolerance, not bitwise
+                np.testing.assert_allclose(
+                    p, a, rtol=5e-3, atol=2e-3,
+                    err_msg=f"{fn.__name__} jit={jitted}")
+    assert tier.fallback_counts() == {}
+
+
+class TestPagedAttentionVariantParity(OpTest):
+    op_type = "paged_attention"
+
+    def test_forced_variants_match_through_the_real_op(self):
+        set_flags({"kernel_tier": "auto"})
+        tier.reset_fallback_counts()
+        self.inputs, self.outputs, h = paged_case()
+        self.attrs = {"num_heads": h}
+        # check_output runs BOTH executor modes (eager + jit) against
+        # the twin-computed expected outputs
+        with at.force_variant("paged_attention", "jnp"):
+            self.check_output(atol=1e-5, rtol=1e-5)
+        with at.force_variant("paged_attention", "pallas"):
+            self.check_output(atol=2e-5, rtol=2e-4)
+        assert tier.fallback_counts() == {}
+
+
+def test_parity_embedding_sparse_sgd_forced_variants():
+    def train(variant, mode):
+        set_flags({"kernel_tier": "auto"})
+        framework.reset_unique_name()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 17
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", shape=[1], dtype="int64",
+                                    lod_level=1)
+            emb = fluid.layers.embedding(ids, size=[15, 8], is_sparse=True)
+            feat = fluid.layers.sequence_pool(emb, "sum")
+            pred = fluid.layers.fc(feat, size=1)
+            label = fluid.layers.data("y", shape=[1])
+            loss = fluid.layers.mean(fluid.layers.square(
+                fluid.layers.elementwise_sub(pred, label)))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss, startup)
+        exe = fluid.Executor(fluid.CPUPlace(), mode=mode)
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(5)
+        seqs = [np.array([[0], [4], [4], [9]], "int64"),
+                np.array([[2]], "int64"),
+                np.array([[14], [0]], "int64")]
+        feed = {"ids": seqs, "y": rng.normal(0, 1, (3, 1)).astype("float32")}
+        with at.force_variant("embedding", variant):
+            return [float(exe.run(main, feed=feed, fetch_list=[loss],
+                                  scope=scope)[0]) for _ in range(2)]
+
+    tier.reset_fallback_counts()
+    for mode in ("eager", "jit"):
+        np.testing.assert_allclose(train("pallas", mode),
+                                   train("jnp", mode),
+                                   rtol=5e-4, atol=1e-6, err_msg=mode)
+    assert tier.fallback_counts() == {}
+
+
+def test_parity_optimizer_fused_momentum_forced_variants_bitwise():
+    def train(variant, mode):
+        set_flags({"kernel_tier": "auto"})
+        framework.reset_unique_name()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 11
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[6])
+            y = fluid.layers.data("y", shape=[1])
+            pred = fluid.layers.fc(x, size=1)
+            loss = fluid.layers.mean(fluid.layers.square(
+                fluid.layers.elementwise_sub(pred, y)))
+            fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                     fused=True).minimize(loss, startup)
+        exe = fluid.Executor(fluid.CPUPlace(), mode=mode)
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(4)
+        feed = {"x": rng.normal(0, 1, (4, 6)).astype("float32"),
+                "y": rng.normal(0, 1, (4, 1)).astype("float32")}
+        with at.force_variant("optimizer", variant):
+            return [float(exe.run(main, feed=feed, fetch_list=[loss],
+                                  scope=scope)[0]) for _ in range(3)]
+
+    tier.reset_fallback_counts()
+    for mode in ("eager", "jit"):
+        # the arena kernel is the same elementwise update in the same
+        # dtype: the loss trajectory must be BITWISE the per-param one
+        assert train("pallas", mode) == train("jnp", mode), mode
+    assert tier.fallback_counts() == {}
+
+
+# ---------------------------------------------------------------------------
+# engine acceptance: publish-time tuning, zero in-band work, parity,
+# corruption downgrades
+# ---------------------------------------------------------------------------
+
+def _export_convnet(dirname, seed=3):
+    framework.reset_unique_name()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[8, 8, 3])
+        c = fluid.layers.conv2d(img, 6, 3, padding=1, bias_attr=False,
+                                data_format="NHWC")
+        b = fluid.layers.batch_norm(c, act="relu", data_layout="NHWC",
+                                    is_test=True)
+        pool = fluid.layers.pool2d(b, pool_type="avg", global_pooling=True,
+                                   data_format="NHWC")
+        logits = fluid.layers.fc(pool, size=4)
+        assert fluid.fuse_conv_bn(main) == 1
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    fluid.io.save_inference_model(str(dirname), ["img"], [logits], exe,
+                                  main, scope=scope)
+
+
+def _img_feed(n=1, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"img": rng.normal(0, 1, (n, 8, 8, 3)).astype("float32")}
+
+
+@pytest.fixture(scope="module")
+def tuned_bundle(tmp_path_factory):
+    """A conv+bn bundle published with tune={'repeats':1,'inner':1} and
+    a warm exec cache — shared by the acceptance tests below."""
+    base = tmp_path_factory.mktemp("tuned")
+    export = base / "export"
+    _export_convnet(export)
+    saved = {n: get_flag(n) for n in FLAGS}
+    set_flags({"kernel_tier": "auto", "kernel_autotune": True})
+    try:
+        at.detach_table()
+        reg = ModelRegistry(str(base / "registry"))
+        v = reg.publish("m", str(export), warm_cache=True,
+                        warm_kwargs={"buckets": "1"},
+                        tune={"repeats": 1, "inner": 1})
+        path, v = reg.resolve("m", v)
+    finally:
+        at.detach_table()
+        set_flags(saved)
+    return str(base / "registry"), path, v
+
+
+def test_publish_tune_ships_manifest_pinned_table(tuned_bundle):
+    root, path, v = tuned_bundle
+    with open(os.path.join(path, "VERSION.json")) as f:
+        m = json.load(f)
+    tf = m.get("tune_files")
+    assert tf, "publish(tune=...) must certify tune_files"
+    assert all(rel.startswith(f"{at.TUNE_DIRNAME}/") for rel in tf)
+    assert any(rel.endswith(at.ARTIFACT_SUFFIX) for rel in tf)
+    # verify() re-hashes the table like every other bundle file
+    ModelRegistry(root).verify("m", v)
+    # the shipped table holds the conv_bn entry the warmup captured
+    store = at.resolve_store(path)
+    table = store.load()
+    assert table is not None
+    assert any(k == "conv_bn" for (k, _ks) in table.entries)
+
+
+def test_tuned_engine_zero_inband_work_and_infer_parity(tuned_bundle):
+    _root, path, v = tuned_bundle
+    set_flags({"kernel_tier": "auto"})
+    # untuned twin FIRST: autotune off -> static routing, digest absent
+    set_flags({"kernel_autotune": False})
+    at.detach_table()
+    ref = InferenceEngine(path, buckets="1")
+    ref.warmup()
+    assert ref.stats()["tune_digest"] is None
+    ref_out = [np.asarray(o) for o in ref.infer(_img_feed())]
+    # tuned engine: the table attaches AT WARMUP, before any compile;
+    # fully tuned means ZERO tuner timings and ZERO compiles in-band
+    set_flags({"kernel_autotune": True})
+    tunes = _counter(TUNES)
+    compiles = obs_perf.COMPILE_LOG.stats()["count"]
+    eng = InferenceEngine(path, buckets="1")
+    assert eng.warmup() == 0, "tuned+warmed engine must load, not compile"
+    assert _counter(TUNES) == tunes, "no in-band tuning work"
+    assert obs_perf.COMPILE_LOG.stats()["count"] == compiles
+    st = eng.stats()
+    assert st["tune_digest"] is not None
+    assert st["tune_digest"] == at.active_digest()
+    out = [np.asarray(o) for o in eng.infer(_img_feed())]
+    # parity tuned vs untuned: bitwise when the tuned selection is the
+    # static family (always on CPU, where jnp wins), tolerance otherwise
+    chosen = {e["variant"] for (k, _ks), e in
+              at.active_table().entries.items() if k == "conv_bn"}
+    for a, b in zip(ref_out, out):
+        if chosen <= {"jnp"}:
+            assert np.array_equal(a, b), "tuned infer must be bitwise"
+        else:
+            np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
+    assert eng.hot_recompiles == 0
+
+
+def test_rewarm_tune_is_idempotent(tuned_bundle):
+    root, _path, v = tuned_bundle
+    set_flags({"kernel_tier": "auto", "kernel_autotune": True})
+    at.detach_table()
+    tunes = _counter(TUNES)
+    ModelRegistry(root).warm("m", v, buckets="1", tune=True)
+    # every captured key is already in the shipped table: nothing re-tunes
+    assert _counter(TUNES) == tunes
+
+
+def test_corrupt_bundle_table_downgrades_to_static_serving(tuned_bundle,
+                                                           tmp_path):
+    root, path, v = tuned_bundle
+    copy = tmp_path / "registry"
+    shutil.copytree(root, copy)
+    cpath = str(copy / os.path.relpath(path, root))
+    tdir = os.path.join(cpath, at.TUNE_DIRNAME)
+    art = [f for f in os.listdir(tdir) if f.endswith(at.ARTIFACT_SUFFIX)]
+    assert len(art) == 1
+    fpath = os.path.join(tdir, art[0])
+    with open(fpath, "rb") as f:
+        raw = bytearray(f.read())
+    raw[-1] ^= 0xFF
+    with open(fpath, "wb") as f:
+        f.write(bytes(raw))
+    set_flags({"kernel_tier": "auto", "kernel_autotune": True})
+    at.detach_table()
+    rejects = _counter(REJECTS)
+    before = len(_reject_events())
+    eng = InferenceEngine(cpath, buckets="1")
+    eng.warmup()                          # never an engine failure
+    # published dir: the manifest's raw-byte pin fires FIRST
+    assert _counter(REJECTS) == rejects + 1
+    evs = _reject_events()
+    assert len(evs) == before + 1
+    assert evs[-1]["detail"]["reason"] == "manifest"
+    assert at.active_digest() is None
+    assert eng.stats()["tune_digest"] is None
+    out = eng.infer(_img_feed())          # static routing still serves
+    assert np.asarray(out[0]).shape[0] == 1
+
+
+def test_manifest_unlisted_tune_table_refused(tuned_bundle, tmp_path):
+    root, path, v = tuned_bundle
+    copy = tmp_path / "registry"
+    shutil.copytree(root, copy)
+    cpath = str(copy / os.path.relpath(path, root))
+    mpath = os.path.join(cpath, "VERSION.json")
+    with open(mpath) as f:
+        m = json.load(f)
+    del m["tune_files"]                   # uncertified tune/ dir
+    with open(mpath, "w") as f:
+        json.dump(m, f)
+    set_flags({"kernel_tier": "auto", "kernel_autotune": True})
+    at.detach_table()
+    before = len(_reject_events())
+    eng = InferenceEngine(cpath, buckets="1")
+    eng.warmup()
+    evs = _reject_events()
+    assert len(evs) == before + 1
+    assert evs[-1]["detail"]["reason"] == "manifest"
+    assert eng.stats()["tune_digest"] is None and at.active_digest() is None
+
+
+def test_generation_publish_tune_zero_inband_and_token_parity(tmp_path):
+    lm = tmp_path / "lm"
+    export_tiny_lm(str(lm), seed=13)
+    set_flags({"kernel_tier": "auto", "kernel_autotune": True})
+    at.detach_table()
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    gen_opts = dict(max_seqs=2, max_len=48)
+    v = reg.publish("lm", str(lm), model_kind="generative",
+                    warm_cache=True, warm_kwargs={"gen_opts": gen_opts},
+                    tune={"repeats": 1, "inner": 1})
+    path, v = reg.resolve("lm", v)
+
+    def tokens(engine, sampling):
+        handle, toks, finished = engine.start([3, 5, 7], 8, sampling)
+        out = list(toks)
+        while not finished:
+            for h, t, f in engine.step():
+                if h is handle:
+                    out += t
+                    finished = f
+        return out
+
+    samplings = ({"mode": "greedy"},
+                 {"mode": "topk", "seed": 3, "top_k": 4},
+                 {"mode": "beam", "beam_size": 2})
+    # untuned twin: autotune off -> static routing
+    set_flags({"kernel_autotune": False})
+    at.detach_table()
+    ref = GenerationEngine(path, **gen_opts)
+    ref.warmup()
+    want = [tokens(ref, dict(s)) for s in samplings]
+    # tuned engine: table attaches at warmup, zero in-band tuning work
+    set_flags({"kernel_autotune": True})
+    tunes = _counter(TUNES)
+    eng = GenerationEngine(path, **gen_opts)
+    assert eng.warmup() == 0, "tuned+warmed engine must load, not compile"
+    assert _counter(TUNES) == tunes
+    assert eng.stats()["tune_digest"] is not None
+    assert eng.stats()["tune_digest"] == at.active_digest()
+    for s, w in zip(samplings, want):
+        assert tokens(eng, dict(s)) == w, s
+    assert eng.hot_recompiles == 0
+
+
+def test_tools_autotune_cli_writes_attachable_table(tmp_path):
+    export = tmp_path / "export"
+    _export_convnet(export)
+    out = tmp_path / "tuned"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "autotune.py"),
+         str(export), "--buckets", "1", "--repeats", "1", "--inner", "1",
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    arts = [f for f in os.listdir(out) if f.endswith(at.ARTIFACT_SUFFIX)]
+    assert len(arts) == 1
+    # the produced table attaches through the kernel_autotune_dir flag
+    set_flags({"kernel_tier": "auto", "kernel_autotune": True,
+               "kernel_autotune_dir": str(out)})
+    at.detach_table()
+    digest = at.attach_for_bundle(None)
+    assert digest is not None and digest == at.active_digest()
+    assert any(k == "conv_bn" for (k, _ks) in at.active_table().entries)

@@ -32,6 +32,7 @@ def test_wheel_builds_with_all_subpackages(tmp_path):
                 "paddle_tpu/fluid/analysis/__init__.py",
                 "paddle_tpu/v2/__init__.py", "paddle_tpu/ops/__init__.py",
                 "paddle_tpu/ops/pallas/__init__.py",
+                "paddle_tpu/ops/autotune.py",
                 "paddle_tpu/parallel/__init__.py",
                 "paddle_tpu/distributed/__init__.py",
                 "paddle_tpu/serving/__init__.py",

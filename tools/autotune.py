@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Standalone kernel-autotune driver for any saved bundle.
+
+Does what ``ModelRegistry.warm(tune=True)`` does at publish time, but for
+an arbitrary bundle dir (a registry version dir or a raw
+``save_inference_model`` export): warm a throwaway engine under
+``ops.autotune.capture`` to learn the REAL dispatch keys, measure every
+captured key's registered variants (interleaved best-of-N windows), and
+persist the winning table.
+
+The table lands under ``<bundle>/tune/`` by default — when the bundle
+carries a registry ``VERSION.json`` its ``tune_files`` digests are
+updated in place (tmp + os.replace, the registry's certify semantics) so
+replicas resolving the version load the table manifest-pinned and
+``registry.verify`` keeps re-hashing it. ``--out`` writes to a plain
+directory instead (point serving at it via the ``kernel_autotune_dir``
+flag) and leaves any manifest alone.
+
+Usage:
+  python tools/autotune.py BUNDLE [--model-kind auto|feedforward|generative]
+         [--buckets 1,8] [--repeats 3] [--inner 2] [--bf16] [--out DIR]
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _sha256_file(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _bundle_model_kind(bundle, requested):
+    if requested != "auto":
+        return requested
+    try:
+        with open(os.path.join(bundle, "VERSION.json")) as f:
+            return json.load(f).get("model_kind", "feedforward")
+    except (OSError, ValueError):
+        return "feedforward"
+
+
+def _capture_keys(bundle, model_kind, buckets):
+    from paddle_tpu.ops import autotune as at
+    if model_kind == "generative":
+        from paddle_tpu.serving import GenerationEngine
+        engine = GenerationEngine(bundle, exec_cache=False)
+        with at.capture() as keys:
+            engine.warmup()
+    else:
+        from paddle_tpu.serving import InferenceEngine
+        engine = InferenceEngine(bundle, buckets=buckets, exec_cache=False)
+        with at.capture() as keys:
+            engine.warmup()
+    return keys
+
+
+def _certify_manifest(bundle, store):
+    """Update the bundle's VERSION.json ``tune_files`` to exactly the
+    artifacts this run touched, pruning stale tables — no-op when the
+    bundle has no manifest (a raw export: the artifact self-digest is
+    the integrity layer)."""
+    from paddle_tpu.ops import autotune as at
+    mpath = os.path.join(bundle, "VERSION.json")
+    try:
+        with open(mpath) as f:
+            m = json.load(f)
+    except (OSError, ValueError):
+        return None
+    touched = set(store.touched())
+    tune_files = {}
+    for name in sorted(os.listdir(store.path)):
+        fpath = os.path.join(store.path, name)
+        if not os.path.isfile(fpath) or name.endswith(".tmp"):
+            continue
+        if name in touched:
+            tune_files[f"{at.TUNE_DIRNAME}/{name}"] = _sha256_file(fpath)
+        elif name.endswith(at.ARTIFACT_SUFFIX):
+            try:
+                os.unlink(fpath)
+            except OSError:
+                pass
+    if m.get("tune_files") != tune_files:
+        m["tune_files"] = tune_files
+        tmp = mpath + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(m, f, indent=1, sort_keys=True)
+        os.replace(tmp, mpath)
+    return tune_files
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="measure per-shape kernel variants for a bundle and "
+                    "persist the winning table")
+    ap.add_argument("bundle", help="registry version dir or raw export")
+    ap.add_argument("--model-kind", default="auto",
+                    choices=("auto", "feedforward", "generative"),
+                    help="engine class; auto reads the bundle's "
+                         "VERSION.json (default feedforward)")
+    ap.add_argument("--buckets", default=None,
+                    help="feed-forward warmup buckets, e.g. '1,8'")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="best-of-N timing windows per variant")
+    ap.add_argument("--inner", type=int, default=2,
+                    help="calls per timing window")
+    ap.add_argument("--bf16", action="store_true",
+                    help="let the tuner consider value-changing "
+                         "bf16-flagged variants (kernel_autotune_bf16)")
+    ap.add_argument("--out", default=None,
+                    help="write the table to this plain dir instead of "
+                         "<bundle>/tune/ (no manifest update)")
+    args = ap.parse_args(argv)
+
+    bundle = os.path.abspath(args.bundle)
+    if not os.path.isdir(bundle):
+        print(f"autotune: {bundle!r} is not a directory", file=sys.stderr)
+        return 2
+
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.ops import autotune as at
+    if args.bf16:
+        set_flags({"kernel_autotune_bf16": True})
+
+    kind = _bundle_model_kind(bundle, args.model_kind)
+    keys = _capture_keys(bundle, kind, args.buckets)
+    print(f"autotune: captured {len(keys)} dispatches "
+          f"({len({(k, at.key_str(key)) for k, key, _ in keys})} distinct "
+          f"keys) from {kind} warmup")
+
+    out_dir = args.out or os.path.join(bundle, at.TUNE_DIRNAME)
+    store = at.TuneStore(out_dir)
+    table = at.Tuner(repeats=args.repeats, inner=args.inner) \
+        .tune(keys, table=store.load())
+    path = store.save(table)
+    if path is None:
+        print(f"autotune: could not write a table under {out_dir!r}",
+              file=sys.stderr)
+        return 1
+    if args.out is None:
+        _certify_manifest(bundle, store)
+
+    for (kernel, ks), e in sorted(table.entries.items()):
+        timed = ", ".join(f"{n}={ms:.3f}ms"
+                          for n, ms in sorted(e["timings_ms"].items()))
+        print(f"  {kernel}: {e['variant']}"
+              + (f"  [{timed}]" if timed else "  [only candidate]")
+              + f"  key={ks}")
+    print(f"autotune: {len(table.entries)} entries -> {path} "
+          f"(digest {table.digest()[:12]}…)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,9 @@ prints; ``_smoke`` suffixes are stripped so a smoke run compares against
 a full run of the same lane. Direction comes from the unit string: units
 starting with ``ms``/``%`` or saying "lower is better" regress UP,
 everything else (img/s, QPS, MB/s, tokens/s, x-speedups) regresses DOWN.
+In ``--dir`` trajectory mode a lane whose two records carry different
+``backend`` stamps is skipped with a one-line note — a CPU-smoke number
+diffed against a TPU number is a machine change, not a regression.
 
 Exit codes (the tier-1 subprocess gate pins all three):
 
@@ -123,17 +126,25 @@ def lower_is_better(record):
             or unit.startswith("s ") or unit.startswith("%"))
 
 
-def compare_records(old, new, threshold_pct=5.0):
+def compare_records(old, new, threshold_pct=5.0, backend_skip=False):
     """Per-lane delta of two ``{lane: record}`` maps. Returns
-    ``{rows, regressions, missing, new_lanes, ok, threshold_pct}`` —
-    ``ok`` ignores missing lanes (the CLI decides their severity)."""
-    rows, regressions, missing = [], [], []
+    ``{rows, regressions, missing, new_lanes, backend_skipped, ok,
+    threshold_pct}`` — ``ok`` ignores missing lanes (the CLI decides
+    their severity). With ``backend_skip`` (trajectory mode), a lane
+    whose two records carry DIFFERENT ``backend`` stamps is excluded
+    from the delta instead of compared: a CPU-smoke number diffed
+    against a TPU number is neither a regression nor an improvement,
+    it's a different machine."""
+    rows, regressions, missing, backend_skipped = [], [], [], []
     thr = float(threshold_pct) / 100.0
     for lane in sorted(old):
         o = old[lane]
         n = new.get(lane)
         if n is None:
             missing.append(lane)
+            continue
+        if backend_skip and o.get("backend") != n.get("backend"):
+            backend_skipped.append(lane)
             continue
         ov, nv = float(o["value"]), float(n["value"])
         lib = lower_is_better(o)
@@ -157,6 +168,7 @@ def compare_records(old, new, threshold_pct=5.0):
         "regressions": regressions,
         "missing": missing,
         "new_lanes": sorted(set(new) - set(old)),
+        "backend_skipped": backend_skipped,
         "ok": not regressions,
         "threshold_pct": float(threshold_pct),
     }
@@ -221,8 +233,16 @@ def main(argv=None):
 
     print(f"bench_compare: {old_path} -> {new_path} "
           f"(threshold {args.threshold:g}%)")
-    result = compare_records(old, new, threshold_pct=args.threshold)
+    # trajectory mode diffs whatever two runs landed last in the dir —
+    # those can straddle backends (a CPU smoke next to a TPU run), so
+    # per-lane backend stamps gate each pair; explicit OLD NEW compares
+    # exactly what the caller asked for
+    result = compare_records(old, new, threshold_pct=args.threshold,
+                             backend_skip=bool(args.trajectory_dir))
     print(format_table(result))
+    if result["backend_skipped"]:
+        print("bench_compare: skipped (backend stamps differ): "
+              + ", ".join(result["backend_skipped"]))
     if result["missing"] and not args.ignore_missing:
         print(f"bench_compare: lanes missing from {new_path}: "
               f"{', '.join(result['missing'])} (pass --ignore-missing "

@@ -8,13 +8,17 @@ Variants:
                 the substitute has no per-channel affine traffic at all
   bnfrozen    — BN with is_test=True (running stats; no reduction pass)
 
-Usage: python tools/bench_variants.py [--steps 24] [--batch 256] [--which all]
+Timing rides the kernel autotuner's shared measurement core
+(paddle_tpu.ops.autotune.measure): interleaved best-of-N windows across
+all requested variants.
+
+Usage: python tools/bench_variants.py [--steps 8] [--windows 3]
+       [--batch 256] [--which all]
 """
 
 import argparse
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -59,7 +63,11 @@ def build_variant(batch, image_size, class_dim, variant):
     return main, startup, avg_loss
 
 
-def run_variant(variant, batch, steps, warmup):
+def build_runner(variant, batch):
+    """Zero-arg timed step closure for one variant — what the shared
+    measurement core (paddle_tpu.ops.autotune.measure) times. Startup
+    runs here, once; the first measured call absorbs the jit compile as
+    the measurement core's per-runner warmup call."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu.fluid as fluid
@@ -80,31 +88,45 @@ def run_variant(variant, batch, steps, warmup):
     exe = fluid.Executor(mode="jit", donate=(variant != "fwd"), amp=True)
     with jax.default_matmul_precision("bfloat16"):
         exe.run(startup, scope=scope)
-        for i in range(warmup):
-            v = exe.run(main_prog, feed=feeds[i % 2], fetch_list=[avg_loss],
-                        scope=scope)
-        t0 = time.perf_counter()
-        for i in range(steps):
+    state = {"i": 0}
+
+    def run():
+        i = state["i"]
+        state["i"] += 1
+        with jax.default_matmul_precision("bfloat16"):
             v = exe.run(main_prog, feed=feeds[i % 2], fetch_list=[avg_loss],
                         scope=scope, return_numpy=False)
-        np.asarray(v[0])
-        dt = (time.perf_counter() - t0) / steps
-    return dt
+        return v[0]
+    return run
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=24)
-    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=8,
+                    help="steps per timing window")
+    ap.add_argument("--windows", type=int, default=3,
+                    help="best-of-N windows per variant (interleaved "
+                         "across variants)")
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--which", default="all")
     args = ap.parse_args()
 
+    # timing rides the autotuner's measurement core: ONE interleaved
+    # best-of-N implementation in the tree (ops/autotune.measure), so
+    # drift hits every variant's windows equally instead of biasing
+    # whichever variant ran last
+    from paddle_tpu.ops.autotune import measure
+
     variants = ["full", "fwd", "bnfrozen", "nobn"] if args.which == "all" \
         else args.which.split(",")
+    runners = {v: build_runner(v, args.batch) for v in variants}
+    times = measure(runners, repeats=args.windows, inner=args.steps)
     for v in variants:
-        dt = run_variant(v, args.batch, args.steps, args.warmup)
-        print(f"{v:10s} {dt*1e3:8.2f} ms/step  "
+        if v not in times:
+            print(f"{v:10s} failed to run", flush=True)
+            continue
+        dt = times[v] / 1e3
+        print(f"{v:10s} {times[v]:8.2f} ms/step  "
               f"({args.batch/dt:.0f} img/s)", flush=True)
 
 

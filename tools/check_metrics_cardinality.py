@@ -62,9 +62,14 @@ BOUNDED_LABELS = {
             "identity rides the CompileRecord, never a label",
     "reason": "artifact reject reasons — the fixed enums "
               "serving.execcache.REJECT_REASONS (format/manifest/"
-              "fingerprint/deserialize/run_failed) and "
+              "fingerprint/deserialize/run_failed), "
               "serving.generate.kvstore.REJECT_REASONS (format/"
-              "manifest/fingerprint/deserialize)",
+              "manifest/fingerprint/deserialize) and "
+              "ops.autotune.REJECT_REASONS (format/manifest/"
+              "fingerprint/deserialize)",
+    "variant": "registered kernel variant names — the fixed code-site "
+               "set ops.autotune.VARIANTS registers (jnp/pallas/"
+               "pallas_db/pallas_bf16)",
     "device": "local jax devices (platform:id) — bounded by the "
               "attached hardware",
 }
@@ -91,6 +96,7 @@ def registered_families():
     import paddle_tpu.online.pool           # noqa: F401
     import paddle_tpu.online.rollout        # noqa: F401
     import paddle_tpu.online.trainer        # noqa: F401
+    import paddle_tpu.ops.autotune          # noqa: F401
     import paddle_tpu.ops.pallas            # noqa: F401
     import paddle_tpu.serving.batcher       # noqa: F401
     import paddle_tpu.serving.engine        # noqa: F401
