@@ -2498,6 +2498,320 @@ def run_reload_storm_serving_lane(n_clients=8, max_seqs=8, vocab=64,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_multi_tenant_serving_lane(noisy_threads=4, quiet_requests=200,
+                                  feature_dim=64, hidden=512, depth=2,
+                                  classes=8, buckets="1,2,4",
+                                  max_delay_ms=2.0, quota_rate=5.0,
+                                  quota_burst=5, attempts=3,
+                                  ratio_gate=1.3, spike_threads=8,
+                                  spike_min_requests=40, poll_s=0.25,
+                                  depth_objective=1.5,
+                                  startup_timeout=240.0):
+    """The multi-tenant fleet milestone, both halves of the loop.
+
+    Phase A (noisy neighbor, in-process): one FleetClient with router-
+    side TenantQuotas serves two tenants — ``noisy_threads`` hammering
+    past a small token-bucket budget (every reject surfaces as the TYPED
+    QuotaExceeded and backs off by its retry ETA; rejects must never
+    bump failovers/spillovers — a quota reject is a policy decision, not
+    replica trouble) while the unlimited ``quiet`` tenant measures its
+    p99. Gate: quiet p99 <= ``ratio_gate`` x a solo-baseline p99
+    (best-of-``attempts`` — CPU boxes are noisy), zero failovers.
+
+    Phase B (burn-rate -> replica-count, spawned fleet): a 1-replica
+    FleetSupervisor under a FleetAutoscaler whose queue-depth SLO rule
+    breaches during a ``spike_threads``-client spike; the autoscaler
+    pre-warms the registry version and spawns a canary-gated replica
+    that the routers join via ``add_replica``; when the spike ends the
+    burn window clears and the autoscaler records recovery. Gates: ONE
+    scale-out, zero canary failures, post-recovery p99 back near steady,
+    and the breach + scale-out decision + recovery flight events all in
+    ONE incident bundle."""
+    import os
+    import tempfile
+    import shutil
+    import threading
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.profiler import percentile
+    from paddle_tpu.distributed import RetryPolicy
+    from paddle_tpu.obs.recorder import IncidentCollector
+    from paddle_tpu.serving import (FleetAutoscaler, FleetClient,
+                                    FleetSupervisor, ModelRegistry,
+                                    ModelServer, QuotaExceeded,
+                                    TenantQuotas)
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data("x", shape=[feature_dim])
+        h = x
+        for _ in range(depth):
+            h = fluid.layers.fc(input=h, size=hidden, act="relu")
+        y = fluid.layers.fc(input=h, size=classes, act="softmax")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    root = tempfile.mkdtemp(prefix="pdtpu-mt-")
+    export_dir = os.path.join(root, "export")
+    fluid.io.save_inference_model(export_dir, ["x"], [y], exe, main_p,
+                                  scope=scope)
+    rng = np.random.RandomState(0)
+    row = rng.normal(0, 1, (1, feature_dim)).astype("float32")
+
+    # ---- phase A: noisy neighbor vs quota-protected quiet tenant ----
+    def solo_p99():
+        server = ModelServer(export_dir, buckets=buckets,
+                             max_delay_ms=max_delay_ms)
+        server.start()
+        try:
+            fc = FleetClient([server.address], retry=None)
+            try:
+                fc.infer({"x": row})          # warm the connection
+                lats = []
+                for _ in range(quiet_requests):
+                    t0 = time.perf_counter()
+                    fc.infer({"x": row}, tenant="quiet")
+                    lats.append(time.perf_counter() - t0)
+                return percentile(lats, 99) * 1e3
+            finally:
+                fc.close()
+        finally:
+            server.shutdown()
+
+    def contended():
+        server = ModelServer(export_dir, buckets=buckets,
+                             max_delay_ms=max_delay_ms)
+        server.start()
+        quotas = TenantQuotas(rate=quota_rate, burst=quota_burst,
+                              overrides={"quiet": (0.0, 1)})
+        fc = FleetClient([server.address], retry=None, quotas=quotas)
+        stop = threading.Event()
+        noisy_stats = {"sent": 0, "rejected": 0, "errs": []}
+        nlock = threading.Lock()
+
+        def noisy():
+            while not stop.is_set():
+                try:
+                    fc.infer({"x": row}, tenant="noisy")
+                    with nlock:
+                        noisy_stats["sent"] += 1
+                except QuotaExceeded as e:
+                    with nlock:
+                        noisy_stats["rejected"] += 1
+                    # a WELL-BEHAVED client backs off by the reject's
+                    # refill ETA; cap it so shutdown stays snappy
+                    stop.wait(min(e.retry_after_s or 0.0, 0.05))
+                except Exception as e:
+                    with nlock:
+                        noisy_stats["errs"].append(e)
+                    return
+        try:
+            fc.infer({"x": row})              # warm the connection
+            ts = [threading.Thread(target=noisy)
+                  for _ in range(noisy_threads)]
+            for t in ts:
+                t.start()
+            lats = []
+            for _ in range(quiet_requests):
+                t0 = time.perf_counter()
+                fc.infer({"x": row}, tenant="quiet")
+                lats.append(time.perf_counter() - t0)
+            stop.set()
+            for t in ts:
+                t.join()
+            st = fc.fleet_stats(include_server_stats=False)
+            assert not noisy_stats["errs"], \
+                f"noisy clients failed: {noisy_stats['errs'][:2]}"
+            assert noisy_stats["rejected"] > 0, \
+                "the noisy tenant was never quota-limited"
+            assert st["failovers"] == 0 and st["spillovers"] == 0, \
+                f"quota rejects leaked into failover/spillover: {st}"
+            assert st["quota_rejects"] == noisy_stats["rejected"]
+            return percentile(lats, 99) * 1e3, dict(noisy_stats), st
+        finally:
+            stop.set()
+            fc.close()
+            server.shutdown()
+
+    best = None
+    for _ in range(max(1, attempts)):
+        base = solo_p99()
+        quiet_p99, noisy_stats, router_stats = contended()
+        ratio = quiet_p99 / base if base > 0 else float("inf")
+        if best is None or ratio < best["ratio"]:
+            best = {"ratio": ratio, "quiet_p99_ms": quiet_p99,
+                    "solo_p99_ms": base, "noisy": noisy_stats,
+                    "quota_rejects": router_stats["quota_rejects"]}
+        if ratio <= ratio_gate:
+            break
+    assert best["ratio"] <= ratio_gate, \
+        f"quiet tenant p99 {best['quiet_p99_ms']:.2f} ms is " \
+        f"{best['ratio']:.2f}x its solo baseline " \
+        f"{best['solo_p99_ms']:.2f} ms (gate {ratio_gate}x)"
+
+    # ---- phase B: burn-rate breach -> warm scale-out -> recovery ----
+    registry = ModelRegistry(os.path.join(root, "registry"))
+    v1 = registry.publish("mlp", export_dir)
+    new_addresses = []       # scale-outs the hammer clients must join
+    addr_lock = threading.Lock()
+
+    def hammer(addresses, n_threads, stop, lats, min_requests=0):
+        errs = []
+
+        def client(i):
+            fc = FleetClient(list(addresses),
+                             retry=RetryPolicy(max_retries=10,
+                                               backoff_base_s=0.05,
+                                               backoff_max_s=0.5))
+            try:
+                fc.infer({"x": row})
+                k = 0
+                while True:
+                    with addr_lock:
+                        for a in new_addresses:
+                            fc.add_replica(a)
+                    t0 = time.perf_counter()
+                    fc.infer({"x": row})
+                    lats.append((t0, time.perf_counter() - t0))
+                    k += 1
+                    if stop.is_set() and k >= min_requests:
+                        return
+            except Exception as e:
+                errs.append((i, e))
+            finally:
+                fc.close()
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        return ts, errs
+
+    try:
+        with FleetSupervisor(registry.root, "mlp", version=v1,
+                             n_replicas=1, buckets=buckets,
+                             max_delay_ms=max_delay_ms) as sup:
+            assert sup.wait_ready(startup_timeout), "fleet never ready"
+            collector = IncidentCollector(
+                addresses_fn=lambda: [tuple(a) for a in sup.addresses],
+                cooldown_s=2.0)
+            from paddle_tpu.obs.slo import SloRule
+            asc = FleetAutoscaler(
+                sup, min_replicas=1, max_replicas=2, poll_s=poll_s,
+                idle_polls=10 ** 6,      # the lane owns scale-in timing
+                warm_kwargs=dict(buckets=buckets),
+                canary_timeout_s=startup_timeout,
+                on_breach=collector.trigger,
+                rules=[SloRule("serving_fleet_queue_depth",
+                               metric="paddle_tpu_server_queue_depth",
+                               objective=float(depth_objective),
+                               reducer="value", agg="sum",
+                               windows=((max(2.0 * poll_s, 1.0), 1.0),))])
+
+            # steady state: light traffic, baseline p99
+            steady_lats = []
+            stop_steady = threading.Event()
+            ts, errs = hammer(sup.addresses, 2, stop_steady, steady_lats,
+                              min_requests=20)
+            time.sleep(1.0)
+            stop_steady.set()
+            for t in ts:
+                t.join()
+            assert not errs, f"steady clients failed: {errs[:2]}"
+            p99_steady = percentile([d for _, d in steady_lats], 99) * 1e3
+
+            # spike: oversubscribe the single replica until the
+            # queue-depth rule burns and the autoscaler scales out
+            spike_lats = []
+            stop_spike = threading.Event()
+            ts, errs = hammer(sup.addresses, spike_threads, stop_spike,
+                              spike_lats,
+                              min_requests=spike_min_requests)
+            scaled_at = None
+            deadline = time.monotonic() + startup_timeout
+            while time.monotonic() < deadline:
+                asc.poll_once()
+                s = asc.stats()
+                if s["scale_ups"] >= 1 and scaled_at is None:
+                    scaled_at = time.perf_counter()
+                    with addr_lock:
+                        new_addresses.append(tuple(sup.addresses[-1]))
+                    break
+                time.sleep(poll_s)
+            assert scaled_at is not None, \
+                f"spike never drove a scale-out: {asc.stats()}"
+            # give the 2-replica fleet a moment of spike traffic, then
+            # end the spike; the burn window clears -> recovery
+            time.sleep(max(1.0, 2.0 * poll_s))
+            stop_spike.set()
+            recovered_at = None
+            deadline = time.monotonic() + startup_timeout
+            while time.monotonic() < deadline:
+                asc.poll_once()
+                if not asc.stats()["breach_active"]:
+                    recovered_at = time.perf_counter()
+                    break
+                time.sleep(poll_s)
+            for t in ts:
+                t.join()
+            assert not errs, f"spike clients failed under scale-out: " \
+                             f"{errs[:2]}"
+            assert recovered_at is not None, \
+                f"SLO never recovered after the spike: {asc.stats()}"
+            s = asc.stats()
+            assert s["scale_ups"] == 1 and s["canary_failures"] == 0
+            assert len(sup.addresses) == 2
+
+            # post-recovery p99: near steady again
+            post_lats = []
+            stop_post = threading.Event()
+            ts, errs = hammer(sup.addresses, 2, stop_post, post_lats,
+                              min_requests=20)
+            time.sleep(1.0)
+            stop_post.set()
+            for t in ts:
+                t.join()
+            assert not errs, f"post-recovery clients failed: {errs[:2]}"
+            p99_post = percentile([d for _, d in post_lats], 99) * 1e3
+            spike_only = [d for t0, d in spike_lats
+                          if scaled_at is None or t0 < scaled_at]
+            p99_spike = percentile(spike_only, 99) * 1e3
+            assert p99_post <= max(1.5 * p99_steady, 0.8 * p99_spike), \
+                f"p99 never recovered: steady {p99_steady:.2f} ms, " \
+                f"spike {p99_spike:.2f} ms, post {p99_post:.2f} ms"
+
+            # ONE bundle carries the whole arc: breach + scale-out
+            # decision + recovery (the local recorder ring holds all
+            # three by capture time)
+            collector.wait_idle(20.0)
+            bundle = collector.capture("scale_cycle")
+            kinds = {e["kind"] for e in bundle["events"]
+                     if e["source"] == "local"}
+            for want in ("slo_breach", "scale_out", "slo_recovered"):
+                assert want in kinds, \
+                    f"incident bundle missing {want!r}: {sorted(kinds)}"
+            breach_bundles = [b for b in collector.bundles
+                              if b["reason"] == "breach"]
+            assert breach_bundles, "the SLO breach never auto-captured"
+            return {
+                "quiet_p99_ms": best["quiet_p99_ms"],
+                "solo_p99_ms": best["solo_p99_ms"],
+                "isolation_ratio": best["ratio"],
+                "quota_rejects": best["quota_rejects"],
+                "noisy_admitted": best["noisy"]["sent"],
+                "noisy_rejected": best["noisy"]["rejected"],
+                "steady_p99_ms": p99_steady,
+                "spike_p99_ms": p99_spike,
+                "post_recovery_p99_ms": p99_post,
+                "scale_out_to_recovery_s": recovered_at - scaled_at,
+                "scale_ups": s["scale_ups"],
+                "canary_failures": s["canary_failures"],
+                "incident_bundle_kinds": sorted(kinds),
+            }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _best_of(run_fn, label, repeats, **kw):
     """Best-of-N jnp and Pallas timings for one RNN lane; the shared dev
     chip shows large run-to-run variance (8.7..14.4 ms for the identical
@@ -2794,6 +3108,34 @@ def main():
         # zero hot recompiles
         "kv_restores": rs["kv_restores"],
         "hot_recompiles": rs["hot_recompiles"],
+    })))
+
+    # ---- multi-tenant serving lane (quota isolation + SLO-driven
+    # autoscaling) ----
+    mt_kw = dict(quiet_requests=120, spike_min_requests=20,
+                 attempts=3) if args.smoke else {}
+    mt = run_multi_tenant_serving_lane(**mt_kw)
+    print(json.dumps(_rec({
+        "metric": "multi_tenant_serving" + ("_smoke" if args.smoke else ""),
+        "value": round(mt["quiet_p99_ms"], 2),
+        "unit": "ms quiet-tenant p99 beside a quota-throttled noisy "
+                "neighbor (lower is better; gate <= 1.3x solo baseline "
+                "asserted in-lane; quota rejects typed, zero failovers)",
+        # higher-is-better context: the quiet/solo isolation ratio the
+        # lane gates on, plus the burn-rate -> scale-out -> recovery arc
+        "isolation_ratio": round(mt["isolation_ratio"], 3),
+        "solo_p99_ms": round(mt["solo_p99_ms"], 2),
+        "quota_rejects": mt["quota_rejects"],
+        "noisy_rejected": mt["noisy_rejected"],
+        "steady_p99_ms": round(mt["steady_p99_ms"], 2),
+        "spike_p99_ms": round(mt["spike_p99_ms"], 2),
+        "post_recovery_p99_ms": round(mt["post_recovery_p99_ms"], 2),
+        "scale_out_to_recovery_s": round(mt["scale_out_to_recovery_s"], 2),
+        # asserted in-lane: exactly one warm scale-out, zero canary
+        # failures, breach + scale-out + recovery in ONE incident bundle
+        "scale_ups": mt["scale_ups"],
+        "canary_failures": mt["canary_failures"],
+        "incident_bundle_kinds": mt["incident_bundle_kinds"],
     })))
 
     # ---- fused-kernel microbench lane (Pallas kernel tier milestone) ----

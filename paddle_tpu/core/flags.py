@@ -326,6 +326,60 @@ DEFINE_flag("serving_max_seqs", 8,
             "tables and an active mask, so this is a capacity knob, "
             "never a retrace trigger")
 
+DEFINE_flag("serving_max_models", 4,
+            "bound on engines a multi-model ModelServer hosts at once: "
+            "adding a model past the budget evicts the least-recently-"
+            "used IDLE hosted model first (a model with in-flight "
+            "requests is never an eviction candidate, and the server's "
+            "default model never evicts); when every candidate is busy "
+            "the add fails typed instead of over-committing arena memory")
+
+DEFINE_flag("serving_tenant_rate", 0.0,
+            "default per-tenant request rate (tokens per second) for "
+            "serving TenantQuotas buckets. Each request spends one "
+            "token; an empty bucket rejects typed with QuotaExceeded "
+            "carrying the refill ETA — and quota rejects never trigger "
+            "router failover/spillover (the request is over budget on "
+            "every replica). <= 0 (default) means unlimited unless a "
+            "tenant has an explicit override")
+
+DEFINE_flag("serving_tenant_burst", 0,
+            "default per-tenant token-bucket ceiling for serving "
+            "TenantQuotas: how many requests a tenant can burst above "
+            "its steady rate. 0 (default) derives ceil(rate) so a "
+            "configured rate always admits at least one request")
+
+DEFINE_flag("serving_tenant_label_cap", 16,
+            "bound on distinct tenant ids mirrored into the "
+            "paddle_tpu_tenant_* metric label set per TenantQuotas "
+            "instance: tenant ids arrive off the wire, so past the cap "
+            "(or for a non-identifier name) the label funnels into "
+            "__other__ exactly like RPC method names — quota "
+            "ENFORCEMENT stays exact per tenant either way")
+
+DEFINE_flag("serving_autoscale_min_replicas", 1,
+            "floor the serving FleetAutoscaler never scales below: "
+            "idle polls retire replicas one at a time down to this "
+            "count and no further")
+
+DEFINE_flag("serving_autoscale_max_replicas", 4,
+            "ceiling the serving FleetAutoscaler never scales above: "
+            "a burning SLO rule spawns replicas one canary-gated step "
+            "at a time up to this count and no further")
+
+DEFINE_flag("serving_autoscale_queue_depth", 8.0,
+            "objective for the FleetAutoscaler's default SLO rule: the "
+            "fleet-summed paddle_tpu_server_queue_depth a replica set "
+            "should stay under. Sustained burn over the rule's windows "
+            "triggers a warm scale-out; zero depth with zero burn "
+            "counts toward scale-in idle polls")
+
+DEFINE_flag("serving_autoscale_idle_polls", 3,
+            "consecutive idle FleetAutoscaler polls (no burning rule, "
+            "empty fleet queues) before ONE replica is retired — "
+            "scale-in damping so a burst lull doesn't thrash the fleet "
+            "(the BacklogAutoscaler precedent, serving-side)")
+
 DEFINE_flag("verify_passes", False,
             "make every program-transforming pass (append_backward, "
             "DistributeTranspiler, memory_optimize/release_memory, "

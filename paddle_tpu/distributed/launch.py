@@ -165,6 +165,7 @@ class ChildSupervisor:
             heartbeat_timeout_s = min(5.0, float(get_flag("rpc_timeout_s")))
 
         self._ctx = mp.get_context(mp_start_method)
+        self._host = host
         self.addresses = [(host, free_port()) for _ in range(n_children)]
         # per-child restart counters in the obs.metrics registry, labeled
         # by a process-unique supervisor instance id (concrete class +
@@ -228,14 +229,92 @@ class ChildSupervisor:
     # ---- supervision loop ----
     def _spawn(self, i):
         with self._spawn_lock:
+            self._spawn_locked(i)
+
+    def _spawn_locked(self, i):
+        if self._stop.is_set():
+            return
+        target, args = self._child_spec(i)
+        p = self._ctx.Process(target=target, args=args, daemon=True)
+        p.start()
+        self._procs[i] = p
+        self._hb_failures[i] = 0
+        self._spawned_at[i] = time.monotonic()
+
+    # ---- dynamic membership (the serving autoscaler's lever) ----
+    def add_child(self):
+        """Grow the fleet by ONE supervised child on a fresh fixed
+        address: every parallel per-child structure gains its slot under
+        the spawn lock (the monitor reads lengths per sweep and skips
+        half-built slots via the IndexError guard), then the child spawns
+        like any other. Returns the new child's ``(host, port)``."""
+        with self._spawn_lock:
             if self._stop.is_set():
-                return
-            target, args = self._child_spec(i)
-            p = self._ctx.Process(target=target, args=args, daemon=True)
-            p.start()
-            self._procs[i] = p
-            self._hb_failures[i] = 0
-            self._spawned_at[i] = time.monotonic()
+                raise RuntimeError(f"{self.obs_instance} is stopped; "
+                                   "cannot add a child")
+            i = len(self._procs)
+            self.addresses.append((self._host, free_port()))
+            self._m_restarts.append(_M_RESTARTS.labels(
+                supervisor=self.obs_instance, child=str(i)))
+            self.last_restart_at.append(None)
+            self.last_restart_reason.append(None)
+            self._hb_failures.append(0)
+            with self._hb_lock:
+                self._hb_clients.append(None)
+            self._spawned_at.append(0.0)
+            # _procs grows LAST: a monitor sweep that sees the new length
+            # finds every sibling list already long enough
+            self._procs.append(None)
+            self._spawn_locked(i)
+            address = self.addresses[i]
+        _flight_record("child_added", component=self.obs_instance,
+                       child=i, address=tuple(address))
+        return tuple(address)
+
+    def retire_child(self, timeout=10.0):
+        """Shrink the fleet by ONE child — always the HIGHEST index, so
+        surviving children keep their indices (and their addresses, and
+        any client placement keyed on them). The slot is nulled first
+        (the monitor skips None and its restart path re-checks slot
+        identity), the child terminated and joined, then every parallel
+        list pops its tail. Returns the retired child's address."""
+        with self._spawn_lock:
+            i = len(self._procs) - 1
+            if i < 0:
+                raise RuntimeError(f"{self.obs_instance} has no children "
+                                   "to retire")
+            p = self._procs[i]
+            self._procs[i] = None    # monitor skips None from here on
+            address = tuple(self.addresses[i])
+        with self._hb_lock:
+            c = self._hb_clients[i]
+            self._hb_clients[i] = None
+        if c is not None:
+            c.close()
+        if p is not None and p.is_alive():
+            p.terminate()
+        if p is not None:
+            p.join(timeout)
+        with self._spawn_lock:
+            # pop the tail slot from every parallel list — only if no
+            # concurrent add_child grew past it (then the lists stay; the
+            # retired slot just remains a permanent None, still skipped)
+            if i == len(self._procs) - 1:
+                self._procs.pop()
+                self.addresses.pop()
+                self._m_restarts.pop()
+                self.last_restart_at.pop()
+                self.last_restart_reason.pop()
+                self._hb_failures.pop()
+                self._spawned_at.pop()
+                with self._hb_lock:
+                    if len(self._hb_clients) > i:
+                        c2 = self._hb_clients.pop()
+                        if c2 is not None:
+                            c2.close()
+        _flight_record("child_retired", component=self.obs_instance,
+                       child=i, address=address)
+        return address
 
     def _heartbeat_ok(self, i):
         from .rpc import RpcClient
@@ -255,67 +334,89 @@ class ChildSupervisor:
     def _watch(self):
         while not self._stop.wait(self._interval):
             for i in range(len(self._procs)):
-                p = self._procs[i]
-                if self._stop.is_set() or p is None:
-                    continue
-                wedged = False
-                if p.is_alive():
-                    if self._heartbeat_ok(i):
-                        self._hb_failures[i] = 0
-                        continue
-                    if (time.monotonic() - self._spawned_at[i]
-                            < self._grace):
-                        continue   # still starting up: misses don't count
-                    self._hb_failures[i] += 1
-                    if self._hb_failures[i] < self._hb_misses_allowed:
-                        continue
-                    p.terminate()  # alive but not answering: wedged
-                    wedged = True
-                p.join()
-                reason = "wedged: no heartbeat" if wedged \
-                    else f"exited code {p.exitcode}"
-                self.last_restart_reason[i] = reason
-                print(f"[{self.obs_instance}] child {i} "
-                      f"{self.addresses[i]} {reason}", file=sys.stderr,
-                      flush=True)
-                if self._stop.is_set():
-                    return
-                if self.restarts[i] >= self._max_restarts:
-                    self._procs[i] = None  # crash-looping: give the child up
-                    continue
-                self._m_restarts[i].inc()
-                self.last_restart_at[i] = time.time()
-                # flight recorder: a dead child with no WHY is
-                # undebuggable — the restart and its reason land in this
-                # process's ring (and, via incident_hook, trigger a
-                # fleet-wide bundle capture)
-                _flight_record(
-                    "child_restart", component=self.obs_instance,
-                    child=i, address=tuple(self.addresses[i]),
-                    reason=reason, restart_count=self.restarts[i])
-                if self.incident_hook is not None:
-                    try:
-                        self.incident_hook(
-                            "child_restart",
-                            detail={"supervisor": self.obs_instance,
-                                    "child": i, "reason": reason})
-                    except Exception:
-                        pass             # monitoring never kills the monitor
                 try:
-                    self._spawn(i)
-                except Exception as e:
-                    # _child_spec can now fail at RESPAWN time (e.g. the
-                    # fleet's registry version was deleted out-of-band);
-                    # give this child up loudly instead of letting the
-                    # exception kill the monitor thread and silently end
-                    # supervision for every OTHER child
-                    import warnings
-                    warnings.warn(
-                        f"ChildSupervisor: respawn of child {i} failed "
-                        f"({type(e).__name__}: {e}); giving it up")
-                    self._procs[i] = None
+                    if self._watch_one(i):
+                        return
+                except IndexError:
+                    # the fleet shrank under this sweep (retire_child
+                    # popped the tail): nothing to supervise at i anymore
+                    continue
+
+    def _watch_one(self, i):
+        """One sweep's supervision of child ``i``; returns True when the
+        monitor should exit (stop() raced a restart)."""
+        p = self._procs[i]
+        if self._stop.is_set() or p is None:
+            return False
+        wedged = False
+        if p.is_alive():
+            if self._heartbeat_ok(i):
+                self._hb_failures[i] = 0
+                return False
+            if (time.monotonic() - self._spawned_at[i]
+                    < self._grace):
+                return False   # still starting up: misses don't count
+            self._hb_failures[i] += 1
+            if self._hb_failures[i] < self._hb_misses_allowed:
+                return False
+            p.terminate()  # alive but not answering: wedged
+            wedged = True
+        p.join()
+        if self._procs[i] is not p:
+            # the slot changed hands while we watched this incarnation
+            # die (retire_child nulled it): not ours to restart
+            return False
+        reason = "wedged: no heartbeat" if wedged \
+            else f"exited code {p.exitcode}"
+        self.last_restart_reason[i] = reason
+        print(f"[{self.obs_instance}] child {i} "
+              f"{self.addresses[i]} {reason}", file=sys.stderr,
+              flush=True)
+        if self._stop.is_set():
+            return True
+        if self.restarts[i] >= self._max_restarts:
+            self._procs[i] = None  # crash-looping: give the child up
+            return False
+        self._m_restarts[i].inc()
+        self.last_restart_at[i] = time.time()
+        # flight recorder: a dead child with no WHY is
+        # undebuggable — the restart and its reason land in this
+        # process's ring (and, via incident_hook, trigger a
+        # fleet-wide bundle capture)
+        _flight_record(
+            "child_restart", component=self.obs_instance,
+            child=i, address=tuple(self.addresses[i]),
+            reason=reason, restart_count=self.restarts[i])
+        if self.incident_hook is not None:
+            try:
+                self.incident_hook(
+                    "child_restart",
+                    detail={"supervisor": self.obs_instance,
+                            "child": i, "reason": reason})
+            except Exception:
+                pass             # monitoring never kills the monitor
+        try:
+            self._spawn(i)
+        except Exception as e:
+            # _child_spec can now fail at RESPAWN time (e.g. the
+            # fleet's registry version was deleted out-of-band);
+            # give this child up loudly instead of letting the
+            # exception kill the monitor thread and silently end
+            # supervision for every OTHER child
+            import warnings
+            warnings.warn(
+                f"ChildSupervisor: respawn of child {i} failed "
+                f"({type(e).__name__}: {e}); giving it up")
+            self._procs[i] = None
+        return False
 
     # ---- operator surface ----
+    @property
+    def n_children(self):
+        """Live fleet size (add_child/retire_child move it)."""
+        with self._spawn_lock:
+            return len(self._procs)
+
     def child_stats(self):
         """Per-child supervision counters: ``[{address, alive,
         restart_count, last_restart_at, gave_up}]`` — ``gave_up`` marks a
@@ -324,15 +425,18 @@ class ChildSupervisor:
         the pserver and serving-fleet supervisors."""
         out = []
         for i in range(len(self.addresses)):
-            p = self._procs[i]
-            out.append({
-                "address": tuple(self.addresses[i]),
-                "alive": p is not None and p.is_alive(),
-                "restart_count": self.restarts[i],
-                "last_restart_at": self.last_restart_at[i],
-                "last_restart_reason": self.last_restart_reason[i],
-                "gave_up": p is None,
-            })
+            try:
+                p = self._procs[i]
+                out.append({
+                    "address": tuple(self.addresses[i]),
+                    "alive": p is not None and p.is_alive(),
+                    "restart_count": self.restarts[i],
+                    "last_restart_at": self.last_restart_at[i],
+                    "last_restart_reason": self.last_restart_reason[i],
+                    "gave_up": p is None,
+                })
+            except IndexError:
+                break    # the fleet shrank mid-walk (retire_child)
         return out
 
     def child_alive(self, i):
@@ -353,10 +457,14 @@ class ChildSupervisor:
         (or post-restart) barrier callers want before sending work."""
         deadline = time.monotonic() + timeout
         for i in range(len(self.addresses)):
-            while self._procs[i] is not None and not self._heartbeat_ok(i):
-                if time.monotonic() > deadline:
-                    return False
-                time.sleep(0.05)
+            try:
+                while self._procs[i] is not None \
+                        and not self._heartbeat_ok(i):
+                    if time.monotonic() > deadline:
+                        return False
+                    time.sleep(0.05)
+            except IndexError:
+                break    # the fleet shrank mid-wait (retire_child)
         return True
 
     def stop(self):

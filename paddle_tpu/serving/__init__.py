@@ -38,21 +38,40 @@ On top of the single server sits the fleet control plane:
   ``<version>/warm/``) keyed by a full identity fingerprint, so
   scale-out replicas, crash restarts and rollout reloads LOAD in
   milliseconds instead of recompiling.
+
+The multi-tenant plane turns that stack into a fleet product:
+
+* Multi-model hosting (server.py) — one :class:`ModelServer` hosts N
+  engines keyed by model name (feed-forward and generative side by
+  side) behind the same RPC endpoint via a ``model=`` field, with a
+  refcount-aware LRU evictor bounding the per-replica budget
+  (``serving_max_models``).
+* :class:`TenantQuotas` / :class:`QuotaExceeded` (batcher.py) —
+  per-tenant token-bucket admission, enforced at the router and/or
+  server, carried over the wire as a structured code exactly like
+  :class:`ServerOverloaded`; quota rejects never trigger failover.
+* :class:`FleetAutoscaler` (autoscale.py) — closes the SLO burn-rate →
+  replica-count loop: judges fleet metrics with SloMonitor windows,
+  scales out one canary-gated warm replica per breach, scales in on
+  sustained idle.
 """
 
 from .execcache import ExecCache
 from .engine import InferenceEngine
-from .batcher import DynamicBatcher, ServerOverloaded
+from .batcher import (DynamicBatcher, QuotaExceeded, ServerOverloaded,
+                      TenantQuotas)
 from .server import ModelServer
 from .client import InferClient
 from .registry import ModelRegistry
 from .fleet import CanaryFailed, FleetSupervisor
 from .router import FleetClient
+from .autoscale import FleetAutoscaler
 from .generate import (PagedKVCache, CacheExhausted, GenerationEngine,
                        NoFreeSlots, ContinuousBatcher, GenClient)
 
 __all__ = ["InferenceEngine", "DynamicBatcher", "ServerOverloaded",
-           "ModelServer", "InferClient", "ModelRegistry", "ExecCache",
-           "FleetSupervisor", "CanaryFailed", "FleetClient",
+           "QuotaExceeded", "TenantQuotas", "ModelServer", "InferClient",
+           "ModelRegistry", "ExecCache", "FleetSupervisor", "CanaryFailed",
+           "FleetClient", "FleetAutoscaler",
            "PagedKVCache", "CacheExhausted", "GenerationEngine",
            "NoFreeSlots", "ContinuousBatcher", "GenClient"]

@@ -19,6 +19,8 @@ latency without bound (the reference's unbounded-queue collapse mode).
 
 from __future__ import annotations
 
+import math
+import re
 import threading
 import time
 from collections import deque
@@ -41,12 +43,163 @@ _M_BATCHES = _METRICS.counter(
     "paddle_tpu_batcher_batches",
     "coalesced batches dispatched by a DynamicBatcher, per instance",
     labels=("instance",))
+_M_QUEUE_DEPTH = _METRICS.gauge(
+    "paddle_tpu_server_queue_depth",
+    "requests currently waiting in a serving queue (DynamicBatcher or "
+    "ContinuousBatcher), per instance — updated on every enqueue/dequeue "
+    "so scrapes and fleet_metrics() read it O(1)",
+    labels=("instance",))
+_M_TENANT_REQUESTS = _METRICS.counter(
+    "paddle_tpu_tenant_requests",
+    "requests checked against a TenantQuotas bucket, by quota instance "
+    "and (capped, funneled) tenant label",
+    labels=("instance", "tenant"))
+_M_TENANT_REJECTED = _METRICS.counter(
+    "paddle_tpu_tenant_rejected",
+    "requests rejected with QuotaExceeded (tenant token bucket empty), "
+    "by quota instance and (capped, funneled) tenant label",
+    labels=("instance", "tenant"))
 
 
 class ServerOverloaded(RuntimeError):
     """The serving queue is full: reject-fast backpressure. Clients should
     back off (bounded exponential delay) and retry or shed the request —
     InferClient re-raises this type from the remote error string."""
+
+
+class QuotaExceeded(RuntimeError):
+    """A tenant's token-bucket quota is exhausted: the request is over
+    budget EVERYWHERE, so — unlike :class:`ServerOverloaded` — routers
+    must surface it without failover or spillover (another replica would
+    reject it identically). Carried over the wire as a structured code and
+    re-raised typed by the clients (see serving/client.py)."""
+
+    def __init__(self, message, tenant=None, retry_after_s=None):
+        super().__init__(message)
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+
+
+_TENANT_NAME_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_\-]*$")
+
+
+class TenantQuotas:
+    """Per-tenant token buckets: each tenant accrues ``rate`` tokens per
+    second up to a ``burst`` ceiling; a request spends one token or is
+    rejected typed with :class:`QuotaExceeded` carrying the refill ETA.
+
+    ``rate``/``burst`` default from the ``serving_tenant_rate`` /
+    ``serving_tenant_burst`` flags (rate <= 0 means UNLIMITED — every
+    tenant admits unless it has an explicit override). ``overrides`` maps
+    tenant name -> (rate, burst) for per-tenant budgets; an override rate
+    <= 0 makes that one tenant unlimited.
+
+    Tenant ids arrive off the WIRE, so the registry mirror funnels them
+    exactly like RPC method names: past ``serving_tenant_label_cap``
+    distinct tenants (or a non-identifier name) the per-tenant series
+    label collapses to ``__other__`` — a misbehaving caller inventing
+    tenant ids must never grow scrape-visible cardinality without bound.
+    ``stats()`` keeps the exact per-tenant view (it dies with the
+    instance)."""
+
+    def __init__(self, rate=None, burst=None, overrides=None,
+                 label_cap=None):
+        self.rate = float(get_flag("serving_tenant_rate")
+                          if rate is None else rate)
+        burst = int(get_flag("serving_tenant_burst")
+                    if burst is None else burst)
+        self.burst = burst if burst > 0 else max(1, int(math.ceil(
+            self.rate if self.rate > 0 else 1)))
+        self.overrides = {}
+        for tenant, spec in (overrides or {}).items():
+            r, b = spec
+            r = float(r)
+            b = int(b) if int(b) > 0 else max(1, int(math.ceil(
+                r if r > 0 else 1)))
+            self.overrides[str(tenant)] = (r, b)
+        self._label_cap = int(get_flag("serving_tenant_label_cap")
+                              if label_cap is None else label_cap)
+        self._lock = threading.Lock()
+        self._buckets = {}    # tenant -> [tokens, last_refill_monotonic]
+        self._rejected = {}   # tenant -> exact reject count
+        self._admitted = {}   # tenant -> exact admit count
+        self.obs_instance = next_instance("quotas")
+        self._m_tenant = {}   # tenant -> (requests child, rejected child)
+
+    # ------------------------------------------------------------------
+    def _limits(self, tenant):
+        return self.overrides.get(tenant, (self.rate, self.burst))
+
+    def _metric_children_locked(self, tenant):
+        mc = self._m_tenant.get(tenant)
+        if mc is None:
+            label = tenant if _TENANT_NAME_RE.match(tenant) \
+                and len(self._m_tenant) < self._label_cap else "__other__"
+            mc = self._m_tenant[tenant] = (
+                _M_TENANT_REQUESTS.labels(instance=self.obs_instance,
+                                          tenant=label),
+                _M_TENANT_REJECTED.labels(instance=self.obs_instance,
+                                          tenant=label))
+        return mc
+
+    def try_acquire(self, tenant, now=None):
+        """Spend one token from ``tenant``'s bucket. Returns
+        ``(admitted, retry_after_s)`` — ``retry_after_s`` is the time
+        until one token refills when rejected, else 0.0."""
+        tenant = str(tenant)
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            m_req, m_rej = self._metric_children_locked(tenant)
+            rate, burst = self._limits(tenant)
+            if rate <= 0:
+                self._admitted[tenant] = self._admitted.get(tenant, 0) + 1
+                admitted, retry = True, 0.0
+            else:
+                bucket = self._buckets.get(tenant)
+                if bucket is None:
+                    bucket = self._buckets[tenant] = [float(burst), now]
+                tokens, last = bucket
+                tokens = min(float(burst), tokens + (now - last) * rate)
+                bucket[1] = now
+                if tokens >= 1.0:
+                    bucket[0] = tokens - 1.0
+                    self._admitted[tenant] = \
+                        self._admitted.get(tenant, 0) + 1
+                    admitted, retry = True, 0.0
+                else:
+                    bucket[0] = tokens
+                    self._rejected[tenant] = \
+                        self._rejected.get(tenant, 0) + 1
+                    admitted, retry = False, (1.0 - tokens) / rate
+        m_req.inc()
+        if not admitted:
+            m_rej.inc()
+            _flight_record("quota_reject", component=self.obs_instance,
+                           tenant=tenant, retry_after_s=round(retry, 6))
+        return admitted, retry
+
+    def check(self, tenant):
+        """:meth:`try_acquire`, raising typed :class:`QuotaExceeded` on
+        rejection (the enforcement form servers and routers call)."""
+        admitted, retry = self.try_acquire(tenant)
+        if not admitted:
+            raise QuotaExceeded(
+                f"tenant {tenant!r} is over its request quota; retry "
+                f"after {retry:.3f}s", tenant=tenant, retry_after_s=retry)
+
+    def stats(self):
+        with self._lock:
+            tenants = sorted(set(self._admitted) | set(self._rejected))
+            out = {
+                "rate": self.rate,
+                "burst": self.burst,
+                "overrides": {t: {"rate": r, "burst": b}
+                              for t, (r, b) in self.overrides.items()},
+                "tenants": {t: {"admitted": self._admitted.get(t, 0),
+                                "rejected": self._rejected.get(t, 0)}
+                            for t in tenants},
+            }
+        return json_safe(out)
 
 
 class _Request:
@@ -93,6 +246,8 @@ class DynamicBatcher:
         self._m_requests = _M_REQUESTS.labels(instance=self.obs_instance)
         self._m_rejected = _M_REJECTED.labels(instance=self.obs_instance)
         self._m_batches = _M_BATCHES.labels(instance=self.obs_instance)
+        self._m_depth = _M_QUEUE_DEPTH.labels(instance=self.obs_instance)
+        self._m_depth.set(0)
         self._batch_hist = {}
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
@@ -133,6 +288,7 @@ class DynamicBatcher:
                     f"serving queue full ({self.capacity} requests "
                     "waiting); back off and retry")
             self._pending.append(req)
+            self._m_depth.set(len(self._pending))
             self._cv.notify_all()
         req.done.wait()
         if req.error is not None:
@@ -167,6 +323,7 @@ class DynamicBatcher:
                     r = self._pending.popleft()
                     batch.append(r)
                     total += r.n
+                self._m_depth.set(len(self._pending))
                 self._m_batches.inc()
                 self._batch_hist[total] = \
                     self._batch_hist.get(total, 0) + 1
@@ -242,6 +399,7 @@ class DynamicBatcher:
             # worker can never race these requests back out of it
             with self._cv:
                 stranded, self._pending = list(self._pending), deque()
+                self._m_depth.set(0)
             err = RuntimeError(
                 "DynamicBatcher is closed: the dispatch worker did not "
                 f"exit within {timeout}s (wedged run_batch); this queued "
@@ -252,4 +410,5 @@ class DynamicBatcher:
         return closed_clean
 
 
-__all__ = ["DynamicBatcher", "ServerOverloaded"]
+__all__ = ["DynamicBatcher", "QuotaExceeded", "ServerOverloaded",
+           "TenantQuotas"]

@@ -14,6 +14,9 @@ callers can program against it:
   rejected the request; back off (the client does NOT auto-retry
   overloads: retrying into a full queue is how collapse spreads). The
   fleet router keys its spillover-to-the-next-replica logic on this type.
+* :class:`~.batcher.QuotaExceeded` — the tenant's token-bucket quota is
+  exhausted; the request is over budget on EVERY replica, so the router
+  surfaces it without failover or spillover.
 * everything else re-raises as the RpcClient's usual errors
   (``RemoteError`` for handler exceptions, connection errors otherwise).
 """
@@ -22,16 +25,26 @@ from __future__ import annotations
 
 from ..distributed.rpc import (RemoteError, RetryPolicy, RpcClient,
                                WIRE_FRAMED)
-from .batcher import ServerOverloaded
+from .batcher import QuotaExceeded, ServerOverloaded
+
+# structured wire code -> client-side exception type: the ONE table the
+# typed re-raise reads, so a new typed serving condition is one row here
+# (server side just raises the type; RpcServer ships type(e).__name__ as
+# the code) instead of another hardwired special case
+WIRE_CODE_EXCEPTIONS = {
+    "ServerOverloaded": ServerOverloaded,
+    "QuotaExceeded": QuotaExceeded,
+}
 
 
 def raise_typed(e):
     """Re-raise a :class:`RemoteError` as its typed client-side form when
-    its structured code names one (``ServerOverloaded`` today) — the ONE
-    place the wire-code -> client-type mapping lives (InferClient and
+    its structured code names one (:data:`WIRE_CODE_EXCEPTIONS`) — the
+    ONE place the wire-code -> client-type mapping lives (InferClient and
     GenClient both route every remote failure through it)."""
-    if e.code == "ServerOverloaded":
-        raise ServerOverloaded(e.remote_message) from None
+    cls = WIRE_CODE_EXCEPTIONS.get(e.code)
+    if cls is not None:
+        raise cls(e.remote_message) from None
     raise e
 
 
@@ -54,11 +67,20 @@ class InferClient:
         except RemoteError as e:
             raise_typed(e)
 
-    def infer(self, feed):
+    def infer(self, feed, model=None, tenant=None):
         """One request; returns the fetch arrays for these rows. Raises
         :class:`ServerOverloaded` when the server rejected under
-        backpressure."""
-        return self._call("infer", feed=feed)
+        backpressure and :class:`QuotaExceeded` when ``tenant`` is over
+        its quota. ``model`` routes to a named hosted model on a
+        multi-model server; both default to None and are then OMITTED
+        from the wire call, keeping the single-model request shape
+        bitwise what it always was."""
+        kwargs = {"feed": feed}
+        if model is not None:
+            kwargs["model"] = model
+        if tenant is not None:
+            kwargs["tenant"] = tenant
+        return self._call("infer", **kwargs)
 
     def health(self):
         return self._call("health")

@@ -451,6 +451,19 @@ class InferenceEngine:
         registry counter (the dict shape callers read is unchanged)."""
         return int(self._m_hot.value)
 
+    def release(self):
+        """Drop this engine's device-memory footprint: the warm
+        executables and the private scope's parameter arrays. The
+        multi-model ModelServer's LRU evictor calls this when a cold
+        model leaves the host so its arena goes back to the device pool
+        with the last reference. The engine is DONE serving afterwards —
+        call only after its final in-flight dispatch finished."""
+        with self._lock:
+            self._warm_execs.clear()
+            self._warm_loaded.clear()
+            self._scope = Scope()
+            self._warmed = False
+
     def _memory_section(self):
         """Accounting reconciliation: bytes this engine can explain
         (its scope's parameter arrays) next to the device's live total,

@@ -350,6 +350,34 @@ class FleetSupervisor(ChildSupervisor):
         # the canary failure detail either way
         self._reload_replica(0, ppath, pv, timeout=wait_timeout)
 
+    def spawn_replica(self, wait_timeout=None):
+        """Scale OUT by one replica: a fresh supervised child on a new
+        fixed address, serving the registry's CURRENT version (its
+        model_dir is the version dir, so published ``warm/`` artifacts
+        make the spawn a warm start). ``wait_timeout`` health-gates the
+        new replica (serving + warmed + current version) before
+        returning — the autoscaler's canary gate. Returns ``(index,
+        address)``."""
+        address = self.add_child()
+        i = len(self.addresses) - 1
+        _flight.record("replica_spawned", component=self._obs_name(),
+                       replica=i, address=address,
+                       version=self.version)
+        if wait_timeout is not None:
+            deadline = time.monotonic() + float(wait_timeout)
+            self._await_replica(i, deadline,
+                                target_version=self.version)
+        return i, address
+
+    def retire_replica(self, timeout=10.0):
+        """Scale IN by one replica (always the highest index — surviving
+        replicas keep their addresses). Returns the retired address."""
+        address = self.retire_child(timeout=timeout)
+        _flight.record("replica_retired", component=self._obs_name(),
+                       address=address,
+                       replicas=len(self.addresses))
+        return address
+
     def replica_stats(self, timeout=5.0):
         """stats() from every reachable replica (index -> stats|None) —
         what the bench lane aggregates hot_recompiles/version over."""
@@ -385,6 +413,23 @@ class FleetSupervisor(ChildSupervisor):
             snaps.append(_m.REGISTRY.snapshot())
         merged = _m.merge_snapshots(snaps)
         out = {"replicas": replicas, "merged": merged}
+        # per-replica serving queue depth, FIRST-CLASS: the batchers
+        # maintain the paddle_tpu_server_queue_depth gauge on every
+        # enqueue/dequeue, so this is an O(1) read off the snapshot just
+        # scraped — no stats() RPC, no re-derivation from batcher dicts.
+        # The autoscaler's second control signal next to SLO burn rate.
+        depths = {}
+        for i, snap in replicas.items():
+            if not snap:
+                depths[i] = None
+                continue
+            fam = snap.get("paddle_tpu_server_queue_depth") or {}
+            depths[i] = sum(v.get("value", 0)
+                            for v in fam.get("values", ()))
+        out["queue_depth"] = {
+            "replicas": depths,
+            "total": sum(d for d in depths.values() if d is not None),
+        }
         # SLO verdicts over the FLEET view: the process-installed
         # monitor's rules re-judged against the merged snapshot — via a
         # THROWAWAY monitor so the one-shot never pollutes the

@@ -31,15 +31,25 @@ class GenClient:
         self._rpc = RpcClient(address, timeout=timeout, retry=None,
                               wire=wire)
 
-    def generate(self, prompt, max_new_tokens, sampling=None):
+    def generate(self, prompt, max_new_tokens, sampling=None, model=None,
+                 tenant=None):
         """Yield generated token ids for ``prompt`` as the server decodes
         them. ``sampling`` is the ``normalize_sampling`` dict form
         ({"mode": "greedy"|"topk"|"beam", ...}); beam streams emit the
-        winning hypothesis once, at completion."""
+        winning hypothesis once, at completion. ``model=`` targets a
+        named hosted model on a multi-model server; ``tenant=`` tags the
+        request for quota accounting (:class:`~..batcher.QuotaExceeded`
+        re-raises typed). Both are omitted from the wire frame when None,
+        so single-model call shapes are unchanged."""
+        kwargs = {"prompt": [int(t) for t in prompt],
+                  "max_new_tokens": int(max_new_tokens),
+                  "sampling": sampling}
+        if model is not None:
+            kwargs["model"] = str(model)
+        if tenant is not None:
+            kwargs["tenant"] = str(tenant)
         try:
-            for frame in self._rpc.stream(
-                    "generate", prompt=[int(t) for t in prompt],
-                    max_new_tokens=int(max_new_tokens), sampling=sampling):
+            for frame in self._rpc.stream("generate", **kwargs):
                 for t in frame["tokens"]:
                     yield int(t)
         except RemoteError as e:

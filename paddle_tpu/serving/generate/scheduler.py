@@ -41,7 +41,7 @@ from collections import deque
 from ...core.flags import get_flag
 from ...obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
 from ...obs.recorder import record as _flight_record
-from ..batcher import ServerOverloaded
+from ..batcher import ServerOverloaded, _M_QUEUE_DEPTH
 from .decode_engine import CacheExhausted, NoFreeSlots, normalize_sampling
 
 _GEN_REQUESTS = _METRICS.counter(
@@ -171,6 +171,8 @@ class ContinuousBatcher:
         self.obs_instance = next_instance("genbatcher")
         self._m_requests = _GEN_REQUESTS.labels(instance=self.obs_instance)
         self._m_rejected = _GEN_REJECTED.labels(instance=self.obs_instance)
+        self._m_depth = _M_QUEUE_DEPTH.labels(instance=self.obs_instance)
+        self._m_depth.set(0)
         self._n_steps = 0
         self._n_tokens = 0
         self._n_ttft_discarded = 0
@@ -201,6 +203,7 @@ class ContinuousBatcher:
                     f"generation queue full ({self.capacity} requests "
                     "waiting); back off and retry")
             self._pending.append(req)
+            self._m_depth.set(len(self._pending))
             self._cv.notify_all()
         return stream
 
@@ -293,6 +296,7 @@ class ContinuousBatcher:
                 for req in list(self._pending):
                     if req.stream is stream:
                         self._pending.remove(req)
+                        self._m_depth.set(len(self._pending))
                         break
             stream._finish(_Cancelled("generation cancelled"))
 
@@ -311,9 +315,11 @@ class ContinuousBatcher:
                 break                  # head blocks until capacity frees
             except Exception as e:     # bad request (typed ValueError...)
                 self._pending.popleft()
+                self._m_depth.set(len(self._pending))
                 req.stream._finish(e)
                 continue
             self._pending.popleft()
+            self._m_depth.set(len(self._pending))
             req.stream._submit_s = req.submit_s
             # TTFT is stamped at the FIRST ACTUAL token: a beam or
             # chunked-prefill admission emits nothing yet — its first
@@ -347,6 +353,7 @@ class ContinuousBatcher:
                            "request was rejected without being served")
         while self._pending:
             self._pending.popleft().stream._finish(err)
+        self._m_depth.set(0)
 
     def transfer_queued(self, other):
         """Move every still-QUEUED (unadmitted) request to ``other``,
@@ -361,6 +368,7 @@ class ContinuousBatcher:
         with self._cv:
             moved = list(self._pending)
             self._pending.clear()
+            self._m_depth.set(0)
         n = 0
         for req in moved:
             with other._cv:
@@ -370,6 +378,7 @@ class ContinuousBatcher:
                     # batcher that actually holds the request
                     req.stream._batcher = other
                     other._pending.append(req)
+                    other._m_depth.set(len(other._pending))
                     other._cv.notify_all()
                     n += 1
                     continue

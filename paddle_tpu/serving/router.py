@@ -24,6 +24,13 @@ Error taxonomy (what moves where):
   retrying into a saturated fleet spreads collapse).
 * response timeout — ambiguous (the request may be executing), surfaced
   to the caller like every other client in this codebase.
+* :class:`~.batcher.QuotaExceeded` — the TENANT is over budget, not the
+  replica: deterministic everywhere, so surfaced typed with NO failover
+  and NO spillover (spilling an over-quota request to the next replica
+  would just burn a connection to be rejected identically). Enforced
+  router-side first (``quotas=``) — a locally rejected request never
+  even picks a replica — and re-raised typed when a server-side bucket
+  rejects over the wire.
 * remote errors (``rpc.RemoteError``) — deterministic (a bad feed fails
   identically on every replica): surfaced, no failover.
 """
@@ -39,7 +46,7 @@ from ..core.profiler import trace_context
 from ..distributed.rpc import RetryPolicy, RpcClient
 from ..obs import recorder as _flight
 from ..obs.metrics import REGISTRY as _METRICS, json_safe, next_instance
-from .batcher import ServerOverloaded
+from .batcher import QuotaExceeded, ServerOverloaded
 from .client import InferClient
 
 _CONN_ERRORS = (EOFError, ConnectionError, BrokenPipeError, OSError)
@@ -59,6 +66,12 @@ _M_SPILLOVERS = _METRICS.counter(
 _M_EJECTIONS = _METRICS.counter(
     "paddle_tpu_router_ejections",
     "replicas ejected from the routing set, per instance",
+    labels=("instance",))
+_M_QUOTA_REJECTS = _METRICS.counter(
+    "paddle_tpu_router_quota_rejects",
+    "requests rejected typed with QuotaExceeded at a FleetClient "
+    "(router-local bucket or a replica's over the wire) — never "
+    "failovers, never spillovers; per instance",
     labels=("instance",))
 _M_FLEET_SECONDS = _METRICS.histogram(
     "paddle_tpu_fleet_request_seconds",
@@ -115,13 +128,18 @@ class FleetClient:
 
     def __init__(self, addresses, timeout=None, retry=True,
                  probe_interval_ms=None, probation_probes=None,
-                 probe_timeout=2.0):
+                 probe_timeout=2.0, quotas=None):
         if not addresses:
             raise ValueError("FleetClient needs at least one replica "
                              "address")
         if retry is True:
             retry = RetryPolicy()
         self._retry = retry or None
+        # router-side tenant quotas (a batcher.TenantQuotas): enforced
+        # BEFORE a replica is picked, so an over-budget request costs
+        # zero fleet work and can never be mistaken for replica trouble
+        self._quotas = quotas
+        self._timeout = timeout
         self._replicas = [_Replica(a, timeout) for a in addresses]
         self._lock = threading.Lock()
         # router counters + latency window live in the obs.metrics
@@ -133,6 +151,8 @@ class FleetClient:
         self._m_spillovers = _M_SPILLOVERS.labels(
             instance=self.obs_instance)
         self._m_ejections = _M_EJECTIONS.labels(instance=self.obs_instance)
+        self._m_quota_rejects = _M_QUOTA_REJECTS.labels(
+            instance=self.obs_instance)
         if probe_interval_ms is None:
             probe_interval_ms = get_flag("serving_probe_interval_ms")
         self._probe_interval_s = float(probe_interval_ms) / 1e3
@@ -146,6 +166,37 @@ class FleetClient:
         self._prober.start()
 
     # ------------------------------------------------------------------
+    def add_replica(self, address):
+        """Join ``address`` to the routing set (the autoscaler's
+        scale-out hand-off: a spawned replica serves no traffic until
+        some router routes to it). Idempotent — re-adding a member is a
+        no-op. Returns True when the set grew."""
+        address = (str(address[0]), int(address[1]))
+        with self._lock:
+            if any(r.address == address for r in self._replicas):
+                return False
+            self._replicas.append(_Replica(address, self._timeout))
+        return True
+
+    def remove_replica(self, address):
+        """Drop ``address`` from the routing set (scale-in), closing its
+        pooled connections; in-flight requests on it finish normally.
+        Refuses to empty the set. Returns True when a member was
+        removed."""
+        address = (str(address[0]), int(address[1]))
+        with self._lock:
+            keep = [r for r in self._replicas if r.address != address]
+            if len(keep) == len(self._replicas):
+                return False
+            if not keep:
+                raise ValueError("cannot remove the last replica "
+                                 f"{address[0]}:{address[1]}")
+            for r in self._replicas:
+                if r.address == address:
+                    r.close_all_locked()
+            self._replicas = keep
+        return True
+
     def _pick(self, tried):
         """Power-of-two-choices over in-flight counts, healthy replicas
         first; falls back to ejected ones (a refused connect is cheap and
@@ -192,12 +243,21 @@ class FleetClient:
                        ejected=ejected)
 
     # ------------------------------------------------------------------
-    def infer(self, feed):
+    def infer(self, feed, model=None, tenant=None):
         """One request through the fleet. Raises ``ServerOverloaded``
         only when every available replica rejected it, connection errors
         only when the whole fleet stayed unreachable through the retry
-        budget."""
+        budget, and ``QuotaExceeded`` immediately when ``tenant`` is
+        over budget (no failover, no spillover — see module docstring).
+        ``model=`` routes to a named hosted model on multi-model
+        replicas."""
         self._m_requests.inc()
+        if self._quotas is not None and tenant is not None:
+            try:
+                self._quotas.check(tenant)
+            except QuotaExceeded:
+                self._m_quota_rejects.inc()
+                raise
         # ONE trace id for the whole fleet request: every failover /
         # spillover attempt below reuses it (the per-attempt InferClient
         # calls pick it up from the context), so the merged chrome trace
@@ -217,9 +277,21 @@ class FleetClient:
                         client = r.acquire_locked()
                     broken = True    # returned to the pool only on success
                     try:
-                        out = client.infer(feed)
+                        out = client.infer(feed, model=model,
+                                           tenant=tenant)
                         broken = False
                         return out
+                    except QuotaExceeded:
+                        # a replica-side bucket rejected: deterministic
+                        # for this tenant everywhere — surface typed,
+                        # conn back to the pool, NO failover/spillover
+                        broken = False
+                        self._m_quota_rejects.inc()
+                        _flight.record(
+                            "quota_reject", component=self.obs_instance,
+                            tenant=tenant,
+                            replica=f"{r.address[0]}:{r.address[1]}")
+                        raise
                     except ServerOverloaded as e:
                         self._m_spillovers.inc()
                         _flight.record(
@@ -294,7 +366,10 @@ class FleetClient:
         counters = {"requests": int(self._m_requests.value),
                     "failovers": int(self._m_failovers.value),
                     "spillovers": int(self._m_spillovers.value),
-                    "ejections": int(self._m_ejections.value)}
+                    "ejections": int(self._m_ejections.value),
+                    "quota_rejects": int(self._m_quota_rejects.value)}
+        if self._quotas is not None:
+            counters["quotas"] = self._quotas.stats()
         engine = {"compiles": 0, "hits": 0, "hot_recompiles": 0}
         versions = set()
         if include_server_stats:

@@ -298,3 +298,27 @@ def test_elastic_training_lane_is_lower_is_better():
     faster = {"elastic_training_smoke":
               dict(rec, metric="elastic_training_smoke", value=300.0)}
     assert bench_compare.compare_records(old, faster, 5.0)["ok"]
+
+
+def test_multi_tenant_serving_lane_is_lower_is_better():
+    """The multi_tenant_serving lane's quiet-tenant-p99 unit (the exact
+    string bench.py emits) pins lower-is-better — a LARGER p99 beside
+    the quota-throttled noisy neighbor is a regression — including for
+    the _smoke-suffixed variant."""
+    rec = {"metric": "multi_tenant_serving", "value": 6.1,
+           "unit": "ms quiet-tenant p99 beside a quota-throttled noisy "
+                   "neighbor (lower is better; gate <= 1.3x solo "
+                   "baseline asserted in-lane; quota rejects typed, "
+                   "zero failovers)"}
+    assert bench_compare.lower_is_better(rec)
+    assert bench_compare.lower_is_better(
+        dict(rec, metric="multi_tenant_serving_smoke"))
+    old = {"multi_tenant_serving_smoke":
+           dict(rec, metric="multi_tenant_serving_smoke")}
+    slower = {"multi_tenant_serving_smoke":
+              dict(rec, metric="multi_tenant_serving_smoke", value=9.0)}
+    res = bench_compare.compare_records(old, slower, 5.0)
+    assert res["regressions"] == ["multi_tenant_serving_smoke"]
+    faster = {"multi_tenant_serving_smoke":
+              dict(rec, metric="multi_tenant_serving_smoke", value=4.0)}
+    assert bench_compare.compare_records(old, faster, 5.0)["ok"]

@@ -36,6 +36,7 @@ def test_wheel_builds_with_all_subpackages(tmp_path):
                 "paddle_tpu/parallel/__init__.py",
                 "paddle_tpu/distributed/__init__.py",
                 "paddle_tpu/serving/__init__.py",
+                "paddle_tpu/serving/autoscale.py",
                 "paddle_tpu/serving/execcache.py",
                 "paddle_tpu/serving/generate/__init__.py",
                 "paddle_tpu/serving/generate/kvstore.py",

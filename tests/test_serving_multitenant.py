@@ -1,0 +1,565 @@
+"""Multi-tenant serving plane: N engines per replica behind one RPC
+endpoint (model= routing, bitwise parity with dedicated single-model
+servers, refcount-aware LRU eviction, per-model reload isolation),
+per-tenant token-bucket quotas with the typed QuotaExceeded wire
+contract (quota rejects never fail over), the first-class queue-depth
+gauge, ChildSupervisor dynamic membership (add/retire under the
+monitor), and the FleetAutoscaler control loop against a scripted fleet.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import RemoteError, RetryPolicy
+from paddle_tpu.distributed.launch import ChildSupervisor
+from paddle_tpu.obs.metrics import REGISTRY
+from paddle_tpu.serving import (FleetAutoscaler, FleetClient, GenClient,
+                                InferClient, InferenceEngine, ModelServer,
+                                QuotaExceeded, ServerOverloaded,
+                                TenantQuotas)
+from paddle_tpu.testing.models import export_tiny_lm
+
+VOCAB = 13
+GEN_OPTS = dict(max_seqs=4, block_size=4, num_blocks=64, max_len=32,
+                prefill_buckets=(8,))
+
+
+def _export_model(tmp_path, name="model", weight_shift=0.0, dim=6,
+                  hidden=8, classes=3, n=16):
+    """Export a tiny MLP; ``weight_shift`` perturbs the params so two
+    exports are DIFFERENT models. Returns (dir, inputs, reference)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[dim])
+        h = fluid.layers.fc(input=x, size=hidden, act="relu")
+        y = fluid.layers.fc(input=h, size=classes, act="softmax")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    if weight_shift:
+        for p in main.all_parameters():
+            v = np.asarray(scope.find_var(p.name))
+            scope.set(p.name, v + np.float32(weight_shift))
+    d = str(tmp_path / name)
+    fluid.io.save_inference_model(d, ["x"], [y], exe, main, scope=scope)
+    rng = np.random.RandomState(0)
+    xs = rng.normal(0, 1, (n, dim)).astype("float32")
+    want = exe.run(main, feed={"x": xs}, fetch_list=[y], scope=scope)[0]
+    return d, xs, want
+
+
+# ---------------------------------------------------------------------------
+# Multi-model hosting: routing, parity, eviction, reload isolation
+# ---------------------------------------------------------------------------
+
+def test_two_models_one_server_bitwise_matches_two_solo_servers(tmp_path):
+    """A hosts-B server answers BOTH models bitwise-identically to two
+    dedicated single-model servers — co-hosting shares the endpoint, not
+    the numerics."""
+    dA, xs, _ = _export_model(tmp_path, "a")
+    dB, _, _ = _export_model(tmp_path, "b", weight_shift=0.25)
+    soloA = ModelServer(dA, buckets="1,2,4,8", max_delay_ms=1.0)
+    soloA.start()
+    soloB = ModelServer(dB, buckets="1,2,4,8", max_delay_ms=1.0)
+    soloB.start()
+    multi = ModelServer(dA, buckets="1,2,4,8", max_delay_ms=1.0)
+    multi.start()
+    added = multi.add_model("bee", model_dir=dB, buckets="1,2,4,8")
+    assert added["model"] == "bee" and added["evicted"] == []
+    try:
+        with InferClient(soloA.address) as ca, \
+                InferClient(soloB.address) as cb, \
+                InferClient(multi.address) as cm:
+            for n in (1, 3, 8):
+                wantA = ca.infer({"x": xs[:n]})[0]
+                wantB = cb.infer({"x": xs[:n]})[0]
+                gotA = cm.infer({"x": xs[:n]})[0]          # default model
+                gotB = cm.infer({"x": xs[:n]}, model="bee")[0]
+                assert np.array_equal(gotA, wantA)
+                assert np.array_equal(gotB, wantB)
+            h = cm.health()
+            assert h["status"] == "serving"
+            assert h["models"]["bee"]["model_kind"] == "feedforward"
+            assert h["models"]["bee"]["inflight"] == 0
+            st = cm.stats()
+            assert st["models"]["bee"]["engine"]["hot_recompiles"] == 0
+            # solo clients never see a "models" section (bitwise-compat
+            # health/stats shapes for single-model servers)
+            assert "models" not in ca.health()
+            with pytest.raises(RemoteError, match="unknown model"):
+                cm.infer({"x": xs[:1]}, model="nope")
+    finally:
+        assert multi.shutdown()
+        soloA.shutdown()
+        soloB.shutdown()
+
+
+def test_generative_model_beside_feedforward_default(tmp_path):
+    """Feed-forward default + named generative LM on ONE server: greedy
+    generate via model= matches a dedicated generative server token for
+    token, and the wrong-surface calls stay typed."""
+    dF, xs, _ = _export_model(tmp_path, "ff")
+    dLM = str(tmp_path / "lm")
+    export_tiny_lm(dLM, vocab=VOCAB, emb=8, heads=2, n_layers=2,
+                   max_pos=64, seed=3)
+    solo = ModelServer(dLM, model_kind="generative", gen_opts=GEN_OPTS)
+    solo.start()
+    multi = ModelServer(dF, buckets="1,2,4", max_delay_ms=1.0)
+    multi.start()
+    multi.add_model("lm", model_dir=dLM, model_kind="generative",
+                    gen_opts=GEN_OPTS)
+    try:
+        with GenClient(solo.address) as cs:
+            want = list(cs.generate([1, 2, 3], 6))
+        with GenClient(multi.address) as cg:
+            got = list(cg.generate([1, 2, 3], 6, model="lm"))
+        assert got == want and len(got) == 6
+        with InferClient(multi.address) as ci:
+            out = ci.infer({"x": xs[:2]})            # default ff intact
+            assert out[0].shape == (2, 3)
+            with pytest.raises(RemoteError, match="GENERATIVE"):
+                ci.infer({"x": xs[:1]}, model="lm")
+        h = multi.health()
+        assert h["models"]["lm"]["model_kind"] == "generative"
+        assert h["models"]["lm"]["warmed"]
+    finally:
+        assert multi.shutdown()
+        solo.shutdown()
+
+
+def test_lru_evicts_idle_never_inflight(tmp_path):
+    """The model budget evicts the LEAST-RECENTLY-USED idle model; a
+    model with in-flight requests is never a candidate, and a budget
+    full of pinned models refuses the add instead of evicting one."""
+    dirs = {}
+    for name, shift in (("a", 0.0), ("b", 0.1), ("c", 0.2), ("d", 0.3)):
+        dirs[name], xs, _ = _export_model(tmp_path, name,
+                                          weight_shift=shift)
+    srv = ModelServer(dirs["a"], buckets="1,2", max_delay_ms=1.0,
+                      max_models=3)            # default + 2 named slots
+    srv.start()
+    try:
+        srv.add_model("b", model_dir=dirs["b"], buckets="1,2")
+        srv.add_model("c", model_dir=dirs["c"], buckets="1,2")
+        with InferClient(srv.address) as c:
+            c.infer({"x": xs[:1]}, model="b")    # b now fresher than c
+        out = srv.add_model("d", model_dir=dirs["d"], buckets="1,2")
+        assert out["evicted"] == ["c"]           # LRU, not insertion order
+        assert sorted(srv.health()["models"]) == ["b", "d"]
+        # pin BOTH hosted models in flight: the evictor must refuse
+        hb = srv._checkout("b")
+        hd = srv._checkout("d")
+        try:
+            with pytest.raises(RuntimeError, match="in-flight"):
+                srv.add_model("c", model_dir=dirs["c"], buckets="1,2")
+        finally:
+            srv._checkin(hb)
+            srv._checkin(hd)
+        # idle again: the same add now succeeds by evicting the LRU
+        out = srv.add_model("c", model_dir=dirs["c"], buckets="1,2")
+        assert len(out["evicted"]) == 1
+        # remove_model refuses while in flight, typed
+        hc = srv._checkout("c")
+        with pytest.raises(RuntimeError, match="in-flight"):
+            srv.remove_model("c")
+        srv._checkin(hc)
+        assert srv.remove_model("c")["removed"]
+    finally:
+        assert srv.shutdown()
+
+
+def test_reload_one_model_leaves_the_other_untouched(tmp_path):
+    """reload(model=...) swaps ONE hosted model's engine; the default
+    model keeps its engine OBJECT and its compile log stays flat."""
+    dA, xs, _ = _export_model(tmp_path, "a")
+    dB, _, _ = _export_model(tmp_path, "b", weight_shift=0.1)
+    dB2, _, wantB2 = _export_model(tmp_path, "b2", weight_shift=0.7)
+    srv = ModelServer(dA, buckets="1,2,4", max_delay_ms=1.0)
+    srv.start()
+    srv.add_model("bee", model_dir=dB, buckets="1,2,4")
+    try:
+        with InferClient(srv.address) as c:
+            before = c.infer({"x": xs[:2]})[0]
+            engineA = srv.engine
+            compilesA = srv.engine.stats()["compiles"]
+            out = srv.reload(dB2, model="bee", version=2)
+            assert out["model"] == "bee" and out["version"] == 2
+            got = c.infer({"x": xs[:4]}, model="bee")[0]
+            np.testing.assert_allclose(got, wantB2[:4], rtol=1e-5,
+                                       atol=1e-6)
+            # the DEFAULT model: same engine object, zero new compiles,
+            # identical answers
+            assert srv.engine is engineA
+            assert srv.engine.stats()["compiles"] == compilesA
+            assert srv.engine.stats()["hot_recompiles"] == 0
+            assert np.array_equal(c.infer({"x": xs[:2]})[0], before)
+            assert c.health()["models"]["bee"]["version"] == 2
+            assert srv.stats()["models"]["bee"]["reloads"] == 1
+    finally:
+        assert srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Tenant quotas: token bucket, wire contract, router non-failover
+# ---------------------------------------------------------------------------
+
+def test_tenant_quotas_token_bucket_and_label_funnel():
+    q = TenantQuotas(rate=0.01, burst=2, overrides={"gold": (0.01, 5)},
+                     label_cap=3)
+    for _ in range(2):
+        assert q.try_acquire("t0") == (True, 0.0)
+    admitted, retry = q.try_acquire("t0")
+    assert not admitted and retry > 0
+    with pytest.raises(QuotaExceeded) as ei:
+        q.check("t0")
+    assert ei.value.tenant == "t0" and ei.value.retry_after_s > 0
+    # per-tenant override: gold's burst of 5 admits where t0 rejected
+    for _ in range(5):
+        assert q.try_acquire("gold")[0]
+    assert not q.try_acquire("gold")[0]
+    st = q.stats()
+    assert st["tenants"]["t0"] == {"admitted": 2, "rejected": 2}
+    assert st["tenants"]["gold"]["admitted"] == 5
+    # metric-label funnel: enforcement stays EXACT per tenant, but past
+    # the label cap (and for non-identifier names) the metric children
+    # collapse into __other__ — bounded cardinality under tenant floods
+    for t in ("t1", "t2", "t3", "t4", "bad name!"):
+        q.try_acquire(t)
+    fam = REGISTRY.snapshot()["paddle_tpu_tenant_requests"]
+    mine = {v["labels"]["tenant"] for v in fam["values"]
+            if v["labels"]["instance"] == q.obs_instance}
+    assert "__other__" in mine
+    assert "t4" not in mine and "bad name!" not in mine
+    assert len(st["overrides"]) == 1
+
+
+def test_rate_zero_means_unlimited():
+    q = TenantQuotas(rate=0.0)
+    for _ in range(50):
+        assert q.try_acquire("anyone")[0]
+    q.check("anyone")                      # never raises
+
+
+def test_both_wire_codes_roundtrip_typed(tmp_path):
+    """ServerOverloaded and QuotaExceeded both cross the wire as
+    structured codes and re-raise as their OWN types client-side; other
+    remote failures stay RemoteError."""
+    d, xs, _ = _export_model(tmp_path)
+    eng = InferenceEngine(d, buckets="1,2")
+    release = threading.Event()
+    inner = eng.infer
+
+    def slow_infer(feed, fetch_list=None):
+        release.wait(5.0)
+        return inner(feed, fetch_list)
+
+    eng.infer = slow_infer
+    srv = ModelServer(engine=eng, batching=True, queue_capacity=1,
+                      max_delay_ms=1.0,
+                      tenant_quotas=TenantQuotas(rate=0.01, burst=1))
+    srv.start()
+    outcomes = []
+
+    def caller(i):
+        with InferClient(srv.address, retry=None) as c:
+            try:
+                c.infer({"x": xs[i:i + 1]})
+                outcomes.append("ok")
+            except ServerOverloaded:
+                outcomes.append("overloaded")
+
+    ts = [threading.Thread(target=caller, args=(i,)) for i in range(5)]
+    for t in ts:
+        t.start()
+    deadline = time.monotonic() + 3.0
+    while outcomes.count("overloaded") < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    release.set()
+    for t in ts:
+        t.join()
+    assert outcomes.count("overloaded") >= 1
+    try:
+        with InferClient(srv.address, retry=None) as c:
+            c.infer({"x": xs[:1]}, tenant="burst")       # spends the burst
+            with pytest.raises(QuotaExceeded, match="quota"):
+                c.infer({"x": xs[:1]}, tenant="burst")
+            with pytest.raises(RemoteError, match="unknown model"):
+                c.infer({"x": xs[:1]}, model="ghost")
+    finally:
+        srv.shutdown()
+
+
+def test_router_quota_rejects_do_not_fail_over(tmp_path):
+    """A noisy tenant's quota rejects surface typed at the router and
+    bump quota_rejects ONLY — zero failovers, zero spillovers, zero
+    requests reaching any replica for the rejected calls."""
+    d, xs, _ = _export_model(tmp_path)
+    s1 = ModelServer(d, buckets="1,2,4", max_delay_ms=1.0)
+    s1.start()
+    s2 = ModelServer(d, buckets="1,2,4", max_delay_ms=1.0)
+    s2.start()
+    fc = FleetClient([s1.address, s2.address],
+                     retry=RetryPolicy(max_retries=2, backoff_base_s=0.01),
+                     quotas=TenantQuotas(rate=0.01, burst=2))
+    try:
+        served = 0
+        rejected = 0
+        for _ in range(6):
+            try:
+                fc.infer({"x": xs[:1]}, tenant="noisy")
+                served += 1
+            except QuotaExceeded:
+                rejected += 1
+        assert served == 2 and rejected == 4
+        fc.infer({"x": xs[:1]})                # untenanted: unaffected
+        st = fc.fleet_stats(include_server_stats=True)
+        assert st["quota_rejects"] == 4
+        assert st["failovers"] == 0
+        assert st["spillovers"] == 0
+        assert st["quotas"]["tenants"]["noisy"]["rejected"] == 4
+        # the replicas saw only the ADMITTED requests
+        served_fleet = sum(r["server"]["batcher"]["requests"]
+                           for r in st["replicas"])
+        assert served_fleet == 3
+    finally:
+        fc.close()
+        s1.shutdown()
+        s2.shutdown()
+
+
+def test_router_dynamic_membership(tmp_path):
+    """add_replica joins a scaled-out replica to the routing set (and
+    really routes to it); remove_replica drops it and refuses to empty
+    the set."""
+    d, xs, _ = _export_model(tmp_path)
+    s1 = ModelServer(d, buckets="1,2", max_delay_ms=1.0)
+    s1.start()
+    s2 = ModelServer(d, buckets="1,2", max_delay_ms=1.0)
+    s2.start()
+    fc = FleetClient([s1.address], retry=RetryPolicy(max_retries=2))
+    try:
+        assert fc.add_replica(s2.address)
+        assert not fc.add_replica(s2.address)     # idempotent
+        for _ in range(24):
+            fc.infer({"x": xs[:1]})
+        st = fc.fleet_stats(include_server_stats=True)
+        served = [r["server"]["batcher"]["requests"]
+                  for r in st["replicas"]]
+        assert len(served) == 2 and all(s > 0 for s in served)
+        assert fc.remove_replica(s2.address)
+        assert not fc.remove_replica(s2.address)  # already gone
+        fc.infer({"x": xs[:1]})                   # survivor still serves
+        with pytest.raises(ValueError, match="last replica"):
+            fc.remove_replica(s1.address)
+    finally:
+        fc.close()
+        s1.shutdown()
+        s2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Queue-depth gauge: O(1) first-class read
+# ---------------------------------------------------------------------------
+
+def test_queue_depth_gauge_tracks_pending(tmp_path):
+    from paddle_tpu.serving.batcher import DynamicBatcher
+
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def run_batch(feed, fetch_list=None):
+        entered.set()
+        gate.wait(5.0)
+        return [np.asarray(feed["x"])]
+
+    b = DynamicBatcher(run_batch, max_batch=1, max_delay_ms=1.0,
+                       capacity=8)
+
+    def depth():
+        fam = REGISTRY.snapshot()["paddle_tpu_server_queue_depth"]
+        for v in fam["values"]:
+            if v["labels"]["instance"] == b.obs_instance:
+                return v["value"]
+        return None
+
+    assert depth() == 0
+    ts = [threading.Thread(target=lambda: b.submit({"x": np.zeros((1, 2))}))
+          for _ in range(4)]
+    for t in ts:
+        t.start()
+    assert entered.wait(5.0)
+    deadline = time.monotonic() + 3.0
+    while (depth() or 0) < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert depth() >= 1                     # queued behind the held batch
+    gate.set()
+    for t in ts:
+        t.join()
+    assert b.close(5.0)
+    assert depth() == 0
+
+
+# ---------------------------------------------------------------------------
+# ChildSupervisor dynamic membership
+# ---------------------------------------------------------------------------
+
+def _echo_child(address, token):
+    from paddle_tpu.distributed.rpc import RpcServer
+
+    class H:
+        def stats(self):
+            return {"token": token, "pid": os.getpid()}
+
+    RpcServer(H(), tuple(address)).serve_forever()
+
+
+class _EchoSupervisor(ChildSupervisor):
+    def _child_spec(self, i):
+        return _echo_child, (self.addresses[i], i)
+
+
+def test_child_supervisor_add_and_retire_members():
+    from paddle_tpu.distributed.rpc import RpcClient
+
+    retry = RetryPolicy(max_retries=25, backoff_base_s=0.05,
+                        backoff_max_s=0.25)
+    with _EchoSupervisor(1, heartbeat_interval_s=0.1) as sup:
+        assert sup.wait_ready(20.0)
+        assert sup.n_children == 1
+        addr1 = sup.add_child()
+        assert sup.n_children == 2 and sup.addresses[1] == addr1
+        c = RpcClient(addr1, timeout=5.0, retry=retry)
+        assert c.call("stats")["token"] == 1     # the NEW child answers
+        # the added child is a full member: the monitor restarts it
+        pid_before = c.call("stats")["pid"]
+        sup.kill(1)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            try:
+                if c.call("stats")["pid"] != pid_before:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.05)
+        assert c.call("stats")["pid"] != pid_before
+        c.close()
+        # retire the tail member: the survivor keeps serving on its
+        # address and the retired child is NOT respawned
+        gone = sup.retire_child()
+        assert gone == addr1 and sup.n_children == 1
+        c0 = RpcClient(sup.addresses[0], timeout=5.0, retry=retry)
+        assert c0.call("stats")["token"] == 0
+        c0.close()
+        time.sleep(0.4)                          # a few monitor beats
+        assert sup.n_children == 1
+
+
+# ---------------------------------------------------------------------------
+# FleetAutoscaler control loop (scripted fleet — no processes)
+# ---------------------------------------------------------------------------
+
+class _ScriptedFleet:
+    """Duck-typed FleetSupervisor: fleet_metrics() serves the scripted
+    queue depth; spawn/retire mutate the address list."""
+
+    def __init__(self, canary_ok=True):
+        self.addresses = [("127.0.0.1", 9001)]
+        self.depth = 0.0
+        self.canary_ok = canary_ok
+        self.version = 1
+        self.model = "m"
+        self.registry = self
+        self.warm_calls = 0
+        self.spawns = 0
+        self.retires = 0
+
+    def warm(self, model, version=None, **kw):
+        self.warm_calls += 1
+
+    def fleet_metrics(self, timeout=2.0, include_local=False):
+        fam = {"type": "gauge", "help": "", "labels": ["instance"],
+               "values": [{"labels": {"instance": "b0"},
+                           "value": self.depth}]}
+        return {"merged": {"paddle_tpu_server_queue_depth": fam},
+                "queue_depth": {"replicas": {0: self.depth},
+                                "total": self.depth}}
+
+    def spawn_replica(self, wait_timeout=None):
+        self.spawns += 1
+        self.addresses.append(("127.0.0.1", 9001 + len(self.addresses)))
+        return len(self.addresses) - 1, self.addresses[-1]
+
+    def _await_replica(self, i, deadline, target_version=None):
+        if not self.canary_ok:
+            raise TimeoutError("canary never went healthy")
+
+    def retire_replica(self, timeout=10.0):
+        self.retires += 1
+        return self.addresses.pop()
+
+
+def test_autoscaler_breach_scales_out_idle_scales_in():
+    sup = _ScriptedFleet()
+    breaches = []
+    asc = FleetAutoscaler(sup, min_replicas=1, max_replicas=2,
+                          poll_s=0.5, idle_polls=2,
+                          on_breach=breaches.append)
+    # queue depth over objective -> breach -> ONE warm scale-out
+    sup.depth = 100.0
+    status = asc.poll_once()
+    assert not status["serving_fleet_queue_depth"]["ok"]
+    assert sup.spawns == 1 and sup.warm_calls == 1
+    assert len(sup.addresses) == 2 and len(breaches) == 1
+    # still burning at max_replicas: no further spawns
+    asc.poll_once()
+    assert sup.spawns == 1
+    # recovery: wait out the burn window, then idle_polls empty polls
+    sup.depth = 0.0
+    time.sleep(1.1)
+    st1 = asc.poll_once()
+    assert st1["serving_fleet_queue_depth"]["ok"]
+    assert sup.retires == 0                  # idle streak not met yet
+    asc.poll_once()
+    assert sup.retires == 1                  # scaled back in...
+    assert len(sup.addresses) == 1
+    asc.poll_once()
+    asc.poll_once()
+    assert sup.retires == 1                  # ...but never below min
+    s = asc.stats()
+    assert s["scale_ups"] == 1 and s["scale_downs"] == 1
+    assert s["replicas"] == 1 and s["canary_failures"] == 0
+    assert not s["breach_active"]
+
+
+def test_autoscaler_failed_canary_is_retired_not_routed():
+    sup = _ScriptedFleet(canary_ok=False)
+    asc = FleetAutoscaler(sup, min_replicas=1, max_replicas=3,
+                          poll_s=0.5, idle_polls=2)
+    sup.depth = 100.0
+    asc.poll_once()
+    # the spawn happened but the canary gate failed: the replica was
+    # retired again, the fleet is back to its pre-spawn size
+    assert sup.spawns == 1 and sup.retires == 1
+    assert len(sup.addresses) == 1
+    assert asc.stats()["canary_failures"] == 1
+    assert asc.stats()["scale_ups"] == 0
+
+
+def test_autoscaler_background_loop_and_bounds():
+    with pytest.raises(ValueError, match="min_replicas"):
+        FleetAutoscaler(_ScriptedFleet(), min_replicas=3, max_replicas=2)
+    sup = _ScriptedFleet()
+    asc = FleetAutoscaler(sup, min_replicas=1, max_replicas=2,
+                          poll_s=0.05, idle_polls=2, registry_warm=False)
+    sup.depth = 50.0
+    with asc.start():
+        deadline = time.monotonic() + 5.0
+        while sup.spawns < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert sup.spawns == 1 and sup.warm_calls == 0
+    assert asc.stats()["last_error"] is None

@@ -72,6 +72,10 @@ BOUNDED_LABELS = {
                "pallas_db/pallas_bf16)",
     "device": "local jax devices (platform:id) — bounded by the "
               "attached hardware",
+    "tenant": "tenant ids — wire-origin, funneled past "
+              "serving_tenant_label_cap (or non-identifier shape) into "
+              "__other__ by serving.batcher.TenantQuotas (the funnel "
+              "check below asserts it)",
 }
 
 # families whose label VALUES can arrive off the RPC wire; each entry
@@ -80,6 +84,14 @@ BOUNDED_LABELS = {
 WIRE_FED = {
     "paddle_tpu_wire_calls": "method",
     "paddle_tpu_wire_call_seconds": "method",
+}
+
+# tenant-labeled families: wire-fed through TenantQuotas, which owns its
+# own funnel (exercised separately below — the producing path differs
+# from WireStats.note)
+TENANT_FED = {
+    "paddle_tpu_tenant_requests": "tenant",
+    "paddle_tpu_tenant_rejected": "tenant",
 }
 
 
@@ -98,6 +110,7 @@ def registered_families():
     import paddle_tpu.online.trainer        # noqa: F401
     import paddle_tpu.ops.autotune          # noqa: F401
     import paddle_tpu.ops.pallas            # noqa: F401
+    import paddle_tpu.serving.autoscale     # noqa: F401
     import paddle_tpu.serving.batcher       # noqa: F401
     import paddle_tpu.serving.engine        # noqa: F401
     import paddle_tpu.serving.generate.kvcache    # noqa: F401
@@ -165,6 +178,43 @@ def wire_funnel_violations(families):
         forged = [m for m in methods if "\n" in m or '"' in m]
         if forged:
             out.append(f"{fam_name}: non-identifier wire name reached "
+                       f"the label set verbatim: {forged!r}")
+    # the tenant funnel: flood a fresh TenantQuotas past its label cap
+    # with wire-shaped tenant ids plus one non-identifier name, assert
+    # the tenant-labeled series stayed capped with overflow in __other__
+    from paddle_tpu.serving.batcher import TenantQuotas
+    tq = TenantQuotas(rate=1000.0, burst=1000, label_cap=8)
+    tcap = tq._label_cap
+    for i in range(tcap + 16):
+        tq.try_acquire(f"tenantfuzz_{i}")
+    tq.try_acquire('bad"} 1\nforged 9')            # non-identifier shape
+    for fam_name, label in sorted(TENANT_FED.items()):
+        fam = families.get(fam_name)
+        if fam is None:
+            out.append(f"{fam_name}: tenant-fed family not registered "
+                       "(stale TENANT_FED entry or missing wiring "
+                       "import)")
+            continue
+        if label not in fam.label_names:
+            out.append(f"{fam_name}: tenant-fed label {label!r} not in "
+                       f"declared labels {fam.label_names}")
+            continue
+        tenants = {key[fam.label_names.index("tenant")]
+                   for key in fam.children()
+                   if key[fam.label_names.index("instance")]
+                   == tq.obs_instance}
+        if "__other__" not in tenants:
+            out.append(f"{fam_name}: flooding past the cap never "
+                       "funneled into __other__ — the tenant funnel is "
+                       "gone")
+        over = {t for t in tenants
+                if t != "__other__" and t.startswith("tenantfuzz_")}
+        if len(over) > tcap:
+            out.append(f"{fam_name}: {len(over)} distinct tenant labels "
+                       f"exceed the declared cap {tcap}")
+        forged = [t for t in tenants if "\n" in t or '"' in t]
+        if forged:
+            out.append(f"{fam_name}: non-identifier tenant id reached "
                        f"the label set verbatim: {forged!r}")
     return out
 
