@@ -136,6 +136,13 @@ def _rec(d):
     # CPU-smoke record must never be mistaken for a TPU measurement when
     # runs are compared (tools/bench_compare.py diffs by lane name only)
     out.setdefault("backend", jax.default_backend())
+    # accelerator-identity stamps, same fields fleet_metrics() carries:
+    # device count and kind make rows (and the placement-plan
+    # fingerprints they summarize) comparable across hosts
+    _dev = jax.devices()[0]
+    out.setdefault("n_devices", jax.device_count())
+    out.setdefault("device_kind",
+                   str(getattr(_dev, "device_kind", _dev.platform)))
     # obs.metrics stamp: the registry's compact per-family totals at the
     # instant the lane record is emitted, so every bench row carries the
     # counter state that produced it (full snapshots are too wide for
@@ -1816,6 +1823,111 @@ def run_kernel_autotune_lane(smoke):
         set_flags(saved)
 
 
+def run_placement_planner_lane(smoke):
+    """End-to-end sweep of the auto-parallelism placement planner
+    (parallel/planner.py) over two models — a wide MLP whose gradient
+    traffic dwarfs its activations (tensor parallelism should win) and
+    the convnet slice (data parallelism should hold) — planned against
+    this host's devices with the compute term MEASURED via
+    ``obs.perf.attribute``.
+
+    Gates, asserted in-lane on every backend:
+      * the planned mesh's modeled step cost <= the naive all-dp
+        candidate's on BOTH models (the planner never ranks a worse
+        mesh above the trivial one);
+      * the report renders (the operator-facing table is non-empty and
+        names a chosen candidate);
+      * a second plan() through the same ``plan_cache_dir`` is a cache
+        HIT: the cache-hits counter moves, the searches counter stays
+        flat, and the loaded report ranks identically.
+
+    The recorded value is the wide-MLP speedup of the planned mesh over
+    naive all-dp in modeled step seconds — a cost-model verdict, which
+    is the point: the ranking must be right even where wall-clock
+    can't be measured per-mesh (the TPU wall-clock gate lives in
+    tests/test_placement_planner.py).
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.flags import get_flag, set_flags
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.obs import REGISTRY
+    from paddle_tpu.parallel import planner as pl
+    from paddle_tpu.testing import models as tmodels
+
+    if smoke:
+        dim, classes, hidden = 128, 64, 512
+        conv_size, conv_nf = 8, 8
+    else:
+        dim, classes, hidden = 512, 256, 2048
+        conv_size, conv_nf = 16, 16
+
+    n = jax.device_count()
+    batch = max(n, 1)
+
+    def _totals(name):
+        return REGISTRY.totals().get(name, 0)
+
+    def plan_model(name, build, feed):
+        main, startup, loss = build()
+        scope = Scope()
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        rep = pl.plan(main, feed_example=feed, n_devices=n,
+                      fetch_list=[loss], executor=exe, scope=scope)
+        assert rep.chosen is not None, f"{name}: every candidate pruned"
+        alldp = rep.candidate(dp=n)
+        assert alldp is not None, f"{name}: no all-dp baseline candidate"
+        chosen_s = rep.chosen.cost.total_s()
+        alldp_s = alldp.cost.total_s()
+        # gate: the planner never ranks a worse mesh above trivial all-dp
+        assert chosen_s <= alldp_s, \
+            f"{name}: planned {chosen_s:.3e}s worse than all-dp {alldp_s:.3e}s"
+        rendered = rep.render()
+        assert rendered and "placement plan" in rendered and "->" in rendered
+        return main, rep, alldp_s / chosen_s
+
+    saved_dir = get_flag("plan_cache_dir")
+    cache_dir = tempfile.mkdtemp(prefix="pdtpu-plan-bench-")
+    try:
+        set_flags({"plan_cache_dir": cache_dir})
+        mlp_main, mlp_rep, mlp_speedup = plan_model(
+            "mlp", lambda: tmodels.build_mlp(dim=dim, classes=classes,
+                                             hidden=hidden),
+            tmodels.mlp_feed(batch, dim, classes))
+        _conv_main, conv_rep, conv_speedup = plan_model(
+            "convnet", lambda: tmodels.build_convnet_slice(size=conv_size,
+                                                           nf=conv_nf),
+            tmodels.convnet_feed(batch, conv_size))
+
+        # gate: the persisted artifacts round-trip as cache hits
+        hits0 = _totals("paddle_tpu_plan_cache_hits")
+        searches0 = _totals("paddle_tpu_plan_searches")
+        cached = pl.plan(mlp_main, n_devices=n, measure=False)
+        assert cached.from_cache, "second plan() was not a cache hit"
+        assert _totals("paddle_tpu_plan_cache_hits") == hits0 + 1
+        assert _totals("paddle_tpu_plan_searches") == searches0
+        assert [c.describe() for c in cached.ranked()] == \
+            [c.describe() for c in mlp_rep.ranked()]
+
+        return {
+            "speedup": round(mlp_speedup, 4),
+            "mlp_chosen": mlp_rep.chosen.describe(),
+            "mlp_candidates": len(mlp_rep.candidates),
+            "convnet_chosen": conv_rep.chosen.describe(),
+            "convnet_speedup": round(conv_speedup, 4),
+            "cache_round_trip": "hit",
+            "gate": 1.0,
+            "gate_ok": True,
+        }
+    finally:
+        set_flags({"plan_cache_dir": saved_dir})
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
 def run_generation_serving_lane(n_clients=8, max_seqs=8, vocab=64, emb=128,
                                 heads=4, n_layers=4, block_size=8,
                                 num_blocks=256, max_len=128,
@@ -3163,6 +3275,22 @@ def main():
         # tier — the lane's own baseline
         "vs_baseline": ka["speedup"],
         **ka,
+    })))
+
+    # ---- placement planner lane (searched meshes over a measured cost
+    # model, persistently cached plans) ----
+    pp = run_placement_planner_lane(args.smoke)
+    print(json.dumps(_rec({
+        "metric": "placement_planner" + ("_smoke" if args.smoke else ""),
+        "value": pp["speedup"],
+        "unit": "x planned mesh vs naive all-dp, modeled step seconds "
+                "on the wide-MLP sweep model (gate: planned <= all-dp "
+                "on every model; report rendered + plan-cache round "
+                "trip hit asserted in-lane)",
+        # higher-is-better speedup of the searched placement over the
+        # trivial one — the lane's own baseline is its all-dp candidate
+        "vs_baseline": pp["speedup"],
+        **pp,
     })))
 
     # ---- host input pipeline lane (reader pool milestone) ----

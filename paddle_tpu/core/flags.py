@@ -539,6 +539,32 @@ DEFINE_flag("kernel_autotune_bf16", False,
             "against static routing; a table entry naming a bf16 "
             "variant is ignored without this opt-in")
 
+DEFINE_flag("plan_memory_budget_bytes", 0,
+            "per-device memory budget the placement planner "
+            "(parallel.planner) prunes mesh candidates against — a "
+            "candidate whose modeled per-device bytes (params + grads + "
+            "optimizer state + activations) exceed the budget is marked "
+            "pruned with a why-note and never ranked; 0 (default) "
+            "disables the budget. Host-side: part of the plan "
+            "fingerprint, never in the jit key")
+
+DEFINE_flag("plan_max_candidates", 16,
+            "maximum ranked candidates a PlacementReport keeps; the "
+            "search still costs every legal mesh, then drops the tail "
+            "past this cap (the report records how many were dropped). "
+            "0 keeps everything. Host-side: part of the plan "
+            "fingerprint, never in the jit key")
+
+DEFINE_flag("plan_cache_dir", "",
+            "local directory of placement-plan artifacts (.jplan) "
+            "consulted read-write by parallel.planner.plan() when no "
+            "published bundle plan/ dir applies: a fingerprint-matching "
+            "artifact skips the search (paddle_tpu_plan_cache_hits), a "
+            "fresh search persists its report there; empty (default) "
+            "disables the local cache. Not in the jit key: the plan "
+            "only chooses mesh/ShardingPlan arguments, the compiled "
+            "step's identity is theirs")
+
 # PDTPU_FLAGS=check_nan_inf=1,benchmark=0 — unknown names warn and are
 # ignored (a typo'd env var must not make the package unimportable)
 _env = os.environ.get("PDTPU_FLAGS", "")

@@ -597,7 +597,7 @@ def cost_totals(compiled):
 
 
 def attribute(target, feed=None, fetch_list=None, batch=1, top=40,
-              executor=None, scope=None, dump_hlo=None):
+              executor=None, scope=None, dump_hlo=None, per_op=False):
     """Per-op cost attribution for one dispatch: AOT-lower ``target``,
     merge the backend's ``cost_analysis()`` totals with the optimized
     HLO's static per-instruction operand+result bytes, and return a
@@ -609,7 +609,15 @@ def attribute(target, feed=None, fetch_list=None, batch=1, top=40,
     ``batch`` rows), or an ``InferenceEngine`` (its program/scope).
     Returns ``{"cost": {flops, bytes_accessed, detail}, "kind_totals",
     "rows": [{bytes, result_bytes, kind, name, hlo}], "instructions",
-    "compile_seconds"}``; ``dump_hlo=`` writes the optimized HLO text."""
+    "compile_seconds"}``; ``dump_hlo=`` writes the optimized HLO text.
+
+    ``per_op=True`` adds a ``"per_op"`` key — EVERY entry instruction
+    (not just the rendered top-N) as structured ``{op, kind, flops,
+    bytes, shape}`` dicts, the measured total FLOPs apportioned over the
+    compute instructions (dot/convolution/fusion/custom-call) by their
+    static byte share, ``flops: None`` when the backend gave no cost
+    analysis — so consumers (the placement planner) never re-parse the
+    rendered table. The default return is bitwise unchanged."""
     from ..serving.engine import InferenceEngine
 
     if isinstance(target, str):
@@ -652,7 +660,7 @@ def attribute(target, feed=None, fetch_list=None, batch=1, top=40,
                                      for f in fetch_list][:4]},
                  flops=cost.get("flops"),
                  bytes_accessed=cost.get("bytes_accessed"))
-    return json_safe({
+    out = {
         "cost": cost,
         "kind_totals": dict(sorted(kind_totals.items(),
                                    key=lambda kv: -kv[1])),
@@ -661,7 +669,38 @@ def attribute(target, feed=None, fetch_list=None, batch=1, top=40,
                  for t, rb, k, n, snip in rows[:int(top)]],
         "instructions": len(rows),
         "compile_seconds": compile_seconds,
-    })
+    }
+    if per_op:
+        out["per_op"] = per_op_rows(rows, cost.get("flops"))
+    return json_safe(out)
+
+
+# HLO instruction kinds that carry the computation's FLOPs — the
+# apportioning targets for per_op_rows
+_COMPUTE_KINDS = ("dot", "convolution", "fusion", "custom-call")
+
+
+def per_op_rows(rows, total_flops=None):
+    """``hlo_entry_rows`` rows as structured per-op dicts
+    ``{op, kind, flops, bytes, shape}``: the result shape re-parsed from
+    each row's HLO snippet, ``total_flops`` (the backend cost_analysis
+    total) apportioned over the compute-kind instructions by their
+    static byte share — ``flops: None`` everywhere when no total is
+    available (a backend without cost analysis)."""
+    compute_bytes = sum(t for t, _rb, k, _n, _s in rows
+                        if k in _COMPUTE_KINDS)
+    out = []
+    for total, _result_b, kind, name, snip in rows:
+        m = re.search(r"=\s*\(?([a-z0-9]+)\[([\d,]*)\]", snip)
+        shape = None
+        if m:
+            shape = [int(d) for d in m.group(2).split(",") if d]
+        flops = None
+        if total_flops and compute_bytes and kind in _COMPUTE_KINDS:
+            flops = float(total_flops) * total / compute_bytes
+        out.append({"op": name, "kind": kind, "flops": flops,
+                    "bytes": total, "shape": shape})
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -778,5 +817,5 @@ __all__ = [
     "aggregate_device_trace", "attribute", "compile_site", "cost_totals",
     "current_site", "enabled", "harvest_cost", "hlo_entry_rows",
     "hlo_shape_bytes", "lower_program", "memory_section", "note_compile",
-    "profile", "sample_device_memory", "template_feed",
+    "per_op_rows", "profile", "sample_device_memory", "template_feed",
 ]

@@ -16,9 +16,17 @@ from .moe import moe_ffn, init_moe_params, shard_moe_params
 from .pipeline import (pipeline_apply, shard_pipeline_params,
                        pipeline_stack_reference)
 from .multihost import init_multihost, global_mesh
+from .planner import (Candidate, PlacementReport, PlanCost, PlanError,
+                      PlanStore, ProgramFeatures, apply_candidate,
+                      cost_candidate, enumerate_meshes, extract_features,
+                      plan)
 
 __all__ = ["ShardingPlan", "make_mesh", "shard_program_step", "place_feed",
            "ring_attention", "init_multihost", "global_mesh",
            "moe_ffn", "init_moe_params", "shard_moe_params",
            "pipeline_apply", "shard_pipeline_params",
-           "pipeline_stack_reference"]
+           "pipeline_stack_reference",
+           "Candidate", "PlacementReport", "PlanCost", "PlanError",
+           "PlanStore", "ProgramFeatures", "apply_candidate",
+           "cost_candidate", "enumerate_meshes", "extract_features",
+           "plan"]

@@ -149,6 +149,94 @@ class ShardingPlan:
     def named(self, spec):
         return NamedSharding(self.mesh, spec)
 
+    # -- serialization (plan persistence: parallel/planner.py artifacts) --
+
+    @staticmethod
+    def _spec_to_list(spec):
+        """PartitionSpec -> JSON-safe list: each entry None, an axis
+        name, or a list of axis names (a multi-axis entry)."""
+        return [list(e) if isinstance(e, (tuple, list)) else e
+                for e in spec]
+
+    @staticmethod
+    def _spec_from_list(entries):
+        if not isinstance(entries, (list, tuple)):
+            raise ValueError("malformed PartitionSpec entries: "
+                             f"{entries!r}")
+        out = []
+        for e in entries:
+            if e is None or isinstance(e, str):
+                out.append(e)
+            elif isinstance(e, (list, tuple)) \
+                    and all(isinstance(a, str) for a in e):
+                out.append(tuple(e))
+            else:
+                raise ValueError(f"malformed PartitionSpec entry: {e!r}")
+        return P(*out)
+
+    def to_dict(self):
+        """JSON-safe round-trippable description: the mesh as its
+        ``make_mesh`` arguments (axes + shape — the device list is a
+        property of the LOADING process, not the plan), the axis roles,
+        the per-name rules, and the policy switches."""
+        return {
+            "schema": "pdtpu-sharding-plan-v1",
+            "mesh": {"axes": list(self.mesh.axis_names),
+                     "shape": list(self.mesh.devices.shape)},
+            "data_axis": self.data_axis,
+            "model_axis": self.model_axis,
+            "rules": [[pat, self._spec_to_list(spec)]
+                      for pat, spec in self.rules],
+            "shard_params": bool(self.shard_params),
+            "shard_conv_filters": bool(self.shard_conv_filters),
+            "shard_opt_state": bool(self.shard_opt_state),
+        }
+
+    @classmethod
+    def from_dict(cls, doc, devices=None):
+        """Rebuild a plan from :meth:`to_dict` output over THIS
+        process's devices (or ``devices``). Typed errors: any schema or
+        shape violation raises ValueError — never a partial plan."""
+        if not isinstance(doc, dict) \
+                or doc.get("schema") != "pdtpu-sharding-plan-v1":
+            raise ValueError("not a pdtpu-sharding-plan-v1 document")
+        mesh_doc = doc.get("mesh")
+        if not isinstance(mesh_doc, dict) \
+                or not isinstance(mesh_doc.get("axes"), (list, tuple)) \
+                or not isinstance(mesh_doc.get("shape"), (list, tuple)) \
+                or len(mesh_doc["axes"]) != len(mesh_doc["shape"]):
+            raise ValueError("malformed sharding-plan mesh (need "
+                             "matching axes and shape lists)")
+        try:
+            shape = tuple(int(d) for d in mesh_doc["shape"])
+        except (TypeError, ValueError):
+            raise ValueError("malformed sharding-plan mesh shape") \
+                from None
+        n = 1
+        for d in shape:
+            n *= d
+        rules_doc = doc.get("rules", [])
+        if not isinstance(rules_doc, (list, tuple)):
+            raise ValueError("malformed sharding-plan rules")
+        rules = []
+        for entry in rules_doc:
+            if not isinstance(entry, (list, tuple)) or len(entry) != 2 \
+                    or not isinstance(entry[0], str):
+                raise ValueError(f"malformed sharding-plan rule: "
+                                 f"{entry!r}")
+            rules.append((entry[0], cls._spec_from_list(entry[1])))
+        mesh = make_mesh(n, axes=tuple(str(a) for a in mesh_doc["axes"]),
+                         shape=shape, devices=devices)
+        return cls(mesh,
+                   data_axis=doc.get("data_axis") or "dp",
+                   model_axis=doc.get("model_axis") or "tp",
+                   rules=rules,
+                   shard_params=bool(doc.get("shard_params", True)),
+                   shard_conv_filters=bool(
+                       doc.get("shard_conv_filters", False)),
+                   shard_opt_state=bool(doc.get("shard_opt_state",
+                                                False)))
+
 
 def _shape_of(v):
     return getattr(v, "shape", None)

@@ -453,6 +453,13 @@ class FleetSupervisor(ChildSupervisor):
                                         "see the background monitor"}
             out["slo"] = {"local": mon.health_section(),
                           "fleet": fleet_view}
+        # host-identity stamps, same fields bench._rec stamps: plan
+        # fingerprints and bench trajectories are only comparable across
+        # hosts when the accelerator identity rides every record
+        import jax
+        dev = jax.devices()[0]
+        out["n_devices"] = jax.device_count()
+        out["device_kind"] = str(getattr(dev, "device_kind", dev.platform))
         return _m.json_safe(out)
 
 

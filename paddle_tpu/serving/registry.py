@@ -105,7 +105,7 @@ class ModelRegistry:
 
     def publish(self, model, src_dir, version=None, kernel_tier=None,
                 model_kind="feedforward", lineage=None, warm_cache=False,
-                warm_kwargs=None, kv_prompts=None, tune=False):
+                warm_kwargs=None, kv_prompts=None, tune=False, plan=False):
         """Copy the bundle at ``src_dir`` in as ``version`` (next integer
         when None) and make it visible by writing the manifest LAST,
         atomically. Returns the published version number. Versions are
@@ -155,7 +155,15 @@ class ModelRegistry:
         ``<version>/tune/`` (ops/autotune.py), manifest-pinned like
         ``warm_files`` — replicas that serve this version route tunable
         kernels by measurement with zero in-band tuning work. Implies a
-        warm pass."""
+        warm pass.
+
+        ``plan=True`` additionally runs the auto-parallelism placement
+        planner (parallel/planner.py) at publish time and ships the
+        searched PlacementReport under ``<version>/plan/``,
+        manifest-pinned as ``plan_files`` — replicas that serve this
+        version resolve their mesh from the certified artifact
+        (``parallel.planner.resolve_store``) without re-searching.
+        Implies a warm pass."""
         if not os.path.exists(os.path.join(src_dir, MODEL_FILENAME)):
             raise ValueError(
                 f"publish: {src_dir!r} is not a save_inference_model "
@@ -232,18 +240,20 @@ class ModelRegistry:
         with open(tmp, "w") as f:
             json.dump(manifest, f, indent=1, sort_keys=True)
         os.replace(tmp, os.path.join(dst, VERSION_MANIFEST))
-        if warm_cache or kv_prompts or tune:
+        if warm_cache or kv_prompts or tune or plan:
             wk = dict(warm_kwargs or {})
             if kv_prompts is not None:
                 wk.setdefault("kv_prompts", kv_prompts)
             if tune:
                 wk.setdefault("tune", tune)
+            if plan:
+                wk.setdefault("plan", plan)
             self.warm(model, version, **wk)
         return version
 
     # ------------------------------------------------------------------
     def warm(self, model, version="latest", buckets=None, sample_feed=None,
-             gen_opts=None, kv_prompts=None, tune=False):
+             gen_opts=None, kv_prompts=None, tune=False, plan=False):
         """Build (or complete) the version's persistent compiled-
         executable artifacts under ``<version>/warm/`` so replicas LOAD
         instead of compile (serving/execcache.py): an engine of the
@@ -294,7 +304,21 @@ class ModelRegistry:
         carries the table digest (a replica loading warm/ under the
         same table hits; one without the table recompiles instead of
         loading mismatched routing). When ``tune`` is falsy an existing
-        ``tune/`` dir is left untouched, like ``kv/``."""
+        ``tune/`` dir is left untouched, like ``kv/``.
+
+        ``plan=True`` runs the publish-time placement search
+        (parallel/planner.py): the bundle is loaded into a throwaway
+        scope, the planner enumerates and cost-models the legal meshes
+        for THIS host's device count, and the ranked PlacementReport
+        lands under ``<version>/plan/`` with ``plan_files`` certified
+        into the manifest — replicas resolve the certified plan
+        (``parallel.planner.resolve_store``) and place without
+        re-searching. Re-warming is idempotent (the fingerprint-matching
+        artifact is a cache hit, nothing is rewritten); a plan pass that
+        fails (e.g. a bundle whose feeds the planner cannot synthesize)
+        records a flight event and certifies nothing — plans are an
+        additive sidecar, never a publish failure. When ``plan`` is
+        falsy an existing ``plan/`` dir is left untouched."""
         path, v = self.resolve(model, version)
         m = self.manifest(model, v)
         from .execcache import ARTIFACT_SUFFIX, ExecCache, WARM_DIRNAME
@@ -307,6 +331,14 @@ class ModelRegistry:
                                     else None)
             if m.get("tune_files") != tune_files:
                 m["tune_files"] = tune_files
+                tmp = os.path.join(path, VERSION_MANIFEST + ".tmp")
+                with open(tmp, "w") as f:
+                    json.dump(m, f, indent=1, sort_keys=True)
+                os.replace(tmp, os.path.join(path, VERSION_MANIFEST))
+        if plan:
+            plan_files = self._plan(path, m)
+            if m.get("plan_files") != plan_files:
+                m["plan_files"] = plan_files
                 tmp = os.path.join(path, VERSION_MANIFEST + ".tmp")
                 with open(tmp, "w") as f:
                     json.dump(m, f, indent=1, sort_keys=True)
@@ -368,7 +400,8 @@ class ModelRegistry:
                 json.dump(m, f, indent=1, sort_keys=True)
             os.replace(tmp, os.path.join(path, VERSION_MANIFEST))
         return sorted(warm_files) + sorted(kv_files or {}) \
-            + sorted(m.get("tune_files", {}) if tune else {})
+            + sorted(m.get("tune_files", {}) if tune else {}) \
+            + sorted(m.get("plan_files", {}) if plan else {})
 
     def _tune(self, path, m, buckets=None, sample_feed=None, gen_opts=None,
               tune_opts=None):
@@ -421,6 +454,57 @@ class ModelRegistry:
                 except OSError:
                     pass
         return tune_files
+
+    def _plan(self, path, m):
+        """Run the publish-time placement search: load the bundle into a
+        throwaway scope, synthesize a template feed at one row per local
+        device (so every data-parallel degree divides), and let
+        ``parallel.planner.plan`` search + persist into ``<version>/
+        plan/``. A fingerprint-matching existing artifact is a cache hit
+        (re-warming is idempotent: same bytes, same digest). The search
+        failing — a bundle whose free dims ``template_feed`` cannot
+        synthesize, a program the lowering rejects — records a flight
+        event and certifies nothing: plans are an additive sidecar.
+        Returns the ``plan_files`` digest map."""
+        import jax
+
+        import paddle_tpu.fluid as fluid
+        from ..core.scope import Scope
+        from ..obs import perf as _perf
+        from ..parallel import planner as _pl
+        plan_dir = os.path.join(path, _pl.PLAN_DIRNAME)
+        store = _pl.PlanStore(plan_dir)
+        try:
+            scope = Scope()
+            exe = fluid.Executor()
+            program, feed_names, fetch_vars = fluid.io.load_inference_model(
+                path, exe, scope=scope)
+            feed = _perf.template_feed(program, feed_names,
+                                       batch=max(jax.device_count(), 1))
+            _pl.plan(program, feed_example=feed, fetch_list=fetch_vars,
+                     executor=exe, scope=scope, store=store)
+        except Exception as e:
+            from ..obs.recorder import record
+            record("plan_publish_failed", component="serving.registry",
+                   model=m.get("model"), version=m.get("version"),
+                   error=f"{type(e).__name__}: {e}")
+        plan_files = {}
+        touched = set(store.touched())
+        for name in sorted(os.listdir(plan_dir)):
+            fpath = os.path.join(plan_dir, name)
+            if not os.path.isfile(fpath) or name.endswith(".tmp"):
+                continue
+            if name in touched:
+                plan_files[f"{_pl.PLAN_DIRNAME}/{name}"] = \
+                    _sha256_file(fpath)
+            elif name.endswith(_pl.ARTIFACT_SUFFIX):
+                # a plan another toolchain/device-count searched: its
+                # filename fingerprint can never match here — prune
+                try:
+                    os.unlink(fpath)
+                except OSError:
+                    pass
+        return plan_files
 
     def _precompute_kv(self, engine, path, kv_prompts):
         """Prefill each prompt on the warm engine (chains that already
@@ -621,6 +705,9 @@ class ModelRegistry:
         # tune_files (publish-time kernel-tuning tables, tune/) too:
         # ops.autotune.TuneStore pins loads to these digests at runtime
         listed.update(m.get("tune_files", {}))
+        # plan_files (publish-time placement plans, plan/) the same:
+        # parallel.planner.PlanStore pins loads to these digests
+        listed.update(m.get("plan_files", {}))
         for name, want in listed.items():
             fpath = os.path.join(path, name)
             if not os.path.exists(fpath):

@@ -231,6 +231,27 @@ def test_kernel_autotune_lane_is_higher_is_better():
     assert bench_compare.compare_records(old, better, 5.0)["ok"]
 
 
+def test_placement_planner_lane_is_higher_is_better():
+    """The placement_planner lane's planned-vs-all-dp speedup unit (the
+    exact string bench.py emits) keeps the higher-is-better default: a
+    SMALLER speedup means the searched placement lost modeled ground to
+    the trivial all-dp mesh."""
+    rec = {"metric": "placement_planner", "value": 1.8,
+           "unit": "x planned mesh vs naive all-dp, modeled step "
+                   "seconds on the wide-MLP sweep model (gate: planned "
+                   "<= all-dp on every model; report rendered + "
+                   "plan-cache round trip hit asserted in-lane)"}
+    assert not bench_compare.lower_is_better(rec)
+    assert not bench_compare.lower_is_better(
+        dict(rec, metric="placement_planner_smoke"))
+    old = {"placement_planner": rec}
+    worse = {"placement_planner": dict(rec, value=1.0)}
+    res = bench_compare.compare_records(old, worse, 5.0)
+    assert res["regressions"] == ["placement_planner"]
+    better = {"placement_planner": dict(rec, value=2.5)}
+    assert bench_compare.compare_records(old, better, 5.0)["ok"]
+
+
 def test_trajectory_backend_skip(tmp_path):
     """--dir trajectory mode skips lanes whose two records carry
     DIFFERENT backend stamps (a CPU smoke diffed against a TPU run is a

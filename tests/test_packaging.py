@@ -34,6 +34,7 @@ def test_wheel_builds_with_all_subpackages(tmp_path):
                 "paddle_tpu/ops/pallas/__init__.py",
                 "paddle_tpu/ops/autotune.py",
                 "paddle_tpu/parallel/__init__.py",
+                "paddle_tpu/parallel/planner.py",
                 "paddle_tpu/distributed/__init__.py",
                 "paddle_tpu/serving/__init__.py",
                 "paddle_tpu/serving/autoscale.py",
@@ -60,3 +61,15 @@ def test_wheel_builds_with_all_subpackages(tmp_path):
     assert meta, names
     entry = zipfile.ZipFile(tmp_path / wheels[0]).read(meta[0]).decode()
     assert "paddle_trainer" in entry
+
+
+def test_tools_scripts_compile():
+    """Operator tools (not shipped in the wheel) at least exist and
+    byte-compile — a syntax error here would only surface on an
+    operator's box otherwise."""
+    import py_compile
+
+    for name in ("autotune.py", "plan_parallel.py"):
+        path = os.path.join(REPO, "tools", name)
+        assert os.path.exists(path), path
+        py_compile.compile(path, doraise=True)

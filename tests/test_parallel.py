@@ -185,3 +185,35 @@ def test_zero1_shards_arbitrary_accumulator_names():
     # recognize the exotic name -> replicated (the old silent behavior, now
     # only a fallback)
     assert plan.spec_for_param(v.name, v.shape) == P()
+
+
+def test_sharding_plan_dict_round_trip():
+    """to_dict()/from_dict() pin: a plan — mesh, axis roles, custom
+    rules, policy switches — survives JSON round-trip and rebuilds over
+    this process's devices; schema violations are typed ValueErrors (the
+    placement planner persists plans through this surface)."""
+    import json
+
+    mesh = make_mesh(8, axes=("dp", "tp"))
+    plan = ShardingPlan(mesh, rules=[(r"^emb_", P(None, "tp")),
+                                     (r"_stat$", P(("dp", "tp")))],
+                        shard_conv_filters=True, shard_opt_state=True)
+    doc = json.loads(json.dumps(plan.to_dict()))
+    assert doc["schema"] == "pdtpu-sharding-plan-v1"
+    rt = ShardingPlan.from_dict(doc)
+    assert rt.to_dict() == plan.to_dict()
+    assert rt.mesh.axis_names == mesh.axis_names
+    assert rt.mesh.devices.shape == mesh.devices.shape
+    # the rebuilt plan assigns identical specs
+    for name, shape in (("fc_0.w_0", (16, 32)), ("emb_table", (12, 8)),
+                        ("x_stat", (4,)), ("fc_0.w_0_velocity", (16, 32))):
+        assert rt.spec_for_param(name, shape) == \
+            plan.spec_for_param(name, shape), name
+    for bad in ({}, {"schema": "pdtpu-sharding-plan-v1"},
+                {"schema": "pdtpu-sharding-plan-v1",
+                 "mesh": {"axes": ["dp"], "shape": [4, 2]}},
+                {"schema": "pdtpu-sharding-plan-v1",
+                 "mesh": {"axes": ["dp"], "shape": [8]},
+                 "rules": [["ok", [["dp"], 3]]]}):
+        with pytest.raises(ValueError):
+            ShardingPlan.from_dict(bad)

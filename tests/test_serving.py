@@ -285,9 +285,13 @@ def test_batcher_close_answers_queued_requests():
     ts = [threading.Thread(target=caller, args=(i,)) for i in range(4)]
     for t in ts:
         t.start()
-    deadline = time.monotonic() + 2.0
-    while not calls and time.monotonic() < deadline:
-        time.sleep(0.005)             # worker holds batch 0, rest queued
+    deadline = time.monotonic() + 5.0
+    # worker must hold batch 0 AND the other three callers must be
+    # QUEUED before close() lands — otherwise a slow-starting caller
+    # thread races close() and gets the typed reject instead of a flush
+    while (not calls or len(b._pending) < 3) \
+            and time.monotonic() < deadline:
+        time.sleep(0.005)
     closed = []
     ct = threading.Thread(target=lambda: closed.append(b.close(10.0)))
     ct.start()

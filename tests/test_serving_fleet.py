@@ -12,6 +12,7 @@ import os
 import threading
 import time
 
+import jax
 import numpy as np
 import pytest
 
@@ -474,6 +475,11 @@ def test_fleet_rolling_reload_keeps_n_minus_1_ready_and_rolls_back(
             assert merged_compiles >= sum(st["engine"]["compiles"]
                                           for st in stats.values())
             json.dumps(fm)     # the whole scrape is wire-safe
+            # accelerator-identity stamps: device count + kind ride the
+            # scrape so fleet views are comparable across hosts
+            assert fm["n_devices"] == jax.device_count()
+            assert fm["device_kind"] == str(getattr(
+                jax.devices()[0], "device_kind", jax.devices()[0].platform))
 
             # ---- failed canary: corrupt v3 rolls back, fleet untouched
             bad_src = tmp_path / "bad"

@@ -64,8 +64,10 @@ BOUNDED_LABELS = {
               "serving.execcache.REJECT_REASONS (format/manifest/"
               "fingerprint/deserialize/run_failed), "
               "serving.generate.kvstore.REJECT_REASONS (format/"
-              "manifest/fingerprint/deserialize) and "
+              "manifest/fingerprint/deserialize), "
               "ops.autotune.REJECT_REASONS (format/manifest/"
+              "fingerprint/deserialize) and "
+              "parallel.planner.REJECT_REASONS (format/manifest/"
               "fingerprint/deserialize)",
     "variant": "registered kernel variant names — the fixed code-site "
                "set ops.autotune.VARIANTS registers (jnp/pallas/"
@@ -110,6 +112,7 @@ def registered_families():
     import paddle_tpu.online.trainer        # noqa: F401
     import paddle_tpu.ops.autotune          # noqa: F401
     import paddle_tpu.ops.pallas            # noqa: F401
+    import paddle_tpu.parallel.planner      # noqa: F401
     import paddle_tpu.serving.autoscale     # noqa: F401
     import paddle_tpu.serving.batcher       # noqa: F401
     import paddle_tpu.serving.engine        # noqa: F401

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Standalone placement-planner driver: render the auto-parallelism
+PlacementReport for a saved bundle.
+
+Does what ``ModelRegistry.warm(plan=True)`` does at publish time, but
+for an arbitrary bundle dir (a registry version dir or a raw
+``save_inference_model`` export): load the bundle into a throwaway
+scope, enumerate the legal (dp, pp, tp, sp) meshes for this host's
+device count, cost each candidate (measured FLOPs/bytes via
+``obs.perf.attribute`` + the analytic collective model), and print the
+ranked report — chosen mesh first, pruned candidates with why-notes.
+
+With ``--out`` (or when the bundle carries a registry ``VERSION.json``,
+with ``--certify``) the searched report is persisted as a ``.jplan``
+artifact (parallel/planner.py's content-addressed envelope) so
+replicas — or the next invocation — load instead of searching. A
+fingerprint-matching existing artifact is a cache hit and re-renders
+without a search.
+
+Usage:
+  python tools/plan_parallel.py --bundle DIR [--devices N]
+         [--batch N] [--memory-budget BYTES] [--max-candidates N]
+         [--out DIR] [--certify] [--json]
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _sha256_file(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _certify_manifest(bundle, store):
+    """Update the bundle's VERSION.json ``plan_files`` to exactly the
+    artifacts this run touched, pruning stale plans — no-op when the
+    bundle has no manifest (a raw export: the artifact self-digest is
+    the integrity layer)."""
+    from paddle_tpu.parallel import planner as pl
+    mpath = os.path.join(bundle, "VERSION.json")
+    try:
+        with open(mpath) as f:
+            m = json.load(f)
+    except (OSError, ValueError):
+        return None
+    touched = set(store.touched())
+    plan_files = {}
+    for name in sorted(os.listdir(store.path)):
+        fpath = os.path.join(store.path, name)
+        if not os.path.isfile(fpath) or name.endswith(".tmp"):
+            continue
+        if name in touched:
+            plan_files[f"{pl.PLAN_DIRNAME}/{name}"] = _sha256_file(fpath)
+        elif name.endswith(pl.ARTIFACT_SUFFIX):
+            try:
+                os.unlink(fpath)
+            except OSError:
+                pass
+    if m.get("plan_files") != plan_files:
+        m["plan_files"] = plan_files
+        tmp = mpath + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(m, f, indent=1, sort_keys=True)
+        os.replace(tmp, mpath)
+    return plan_files
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="enumerate + cost-model parallel placements for a "
+                    "bundle and render the ranked report")
+    ap.add_argument("--bundle", required=True,
+                    help="registry version dir or raw "
+                         "save_inference_model export")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="device count to plan for (default: this "
+                         "host's jax.device_count())")
+    ap.add_argument("--batch", type=int, default=None,
+                    help="feed batch rows to synthesize (default: the "
+                         "device count, so every dp degree divides)")
+    ap.add_argument("--memory-budget", type=int, default=None,
+                    help="per-device memory budget in bytes (default: "
+                         "the plan_memory_budget_bytes flag; 0 = off)")
+    ap.add_argument("--max-candidates", type=int, default=None,
+                    help="ranked candidates to keep (default: the "
+                         "plan_max_candidates flag)")
+    ap.add_argument("--out", default=None,
+                    help="persist the report into this plan-artifact "
+                         "dir instead of <bundle>/plan/")
+    ap.add_argument("--certify", action="store_true",
+                    help="persist under <bundle>/plan/ and update the "
+                         "bundle's VERSION.json plan_files (the "
+                         "registry certify semantics)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full report document as JSON "
+                         "instead of the rendered table")
+    args = ap.parse_args(argv)
+
+    bundle = os.path.abspath(args.bundle)
+    if not os.path.isdir(bundle):
+        print(f"plan_parallel: {bundle!r} is not a directory",
+              file=sys.stderr)
+        return 2
+
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.obs import perf
+    from paddle_tpu.parallel import planner as pl
+
+    n = args.devices or jax.device_count()
+    scope = Scope()
+    exe = fluid.Executor()
+    try:
+        program, feed_names, fetch_vars = fluid.io.load_inference_model(
+            bundle, exe, scope=scope)
+    except (OSError, ValueError) as e:
+        print(f"plan_parallel: cannot load bundle {bundle!r}: {e}",
+              file=sys.stderr)
+        return 2
+    try:
+        feed = perf.template_feed(program, feed_names,
+                                  batch=args.batch or max(n, 1))
+    except ValueError as e:
+        print(f"plan_parallel: cannot synthesize feeds: {e}",
+              file=sys.stderr)
+        return 2
+
+    store = None
+    if args.out:
+        store = pl.PlanStore(args.out)
+    elif args.certify:
+        store = pl.PlanStore(os.path.join(bundle, pl.PLAN_DIRNAME))
+    else:
+        # read the bundle's published plan/ dir (manifest-pinned) when
+        # it exists — a matching artifact renders without a search
+        store = pl.resolve_store(bundle)
+
+    try:
+        report = pl.plan(program, feed_example=feed, n_devices=n,
+                         fetch_list=fetch_vars, executor=exe, scope=scope,
+                         memory_budget=args.memory_budget,
+                         max_candidates=args.max_candidates, store=store)
+    except pl.PlanError as e:
+        print(f"plan_parallel: {e}", file=sys.stderr)
+        return 1
+
+    if args.json:
+        print(json.dumps(report.to_doc(), indent=1, sort_keys=True))
+    else:
+        print(report.render())
+    if args.certify and store is not None:
+        _certify_manifest(bundle, store)
+    if report.chosen is None:
+        print("plan_parallel: every candidate was pruned — raise the "
+              "memory budget or shrink the model", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
